@@ -1,0 +1,43 @@
+"""paimon-tpu: a TPU-native LSM lake format.
+
+A from-scratch framework with the capabilities of Apache Paimon (the reference
+at /root/reference): snapshot-isolated tables on a lake filesystem with
+primary-key upserts, schema evolution, time travel, and streaming changelog —
+whose hot paths (k-way sorted merge-on-read, LSM compaction rewrites, predicate
+filtering) execute as vectorized JAX/XLA kernels on TPU instead of per-row JVM
+loops. See SURVEY.md at the repo root for the full structural map.
+
+Layering (mirrors the reference's L0..L7):
+  fs/       L0  filesystem abstraction + atomic-rename commit primitive
+  data/     L1  column batches, normalized uint32 key lanes, predicates
+  types.py  L1  SQL type system with field-id schema evolution
+  format/   L2  parquet/orc encode-decode, stats, bloom file index
+  ops/      --  the TPU kernels (sort-merge, segment-reduce merge engines)
+  core/     L3  LSM merge-tree, compaction, manifests, snapshots, commit
+  table/    L4  engine-neutral Table API (read/write builders, scans)
+  parallel/ --  bucket/key-range sharding over jax device meshes
+  catalog/  L4  catalog + warehouse layout
+"""
+
+from .types import (
+    BIGINT,
+    BOOLEAN,
+    BYTES,
+    DATE,
+    DECIMAL,
+    DOUBLE,
+    FLOAT,
+    INT,
+    SMALLINT,
+    STRING,
+    TIMESTAMP,
+    TINYINT,
+    DataField,
+    DataType,
+    RowKind,
+    RowType,
+)
+from .options import CoreOptions, MergeEngine, Options
+from .data import ColumnBatch, PredicateBuilder
+
+__version__ = "0.1.0"

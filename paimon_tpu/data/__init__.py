@@ -1,0 +1,48 @@
+"""L1: the data kernel — column batches, normalized keys, predicates, casts.
+
+Where the reference's currency is the row (BinaryRow over MemorySegments,
+/root/reference/paimon-common/.../data/BinaryRow.java:55), ours is the column
+batch: dense numpy vectors host-side that transfer to TPU HBM as jax arrays.
+Rows exist only at API edges (to_pylist / from_pylist).
+"""
+
+from .batch import Column, ColumnBatch, concat_batches
+from .keys import NormalizedKeys, encode_key_lanes
+from .predicate import (
+    Predicate,
+    PredicateBuilder,
+    and_,
+    equal,
+    greater_or_equal,
+    greater_than,
+    in_,
+    is_not_null,
+    is_null,
+    less_or_equal,
+    less_than,
+    not_equal,
+    or_,
+    starts_with,
+)
+
+__all__ = [
+    "Column",
+    "ColumnBatch",
+    "concat_batches",
+    "NormalizedKeys",
+    "encode_key_lanes",
+    "Predicate",
+    "PredicateBuilder",
+    "and_",
+    "or_",
+    "equal",
+    "not_equal",
+    "less_than",
+    "less_or_equal",
+    "greater_than",
+    "greater_or_equal",
+    "is_null",
+    "is_not_null",
+    "in_",
+    "starts_with",
+]

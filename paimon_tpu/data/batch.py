@@ -1,0 +1,254 @@
+"""Columnar batch model.
+
+Replaces the reference's row + columnar-batch duo (BinaryRow,
+VectorizedColumnBatch — /root/reference/paimon-common/.../data/columnar/
+VectorizedColumnBatch.java:37) with a single structure: a ColumnBatch is a
+RowType plus one dense numpy vector (and optional validity bitmap) per field.
+
+Design rules that keep this TPU-friendly:
+  * fixed-width columns are contiguous numpy arrays of the type's dtype —
+    they move to device memory with zero transformation;
+  * validity is a separate bool vector (never sentinel values), so device
+    kernels can consume it as a mask lane;
+  * variable-width (string/bytes) columns are object arrays host-side and are
+    never shipped to device — kernels see them only as dictionary ranks
+    (see paimon_tpu.data.keys) and rematerialize by gather on host;
+  * all structural ops (take/slice/concat) are O(columns) numpy calls, no
+    Python-per-row loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from ..types import DataField, DataType, RowType, TypeRoot
+
+__all__ = ["Column", "ColumnBatch", "concat_batches"]
+
+
+@dataclass
+class Column:
+    """values + optional validity (True = present). validity None = all valid."""
+
+    values: np.ndarray
+    validity: np.ndarray | None = None
+
+    def __post_init__(self):
+        if self.validity is not None:
+            assert self.validity.dtype == np.bool_
+            assert len(self.validity) == len(self.values)
+            if bool(self.validity.all()):
+                self.validity = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def null_count(self) -> int:
+        return 0 if self.validity is None else int((~self.validity).sum())
+
+    def is_null(self) -> np.ndarray:
+        if self.validity is None:
+            return np.zeros(len(self.values), dtype=np.bool_)
+        return ~self.validity
+
+    def valid_mask(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones(len(self.values), dtype=np.bool_)
+        return self.validity
+
+    def take(self, indices: np.ndarray) -> "Column":
+        v = self.values.take(indices)
+        m = None if self.validity is None else self.validity.take(indices)
+        return Column(v, m)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        m = None if self.validity is None else self.validity[start:stop]
+        return Column(self.values[start:stop], m)
+
+    def filter(self, mask: np.ndarray) -> "Column":
+        m = None if self.validity is None else self.validity[mask]
+        return Column(self.values[mask], m)
+
+    def to_pylist(self) -> list:
+        if self.validity is None:
+            return self.values.tolist()
+        return [v if ok else None for v, ok in zip(self.values.tolist(), self.validity.tolist())]
+
+    @staticmethod
+    def from_pylist(data: Sequence[Any], dtype: DataType) -> "Column":
+        np_dtype = dtype.numpy_dtype()
+        validity = np.array([x is not None for x in data], dtype=np.bool_)
+        if np_dtype == np.dtype(object):
+            values = np.empty(len(data), dtype=object)
+            for i, x in enumerate(data):
+                values[i] = x
+        else:
+            fill: Any = 0
+            values = np.array([fill if x is None else x for x in data], dtype=np_dtype)
+        return Column(values, None if validity.all() else validity)
+
+    @staticmethod
+    def concat(cols: Sequence["Column"]) -> "Column":
+        values = np.concatenate([c.values for c in cols])
+        if all(c.validity is None for c in cols):
+            return Column(values)
+        validity = np.concatenate([c.valid_mask() for c in cols])
+        return Column(values, validity)
+
+
+class ColumnBatch:
+    """A schema-carrying bundle of equal-length Columns."""
+
+    def __init__(self, schema: RowType, columns: Mapping[str, Column] | Sequence[Column]):
+        self.schema = schema
+        if isinstance(columns, Mapping):
+            cols = {name: columns[name] for name in schema.field_names}
+        else:
+            cols = {f.name: c for f, c in zip(schema.fields, columns)}
+        assert len(cols) == len(schema.fields), (list(cols), schema.field_names)
+        lengths = {len(c) for c in cols.values()}
+        assert len(lengths) <= 1, f"ragged columns: { {n: len(c) for n, c in cols.items()} }"
+        self.columns: dict[str, Column] = cols
+        self._num_rows = lengths.pop() if lengths else 0
+
+    # ---- construction --------------------------------------------------
+    @staticmethod
+    def from_pydict(schema: RowType, data: Mapping[str, Sequence[Any]]) -> "ColumnBatch":
+        cols = {f.name: Column.from_pylist(data[f.name], f.type) for f in schema.fields}
+        return ColumnBatch(schema, cols)
+
+    @staticmethod
+    def from_pylist(schema: RowType, rows: Sequence[Sequence[Any]]) -> "ColumnBatch":
+        data = {f.name: [r[i] for r in rows] for i, f in enumerate(schema.fields)}
+        return ColumnBatch.from_pydict(schema, data)
+
+    @staticmethod
+    def empty(schema: RowType) -> "ColumnBatch":
+        cols = {
+            f.name: Column(np.empty(0, dtype=f.type.numpy_dtype()))
+            for f in schema.fields
+        }
+        return ColumnBatch(schema, cols)
+
+    # ---- accessors -----------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    # ---- structural ops ------------------------------------------------
+    def take(self, indices: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch(self.schema, {n: c.take(indices) for n, c in self.columns.items()})
+
+    def slice(self, start: int, stop: int) -> "ColumnBatch":
+        return ColumnBatch(self.schema, {n: c.slice(start, stop) for n, c in self.columns.items()})
+
+    def filter(self, mask: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch(self.schema, {n: c.filter(mask) for n, c in self.columns.items()})
+
+    def select(self, names: Iterable[str]) -> "ColumnBatch":
+        names = list(names)
+        return ColumnBatch(self.schema.project(names), {n: self.columns[n] for n in names})
+
+    def with_column(self, field: DataField, col: Column) -> "ColumnBatch":
+        fields = list(self.schema.fields) + [field]
+        cols = dict(self.columns)
+        cols[field.name] = col
+        return ColumnBatch(RowType(fields), cols)
+
+    def rename(self, schema: RowType) -> "ColumnBatch":
+        """Reinterpret under a same-arity schema (positional)."""
+        assert len(schema) == len(self.schema)
+        cols = {
+            nf.name: self.columns[of.name]
+            for of, nf in zip(self.schema.fields, schema.fields)
+        }
+        return ColumnBatch(schema, cols)
+
+    # ---- conversion ----------------------------------------------------
+    def to_pydict(self) -> dict[str, list]:
+        return {n: c.to_pylist() for n, c in self.columns.items()}
+
+    def to_pylist(self) -> list[tuple]:
+        cols = [self.columns[f.name].to_pylist() for f in self.schema.fields]
+        return list(zip(*cols)) if cols else []
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        arrays = []
+        for f in self.schema.fields:
+            c = self.columns[f.name]
+            mask = None if c.validity is None else ~c.validity
+            arrays.append(pa.array(c.values, from_pandas=True, mask=mask))
+        return pa.table(dict(zip(self.schema.field_names, arrays)))
+
+    @staticmethod
+    def from_arrow(table, schema: RowType) -> "ColumnBatch":
+        cols: dict[str, Column] = {}
+        for f in schema.fields:
+            arr = table.column(f.name).combine_chunks()
+            cols[f.name] = _arrow_to_column(arr, f.type)
+        return ColumnBatch(schema, cols)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ColumnBatch(rows={self.num_rows}, fields={self.schema.field_names})"
+
+
+def _arrow_to_column(arr, dtype: DataType) -> Column:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(pc.is_valid(arr))
+    np_dtype = dtype.numpy_dtype()
+    if np_dtype == np.dtype(object):
+        values = np.empty(len(arr), dtype=object)
+        pylist = arr.to_pylist()
+        for i, x in enumerate(pylist):
+            values[i] = x
+    else:
+        if arr.null_count:
+            arr = arr.fill_null(_zero_value(dtype))
+        if pa.types.is_timestamp(arr.type):
+            arr = arr.cast(pa.int64())
+        elif pa.types.is_date32(arr.type):
+            arr = arr.cast(pa.int32())
+        elif pa.types.is_decimal(arr.type):
+            # exact unscaled int64: stay in decimal space (no float detour)
+            scale = arr.type.scale
+            widened = arr.cast(pa.decimal256(38, scale))
+            arr = pc.multiply(widened, pa.scalar(10**scale, pa.decimal256(20, 0))).cast(pa.int64())
+        values = arr.to_numpy(zero_copy_only=False).astype(np_dtype, copy=False)
+    return Column(values, validity)
+
+
+def _zero_value(dtype: DataType):
+    if dtype.root == TypeRoot.BOOLEAN:
+        return False
+    return 0
+
+
+def concat_batches(batches: Sequence[ColumnBatch]) -> ColumnBatch:
+    if not batches:
+        raise ValueError("no batches")
+    non_empty = [b for b in batches if b.num_rows]
+    batches = non_empty or [batches[0]]
+    schema = batches[0].schema
+    cols = {
+        n: Column.concat([b.columns[n] for b in batches]) for n in schema.field_names
+    }
+    return ColumnBatch(schema, cols)

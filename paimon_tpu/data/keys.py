@@ -1,0 +1,170 @@
+"""Normalized binary sort keys as uint32 lanes.
+
+The reference JIT-generates per-schema comparators over BinaryRow bytes
+(paimon-codegen SortCodeGenerator / NormalizedKeyComputer; loaded via
+/root/reference/paimon-common/.../codegen/CompileUtils.java). The TPU analog:
+encode each key column into one or two uint32 "lanes" such that unsigned
+lexicographic comparison of the lane tuple equals the typed comparison of the
+key tuple. Sorting N rows by a K-column key then becomes one
+`jax.lax.sort(lanes..., num_keys=L)` — no comparators, no codegen, and the
+same encoding serves the merge kernel, min/max stats, and range partitioning.
+
+uint32 (not uint64) because 32-bit is the TPU's native integer width.
+
+Encodings (all order-preserving into unsigned space):
+  * signed ints  : flip the sign bit (x ^ 0x80..0), widened to 32 bits
+  * floats       : IEEE total order — if sign bit set, flip all bits, else
+                   set the sign bit
+  * bool/date/time/timestamp/decimal(unscaled) : via the int paths
+  * string/bytes : dictionary rank against a sorted pool built over all
+                   inputs participating in one merge (exact, collision-free;
+                   see build_string_pool). Variable-length data itself never
+                   reaches the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ..types import RowType, TypeRoot
+from .batch import ColumnBatch
+
+__all__ = [
+    "NormalizedKeys",
+    "encode_key_lanes",
+    "lane_count",
+    "build_string_pool",
+    "split_int64_lanes",
+    "lexsort_rows",
+]
+
+
+def lane_count(row_type: RowType, key_names: Sequence[str]) -> int:
+    n = 0
+    for name in key_names:
+        n += _lanes_for(row_type.field(name).type.root)
+    return n
+
+
+def _lanes_for(root: TypeRoot) -> int:
+    if root in (
+        TypeRoot.BOOLEAN,
+        TypeRoot.TINYINT,
+        TypeRoot.SMALLINT,
+        TypeRoot.INT,
+        TypeRoot.DATE,
+        TypeRoot.TIME,
+        TypeRoot.FLOAT,
+        TypeRoot.CHAR,
+        TypeRoot.VARCHAR,
+        TypeRoot.BINARY,
+        TypeRoot.VARBINARY,
+    ):
+        return 1
+    if root in (
+        TypeRoot.BIGINT,
+        TypeRoot.TIMESTAMP,
+        TypeRoot.TIMESTAMP_LTZ,
+        TypeRoot.DOUBLE,
+        TypeRoot.DECIMAL,
+    ):
+        return 2
+    raise ValueError(f"type {root} not supported as a key column")
+
+
+def split_int64_lanes(v: np.ndarray, signed: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """int64 -> (hi, lo) uint32 lanes, order preserving."""
+    u = v.astype(np.int64).view(np.uint64)
+    if signed:
+        u = u ^ np.uint64(1 << 63)
+    hi = (u >> np.uint64(32)).astype(np.uint32)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def _encode_column(values: np.ndarray, root: TypeRoot, pool: np.ndarray | None) -> list[np.ndarray]:
+    if root == TypeRoot.BOOLEAN:
+        return [values.astype(np.uint32)]
+    if root in (TypeRoot.TINYINT, TypeRoot.SMALLINT, TypeRoot.INT, TypeRoot.DATE, TypeRoot.TIME):
+        v32 = values.astype(np.int32)
+        return [v32.view(np.uint32) ^ np.uint32(0x80000000)]
+    if root in (TypeRoot.BIGINT, TypeRoot.TIMESTAMP, TypeRoot.TIMESTAMP_LTZ, TypeRoot.DECIMAL):
+        hi, lo = split_int64_lanes(values)
+        return [hi, lo]
+    if root == TypeRoot.FLOAT:
+        b = values.astype(np.float32).view(np.uint32)
+        neg = (b & np.uint32(0x80000000)) != 0
+        return [np.where(neg, ~b, b | np.uint32(0x80000000))]
+    if root == TypeRoot.DOUBLE:
+        b = values.astype(np.float64).view(np.uint64)
+        neg = (b & np.uint64(1 << 63)) != 0
+        u = np.where(neg, ~b, b | np.uint64(1 << 63))
+        return [(u >> np.uint64(32)).astype(np.uint32), (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)]
+    if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
+        if pool is None:
+            raise ValueError("string key column requires a pool (build_string_pool)")
+        ranks = np.searchsorted(pool, values)
+        # a value missing from the pool would silently collide with its
+        # successor's rank — turn that data corruption into an error
+        clipped = np.minimum(ranks, len(pool) - 1) if len(pool) else ranks
+        if len(pool) == 0 or not bool(np.all(pool[clipped] == values)):
+            raise ValueError("string key value(s) missing from pool; pool must cover all merge inputs")
+        return [ranks.astype(np.uint32)]
+    raise ValueError(f"type {root} not supported as key column")
+
+
+def build_string_pool(column_values: Sequence[np.ndarray]) -> np.ndarray:
+    """Sorted unique values across every input of one merge. Ranks against this
+    pool are exact order-preserving surrogates for the strings themselves."""
+    allv = np.concatenate([v for v in column_values if len(v)]) if column_values else np.empty(0, object)
+    if len(allv) == 0:
+        return allv
+    return np.unique(allv)
+
+
+def encode_key_lanes(
+    batch: ColumnBatch,
+    key_names: Sequence[str],
+    string_pools: Mapping[str, np.ndarray] | None = None,
+) -> np.ndarray:
+    """(N, L) uint32 lanes for the given key columns. Key columns must be
+    non-null (primary keys are NOT NULL by schema validation)."""
+    lanes: list[np.ndarray] = []
+    for name in key_names:
+        col = batch.column(name)
+        if col.null_count:
+            raise ValueError(f"key column {name!r} contains nulls")
+        root = batch.schema.field(name).type.root
+        pool = None if string_pools is None else string_pools.get(name)
+        lanes.extend(_encode_column(col.values, root, pool))
+    if not lanes:
+        return np.zeros((batch.num_rows, 0), dtype=np.uint32)
+    return np.stack(lanes, axis=1)
+
+
+@dataclass
+class NormalizedKeys:
+    """Lanes plus the metadata needed to interpret them."""
+
+    lanes: np.ndarray  # (N, L) uint32
+    key_names: tuple[str, ...]
+
+    def __len__(self) -> int:
+        return self.lanes.shape[0]
+
+    @property
+    def num_lanes(self) -> int:
+        return self.lanes.shape[1]
+
+
+def lexsort_rows(lanes: np.ndarray, *tiebreakers: np.ndarray) -> np.ndarray:
+    """Host-side (numpy) stable lexicographic argsort: lanes left-to-right are
+    most-to-least significant, then tiebreaker arrays. Reference oracle for the
+    device kernel in paimon_tpu.ops.merge."""
+    keys = list(tiebreakers)[::-1] + [lanes[:, i] for i in range(lanes.shape[1] - 1, -1, -1)]
+    if not keys:
+        return np.arange(lanes.shape[0])
+    return np.lexsort(keys)

@@ -1,0 +1,389 @@
+"""Predicate AST with two vectorized evaluators.
+
+Capability parity with the reference predicate kernel
+(/root/reference/paimon-common/.../predicate/Predicate.java, LeafPredicate /
+CompoundPredicate / PredicateBuilder, ~30 leaf functions): the same AST is
+evaluated (a) against data as a dense boolean mask over a ColumnBatch — one
+numpy/XLA expression per leaf, no per-row interpretation — and (b) against
+per-file / per-field min/max/null-count stats to decide whether a file can be
+skipped entirely (file pruning in the scan planner).
+
+Leaves are serializable (to_dict/from_dict) so splits can carry them across
+process boundaries, mirroring Paimon's serializable predicates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .batch import ColumnBatch
+
+__all__ = [
+    "Predicate",
+    "LeafPredicate",
+    "CompoundPredicate",
+    "PredicateBuilder",
+    "FieldStats",
+    "and_",
+    "or_",
+    "equal",
+    "not_equal",
+    "less_than",
+    "less_or_equal",
+    "greater_than",
+    "greater_or_equal",
+    "is_null",
+    "is_not_null",
+    "in_",
+    "not_in",
+    "starts_with",
+    "ends_with",
+    "contains",
+    "between",
+]
+
+
+@dataclass(frozen=True)
+class FieldStats:
+    """Per-file, per-field statistics used for pruning (reference:
+    stats/SimpleStats + predicate evaluation on stats)."""
+
+    min: Any
+    max: Any
+    null_count: int
+    row_count: int
+
+    @property
+    def all_null(self) -> bool:
+        return self.null_count >= self.row_count
+
+
+class Predicate:
+    def eval(self, batch: ColumnBatch) -> np.ndarray:
+        """Dense bool mask, SQL three-valued logic collapsed to False for NULL."""
+        raise NotImplementedError
+
+    def test_stats(self, stats: dict[str, FieldStats]) -> bool:
+        """True if a file with these stats *might* contain a matching row.
+        Missing stats for a referenced field => conservatively True."""
+        raise NotImplementedError
+
+    def referenced_fields(self) -> set[str]:
+        raise NotImplementedError
+
+    def negate(self) -> Optional["Predicate"]:
+        return None
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "Predicate":
+        if d["kind"] == "leaf":
+            return LeafPredicate(d["function"], d["field"], d.get("literals"))
+        return CompoundPredicate(d["function"], [Predicate.from_dict(c) for c in d["children"]])
+
+    def __and__(self, other: "Predicate") -> "Predicate":
+        return and_(self, other)
+
+    def __or__(self, other: "Predicate") -> "Predicate":
+        return or_(self, other)
+
+
+_NEGATIONS = {
+    "equal": "notEqual",
+    "notEqual": "equal",
+    "lessThan": "greaterOrEqual",
+    "greaterOrEqual": "lessThan",
+    "greaterThan": "lessOrEqual",
+    "lessOrEqual": "greaterThan",
+    "isNull": "isNotNull",
+    "isNotNull": "isNull",
+    "in": "notIn",
+    "notIn": "in",
+}
+
+
+@dataclass(frozen=True)
+class LeafPredicate(Predicate):
+    function: str
+    field: str
+    literals: Any = None  # scalar, or list for in/notIn/between
+
+    def referenced_fields(self) -> set[str]:
+        return {self.field}
+
+    def negate(self) -> Optional[Predicate]:
+        neg = _NEGATIONS.get(self.function)
+        return LeafPredicate(neg, self.field, self.literals) if neg else None
+
+    def to_dict(self) -> dict:
+        return {"kind": "leaf", "function": self.function, "field": self.field, "literals": self.literals}
+
+    # ---- data evaluation ----------------------------------------------
+    def eval(self, batch: ColumnBatch) -> np.ndarray:
+        col = batch.column(self.field)
+        v, valid = col.values, col.valid_mask()
+        f, lit = self.function, self.literals
+        if f == "isNull":
+            return ~valid
+        if f == "isNotNull":
+            return valid.copy()
+        if f == "equal":
+            m = v == lit
+        elif f == "notEqual":
+            m = v != lit
+        elif f == "lessThan":
+            m = v < lit
+        elif f == "lessOrEqual":
+            m = v <= lit
+        elif f == "greaterThan":
+            m = v > lit
+        elif f == "greaterOrEqual":
+            m = v >= lit
+        elif f == "in":
+            m = np.isin(v, np.asarray(list(lit), dtype=v.dtype)) if v.dtype != object else np.isin(v, list(lit))
+        elif f == "notIn":
+            m = (
+                ~np.isin(v, np.asarray(list(lit), dtype=v.dtype))
+                if v.dtype != object
+                else ~np.isin(v, list(lit))
+            )
+        elif f == "between":
+            lo, hi = lit
+            m = (v >= lo) & (v <= hi)
+        elif f in ("startsWith", "endsWith", "contains"):
+            m = _string_match(v, f, lit)
+        else:
+            raise ValueError(f"unknown predicate function {f}")
+        return np.asarray(m, dtype=np.bool_) & valid
+
+    # ---- stats evaluation (file skipping) ------------------------------
+    def test_stats(self, stats: dict[str, FieldStats]) -> bool:
+        st = stats.get(self.field)
+        if st is None:
+            return True
+        f, lit = self.function, self.literals
+        if f == "isNull":
+            return st.null_count > 0
+        if f == "isNotNull":
+            return not st.all_null
+        if st.all_null:
+            return False
+        if st.min is None or st.max is None:
+            return True  # stats not collected: cannot prune
+        if f == "equal":
+            return st.min <= lit <= st.max
+        if f == "notEqual":
+            return not (st.min == lit == st.max)
+        if f == "lessThan":
+            return st.min < lit
+        if f == "lessOrEqual":
+            return st.min <= lit
+        if f == "greaterThan":
+            return st.max > lit
+        if f == "greaterOrEqual":
+            return st.max >= lit
+        if f == "in":
+            return any(st.min <= x <= st.max for x in lit)
+        if f == "notIn":
+            return not all(st.min == x == st.max for x in lit)
+        if f == "between":
+            lo, hi = lit
+            return st.max >= lo and st.min <= hi
+        if f == "startsWith":
+            p = lit
+            lo = str(st.min)[: len(p)] if st.min is not None else ""
+            hi = str(st.max)[: len(p)] if st.max is not None else ""
+            return lo <= p <= hi
+        return True  # endsWith/contains can't prune
+
+
+def _string_match(v: np.ndarray, f: str, lit: Any) -> np.ndarray:
+    out = np.zeros(len(v), dtype=np.bool_)
+    if f == "startsWith":
+        for i, x in enumerate(v):
+            out[i] = x is not None and str(x).startswith(lit)
+    elif f == "endsWith":
+        for i, x in enumerate(v):
+            out[i] = x is not None and str(x).endswith(lit)
+    else:
+        for i, x in enumerate(v):
+            out[i] = x is not None and lit in str(x)
+    return out
+
+
+@dataclass(frozen=True)
+class CompoundPredicate(Predicate):
+    function: str  # "and" | "or"
+    children: tuple[Predicate, ...]
+
+    def __init__(self, function: str, children: Sequence[Predicate]):
+        object.__setattr__(self, "function", function)
+        object.__setattr__(self, "children", tuple(children))
+
+    def referenced_fields(self) -> set[str]:
+        out: set[str] = set()
+        for c in self.children:
+            out |= c.referenced_fields()
+        return out
+
+    def negate(self) -> Optional[Predicate]:
+        negs = [c.negate() for c in self.children]
+        if any(n is None for n in negs):
+            return None
+        return CompoundPredicate("or" if self.function == "and" else "and", negs)  # type: ignore[arg-type]
+
+    def to_dict(self) -> dict:
+        return {"kind": "compound", "function": self.function, "children": [c.to_dict() for c in self.children]}
+
+    def eval(self, batch: ColumnBatch) -> np.ndarray:
+        masks = [c.eval(batch) for c in self.children]
+        out = masks[0]
+        for m in masks[1:]:
+            out = (out & m) if self.function == "and" else (out | m)
+        return out
+
+    def test_stats(self, stats: dict[str, FieldStats]) -> bool:
+        if self.function == "and":
+            return all(c.test_stats(stats) for c in self.children)
+        return any(c.test_stats(stats) for c in self.children)
+
+
+# ---- builder functions --------------------------------------------------
+
+def equal(field: str, value: Any) -> Predicate:
+    return LeafPredicate("equal", field, value)
+
+
+def not_equal(field: str, value: Any) -> Predicate:
+    return LeafPredicate("notEqual", field, value)
+
+
+def less_than(field: str, value: Any) -> Predicate:
+    return LeafPredicate("lessThan", field, value)
+
+
+def less_or_equal(field: str, value: Any) -> Predicate:
+    return LeafPredicate("lessOrEqual", field, value)
+
+
+def greater_than(field: str, value: Any) -> Predicate:
+    return LeafPredicate("greaterThan", field, value)
+
+
+def greater_or_equal(field: str, value: Any) -> Predicate:
+    return LeafPredicate("greaterOrEqual", field, value)
+
+
+def is_null(field: str) -> Predicate:
+    return LeafPredicate("isNull", field)
+
+
+def is_not_null(field: str) -> Predicate:
+    return LeafPredicate("isNotNull", field)
+
+
+def in_(field: str, values: Sequence[Any]) -> Predicate:
+    return LeafPredicate("in", field, list(values))
+
+
+def not_in(field: str, values: Sequence[Any]) -> Predicate:
+    return LeafPredicate("notIn", field, list(values))
+
+
+def starts_with(field: str, prefix: str) -> Predicate:
+    return LeafPredicate("startsWith", field, prefix)
+
+
+def ends_with(field: str, suffix: str) -> Predicate:
+    return LeafPredicate("endsWith", field, suffix)
+
+
+def contains(field: str, sub: str) -> Predicate:
+    return LeafPredicate("contains", field, sub)
+
+
+def between(field: str, lo: Any, hi: Any) -> Predicate:
+    return LeafPredicate("between", field, [lo, hi])
+
+
+def and_(*preds: Predicate) -> Predicate:
+    flat: list[Predicate] = []
+    for p in preds:
+        if isinstance(p, CompoundPredicate) and p.function == "and":
+            flat.extend(p.children)
+        else:
+            flat.append(p)
+    return flat[0] if len(flat) == 1 else CompoundPredicate("and", flat)
+
+
+def or_(*preds: Predicate) -> Predicate:
+    flat: list[Predicate] = []
+    for p in preds:
+        if isinstance(p, CompoundPredicate) and p.function == "or":
+            flat.extend(p.children)
+        else:
+            flat.append(p)
+    return flat[0] if len(flat) == 1 else CompoundPredicate("or", flat)
+
+
+class PredicateBuilder:
+    """Schema-aware helper mirroring reference PredicateBuilder: validates the
+    field exists and splits conjunctions for pushdown."""
+
+    def __init__(self, row_type):
+        self.row_type = row_type
+
+    def _check(self, field: str) -> str:
+        if field not in self.row_type:
+            raise KeyError(f"no field {field!r} in {self.row_type.field_names}")
+        return field
+
+    def equal(self, field: str, value: Any) -> Predicate:
+        return equal(self._check(field), value)
+
+    def not_equal(self, field: str, value: Any) -> Predicate:
+        return not_equal(self._check(field), value)
+
+    def less_than(self, field: str, value: Any) -> Predicate:
+        return less_than(self._check(field), value)
+
+    def less_or_equal(self, field: str, value: Any) -> Predicate:
+        return less_or_equal(self._check(field), value)
+
+    def greater_than(self, field: str, value: Any) -> Predicate:
+        return greater_than(self._check(field), value)
+
+    def greater_or_equal(self, field: str, value: Any) -> Predicate:
+        return greater_or_equal(self._check(field), value)
+
+    def is_null(self, field: str) -> Predicate:
+        return is_null(self._check(field))
+
+    def is_not_null(self, field: str) -> Predicate:
+        return is_not_null(self._check(field))
+
+    def in_(self, field: str, values: Sequence[Any]) -> Predicate:
+        return in_(self._check(field), values)
+
+    def between(self, field: str, lo: Any, hi: Any) -> Predicate:
+        return between(self._check(field), lo, hi)
+
+    def starts_with(self, field: str, prefix: str) -> Predicate:
+        return starts_with(self._check(field), prefix)
+
+    @staticmethod
+    def split_and(p: Predicate | None) -> list[Predicate]:
+        if p is None:
+            return []
+        if isinstance(p, CompoundPredicate) and p.function == "and":
+            return list(p.children)
+        return [p]
+
+    @staticmethod
+    def pick_by_fields(preds: Sequence[Predicate], fields: set[str]) -> list[Predicate]:
+        return [p for p in preds if p.referenced_fields() <= fields]

@@ -1,0 +1,173 @@
+"""Fault-injecting and leak-tracking FileIO wrappers for tests.
+
+Capability parity with the reference test infrastructure:
+  /root/reference/paimon-core/src/test/java/org/apache/paimon/utils/FailingFileIO.java:44
+  (reset(name, maxFails, possibility) :57) and TraceableFileIO (open-stream
+  leak tracking). Registered under their own schemes so the whole store stack
+  runs against them unchanged — that is how commit crash-safety is proven.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+
+from . import FileIO, FileStatus, LocalFileIO, register_file_io, split_scheme
+
+
+class ArtificialException(IOError):
+    """Deliberately injected failure."""
+
+
+@dataclass
+class _FailState:
+    max_fails: int = 0
+    possibility: int = 0  # fail with probability 1/possibility
+    fails: int = 0
+    rng: random.Random = field(default_factory=lambda: random.Random(0))
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def maybe_fail(self) -> None:
+        with self.lock:
+            if self.possibility > 0 and self.fails < self.max_fails:
+                if self.rng.randrange(self.possibility) == 0:
+                    self.fails += 1
+                    raise ArtificialException("artificial failure")
+
+
+class FailingFileIO(FileIO):
+    """Randomly throws ArtificialException on read/write, per named domain.
+
+    Usage:
+        FailingFileIO.reset("mytest", max_fails=100, possibility=10)
+        path = f"fail://mytest{local_dir}"
+    """
+
+    _states: dict[str, _FailState] = {}
+
+    def __init__(self):
+        self._inner = LocalFileIO()
+
+    @classmethod
+    def reset(cls, name: str, max_fails: int, possibility: int, seed: int = 0) -> None:
+        st = _FailState(max_fails, possibility)
+        st.rng = random.Random(seed)
+        cls._states[name] = st
+
+    @classmethod
+    def retry_until_success(cls, name: str, fn):
+        """Disable injection for `name`, then run fn (for final verification)."""
+        cls._states.pop(name, None)
+        return fn()
+
+    def _strip(self, path: str) -> tuple[_FailState | None, str]:
+        scheme, rest = split_scheme(path)
+        # path layout: fail://<name><abs-path>
+        name, sep, tail = rest.lstrip("/").partition("/")
+        local = "/" + tail
+        return self._states.get(name), local
+
+    def _wrap(self, path: str) -> str:
+        st, local = self._strip(path)
+        if st is not None:
+            st.maybe_fail()
+        return local
+
+    def read_bytes(self, path: str) -> bytes:
+        return self._inner.read_bytes(self._wrap(path))
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self._inner.write_bytes(self._wrap(path), data, overwrite)
+
+    def exists(self, path: str) -> bool:
+        _, local = self._strip(path)
+        return self._inner.exists(local)
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        _, local = self._strip(path)
+        return self._inner.delete(local, recursive)
+
+    def mkdirs(self, path: str) -> None:
+        _, local = self._strip(path)
+        self._inner.mkdirs(local)
+
+    def rename(self, src: str, dst: str) -> bool:
+        st, s = self._strip(src)
+        _, d = self._strip(dst)
+        if st is not None:
+            st.maybe_fail()
+        return self._inner.rename(s, d)
+
+    def list_status(self, path: str) -> list[FileStatus]:
+        _, local = self._strip(path)
+        return self._inner.list_status(local)
+
+    def get_status(self, path: str) -> FileStatus:
+        _, local = self._strip(path)
+        return self._inner.get_status(local)
+
+    def open_input(self, path: str):
+        return self._inner.open_input(self._wrap(path))
+
+
+class TraceableFileIO(FileIO):
+    """Tracks open streams so tests can assert no reader/writer leaks."""
+
+    open_streams: list[str] = []
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._inner = LocalFileIO()
+
+    @classmethod
+    def assert_no_leaks(cls) -> None:
+        with cls._lock:
+            assert not cls.open_streams, f"leaked streams: {cls.open_streams}"
+
+    def _p(self, path: str) -> str:
+        return split_scheme(path)[1]
+
+    def open_input(self, path: str):
+        f = self._inner.open_input(self._p(path))
+        with TraceableFileIO._lock:
+            TraceableFileIO.open_streams.append(path)
+        orig_close = f.close
+
+        def close():
+            with TraceableFileIO._lock:
+                if path in TraceableFileIO.open_streams:
+                    TraceableFileIO.open_streams.remove(path)
+            orig_close()
+
+        f.close = close  # type: ignore[method-assign]
+        return f
+
+    # explicit delegation (base-class stubs would otherwise shadow __getattr__)
+    def read_bytes(self, path: str) -> bytes:
+        return self._inner.read_bytes(self._p(path))
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self._inner.write_bytes(self._p(path), data, overwrite)
+
+    def exists(self, path: str) -> bool:
+        return self._inner.exists(self._p(path))
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        return self._inner.delete(self._p(path), recursive)
+
+    def mkdirs(self, path: str) -> None:
+        self._inner.mkdirs(self._p(path))
+
+    def rename(self, src: str, dst: str) -> bool:
+        return self._inner.rename(self._p(src), self._p(dst))
+
+    def list_status(self, path: str) -> list[FileStatus]:
+        return self._inner.list_status(self._p(path))
+
+    def get_status(self, path: str) -> FileStatus:
+        return self._inner.get_status(self._p(path))
+
+
+register_file_io("fail", FailingFileIO)
+register_file_io("traceable", TraceableFileIO)

@@ -1,0 +1,343 @@
+"""Typed configuration system.
+
+Capability parity with the reference options kernel
+(/root/reference/paimon-common/.../options/Options.java, ConfigOption with
+typed defaults + fallback keys; CoreOptions.java — the table option surface
+with MergeEngine/StartupMode/ChangelogProducer/SortEngine enums). Options are
+plain string maps persisted inside the schema JSON; ConfigOption gives them
+types, defaults, and fallback keys.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Generic, Mapping, TypeVar
+
+T = TypeVar("T")
+
+__all__ = [
+    "ConfigOption",
+    "Options",
+    "MemorySize",
+    "CoreOptions",
+    "MergeEngine",
+    "StartupMode",
+    "ChangelogProducer",
+    "SortEngine",
+    "BucketMode",
+]
+
+
+class MemorySize(int):
+    """Bytes, parseable from '128 mb' style strings."""
+
+    _UNITS = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30, "tb": 1 << 40}
+
+    @staticmethod
+    def parse(s: "str | int | MemorySize") -> "MemorySize":
+        if isinstance(s, int):
+            return MemorySize(s)
+        t = s.strip().lower().replace(" ", "")
+        for u in ("tb", "gb", "mb", "kb", "b"):
+            if t.endswith(u):
+                return MemorySize(int(float(t[: -len(u)]) * MemorySize._UNITS[u]))
+        return MemorySize(int(t))
+
+    def __str__(self) -> str:
+        return f"{int(self)} b"
+
+
+@dataclass(frozen=True)
+class ConfigOption(Generic[T]):
+    key: str
+    default: T
+    parser: Callable[[Any], T]
+    description: str = ""
+    fallback_keys: tuple[str, ...] = ()
+
+    @staticmethod
+    def string(key: str, default: str | None = None, description: str = "", fallback: tuple[str, ...] = ()):
+        return ConfigOption(key, default, lambda v: None if v is None else str(v), description, fallback)
+
+    @staticmethod
+    def int_(key: str, default: int | None = None, description: str = "", fallback: tuple[str, ...] = ()):
+        return ConfigOption(key, default, lambda v: None if v is None else int(v), description, fallback)
+
+    @staticmethod
+    def float_(key: str, default: float | None = None, description: str = ""):
+        return ConfigOption(key, default, lambda v: None if v is None else float(v), description)
+
+    @staticmethod
+    def bool_(key: str, default: bool = False, description: str = ""):
+        return ConfigOption(key, default, lambda v: v if isinstance(v, bool) else str(v).lower() == "true", description)
+
+    @staticmethod
+    def memory(key: str, default: str, description: str = ""):
+        return ConfigOption(key, MemorySize.parse(default), MemorySize.parse, description)
+
+    @staticmethod
+    def enum(key: str, enum_cls, default, description: str = ""):
+        def parse(v):
+            if isinstance(v, enum_cls):
+                return v
+            return enum_cls(str(v).lower().replace("_", "-"))
+
+        return ConfigOption(key, default, parse, description)
+
+
+class Options:
+    """A string->value map with typed access via ConfigOption."""
+
+    def __init__(self, data: Mapping[str, Any] | None = None):
+        self._data: dict[str, Any] = dict(data or {})
+
+    def get(self, option: ConfigOption[T]) -> T:
+        for key in (option.key, *option.fallback_keys):
+            if key in self._data:
+                return option.parser(self._data[key])
+        return option.default
+
+    def set(self, option: "ConfigOption | str", value: Any) -> "Options":
+        key = option if isinstance(option, str) else option.key
+        self._data[key] = value
+        return self
+
+    def contains(self, option: "ConfigOption | str") -> bool:
+        key = option if isinstance(option, str) else option.key
+        return key in self._data
+
+    def remove(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def to_map(self) -> dict[str, str]:
+        return {k: (v if isinstance(v, str) else str(v)) for k, v in self._data.items()}
+
+    def copy(self) -> "Options":
+        return Options(self._data)
+
+    def update(self, other: "Options | Mapping[str, Any]") -> "Options":
+        self._data.update(other._data if isinstance(other, Options) else other)
+        return self
+
+    def __eq__(self, o):
+        return isinstance(o, Options) and self._data == o._data
+
+    def __repr__(self):
+        return f"Options({self._data})"
+
+
+# ---- enums mirroring CoreOptions (reference CoreOptions.java:1937,1966,2107,2321)
+
+
+class MergeEngine(str, enum.Enum):
+    DEDUPLICATE = "deduplicate"
+    PARTIAL_UPDATE = "partial-update"
+    AGGREGATE = "aggregation"
+    FIRST_ROW = "first-row"
+
+
+class StartupMode(str, enum.Enum):
+    DEFAULT = "default"
+    LATEST_FULL = "latest-full"
+    LATEST = "latest"
+    FROM_TIMESTAMP = "from-timestamp"
+    FROM_SNAPSHOT = "from-snapshot"
+    FROM_SNAPSHOT_FULL = "from-snapshot-full"
+    COMPACTED_FULL = "compacted-full"
+
+
+class ChangelogProducer(str, enum.Enum):
+    NONE = "none"
+    INPUT = "input"
+    FULL_COMPACTION = "full-compaction"
+    LOOKUP = "lookup"
+
+
+class SortEngine(str, enum.Enum):
+    XLA_SEGMENTED = "xla-segmented"  # device sort+segment-reduce (default)
+    NUMPY = "numpy"  # host oracle
+
+
+class BucketMode(str, enum.Enum):
+    FIXED = "fixed"
+    DYNAMIC = "dynamic"
+    UNAWARE = "unaware"
+
+
+class CoreOptions:
+    """The table option surface (reference CoreOptions.java — 149 options;
+    the ones that drive behavior here, same keys where concepts map 1:1)."""
+
+    BUCKET = ConfigOption.int_("bucket", -1, "Number of buckets (-1 = dynamic/unaware).")
+    BUCKET_KEY = ConfigOption.string("bucket-key", None, "Comma-separated bucket key columns (default: primary key).")
+    PATH = ConfigOption.string("path", None, "Table path.")
+    FILE_FORMAT = ConfigOption.string("file.format", "parquet", "Data file format: parquet|orc|lance.")
+    FILE_COMPRESSION = ConfigOption.string("file.compression", "zstd", "Data file compression codec.")
+    MANIFEST_FORMAT = ConfigOption.string("manifest.format", "jsonl", "Manifest file format.")
+    TARGET_FILE_SIZE = ConfigOption.memory("target-file-size", "128 mb", "Rolling target size for data files.")
+    WRITE_BUFFER_SIZE = ConfigOption.memory("write-buffer-size", "256 mb", "Memtable size before flush.")
+    WRITE_BUFFER_ROWS = ConfigOption.int_("write-buffer-rows", 1_000_000, "Memtable row cap before flush.")
+    WRITE_ONLY = ConfigOption.bool_("write-only", False, "Skip compaction (dedicated compact job mode).")
+    MERGE_ENGINE = ConfigOption.enum("merge-engine", MergeEngine, MergeEngine.DEDUPLICATE, "How same-key records merge.")
+    IGNORE_DELETE = ConfigOption.bool_("ignore-delete", False, "Ignore -D records on write/merge.")
+    SORT_ENGINE = ConfigOption.enum("sort-engine", SortEngine, SortEngine.XLA_SEGMENTED, "Merge kernel backend.")
+    CHANGELOG_PRODUCER = ConfigOption.enum(
+        "changelog-producer", ChangelogProducer, ChangelogProducer.NONE, "How changelog files are produced."
+    )
+    SCAN_MODE = ConfigOption.enum("scan.mode", StartupMode, StartupMode.DEFAULT, "Startup mode for scans.")
+    SCAN_SNAPSHOT_ID = ConfigOption.int_("scan.snapshot-id", None, "Snapshot id for time travel.")
+    SCAN_TIMESTAMP_MILLIS = ConfigOption.int_("scan.timestamp-millis", None, "Timestamp for time travel.")
+    SCAN_TAG_NAME = ConfigOption.string("scan.tag-name", None, "Tag name for time travel.")
+    SNAPSHOT_NUM_RETAINED_MIN = ConfigOption.int_("snapshot.num-retained.min", 10, "Min snapshots retained.")
+    SNAPSHOT_NUM_RETAINED_MAX = ConfigOption.int_("snapshot.num-retained.max", 2147483647, "Max snapshots retained.")
+    SNAPSHOT_TIME_RETAINED_MS = ConfigOption.int_("snapshot.time-retained.ms", 3600_000, "Snapshot retention time.")
+    NUM_SORTED_RUNS_COMPACTION_TRIGGER = ConfigOption.int_(
+        "num-sorted-run.compaction-trigger", 5, "Sorted runs per bucket that trigger compaction."
+    )
+    NUM_SORTED_RUNS_STOP_TRIGGER = ConfigOption.int_(
+        "num-sorted-run.stop-trigger", None, "Sorted runs that block writes (default trigger+3)."
+    )
+    NUM_LEVELS = ConfigOption.int_("num-levels", None, "LSM levels (default trigger+1).")
+    COMPACTION_MAX_SIZE_AMP_PERCENT = ConfigOption.int_(
+        "compaction.max-size-amplification-percent", 200, "Universal compaction size-amp trigger."
+    )
+    COMPACTION_SIZE_RATIO = ConfigOption.int_("compaction.size-ratio", 1, "Universal compaction size ratio percent.")
+    COMPACTION_MIN_FILE_NUM = ConfigOption.int_("compaction.min.file-num", 5, "Min files for size-ratio pick.")
+    COMPACTION_OPTIMIZATION_INTERVAL = ConfigOption.int_(
+        "compaction.optimization-interval", None, "Force full compaction every N millis."
+    )
+    FULL_COMPACTION_DELTA_COMMITS = ConfigOption.int_(
+        "full-compaction.delta-commits", None, "Full compaction every N commits."
+    )
+    DYNAMIC_BUCKET_TARGET_ROW_NUM = ConfigOption.int_(
+        "dynamic-bucket.target-row-num", 2_000_000, "Rows per dynamic bucket."
+    )
+    DELETION_VECTORS_ENABLED = ConfigOption.bool_("deletion-vectors.enabled", False, "Deletion-vector mode.")
+    SEQUENCE_FIELD = ConfigOption.string("sequence.field", None, "User-defined sequence column(s).")
+    PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE = ConfigOption.bool_(
+        "partial-update.remove-record-on-delete", False, "-D removes whole row under partial-update."
+    )
+    AGGREGATE_DEFAULT_FUNC = ConfigOption.string(
+        "fields.default-aggregate-function", None, "Default aggregate for unconfigured fields."
+    )
+    WRITE_MAX_WRITERS_TO_SPILL = ConfigOption.int_("write-max-writers-to-spill", 5, "Writers before spill.")
+    SORT_SPILL_THRESHOLD = ConfigOption.int_("sort-spill-threshold", None, "Merge fan-in before spill.")
+    MERGE_READ_BATCH_ROWS = ConfigOption.int_(
+        "merge.read-batch-rows", 1 << 20, "Row tile per device merge step (key-range tiling)."
+    )
+    CONSUMER_ID = ConfigOption.string("consumer-id", None, "Consumer id protecting read progress.")
+    CONSUMER_EXPIRATION_TIME_MS = ConfigOption.int_("consumer.expiration-time.ms", None, "Consumer expiry.")
+    TAG_AUTOMATIC_CREATION = ConfigOption.string("tag.automatic-creation", "none", "none|process-time|watermark.")
+    TAG_CREATION_PERIOD = ConfigOption.string("tag.creation-period", "daily", "daily|hourly.")
+    METADATA_STATS_MODE = ConfigOption.string("metadata.stats-mode", "truncate(16)", "Stats collection mode.")
+    MANIFEST_TARGET_SIZE = ConfigOption.memory("manifest.target-file-size", "8 mb", "Manifest merge target size.")
+    MANIFEST_MERGE_MIN_COUNT = ConfigOption.int_("manifest.merge-min-count", 30, "Small manifests before merge.")
+    PARTITION_EXPIRATION_TIME_MS = ConfigOption.int_("partition.expiration-time.ms", None, "Partition TTL.")
+    PARTITION_TIMESTAMP_FORMATTER = ConfigOption.string("partition.timestamp-formatter", None)
+    PARTITION_TIMESTAMP_PATTERN = ConfigOption.string("partition.timestamp-pattern", None)
+    RECORD_LEVEL_EXPIRE_TIME_MS = ConfigOption.int_("record-level.expire-time.ms", None, "Row TTL on read/compact.")
+    RECORD_LEVEL_TIME_FIELD = ConfigOption.string("record-level.time-field", None, "Row TTL time column.")
+    FILE_INDEX_BLOOM_COLUMNS = ConfigOption.string(
+        "file-index.bloom-filter.columns", None, "Columns with bloom file index."
+    )
+    FILE_INDEX_BLOOM_FPP = ConfigOption.float_("file-index.bloom-filter.fpp", 0.05, "Bloom false-positive rate.")
+    FIELDS_PREFIX = "fields."  # fields.<name>.aggregate-function / .sequence-group / .ignore-retract
+
+    def __init__(self, options: Options | Mapping[str, Any] | None = None):
+        self.options = options if isinstance(options, Options) else Options(options)
+
+    # typed views ---------------------------------------------------------
+    @property
+    def bucket(self) -> int:
+        return self.options.get(CoreOptions.BUCKET)
+
+    @property
+    def bucket_mode_hint(self) -> BucketMode:
+        return BucketMode.FIXED if self.bucket > 0 else BucketMode.DYNAMIC
+
+    @property
+    def file_format(self) -> str:
+        return self.options.get(CoreOptions.FILE_FORMAT)
+
+    @property
+    def file_compression(self) -> str:
+        return self.options.get(CoreOptions.FILE_COMPRESSION)
+
+    @property
+    def merge_engine(self) -> MergeEngine:
+        return self.options.get(CoreOptions.MERGE_ENGINE)
+
+    @property
+    def sort_engine(self) -> SortEngine:
+        return self.options.get(CoreOptions.SORT_ENGINE)
+
+    @property
+    def changelog_producer(self) -> ChangelogProducer:
+        return self.options.get(CoreOptions.CHANGELOG_PRODUCER)
+
+    @property
+    def target_file_size(self) -> int:
+        return int(self.options.get(CoreOptions.TARGET_FILE_SIZE))
+
+    @property
+    def write_buffer_rows(self) -> int:
+        return self.options.get(CoreOptions.WRITE_BUFFER_ROWS)
+
+    @property
+    def write_only(self) -> bool:
+        return self.options.get(CoreOptions.WRITE_ONLY)
+
+    @property
+    def num_sorted_runs_compaction_trigger(self) -> int:
+        return self.options.get(CoreOptions.NUM_SORTED_RUNS_COMPACTION_TRIGGER)
+
+    @property
+    def num_sorted_runs_stop_trigger(self) -> int:
+        v = self.options.get(CoreOptions.NUM_SORTED_RUNS_STOP_TRIGGER)
+        return v if v is not None else self.num_sorted_runs_compaction_trigger + 3
+
+    @property
+    def num_levels(self) -> int:
+        v = self.options.get(CoreOptions.NUM_LEVELS)
+        return v if v is not None else self.num_sorted_runs_compaction_trigger + 1
+
+    @property
+    def max_size_amplification_percent(self) -> int:
+        return self.options.get(CoreOptions.COMPACTION_MAX_SIZE_AMP_PERCENT)
+
+    @property
+    def size_ratio(self) -> int:
+        return self.options.get(CoreOptions.COMPACTION_SIZE_RATIO)
+
+    @property
+    def compaction_min_file_num(self) -> int:
+        return self.options.get(CoreOptions.COMPACTION_MIN_FILE_NUM)
+
+    @property
+    def snapshot_num_retained_min(self) -> int:
+        return self.options.get(CoreOptions.SNAPSHOT_NUM_RETAINED_MIN)
+
+    @property
+    def snapshot_num_retained_max(self) -> int:
+        return self.options.get(CoreOptions.SNAPSHOT_NUM_RETAINED_MAX)
+
+    @property
+    def snapshot_time_retained_ms(self) -> int:
+        return self.options.get(CoreOptions.SNAPSHOT_TIME_RETAINED_MS)
+
+    @property
+    def sequence_field(self) -> list[str]:
+        v = self.options.get(CoreOptions.SEQUENCE_FIELD)
+        return [s.strip() for s in v.split(",")] if v else []
+
+    @property
+    def ignore_delete(self) -> bool:
+        return self.options.get(CoreOptions.IGNORE_DELETE)
+
+    def field_option(self, field_name: str, suffix: str) -> str | None:
+        key = f"fields.{field_name}.{suffix}"
+        return self.options._data.get(key)
+
+    def to_map(self) -> dict[str, str]:
+        return self.options.to_map()

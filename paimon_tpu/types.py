@@ -1,0 +1,402 @@
+"""SQL-style type system with field-id based schema evolution.
+
+TPU-first design notes: every fixed-width type maps onto a numpy dtype that the
+column-batch model (paimon_tpu.data.batch) stores directly, so predicate masks,
+normalized sort keys, and merge kernels operate on dense vectors. Variable-width
+types (STRING/BYTES) live host-side and enter device kernels only as
+dictionary ranks (paimon_tpu.data.keys).
+
+Capability parity with the reference type kernel:
+  /root/reference/paimon-common/src/main/java/org/apache/paimon/types/ —
+  DataType subclasses, RowType, DataField (field-id based evolution),
+  RowKind (+I/-U/+U/-D) in types/RowKind.java.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import re
+from dataclasses import dataclass, field, replace
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+__all__ = [
+    "TypeRoot",
+    "DataType",
+    "ArrayType",
+    "MapType",
+    "DataField",
+    "RowType",
+    "RowKind",
+    "TINYINT",
+    "SMALLINT",
+    "INT",
+    "BIGINT",
+    "FLOAT",
+    "DOUBLE",
+    "BOOLEAN",
+    "STRING",
+    "BYTES",
+    "DATE",
+    "TIMESTAMP",
+    "DECIMAL",
+    "parse_type",
+]
+
+
+class TypeRoot(str, enum.Enum):
+    """Logical type families (reference: types/DataTypeRoot.java)."""
+
+    BOOLEAN = "BOOLEAN"
+    TINYINT = "TINYINT"
+    SMALLINT = "SMALLINT"
+    INT = "INT"
+    BIGINT = "BIGINT"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    DECIMAL = "DECIMAL"
+    CHAR = "CHAR"
+    VARCHAR = "VARCHAR"  # STRING == VARCHAR(max)
+    BINARY = "BINARY"
+    VARBINARY = "VARBINARY"  # BYTES == VARBINARY(max)
+    DATE = "DATE"
+    TIME = "TIME"
+    TIMESTAMP = "TIMESTAMP"
+    TIMESTAMP_LTZ = "TIMESTAMP_LTZ"
+    ARRAY = "ARRAY"
+    MAP = "MAP"
+    ROW = "ROW"
+
+
+_FIXED_NUMPY = {
+    TypeRoot.BOOLEAN: np.dtype(np.bool_),
+    TypeRoot.TINYINT: np.dtype(np.int8),
+    TypeRoot.SMALLINT: np.dtype(np.int16),
+    TypeRoot.INT: np.dtype(np.int32),
+    TypeRoot.BIGINT: np.dtype(np.int64),
+    TypeRoot.FLOAT: np.dtype(np.float32),
+    TypeRoot.DOUBLE: np.dtype(np.float64),
+    TypeRoot.DATE: np.dtype(np.int32),  # days since epoch
+    TypeRoot.TIME: np.dtype(np.int32),  # millis of day
+    TypeRoot.TIMESTAMP: np.dtype(np.int64),  # micros since epoch
+    TypeRoot.TIMESTAMP_LTZ: np.dtype(np.int64),
+    TypeRoot.DECIMAL: np.dtype(np.int64),  # unscaled long (precision <= 18)
+}
+
+_MAX_LEN = 2147483647
+
+
+@dataclass(frozen=True)
+class DataType:
+    """A logical type instance: root + nullability + parameters."""
+
+    root: TypeRoot
+    nullable: bool = True
+    # length for CHAR/VARCHAR/BINARY/VARBINARY; precision for TIMESTAMP/DECIMAL
+    length: int | None = None
+    precision: int | None = None
+    scale: int | None = None
+
+    # ---- classification ------------------------------------------------
+    def is_fixed_width(self) -> bool:
+        return self.root in _FIXED_NUMPY
+
+    def is_string_like(self) -> bool:
+        return self.root in (
+            TypeRoot.CHAR,
+            TypeRoot.VARCHAR,
+            TypeRoot.BINARY,
+            TypeRoot.VARBINARY,
+        )
+
+    def is_numeric(self) -> bool:
+        return self.root in (
+            TypeRoot.TINYINT,
+            TypeRoot.SMALLINT,
+            TypeRoot.INT,
+            TypeRoot.BIGINT,
+            TypeRoot.FLOAT,
+            TypeRoot.DOUBLE,
+            TypeRoot.DECIMAL,
+        )
+
+    def numpy_dtype(self) -> np.dtype:
+        """Physical host dtype. Variable-width types use object arrays."""
+        if self.root in _FIXED_NUMPY:
+            return _FIXED_NUMPY[self.root]
+        return np.dtype(object)
+
+    def with_nullable(self, nullable: bool) -> "DataType":
+        return replace(self, nullable=nullable)
+
+    def copy(self) -> "DataType":
+        return self
+
+    # ---- serialization -------------------------------------------------
+    def serialize(self) -> Any:
+        """Compact string form, e.g. "INT NOT NULL", "VARCHAR(10)", "DECIMAL(10,2)"."""
+        r = self.root
+        if r in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
+            if self.length is None or self.length == _MAX_LEN:
+                base = {"VARCHAR": "STRING", "VARBINARY": "BYTES"}.get(r.value, f"{r.value}({_MAX_LEN})")
+            else:
+                base = f"{r.value}({self.length})"
+        elif r == TypeRoot.DECIMAL:
+            base = f"DECIMAL({self.precision or 18},{self.scale or 0})"
+        elif r in (TypeRoot.TIMESTAMP, TypeRoot.TIMESTAMP_LTZ):
+            p = 6 if self.precision is None else self.precision
+            base = f"{r.value}({p})"
+        else:
+            base = r.value
+        return base if self.nullable else base + " NOT NULL"
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        s = self.serialize()
+        return s if isinstance(s, str) else json.dumps(s)
+
+
+@dataclass(frozen=True)
+class ArrayType(DataType):
+    element: DataType = None  # type: ignore[assignment]
+
+    def __init__(self, element: DataType, nullable: bool = True):
+        object.__setattr__(self, "root", TypeRoot.ARRAY)
+        object.__setattr__(self, "nullable", nullable)
+        object.__setattr__(self, "length", None)
+        object.__setattr__(self, "precision", None)
+        object.__setattr__(self, "scale", None)
+        object.__setattr__(self, "element", element)
+
+    def serialize(self) -> Any:
+        return {"type": "ARRAY" if self.nullable else "ARRAY NOT NULL", "element": self.element.serialize()}
+
+
+@dataclass(frozen=True)
+class MapType(DataType):
+    key: DataType = None  # type: ignore[assignment]
+    value: DataType = None  # type: ignore[assignment]
+
+    def __init__(self, key: DataType, value: DataType, nullable: bool = True):
+        object.__setattr__(self, "root", TypeRoot.MAP)
+        object.__setattr__(self, "nullable", nullable)
+        object.__setattr__(self, "length", None)
+        object.__setattr__(self, "precision", None)
+        object.__setattr__(self, "scale", None)
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
+
+    def serialize(self) -> Any:
+        return {
+            "type": "MAP" if self.nullable else "MAP NOT NULL",
+            "key": self.key.serialize(),
+            "value": self.value.serialize(),
+        }
+
+
+# ---- convenience constructors ------------------------------------------
+
+def TINYINT(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.TINYINT, nullable)
+
+
+def SMALLINT(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.SMALLINT, nullable)
+
+
+def INT(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.INT, nullable)
+
+
+def BIGINT(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.BIGINT, nullable)
+
+
+def FLOAT(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.FLOAT, nullable)
+
+
+def DOUBLE(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.DOUBLE, nullable)
+
+
+def BOOLEAN(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.BOOLEAN, nullable)
+
+
+def STRING(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.VARCHAR, nullable, length=_MAX_LEN)
+
+
+def BYTES(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.VARBINARY, nullable, length=_MAX_LEN)
+
+
+def DATE(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.DATE, nullable)
+
+
+def TIMESTAMP(precision: int = 6, nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.TIMESTAMP, nullable, precision=precision)
+
+
+def DECIMAL(precision: int = 18, scale: int = 0, nullable: bool = True) -> DataType:
+    if precision > 18:
+        raise ValueError("paimon-tpu supports DECIMAL precision <= 18 (unscaled int64)")
+    return DataType(TypeRoot.DECIMAL, nullable, precision=precision, scale=scale)
+
+
+_TYPE_RE = re.compile(r"^([A-Z_]+)(?:\((\d+)(?:,\s*(\d+))?\))?( NOT NULL)?$")
+
+
+def parse_type(s: Any) -> DataType:
+    """Inverse of DataType.serialize()."""
+    if isinstance(s, dict):
+        t = s["type"]
+        nullable = not t.endswith("NOT NULL")
+        base = t.replace(" NOT NULL", "")
+        if base == "ARRAY":
+            return ArrayType(parse_type(s["element"]), nullable)
+        if base == "MAP":
+            return MapType(parse_type(s["key"]), parse_type(s["value"]), nullable)
+        if base == "ROW":
+            return RowType([DataField.from_dict(f) for f in s["fields"]], nullable)
+        raise ValueError(f"unknown structured type {t}")
+    m = _TYPE_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"cannot parse type {s!r}")
+    name, p1, p2, notnull = m.groups()
+    nullable = notnull is None
+    if name == "STRING":
+        return STRING(nullable)
+    if name == "BYTES":
+        return BYTES(nullable)
+    root = TypeRoot(name)
+    if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
+        return DataType(root, nullable, length=int(p1) if p1 else _MAX_LEN)
+    if root == TypeRoot.DECIMAL:
+        return DataType(root, nullable, precision=int(p1 or 18), scale=int(p2 or 0))
+    if root in (TypeRoot.TIMESTAMP, TypeRoot.TIMESTAMP_LTZ):
+        return DataType(root, nullable, precision=int(p1) if p1 else 6)
+    return DataType(root, nullable)
+
+
+@dataclass(frozen=True)
+class DataField:
+    """A named, id-carrying field. Field ids — not names or positions — are the
+    durable identity used for schema evolution (reference:
+    types/DataField.java, schema/SchemaEvolutionUtil.java:54)."""
+
+    id: int
+    name: str
+    type: DataType
+    description: str | None = None
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id, "name": self.name, "type": self.type.serialize()}
+        if self.description:
+            d["description"] = self.description
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "DataField":
+        return DataField(d["id"], d["name"], parse_type(d["type"]), d.get("description"))
+
+
+class RowType(DataType):
+    """A sequence of DataFields; the schema of every row/batch."""
+
+    def __init__(self, fields: Iterable[DataField], nullable: bool = True):
+        object.__setattr__(self, "root", TypeRoot.ROW)
+        object.__setattr__(self, "nullable", nullable)
+        object.__setattr__(self, "length", None)
+        object.__setattr__(self, "precision", None)
+        object.__setattr__(self, "scale", None)
+        object.__setattr__(self, "fields", tuple(fields))
+        names = [f.name for f in self.fields]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate field names in {names}")
+        object.__setattr__(self, "_index", {f.name: i for i, f in enumerate(self.fields)})
+
+    fields: tuple[DataField, ...]
+    _index: dict
+
+    # ---- construction helpers -----------------------------------------
+    @staticmethod
+    def of(*spec: tuple[str, DataType]) -> "RowType":
+        """RowType.of(("k", INT()), ("v", STRING())) with ids 0..n-1."""
+        return RowType([DataField(i, n, t) for i, (n, t) in enumerate(spec)])
+
+    # ---- accessors -----------------------------------------------------
+    @property
+    def field_names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def field_types(self) -> list[DataType]:
+        return [f.type for f in self.fields]
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def field(self, name: str) -> DataField:
+        return self.fields[self._index[name]]
+
+    def field_index(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def highest_field_id(self) -> int:
+        return max((f.id for f in self.fields), default=-1)
+
+    def project(self, names: Iterable[str]) -> "RowType":
+        return RowType([self.field(n) for n in names], self.nullable)
+
+    # ---- serialization -------------------------------------------------
+    def serialize(self) -> Any:
+        return {
+            "type": "ROW" if self.nullable else "ROW NOT NULL",
+            "fields": [f.to_dict() for f in self.fields],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.serialize(), indent=2)
+
+    @staticmethod
+    def from_json(s: str | dict) -> "RowType":
+        d = json.loads(s) if isinstance(s, str) else s
+        t = parse_type(d)
+        assert isinstance(t, RowType)
+        return t
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, RowType) and self.fields == other.fields
+
+    def __hash__(self) -> int:
+        return hash(self.fields)
+
+
+class RowKind(enum.IntEnum):
+    """Changelog row kinds (reference: types/RowKind.java). Stored as uint8
+    vectors; byte values match the reference's ordinal for changelog parity."""
+
+    INSERT = 0  # +I
+    UPDATE_BEFORE = 1  # -U
+    UPDATE_AFTER = 2  # +U
+    DELETE = 3  # -D
+
+    @property
+    def short_string(self) -> str:
+        return ("+I", "-U", "+U", "-D")[int(self)]
+
+    @property
+    def is_add(self) -> bool:
+        """Rows that accumulate state (+I/+U) vs retract (-U/-D)."""
+        return self in (RowKind.INSERT, RowKind.UPDATE_AFTER)
+
+    @staticmethod
+    def from_short_string(s: str) -> "RowKind":
+        return {"+I": RowKind.INSERT, "-U": RowKind.UPDATE_BEFORE, "+U": RowKind.UPDATE_AFTER, "-D": RowKind.DELETE}[s]

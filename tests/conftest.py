@@ -1,0 +1,25 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes,
+so multi-chip sharding paths are exercised without TPU hardware (the driver
+separately dry-runs the real multi-chip path via __graft_entry__)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_warehouse(tmp_path):
+    w = tmp_path / "warehouse"
+    w.mkdir()
+    return str(w)

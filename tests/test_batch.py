@@ -1,0 +1,58 @@
+import numpy as np
+import pytest
+
+from paimon_tpu.data import ColumnBatch, Column, concat_batches
+from paimon_tpu.types import BIGINT, DOUBLE, INT, STRING, DataField, RowType
+
+SCHEMA = RowType.of(("k", INT(False)), ("v", DOUBLE()), ("s", STRING()))
+
+
+def test_from_pydict_and_back():
+    b = ColumnBatch.from_pydict(SCHEMA, {"k": [1, 2, 3], "v": [1.5, None, 3.0], "s": ["a", "b", None]})
+    assert b.num_rows == 3
+    assert b["v"].null_count == 1
+    assert b.to_pydict() == {"k": [1, 2, 3], "v": [1.5, None, 3.0], "s": ["a", "b", None]}
+    assert b.to_pylist() == [(1, 1.5, "a"), (2, None, "b"), (3, 3.0, None)]
+
+
+def test_take_filter_slice_concat():
+    b = ColumnBatch.from_pydict(SCHEMA, {"k": [1, 2, 3, 4], "v": [1.0, None, 3.0, 4.0], "s": list("wxyz")})
+    t = b.take(np.array([3, 0]))
+    assert t.to_pylist() == [(4, 4.0, "z"), (1, 1.0, "w")]
+    f = b.filter(np.array([True, False, True, False]))
+    assert f.to_pylist() == [(1, 1.0, "w"), (3, 3.0, "y")]
+    s = b.slice(1, 3)
+    assert s.to_pylist() == [(2, None, "x"), (3, 3.0, "y")]
+    c = concat_batches([t, s])
+    assert c.num_rows == 4
+    assert c.to_pylist()[2] == (2, None, "x")
+
+
+def test_select_preserves_ids():
+    b = ColumnBatch.from_pydict(SCHEMA, {"k": [1], "v": [2.0], "s": ["x"]})
+    p = b.select(["s", "k"])
+    assert p.schema.field("s").id == 2
+    assert p.to_pylist() == [("x", 1)]
+
+
+def test_arrow_roundtrip():
+    b = ColumnBatch.from_pydict(SCHEMA, {"k": [1, 2], "v": [None, 2.5], "s": ["a", None]})
+    t = b.to_arrow()
+    back = ColumnBatch.from_arrow(t, SCHEMA)
+    assert back.to_pydict() == b.to_pydict()
+
+
+def test_ragged_rejected():
+    with pytest.raises(AssertionError):
+        ColumnBatch(
+            RowType.of(("a", INT()), ("b", INT())),
+            {"a": Column(np.array([1, 2])), "b": Column(np.array([1]))},
+        )
+
+
+def test_with_column_and_rename():
+    b = ColumnBatch.from_pydict(RowType.of(("a", INT())), {"a": [1, 2]})
+    b2 = b.with_column(DataField(5, "seq", BIGINT(False)), Column(np.array([10, 11], dtype=np.int64)))
+    assert b2.schema.field("seq").id == 5
+    renamed = b.rename(RowType.of(("z", INT())))
+    assert renamed.to_pydict() == {"z": [1, 2]}

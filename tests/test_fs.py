@@ -1,0 +1,85 @@
+import threading
+
+import pytest
+
+from paimon_tpu.fs import LocalFileIO, get_file_io, split_scheme
+from paimon_tpu.fs.testing import ArtificialException, FailingFileIO
+
+
+def test_split_scheme():
+    assert split_scheme("/a/b") == ("file", "/a/b")
+    assert split_scheme("file:///a/b") == ("file", "/a/b")
+    assert split_scheme("fail://dom/a/b") == ("fail", "dom/a/b")
+
+
+def test_local_read_write_list(tmp_path):
+    io = LocalFileIO()
+    p = str(tmp_path / "d" / "x.txt")
+    io.write_text(p, "hello")
+    assert io.read_text(p) == "hello"
+    assert io.exists(p)
+    with pytest.raises(FileExistsError):
+        io.write_text(p, "again")
+    st = io.get_status(p)
+    assert st.size == 5 and not st.is_dir
+    files = io.list_files(str(tmp_path / "d"))
+    assert [f.path for f in files] == [p]
+    assert io.delete(p)
+    assert not io.exists(p)
+
+
+def test_atomic_write_cas(tmp_path):
+    io = LocalFileIO()
+    p = str(tmp_path / "snapshot-1")
+    assert io.try_atomic_write(p, b"a")
+    # second writer loses the race, file unchanged
+    assert not io.try_atomic_write(p, b"b")
+    assert io.read_bytes(p) == b"a"
+    # no temp litter
+    assert len(io.list_files(str(tmp_path))) == 1
+
+
+def test_atomic_write_concurrent(tmp_path):
+    io = LocalFileIO()
+    p = str(tmp_path / "snapshot-7")
+    results = []
+
+    def attempt(i):
+        results.append((i, io.try_atomic_write(p, f"writer-{i}".encode())))
+
+    threads = [threading.Thread(target=attempt, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [i for i, ok in results if ok]
+    assert len(winners) == 1
+    assert io.read_bytes(p).decode() == f"writer-{winners[0]}"
+
+
+def test_failing_file_io(tmp_path):
+    FailingFileIO.reset("t1", max_fails=1000, possibility=1)  # always fail
+    io = get_file_io("fail://t1/x")
+    path = f"fail://t1{tmp_path}/f.txt"
+    with pytest.raises(ArtificialException):
+        io.write_text(path, "x")
+    FailingFileIO.reset("t1", max_fails=0, possibility=0)  # heal
+    io.write_text(path, "x")
+    assert io.read_text(path) == "x"
+
+
+def test_failing_file_io_eventually_succeeds(tmp_path):
+    FailingFileIO.reset("t2", max_fails=3, possibility=2, seed=7)
+    io = get_file_io("fail://t2/x")
+    path = f"fail://t2{tmp_path}/g.txt"
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            io.write_text(path, "ok", overwrite=True)
+            break
+        except ArtificialException:
+            continue
+    FailingFileIO.reset("t2", max_fails=0, possibility=0)  # heal before verify
+    assert io.read_text(path) == "ok"
+    assert attempts <= 4

@@ -1,0 +1,91 @@
+"""Normalized key lanes: unsigned lane-tuple order must equal typed key order."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.data import ColumnBatch, encode_key_lanes
+from paimon_tpu.data.keys import build_string_pool, lane_count, lexsort_rows, split_int64_lanes
+from paimon_tpu.types import BIGINT, DOUBLE, FLOAT, INT, SMALLINT, STRING, TIMESTAMP, RowType
+
+
+def lanes_tuplesort(lanes):
+    return sorted(range(lanes.shape[0]), key=lambda i: tuple(lanes[i]))
+
+
+def check_order_preserved(values, schema, key, pools=None):
+    b = ColumnBatch.from_pydict(schema, {key: list(values)})
+    lanes = encode_key_lanes(b, [key], pools)
+    order_by_lanes = lanes_tuplesort(lanes)
+    order_by_value = sorted(range(len(values)), key=lambda i: values[i])
+    assert [values[i] for i in order_by_lanes] == [values[i] for i in order_by_value]
+
+
+def test_int32_order():
+    vals = [0, -1, 1, 2**31 - 1, -(2**31), 7, -7]
+    check_order_preserved(vals, RowType.of(("k", INT(False))), "k")
+
+
+def test_int64_order_two_lanes():
+    vals = [0, -1, 1, 2**63 - 1, -(2**63), 2**40, -(2**40)]
+    schema = RowType.of(("k", BIGINT(False)))
+    b = ColumnBatch.from_pydict(schema, {"k": vals})
+    lanes = encode_key_lanes(b, ["k"])
+    assert lanes.shape == (len(vals), 2)
+    check_order_preserved(vals, schema, "k")
+
+
+def test_smallint_and_timestamp():
+    check_order_preserved([3, -3, 0, 32767, -32768], RowType.of(("k", SMALLINT(False))), "k")
+    check_order_preserved([10**12, -5, 0, 10**15], RowType.of(("k", TIMESTAMP(6, False))), "k")
+
+
+def test_float_order():
+    vals = [0.0, -0.5, 0.5, float("inf"), float("-inf"), 1e-30, -1e-30, 123.25]
+    check_order_preserved(vals, RowType.of(("k", FLOAT(False))), "k")
+    check_order_preserved(vals, RowType.of(("k", DOUBLE(False))), "k")
+
+
+def test_string_pool_ranks():
+    vals = ["pear", "apple", "fig", "banana", "apple"]
+    schema = RowType.of(("k", STRING(False)))
+    b = ColumnBatch.from_pydict(schema, {"k": vals})
+    pool = build_string_pool([b["k"].values])
+    lanes = encode_key_lanes(b, ["k"], {"k": pool})
+    order = lanes_tuplesort(lanes)
+    assert [vals[i] for i in order] == sorted(vals)
+    # equal strings share a rank
+    assert lanes[1, 0] == lanes[4, 0]
+
+
+def test_composite_key_lex_order():
+    schema = RowType.of(("a", INT(False)), ("b", BIGINT(False)))
+    data = {"a": [1, 1, 0, 2, 1], "b": [5, -1, 100, 0, 5]}
+    b = ColumnBatch.from_pydict(schema, data)
+    lanes = encode_key_lanes(b, ["a", "b"])
+    assert lanes.shape[1] == lane_count(schema, ["a", "b"]) == 3
+    order = lanes_tuplesort(lanes)
+    expect = sorted(range(5), key=lambda i: (data["a"][i], data["b"][i]))
+    assert order == expect
+
+
+def test_lexsort_rows_matches_tuplesort_and_is_stable():
+    rng = np.random.default_rng(0)
+    lanes = rng.integers(0, 3, size=(50, 2)).astype(np.uint32)
+    seq = rng.integers(0, 2, size=50).astype(np.uint32)
+    order = lexsort_rows(lanes, seq)
+    keyed = [(tuple(lanes[i]), seq[i], i) for i in range(50)]
+    assert [k[2] for k in sorted(keyed)] == list(order)
+
+
+def test_null_key_rejected():
+    schema = RowType.of(("k", INT()))
+    b = ColumnBatch.from_pydict(schema, {"k": [1, None]})
+    with pytest.raises(ValueError):
+        encode_key_lanes(b, ["k"])
+
+
+def test_split_int64_lanes_roundtrip_order():
+    v = np.array([-(2**62), -1, 0, 1, 2**62], dtype=np.int64)
+    hi, lo = split_int64_lanes(v)
+    pairs = list(zip(hi.tolist(), lo.tolist()))
+    assert pairs == sorted(pairs)

@@ -1,0 +1,98 @@
+import numpy as np
+
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.data.predicate import (
+    FieldStats,
+    Predicate,
+    PredicateBuilder,
+    and_,
+    between,
+    contains,
+    equal,
+    greater_than,
+    in_,
+    is_null,
+    less_than,
+    not_in,
+    or_,
+    starts_with,
+)
+from paimon_tpu.types import DOUBLE, INT, STRING, RowType
+
+SCHEMA = RowType.of(("a", INT()), ("b", DOUBLE()), ("s", STRING()))
+BATCH = ColumnBatch.from_pydict(
+    SCHEMA,
+    {"a": [1, 2, 3, 4, None], "b": [1.0, None, 3.0, 4.0, 5.0], "s": ["apple", "banana", None, "apricot", "fig"]},
+)
+
+
+def ev(p):
+    return p.eval(BATCH).tolist()
+
+
+def test_leaf_eval():
+    assert ev(equal("a", 2)) == [False, True, False, False, False]
+    assert ev(less_than("a", 3)) == [True, True, False, False, False]
+    assert ev(is_null("a")) == [False, False, False, False, True]
+    assert ev(in_("a", [1, 4])) == [True, False, False, True, False]
+    assert ev(not_in("a", [1, 4])) == [False, True, True, False, False]  # null -> False
+    assert ev(between("b", 3.0, 4.5)) == [False, False, True, True, False]
+
+
+def test_string_eval():
+    assert ev(starts_with("s", "ap")) == [True, False, False, True, False]
+    assert ev(contains("s", "an")) == [False, True, False, False, False]
+
+
+def test_compound_eval_and_flatten():
+    p = and_(greater_than("a", 1), less_than("a", 4))
+    assert ev(p) == [False, True, True, False, False]
+    q = or_(equal("a", 1), equal("a", 4), is_null("a"))
+    assert ev(q) == [True, False, False, True, True]
+    assert len(and_(p, equal("a", 2)).children) == 3  # flattened
+
+
+def test_negate():
+    p = and_(greater_than("a", 1), less_than("a", 4)).negate()
+    assert ev(p) == [True, False, False, True, False]  # nulls stay False
+
+
+def test_serde_roundtrip():
+    p = or_(and_(equal("a", 1), less_than("b", 2.0)), starts_with("s", "x"))
+    q = Predicate.from_dict(p.to_dict())
+    assert ev(q) == ev(p)
+
+
+def test_stats_pruning():
+    stats = {"a": FieldStats(10, 20, 0, 100)}
+    assert not equal("a", 5).test_stats(stats)
+    assert equal("a", 15).test_stats(stats)
+    assert not greater_than("a", 20).test_stats(stats)
+    assert greater_than("a", 19).test_stats(stats)
+    assert not between("a", 30, 40).test_stats(stats)
+    assert in_("a", [1, 11]).test_stats(stats)
+    assert not in_("a", [1, 2]).test_stats(stats)
+    # all-null file
+    stats2 = {"a": FieldStats(None, None, 100, 100)}
+    assert not equal("a", 1).test_stats(stats2)
+    assert is_null("a").test_stats(stats2)
+    # unknown field -> conservative keep
+    assert equal("zz", 1).test_stats(stats)
+
+
+def test_stats_compound():
+    stats = {"a": FieldStats(10, 20, 0, 100), "b": FieldStats(0.0, 1.0, 0, 100)}
+    assert not and_(equal("a", 15), greater_than("b", 2.0)).test_stats(stats)
+    assert or_(equal("a", 15), greater_than("b", 2.0)).test_stats(stats)
+
+
+def test_builder_checks_fields():
+    pb = PredicateBuilder(SCHEMA)
+    pb.equal("a", 1)
+    import pytest
+
+    with pytest.raises(KeyError):
+        pb.equal("nope", 1)
+    parts = PredicateBuilder.split_and(and_(equal("a", 1), equal("b", 2.0)))
+    assert len(parts) == 2
+    assert PredicateBuilder.pick_by_fields(parts, {"a"}) == [parts[0]]

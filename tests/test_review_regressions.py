@@ -1,0 +1,65 @@
+"""Regressions for the round-1 code-review findings."""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from paimon_tpu.data import ColumnBatch, concat_batches, encode_key_lanes
+from paimon_tpu.data.predicate import FieldStats, equal
+from paimon_tpu.fs.testing import TraceableFileIO
+from paimon_tpu.options import CoreOptions, MergeEngine, Options
+from paimon_tpu.types import DECIMAL, INT, STRING, RowType
+
+
+def test_traceable_file_io_delegates(tmp_path):
+    io = TraceableFileIO()
+    p = str(tmp_path / "x")
+    io.write_bytes(p, b"hi")
+    assert io.read_bytes(p) == b"hi"
+    assert io.exists(p)
+    with io.open_input(p) as f:
+        assert f.read() == b"hi"
+    TraceableFileIO.assert_no_leaks()
+
+
+def test_decimal_arrow_exact():
+    import pyarrow as pa
+
+    schema = RowType.of(("d", DECIMAL(18, 2)))
+    t = pa.table({"d": pa.array([Decimal("0.07"), Decimal("12345678901234.56"), None], pa.decimal128(18, 2))})
+    b = ColumnBatch.from_arrow(t, schema)
+    assert b["d"].values[0] == 7
+    assert b["d"].values[1] == 1234567890123456
+    assert b["d"].null_count == 1
+
+
+def test_enum_option_normalization():
+    co = CoreOptions(Options({"merge-engine": "PARTIAL_UPDATE"}))
+    assert co.merge_engine == MergeEngine.PARTIAL_UPDATE
+    co2 = CoreOptions(Options({"merge-engine": "aggregation"}))
+    assert co2.merge_engine == MergeEngine.AGGREGATE
+
+
+def test_concat_all_empty():
+    s = RowType.of(("a", INT()))
+    out = concat_batches([ColumnBatch.empty(s), ColumnBatch.empty(s)])
+    assert out.num_rows == 0
+    assert out.schema == s
+
+
+def test_stats_missing_minmax_not_pruned():
+    # stats not collected but rows present: must NOT prune
+    st = {"a": FieldStats(None, None, 0, 100)}
+    assert equal("a", 5).test_stats(st)
+    # genuinely all-null: prune
+    st2 = {"a": FieldStats(None, None, 100, 100)}
+    assert not equal("a", 5).test_stats(st2)
+
+
+def test_string_pool_coverage_enforced():
+    schema = RowType.of(("s", STRING(False)))
+    b = ColumnBatch.from_pydict(schema, {"s": ["b", "c"]})
+    pool = np.array(["a", "c"], dtype=object)
+    with pytest.raises(ValueError, match="missing from pool"):
+        encode_key_lanes(b, ["s"], {"s": pool})

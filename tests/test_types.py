@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+
+from paimon_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DECIMAL,
+    DOUBLE,
+    INT,
+    STRING,
+    TIMESTAMP,
+    ArrayType,
+    DataField,
+    MapType,
+    RowKind,
+    RowType,
+    parse_type,
+)
+
+
+def test_serialize_roundtrip_scalars():
+    for t in [INT(), INT(False), BIGINT(), STRING(), STRING(False), DOUBLE(), BOOLEAN(), TIMESTAMP(3), DECIMAL(10, 2)]:
+        assert parse_type(t.serialize()) == t
+
+
+def test_serialize_roundtrip_nested():
+    t = ArrayType(MapType(STRING(False), INT()))
+    assert parse_type(t.serialize()) == t
+
+
+def test_row_type_roundtrip_and_ids():
+    rt = RowType.of(("k", INT(False)), ("v", STRING()), ("ts", TIMESTAMP()))
+    assert rt.field("k").id == 0
+    assert rt.field("ts").id == 2
+    assert rt.highest_field_id() == 2
+    back = RowType.from_json(rt.to_json())
+    assert back == rt
+    assert back.field("v").type == STRING()
+
+
+def test_row_type_project_and_index():
+    rt = RowType.of(("a", INT()), ("b", STRING()), ("c", DOUBLE()))
+    p = rt.project(["c", "a"])
+    assert p.field_names == ["c", "a"]
+    assert p.field("c").id == 2  # ids survive projection
+    assert rt.field_index("b") == 1
+    assert "b" in rt and "z" not in rt
+
+
+def test_duplicate_names_rejected():
+    with pytest.raises(ValueError):
+        RowType.of(("a", INT()), ("a", INT()))
+
+
+def test_numpy_dtypes():
+    assert INT().numpy_dtype() == np.dtype(np.int32)
+    assert BIGINT().numpy_dtype() == np.dtype(np.int64)
+    assert TIMESTAMP().numpy_dtype() == np.dtype(np.int64)
+    assert STRING().numpy_dtype() == np.dtype(object)
+
+
+def test_row_kind():
+    assert RowKind.INSERT.short_string == "+I"
+    assert RowKind.from_short_string("-D") == RowKind.DELETE
+    assert RowKind.UPDATE_AFTER.is_add and not RowKind.UPDATE_BEFORE.is_add
+    assert int(RowKind.DELETE) == 3
