@@ -119,10 +119,10 @@ def _encode_column(values: np.ndarray, root: TypeRoot, pool: np.ndarray | None) 
 def build_string_pool(column_values: Sequence[np.ndarray]) -> np.ndarray:
     """Sorted unique values across every input of one merge. Ranks against this
     pool are exact order-preserving surrogates for the strings themselves."""
-    allv = np.concatenate([v for v in column_values if len(v)]) if column_values else np.empty(0, object)
-    if len(allv) == 0:
-        return allv
-    return np.unique(allv)
+    non_empty = [v for v in column_values if len(v)]
+    if not non_empty:
+        return np.empty(0, dtype=object)
+    return np.unique(np.concatenate(non_empty))
 
 
 def encode_key_lanes(

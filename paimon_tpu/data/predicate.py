@@ -49,16 +49,20 @@ __all__ = [
 @dataclass(frozen=True)
 class FieldStats:
     """Per-file, per-field statistics used for pruning (reference:
-    stats/SimpleStats + predicate evaluation on stats)."""
+    stats/SimpleStats + predicate evaluation on stats).
+
+    null_count None means *unknown* (the writer did not record it): null
+    predicates then cannot prune, and the field is never treated as all-null.
+    """
 
     min: Any
     max: Any
-    null_count: int
+    null_count: int | None
     row_count: int
 
     @property
     def all_null(self) -> bool:
-        return self.null_count >= self.row_count
+        return self.null_count is not None and self.null_count >= self.row_count
 
 
 class Predicate:
@@ -133,17 +137,17 @@ class LeafPredicate(Predicate):
         if f == "isNotNull":
             return valid.copy()
         if f == "equal":
-            m = v == lit
+            m = _masked_cmp(v, valid, "==", lit)
         elif f == "notEqual":
-            m = v != lit
+            m = _masked_cmp(v, valid, "!=", lit)
         elif f == "lessThan":
-            m = v < lit
+            m = _masked_cmp(v, valid, "<", lit)
         elif f == "lessOrEqual":
-            m = v <= lit
+            m = _masked_cmp(v, valid, "<=", lit)
         elif f == "greaterThan":
-            m = v > lit
+            m = _masked_cmp(v, valid, ">", lit)
         elif f == "greaterOrEqual":
-            m = v >= lit
+            m = _masked_cmp(v, valid, ">=", lit)
         elif f == "in":
             m = np.isin(v, np.asarray(list(lit), dtype=v.dtype)) if v.dtype != object else np.isin(v, list(lit))
         elif f == "notIn":
@@ -154,7 +158,7 @@ class LeafPredicate(Predicate):
             )
         elif f == "between":
             lo, hi = lit
-            m = (v >= lo) & (v <= hi)
+            m = _masked_cmp(v, valid, ">=", lo) & _masked_cmp(v, valid, "<=", hi)
         elif f in ("startsWith", "endsWith", "contains"):
             m = _string_match(v, f, lit)
         else:
@@ -168,7 +172,7 @@ class LeafPredicate(Predicate):
             return True
         f, lit = self.function, self.literals
         if f == "isNull":
-            return st.null_count > 0
+            return st.null_count is None or st.null_count > 0
         if f == "isNotNull":
             return not st.all_null
         if st.all_null:
@@ -200,6 +204,27 @@ class LeafPredicate(Predicate):
             hi = str(st.max)[: len(p)] if st.max is not None else ""
             return lo <= p <= hi
         return True  # endsWith/contains can't prune
+
+
+_OPS = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+def _masked_cmp(v: np.ndarray, valid: np.ndarray, op: str, lit: Any) -> np.ndarray:
+    """Comparison that never evaluates null slots (object arrays hold None,
+    which would raise on ordering comparisons)."""
+    fn = _OPS[op]
+    if v.dtype == np.dtype(object) and not valid.all():
+        out = np.zeros(len(v), dtype=np.bool_)
+        out[valid] = np.asarray(fn(v[valid], lit), dtype=np.bool_)
+        return out
+    return np.asarray(fn(v, lit), dtype=np.bool_)
 
 
 def _string_match(v: np.ndarray, f: str, lit: Any) -> np.ndarray:

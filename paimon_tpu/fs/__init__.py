@@ -107,13 +107,23 @@ class FileIO:
     def write_text(self, path: str, text: str, overwrite: bool = False) -> None:
         self.write_bytes(path, text.encode("utf-8"), overwrite)
 
-    def try_overwrite(self, path: str, data: bytes) -> None:
+    def try_overwrite(self, path: str, data: bytes) -> bool:
         """Overwrite via temp+delete+rename (used for hint files; readers may
-        transiently miss the file but never see partial content)."""
+        transiently miss the file but never see partial content). Returns
+        False if a concurrent writer won the re-create race; never leaks the
+        temp file either way."""
         tmp = self._temp_sibling(path)
         self.write_bytes(tmp, data, overwrite=True)
-        self.delete(path)
-        self.rename(tmp, path)
+        try:
+            self.delete(path)
+            ok = self.rename(tmp, path)
+        finally:
+            if self.exists(tmp):
+                try:
+                    self.delete(tmp)
+                except Exception:
+                    pass
+        return ok
 
     def list_files(self, path: str) -> list[FileStatus]:
         return [s for s in self.list_status(path) if not s.is_dir]

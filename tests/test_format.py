@@ -1,0 +1,118 @@
+import numpy as np
+import pytest
+
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.data.predicate import equal, greater_than, in_, or_
+from paimon_tpu.format import collect_stats, get_format, stats_from_json, stats_to_json
+from paimon_tpu.format.fileindex import BloomFilter, FileIndexPredicate, index_path, write_file_index
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.types import BIGINT, DOUBLE, INT, STRING, RowType
+
+SCHEMA = RowType.of(("k", BIGINT(False)), ("v", DOUBLE()), ("s", STRING()))
+
+
+def make_batch(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnBatch.from_pydict(
+        SCHEMA,
+        {
+            "k": rng.integers(0, 10**9, n).tolist(),
+            "v": [None if i % 7 == 0 else float(i) for i in range(n)],
+            "s": [f"s-{i:05d}" for i in range(n)],
+        },
+    )
+
+
+@pytest.mark.parametrize("fmt_id", ["parquet", "orc"])
+def test_write_read_roundtrip(tmp_path, fmt_id):
+    io, fmt = LocalFileIO(), get_format(fmt_id)
+    b = make_batch(500)
+    p = str(tmp_path / f"f.{fmt_id}")
+    fmt.write(io, p, b)
+    out = list(fmt.read(io, p, SCHEMA))
+    got = ColumnBatch.from_pydict(SCHEMA, {n: sum((x.to_pydict()[n] for x in out), []) for n in SCHEMA.field_names})
+    assert got.to_pydict() == b.to_pydict()
+
+
+@pytest.mark.parametrize("fmt_id", ["parquet", "orc"])
+def test_projection(tmp_path, fmt_id):
+    io, fmt = LocalFileIO(), get_format(fmt_id)
+    b = make_batch(100)
+    p = str(tmp_path / f"g.{fmt_id}")
+    fmt.write(io, p, b)
+    out = next(iter(fmt.read(io, p, SCHEMA, projection=["s", "k"])))
+    assert out.schema.field_names == ["s", "k"]
+    assert out.schema.field("s").id == 2
+
+
+def test_parquet_row_group_pruning(tmp_path):
+    import pyarrow.parquet as pq
+
+    io, fmt = LocalFileIO(), get_format("parquet")
+    # force multiple row groups with disjoint k ranges
+    import io as _io
+
+    b1 = ColumnBatch.from_pydict(SCHEMA, {"k": list(range(0, 100)), "v": [1.0] * 100, "s": ["a"] * 100})
+    b2 = ColumnBatch.from_pydict(SCHEMA, {"k": list(range(1000, 1100)), "v": [2.0] * 100, "s": ["b"] * 100})
+    buf = _io.BytesIO()
+    w = pq.ParquetWriter(buf, b1.to_arrow().schema)
+    w.write_table(b1.to_arrow())
+    w.write_table(b2.to_arrow())
+    w.close()
+    p = str(tmp_path / "multi.parquet")
+    io.write_bytes(p, buf.getvalue())
+    out = list(fmt.read(io, p, SCHEMA, predicate=greater_than("k", 999)))
+    assert len(out) == 1 and out[0].num_rows == 100
+    assert out[0]["v"].values[0] == 2.0
+
+
+def test_collect_stats():
+    b = ColumnBatch.from_pydict(SCHEMA, {"k": [5, 1, 9], "v": [None, 2.0, None], "s": ["zz", None, "aa"]})
+    st = collect_stats(b)
+    assert (st["k"].min, st["k"].max, st["k"].null_count) == (1, 9, 0)
+    assert (st["v"].min, st["v"].max, st["v"].null_count) == (2.0, 2.0, 2)
+    assert (st["s"].min, st["s"].max) == ("aa", "zz")
+    back = stats_from_json(stats_to_json(st))
+    assert back == st
+
+
+def test_stats_string_truncation():
+    b = ColumnBatch.from_pydict(RowType.of(("s", STRING())), {"s": ["a" * 40, "z" * 40]})
+    st = collect_stats(b)
+    assert st["s"].min == "a" * 16
+    assert len(st["s"].max) <= 17 and st["s"].max > "z" * 40  # still an upper bound
+
+
+def test_bloom_filter_membership(rng):
+    vals = rng.integers(0, 10**12, 5000).astype(np.int64)
+    bf = BloomFilter.for_items(len(vals), 0.01)
+    from paimon_tpu.format.fileindex import _hash64
+
+    bf.add_hashes(_hash64(vals))
+    # no false negatives
+    assert bf.might_contain_hashes(_hash64(vals)).all()
+    # bounded false positives
+    others = rng.integers(2 * 10**12, 3 * 10**12, 5000).astype(np.int64)
+    fp = bf.might_contain_hashes(_hash64(others)).mean()
+    assert fp < 0.05
+
+
+def test_file_index_roundtrip(tmp_path):
+    io = LocalFileIO()
+    b = ColumnBatch.from_pydict(SCHEMA, {"k": [1, 2, 3], "v": [1.0, 2.0, 3.0], "s": ["x", "y", "z"]})
+    data_path = str(tmp_path / "data.parquet")
+    idx = write_file_index(io, data_path, b, ["k", "s"], fpp=0.01)
+    assert idx == index_path(data_path)
+    fip = FileIndexPredicate(io, idx)
+    assert fip.test(equal("k", 2))
+    assert not fip.test(equal("k", 999_999))
+    assert fip.test(equal("s", "y"))
+    assert not fip.test(equal("s", "nope"))
+    assert fip.test(in_("k", [999, 3]))
+    assert not fip.test(in_("k", [999, 998]))
+    # or-compound: either side may match
+    assert fip.test(or_(equal("k", 999_999), equal("s", "z")))
+    # non-equality predicates can't prune
+    assert fip.test(greater_than("k", 100))
+    # unindexed column can't prune
+    assert fip.test(equal("v", 123.0))

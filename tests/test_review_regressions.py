@@ -63,3 +63,76 @@ def test_string_pool_coverage_enforced():
     pool = np.array(["a", "c"], dtype=object)
     with pytest.raises(ValueError, match="missing from pool"):
         encode_key_lanes(b, ["s"], {"s": pool})
+
+
+def test_nan_stats_do_not_prune():
+    from paimon_tpu.format import collect_stats
+    from paimon_tpu.types import DOUBLE
+
+    b = ColumnBatch.from_pydict(RowType.of(("x", DOUBLE())), {"x": [1.0, float("nan"), 5.0]})
+    st = collect_stats(b)
+    assert st["x"].min == 1.0 and st["x"].max == 5.0
+    assert equal("x", 1.0).test_stats(st)
+
+
+def test_null_ordering_predicate_on_strings():
+    from paimon_tpu.data.predicate import less_than, between
+
+    b = ColumnBatch.from_pydict(RowType.of(("s", STRING())), {"s": ["a", None, "c"]})
+    assert less_than("s", "b").eval(b).tolist() == [True, False, False]
+    assert between("s", "b", "z").eval(b).tolist() == [False, False, True]
+
+
+def test_build_string_pool_all_empty():
+    from paimon_tpu.data.keys import build_string_pool
+
+    pool = build_string_pool([np.empty(0, dtype=object), np.empty(0, dtype=object)])
+    assert len(pool) == 0
+
+
+def test_unknown_null_count_keeps_is_null():
+    from paimon_tpu.data.predicate import FieldStats, is_null
+
+    st = {"a": FieldStats(1, 10, None, 100)}
+    assert is_null("a").test_stats(st)
+    assert equal("a", 5).test_stats(st)
+
+
+def test_try_overwrite_returns_and_cleans(tmp_path):
+    from paimon_tpu.fs import LocalFileIO
+
+    io = LocalFileIO()
+    p = str(tmp_path / "hint")
+    assert io.try_overwrite(p, b"1")
+    assert io.try_overwrite(p, b"2")
+    assert io.read_bytes(p) == b"2"
+    assert len(io.list_files(str(tmp_path))) == 1  # no temp litter
+
+
+def test_external_parquet_timestamp_decimal_pruning(tmp_path):
+    import datetime
+    from decimal import Decimal
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from paimon_tpu.format import get_format
+    from paimon_tpu.data.predicate import greater_than
+    from paimon_tpu.fs import LocalFileIO
+    from paimon_tpu.types import DECIMAL, TIMESTAMP
+
+    t = pa.table(
+        {
+            "ts": pa.array([datetime.datetime(2024, 1, 1), datetime.datetime(2024, 6, 1)], pa.timestamp("us")),
+            "d": pa.array([Decimal("1.23"), Decimal("99.50")], pa.decimal128(18, 2)),
+        }
+    )
+    p = str(tmp_path / "ext.parquet")
+    pq.write_table(t, p)
+    schema = RowType.of(("ts", TIMESTAMP()), ("d", DECIMAL(18, 2)))
+    fmt = get_format("parquet")
+    micros_2024_03 = int(datetime.datetime(2024, 3, 1).timestamp() * 1e6)
+    out = list(fmt.read(LocalFileIO(), p, schema, predicate=greater_than("ts", micros_2024_03)))
+    assert sum(b.num_rows for b in out) == 2  # row group kept (contains one match)
+    out2 = list(fmt.read(LocalFileIO(), p, schema, predicate=greater_than("d", 500)))  # unscaled 5.00
+    assert sum(b.num_rows for b in out2) == 2  # 99.50 -> 9950 > 500: kept, not wrongly pruned
