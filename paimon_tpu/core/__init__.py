@@ -1,0 +1,16 @@
+"""L3: the LSM core — merge-tree, compaction, manifests, snapshots, commit.
+
+Capability parity map (reference /root/reference/paimon-core/):
+  kv.py        KeyValue batch model            KeyValue.java:44
+  datafile.py  DataFileMeta, file read/write   io/DataFileMeta.java:54, io/KeyValue*
+  mergefn.py   merge-engine orchestration      mergetree/compact/MergeFunction.java
+  levels.py    Levels/SortedRun/sections       mergetree/Levels.java:38, IntervalPartition.java:33
+  writer.py    memtable + MergeTreeWriter      mergetree/MergeTreeWriter.java:57
+  compact.py   universal compaction            mergetree/compact/UniversalCompaction.java:42
+  manifest.py  manifest tree                   manifest/ManifestFile.java:48
+  snapshot.py  snapshots + expiry              Snapshot.java:68, utils/SnapshotManager.java:55
+  schema.py    schema + evolution              schema/SchemaManager.java:76, SchemaEvolutionUtil.java:54
+  commit.py    CAS commit protocol             operation/FileStoreCommitImpl.java:219
+  scan.py      snapshot scan planning          operation/AbstractFileStoreScan.java:221
+  read.py      merge-on-read execution         operation/MergeFileSplitRead.java
+"""

@@ -1,0 +1,254 @@
+"""The snapshot-CAS commit protocol.
+
+Parity: /root/reference/paimon-core/.../operation/FileStoreCommitImpl.java
+(:219 commit, :202-207 filterCommitted via latestSnapshotOfUser, :678 tryCommit
+loop, :774 tryCommitOnce, :843-852 manifest merging, :942 atomic snapshot
+write, :917 cleanUpTmpManifests) and table/sink/TableCommitImpl.java:183
+(filterAndCommit idempotent replay).
+
+One logical commit produces up to two snapshots: APPEND (the writers' new
+level-0 files + input changelog) then COMPACT (compaction before/after), same
+as the reference — so a crashed commit retried after the APPEND snapshot only
+re-applies the missing COMPACT part via commit-identifier filtering.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from ..fs import FileIO
+from ..options import CoreOptions
+from ..utils import now_millis
+from .manifest import (
+    CommitMessage,
+    FileKind,
+    ManifestCommittable,
+    ManifestEntry,
+    ManifestFile,
+    ManifestFileMeta,
+    ManifestList,
+    merge_entries,
+    merge_entries_keep_deletes,
+)
+from .snapshot import CommitKind, Snapshot, SnapshotManager
+
+__all__ = ["FileStoreCommit", "CommitConflictError"]
+
+
+class CommitConflictError(RuntimeError):
+    pass
+
+
+class FileStoreCommit:
+    def __init__(
+        self,
+        file_io: FileIO,
+        table_path: str,
+        commit_user: str,
+        schema_id: int,
+        options: CoreOptions | None = None,
+    ):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.commit_user = commit_user
+        self.schema_id = schema_id
+        self.options = options or CoreOptions()
+        self.snapshot_manager = SnapshotManager(file_io, table_path)
+        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
+        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
+
+    # ---- idempotence ----------------------------------------------------
+    def filter_committed(self, committables: Sequence[ManifestCommittable]) -> list[ManifestCommittable]:
+        """Drop committables whose identifier this user already committed
+        (crash-replay safety; reference FileStoreCommit.filterCommitted)."""
+        latest_of_user = self.snapshot_manager.latest_snapshot_of_user(self.commit_user)
+        if latest_of_user is None:
+            return list(committables)
+        done = latest_of_user.commit_identifier
+        out: list[ManifestCommittable] = []
+        for c in committables:
+            if c.commit_identifier > done:
+                out.append(c)
+            elif c.commit_identifier == done:
+                # the APPEND snapshot landed; keep the committable if its
+                # COMPACT phase is still missing (commit() will skip APPEND)
+                has_compact = any(m.compact_before or m.compact_after for m in c.messages)
+                if has_compact:
+                    kinds = {
+                        s.commit_kind
+                        for s in self.snapshot_manager.snapshots_of_user_with_identifier(
+                            self.commit_user, c.commit_identifier
+                        )
+                    }
+                    if CommitKind.COMPACT not in kinds:
+                        out.append(c)
+        return out
+
+    # ---- commit ---------------------------------------------------------
+    def commit(self, committable: ManifestCommittable) -> list[int]:
+        """Returns the snapshot ids written (0, 1, or 2)."""
+        append_entries: list[ManifestEntry] = []
+        compact_entries: list[ManifestEntry] = []
+        for msg in committable.messages:
+            for f in msg.new_files:
+                append_entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
+            for f in msg.compact_before:
+                compact_entries.append(ManifestEntry(FileKind.DELETE, msg.partition, msg.bucket, msg.total_buckets, f))
+            for f in msg.compact_after:
+                compact_entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
+        # crash-replay: if this identifier already produced some snapshots,
+        # re-apply only the missing phase (APPEND landed, COMPACT did not)
+        done_kinds = {
+            s.commit_kind
+            for s in self.snapshot_manager.snapshots_of_user_with_identifier(
+                self.commit_user, committable.commit_identifier
+            )
+        }
+        written: list[int] = []
+        if CommitKind.APPEND not in done_kinds and (append_entries or not compact_entries):
+            written.append(
+                self._try_commit(CommitKind.APPEND, append_entries, committable, check_conflicts=False)
+            )
+        if compact_entries and CommitKind.COMPACT not in done_kinds:
+            written.append(
+                self._try_commit(CommitKind.COMPACT, compact_entries, committable, check_conflicts=True)
+            )
+        return [w for w in written if w >= 0]
+
+    def overwrite(
+        self,
+        committable: ManifestCommittable,
+        partition_filter: Callable[[tuple], bool] | None = None,
+    ) -> list[int]:
+        """INSERT OVERWRITE: logically delete current files (of the matching
+        partitions), then add the new ones, in one OVERWRITE snapshot."""
+        latest = self.snapshot_manager.latest_snapshot()
+        entries: list[ManifestEntry] = []
+        if latest is not None:
+            for e in self._live_entries(latest):
+                if partition_filter is None or partition_filter(e.partition):
+                    entries.append(ManifestEntry(FileKind.DELETE, e.partition, e.bucket, e.total_buckets, e.file))
+        for msg in committable.messages:
+            for f in msg.new_files:
+                entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
+        return [self._try_commit(CommitKind.OVERWRITE, entries, committable, check_conflicts=False)]
+
+    # ---- internals ------------------------------------------------------
+    def _live_entries(self, snapshot: Snapshot) -> list[ManifestEntry]:
+        metas = self.manifest_list.read(snapshot.base_manifest_list) + self.manifest_list.read(
+            snapshot.delta_manifest_list
+        )
+        return merge_entries(*(self.manifest_file.read(m.file_name) for m in metas))
+
+    def _try_commit(
+        self,
+        kind: CommitKind,
+        entries: list[ManifestEntry],
+        committable: ManifestCommittable,
+        check_conflicts: bool,
+    ) -> int:
+        retries = 0
+        while True:
+            latest = self.snapshot_manager.latest_snapshot()
+            if check_conflicts and latest is not None:
+                self._no_conflicts_or_fail(latest, entries)
+            tmp_files: list[str] = []
+            try:
+                snapshot_id = (latest.id + 1) if latest else 1
+                base_metas = (
+                    self.manifest_list.read(latest.base_manifest_list)
+                    + self.manifest_list.read(latest.delta_manifest_list)
+                    if latest
+                    else []
+                )
+                base_metas = self._maybe_merge_manifests(base_metas, tmp_files)
+                delta_meta = self.manifest_file.write(entries, self.schema_id)
+                tmp_files.append(delta_meta.file_name)
+                base_name = self.manifest_list.write(base_metas)
+                tmp_files.append(base_name)
+                delta_name = self.manifest_list.write([delta_meta])
+                tmp_files.append(delta_name)
+                added = sum(e.file.row_count for e in entries if e.kind == FileKind.ADD)
+                deleted = sum(e.file.row_count for e in entries if e.kind == FileKind.DELETE)
+                prev_total = (latest.total_record_count or 0) if latest else 0
+                snapshot = Snapshot(
+                    id=snapshot_id,
+                    schema_id=self.schema_id,
+                    base_manifest_list=base_name,
+                    delta_manifest_list=delta_name,
+                    changelog_manifest_list=None,
+                    commit_user=self.commit_user,
+                    commit_identifier=committable.commit_identifier,
+                    commit_kind=kind,
+                    time_millis=now_millis(),
+                    total_record_count=prev_total + added - deleted,
+                    delta_record_count=added - deleted,
+                    watermark=committable.watermark,
+                    log_offsets=dict(committable.log_offsets),
+                )
+                path = self.snapshot_manager.snapshot_path(snapshot_id)
+                if self.file_io.try_atomic_write(path, snapshot.to_json().encode()):
+                    # committed: the snapshot now references these manifests —
+                    # they must never be cleaned up, even if hints fail
+                    tmp_files.clear()
+                    try:
+                        self.snapshot_manager.commit_latest_hint(snapshot_id)
+                        if snapshot_id == 1:
+                            self.snapshot_manager.commit_earliest_hint(1)
+                    except Exception:
+                        pass  # hints are best-effort; listing is authoritative
+                    return snapshot_id
+                # lost the race: clean tmp metadata and retry against new latest
+                self._cleanup(tmp_files)
+                retries += 1
+            except CommitConflictError:
+                raise
+            except Exception:
+                self._cleanup(tmp_files)
+                raise
+
+    def _no_conflicts_or_fail(self, latest: Snapshot, entries: list[ManifestEntry]) -> None:
+        """Every file we logically delete must still be live (reference
+        noConflictsOrFail :804-808 — a concurrent compaction removing the same
+        files is a conflict; the loser abandons its compaction)."""
+        deletes = [e for e in entries if e.kind == FileKind.DELETE]
+        if not deletes:
+            return
+        live = {(e.partition, e.bucket, e.file.file_name) for e in self._live_entries(latest)}
+        for e in deletes:
+            if (e.partition, e.bucket, e.file.file_name) not in live:
+                raise CommitConflictError(
+                    f"file {e.file.file_name} (partition={e.partition}, bucket={e.bucket}) "
+                    f"was removed by a concurrent commit; giving up this compaction"
+                )
+
+    def _maybe_merge_manifests(
+        self, metas: list[ManifestFileMeta], tmp_files: list[str]
+    ) -> list[ManifestFileMeta]:
+        """Compact many small manifests into fewer big ones (reference
+        ManifestFileMeta.merge at commit :843-852)."""
+        min_count = self.options.options.get(CoreOptions.MANIFEST_MERGE_MIN_COUNT)
+        target = int(self.options.options.get(CoreOptions.MANIFEST_TARGET_SIZE))
+        small = [m for m in metas if m.file_size < target]
+        if len(small) < min_count:
+            return metas
+        big = [m for m in metas if m.file_size >= target]
+        entries = merge_entries_keep_deletes(*(self.manifest_file.read(m.file_name) for m in small))
+        out = list(big)
+        if entries:
+            # chunk to roughly target size (estimate ~400 compressed bytes/entry)
+            per_file = max(1, target // 400)
+            for i in range(0, len(entries), per_file):
+                meta = self.manifest_file.write(entries[i : i + per_file], self.schema_id)
+                tmp_files.append(meta.file_name)
+                out.append(meta)
+        return out
+
+    def _cleanup(self, names: list[str]) -> None:
+        for name in names:
+            try:
+                self.file_io.delete(f"{self.table_path}/manifest/{name}")
+            except Exception:
+                pass
+        names.clear()

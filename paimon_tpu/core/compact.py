@@ -1,0 +1,217 @@
+"""LSM compaction: universal strategy, upgrade-vs-rewrite tasks, rewriter.
+
+Parity: /root/reference/paimon-core/.../mergetree/compact/ —
+  UniversalCompaction.java:42 (RocksDB-style: size-amplification trigger
+  pickForSizeAmp:114, size-ratio pickForSizeRatio:150, run-count trigger
+  pick:100-108, optional full-compact interval :73-80),
+  MergeTreeCompactManager.java:67 (triggerCompaction:115-176, dropDelete rule
+  :148-158), MergeTreeCompactTask.java:40 (doCompact:77-105 partitions the
+  unit into sections, *upgrades* large non-overlapping files vs *rewrites*
+  overlapping/small ones), MergeTreeCompactRewriter.java:76-84 (rewrite =
+  the same merge kernel as the read path + rolling writer at outputLevel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from ..options import CoreOptions
+from ..utils import now_millis
+from .datafile import DataFileMeta, KeyValueFileReaderFactory, KeyValueFileWriterFactory
+from .kv import KVBatch
+from .levels import IntervalPartition, Levels, SortedRun
+from .mergefn import MergeExecutor
+
+__all__ = ["CompactUnit", "CompactResult", "UniversalCompaction", "MergeTreeCompactRewriter", "MergeTreeCompactManager"]
+
+
+@dataclass
+class CompactUnit:
+    output_level: int
+    files: list[DataFileMeta]
+    file_num_based: bool = False
+
+
+@dataclass
+class CompactResult:
+    before: list[DataFileMeta] = field(default_factory=list)
+    after: list[DataFileMeta] = field(default_factory=list)
+    changelog: list[DataFileMeta] = field(default_factory=list)
+
+    def is_empty(self) -> bool:
+        return not self.before and not self.after
+
+
+class UniversalCompaction:
+    """Pick which sorted runs to compact (reference UniversalCompaction)."""
+
+    def __init__(
+        self,
+        max_size_amp_percent: int = 200,
+        size_ratio_percent: int = 1,
+        num_run_compaction_trigger: int = 5,
+        optimization_interval_millis: int | None = None,
+    ):
+        self.max_size_amp = max_size_amp_percent
+        self.size_ratio = size_ratio_percent
+        self.num_run_trigger = num_run_compaction_trigger
+        self.opt_interval = optimization_interval_millis
+        self._last_opt_millis = now_millis()
+
+    def pick(self, num_levels: int, runs: list[tuple[int, SortedRun]]) -> CompactUnit | None:
+        max_level = num_levels - 1
+        if self.opt_interval is not None and now_millis() - self._last_opt_millis >= self.opt_interval:
+            self._last_opt_millis = now_millis()
+            return self._unit(runs, max_level, len(runs))
+        # 1. size amplification
+        unit = self._pick_size_amp(max_level, runs)
+        if unit is not None:
+            return unit
+        # 2. size ratio
+        unit = self._pick_size_ratio(max_level, runs)
+        if unit is not None:
+            return unit
+        # 3. run count
+        if len(runs) > self.num_run_trigger:
+            candidate = len(runs) - self.num_run_trigger + 1
+            return self._unit(runs, max_level, candidate, file_num_based=True)
+        return None
+
+    def _pick_size_amp(self, max_level: int, runs) -> CompactUnit | None:
+        if len(runs) <= self.num_run_trigger:
+            return None
+        candidate = sum(r.total_size() for _, r in runs[:-1])
+        earliest = runs[-1][1].total_size()
+        if earliest and candidate * 100 / earliest >= self.max_size_amp:
+            return self._unit(runs, max_level, len(runs))
+        return None
+
+    def _pick_size_ratio(self, max_level: int, runs) -> CompactUnit | None:
+        if len(runs) <= self.num_run_trigger:
+            return None
+        candidate_size = runs[0][1].total_size()
+        count = 1
+        for lv, run in runs[1:]:
+            if candidate_size * (100.0 + self.size_ratio) / 100.0 < run.total_size():
+                break
+            candidate_size += run.total_size()
+            count += 1
+        if count > 1:
+            return self._unit(runs, max_level, count)
+        return None
+
+    @staticmethod
+    def _unit(runs, max_level: int, count: int, file_num_based: bool = False) -> CompactUnit:
+        if count == len(runs):
+            output = max_level
+        else:
+            output = max(1, runs[count][0] - 1)
+        files = [f for _, r in runs[:count] for f in r.files]
+        return CompactUnit(output, files, file_num_based)
+
+    def force_full(self, num_levels: int, runs) -> CompactUnit | None:
+        return self._unit(runs, num_levels - 1, len(runs)) if runs else None
+
+
+class MergeTreeCompactRewriter:
+    """Merge-read the unit's sections and rewrite at the output level —
+    the same kernel as the read path."""
+
+    def __init__(
+        self,
+        reader_factory: KeyValueFileReaderFactory,
+        writer_factory: KeyValueFileWriterFactory,
+        merge_executor: MergeExecutor,
+    ):
+        self.reader_factory = reader_factory
+        self.writer_factory = writer_factory
+        self.merge = merge_executor
+
+    def rewrite(self, sections: list[list[SortedRun]], output_level: int, drop_delete: bool) -> list[DataFileMeta]:
+        out: list[DataFileMeta] = []
+        for section in sections:
+            batches = []
+            for run in section:
+                for f in run.files:
+                    batches.append(self.reader_factory.read(f))
+            kv = KVBatch.concat(batches)
+            merged = self.merge.merge(kv)
+            if drop_delete:
+                merged = merged.drop_deletes()
+            out.extend(self.writer_factory.write(merged, output_level, file_source="compact"))
+        return out
+
+    def upgrade(self, file: DataFileMeta, output_level: int) -> DataFileMeta:
+        return file.upgrade(output_level)
+
+
+class MergeTreeCompactManager:
+    """Decides when and what to compact for one bucket's Levels. Execution is
+    synchronous-on-demand here (deterministic); the async thread-pool offload
+    of the reference maps to the parallel runtime's bucket sharding instead."""
+
+    def __init__(
+        self,
+        levels: Levels,
+        strategy: UniversalCompaction,
+        rewriter: MergeTreeCompactRewriter,
+        options: CoreOptions,
+    ):
+        self.levels = levels
+        self.strategy = strategy
+        self.rewriter = rewriter
+        self.options = options
+
+    def should_wait_for_compaction(self) -> bool:
+        return self.levels.number_of_sorted_runs() > self.options.num_sorted_runs_stop_trigger
+
+    def trigger_compaction(self, full: bool = False) -> CompactResult | None:
+        runs = self.levels.level_sorted_runs()
+        if full:
+            unit = self.strategy.force_full(self.levels.num_levels, runs)
+        else:
+            unit = self.strategy.pick(self.levels.num_levels, runs)
+        if unit is None or not unit.files:
+            return None
+        # drop deletes iff the output is the highest non-empty level's floor
+        # (reference MergeTreeCompactManager.triggerCompaction :148-158)
+        drop_delete = unit.output_level != 0 and unit.output_level >= self.levels.non_empty_highest_level()
+        result = self._do_compact(unit, drop_delete)
+        if result is not None and not result.is_empty():
+            self.levels.update(result.before, result.after)
+        return result
+
+    def _do_compact(self, unit: CompactUnit, drop_delete: bool) -> CompactResult:
+        """Upgrade-vs-rewrite (reference MergeTreeCompactTask.doCompact)."""
+        result = CompactResult()
+        sections = IntervalPartition(unit.files).partition()
+        rewrite_sections: list[list[SortedRun]] = []
+        min_rewrite_size = self.options.target_file_size  # files below target get merged together
+        for section in sections:
+            if len(section) == 1:
+                for f in section[0].files:
+                    if self._can_upgrade(f, unit.output_level, drop_delete, min_rewrite_size):
+                        if f.level != unit.output_level:
+                            up = self.rewriter.upgrade(f, unit.output_level)
+                            result.before.append(f)
+                            result.after.append(up)
+                        # same level: untouched
+                    else:
+                        rewrite_sections.append([SortedRun([f])])
+            else:
+                rewrite_sections.append(section)
+        if rewrite_sections:
+            flat_before = [f for sec in rewrite_sections for r in sec for f in r.files]
+            after = self.rewriter.rewrite(rewrite_sections, unit.output_level, drop_delete)
+            result.before.extend(flat_before)
+            result.after.extend(after)
+        return result
+
+    @staticmethod
+    def _can_upgrade(f: DataFileMeta, output_level: int, drop_delete: bool, min_size: int) -> bool:
+        if f.level == 0 and f.file_size < min_size:
+            return False  # merge small level-0 files together
+        if drop_delete and f.delete_row_count > 0:
+            return False  # must rewrite to physically drop deletes at top level
+        return True

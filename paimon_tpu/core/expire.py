@@ -1,0 +1,120 @@
+"""Snapshot expiration: retention windows + safe physical deletion.
+
+Parity: /root/reference/paimon-core/.../operation/ExpireSnapshotsImpl +
+SnapshotDeletion — expire snapshots outside (num-retained-min/max,
+time-retained), then delete data files and manifests referenced only by the
+expired snapshots. Protected snapshots (tags, consumers) are excluded via the
+`protected_ids` provider.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ..fs import FileIO
+from ..options import CoreOptions
+from ..utils import now_millis
+from .manifest import ManifestFile, ManifestList, merge_entries
+from .snapshot import Snapshot, SnapshotManager
+
+__all__ = ["SnapshotExpire"]
+
+
+class SnapshotExpire:
+    def __init__(
+        self,
+        file_io: FileIO,
+        table_path: str,
+        options: CoreOptions | None = None,
+        protected_ids: Callable[[], Iterable[int]] | None = None,
+        partition_keys: Iterable[str] = (),
+    ):
+        self._partition_keys = tuple(partition_keys)
+        self.file_io = file_io
+        self.table_path = table_path
+        self.options = options or CoreOptions()
+        self.snapshot_manager = SnapshotManager(file_io, table_path)
+        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
+        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
+        self.protected_ids = protected_ids or (lambda: ())
+
+    def expire(self) -> int:
+        sm = self.snapshot_manager
+        latest = sm.latest_snapshot_id()
+        earliest = sm.earliest_snapshot_id()
+        if latest is None or earliest is None:
+            return 0
+        retained_min = self.options.snapshot_num_retained_min
+        retained_max = self.options.snapshot_num_retained_max
+        time_retained = self.options.snapshot_time_retained_ms
+        # the newest id that may be expired (exclusive end of expiry range)
+        end = max(earliest, latest - retained_max + 1)
+        # time rule can push further, bounded by retained_min
+        time_bound = max(earliest, latest - retained_min + 1)
+        cutoff = now_millis() - time_retained
+        for sid in range(end, time_bound):
+            if sm.snapshot_exists(sid) and sm.snapshot(sid).time_millis < cutoff:
+                end = sid + 1
+            else:
+                break
+        protected = set(self.protected_ids())
+        expire_ids = [i for i in range(earliest, end) if i not in protected and sm.snapshot_exists(i)]
+        if not expire_ids:
+            return 0
+        retained_ids = [i for i in range(earliest, latest + 1) if i not in expire_ids and sm.snapshot_exists(i)]
+
+        live_files: set[tuple] = set()
+        live_manifests: set[str] = set()
+        for sid in retained_ids:
+            snap = sm.snapshot(sid)
+            for name, entries in self._snapshot_manifests(snap):
+                live_manifests.add(name)
+                for e in entries:
+                    live_files.add((e.partition, e.bucket, e.file.file_name))
+            live_manifests.add(snap.base_manifest_list)
+            live_manifests.add(snap.delta_manifest_list)
+            if snap.changelog_manifest_list:
+                live_manifests.add(snap.changelog_manifest_list)
+
+        dead_manifests: set[str] = set()
+        dead_files: set[tuple] = set()
+        for sid in expire_ids:
+            snap = sm.snapshot(sid)
+            for name, entries in self._snapshot_manifests(snap):
+                if name not in live_manifests:
+                    dead_manifests.add(name)
+                for e in entries:
+                    key = (e.partition, e.bucket, e.file.file_name)
+                    if key not in live_files:
+                        dead_files.add((key, e.file.extra_files))
+            for lst in (snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list):
+                if lst and lst not in live_manifests:
+                    dead_manifests.add(lst)
+
+        from ..utils import partition_path
+
+        for (partition, bucket, file_name), extra in dead_files:
+            # partition path needs key names; data dirs embed them already —
+            # bucket dirs are resolved by the store layer convention
+            pp = self._bucket_dir(partition, bucket)
+            self.file_io.delete(f"{pp}/{file_name}")
+            for x in extra:
+                self.file_io.delete(f"{pp}/{x}")
+        for name in dead_manifests:
+            self.file_io.delete(f"{self.table_path}/manifest/{name}")
+        for sid in expire_ids:
+            self.file_io.delete(sm.snapshot_path(sid))
+        sm.commit_earliest_hint(end)
+        return len(expire_ids)
+
+    def _snapshot_manifests(self, snap: Snapshot):
+        for lst in (snap.base_manifest_list, snap.delta_manifest_list):
+            for meta in self.manifest_list.read(lst):
+                yield meta.file_name, self.manifest_file.read(meta.file_name)
+
+    def _bucket_dir(self, partition: tuple, bucket: int) -> str:
+        from ..utils import partition_path
+
+        pp = partition_path(self._partition_keys, partition)
+        base = f"{self.table_path}/{pp}" if pp else self.table_path
+        return f"{base}/bucket-{bucket}"

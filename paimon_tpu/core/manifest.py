@@ -1,0 +1,194 @@
+"""The manifest metadata tree.
+
+Parity: /root/reference/paimon-core/.../manifest/ — ManifestEntry (ADD/DELETE
+of a DataFileMeta at (partition, bucket)), ManifestFile.java:48,
+ManifestFileMeta.java:54 (+ merge() small-manifest compaction at commit),
+ManifestList, ManifestCommittable (per-checkpoint committable), and
+sink/CommitMessage. Storage is zstd-compressed JSON-lines (the reference uses
+Avro; the logical content is identical — metadata is host-side and tiny
+relative to data, so the container format is not a hot path).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+import zstandard
+
+from ..fs import FileIO
+from ..utils import dumps, loads, new_file_name
+from .datafile import DataFileMeta
+
+__all__ = [
+    "FileKind",
+    "ManifestEntry",
+    "ManifestFileMeta",
+    "ManifestFile",
+    "ManifestList",
+    "CommitMessage",
+    "ManifestCommittable",
+    "merge_entries",
+]
+
+
+class FileKind(int, enum.Enum):
+    ADD = 0
+    DELETE = 1
+
+
+@dataclass(frozen=True)
+class ManifestEntry:
+    kind: FileKind
+    partition: tuple
+    bucket: int
+    total_buckets: int
+    file: DataFileMeta
+
+    def identifier(self) -> tuple:
+        return (self.partition, self.bucket, self.file.level, self.file.file_name)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": int(self.kind),
+            "partition": list(self.partition),
+            "bucket": self.bucket,
+            "totalBuckets": self.total_buckets,
+            "file": self.file.to_dict(),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ManifestEntry":
+        return ManifestEntry(
+            FileKind(d["kind"]), tuple(d["partition"]), d["bucket"], d["totalBuckets"], DataFileMeta.from_dict(d["file"])
+        )
+
+
+@dataclass(frozen=True)
+class ManifestFileMeta:
+    file_name: str
+    file_size: int
+    num_added_files: int
+    num_deleted_files: int
+    schema_id: int
+
+    def to_dict(self) -> dict:
+        return {
+            "fileName": self.file_name,
+            "fileSize": self.file_size,
+            "numAddedFiles": self.num_added_files,
+            "numDeletedFiles": self.num_deleted_files,
+            "schemaId": self.schema_id,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ManifestFileMeta":
+        return ManifestFileMeta(d["fileName"], d["fileSize"], d["numAddedFiles"], d["numDeletedFiles"], d["schemaId"])
+
+
+class _JsonlZst:
+    def __init__(self, file_io: FileIO, directory: str):
+        self.file_io = file_io
+        self.directory = directory
+
+    def _write_lines(self, name: str, dicts: Iterable[dict]) -> int:
+        raw = "\n".join(dumps(d) for d in dicts).encode()
+        data = zstandard.ZstdCompressor(level=3).compress(raw)
+        path = f"{self.directory}/{name}"
+        self.file_io.write_bytes(path, data)
+        return len(data)
+
+    def _read_lines(self, name: str) -> list[dict]:
+        data = self.file_io.read_bytes(f"{self.directory}/{name}")
+        raw = zstandard.ZstdDecompressor().decompress(data)
+        return [loads(line) for line in raw.decode().splitlines() if line]
+
+    def delete(self, name: str) -> None:
+        self.file_io.delete(f"{self.directory}/{name}")
+
+
+class ManifestFile(_JsonlZst):
+    """Reads/writes manifest files (lists of ManifestEntry)."""
+
+    def write(self, entries: Sequence[ManifestEntry], schema_id: int) -> ManifestFileMeta:
+        name = new_file_name("manifest")
+        size = self._write_lines(name, (e.to_dict() for e in entries))
+        added = sum(1 for e in entries if e.kind == FileKind.ADD)
+        return ManifestFileMeta(name, size, added, len(entries) - added, schema_id)
+
+    def read(self, name: str) -> list[ManifestEntry]:
+        return [ManifestEntry.from_dict(d) for d in self._read_lines(name)]
+
+
+class ManifestList(_JsonlZst):
+    """Reads/writes manifest lists (lists of ManifestFileMeta)."""
+
+    def write(self, metas: Sequence[ManifestFileMeta]) -> str:
+        name = new_file_name("manifest-list")
+        self._write_lines(name, (m.to_dict() for m in metas))
+        return name
+
+    def read(self, name: str) -> list[ManifestFileMeta]:
+        return [ManifestFileMeta.from_dict(d) for d in self._read_lines(name)]
+
+
+def merge_entries(*entry_lists: Iterable[ManifestEntry]) -> list[ManifestEntry]:
+    """Apply DELETE entries against ADDs in order (reference
+    FileEntry.mergeEntries): the live set is ADDs not later DELETEd."""
+    live: dict[tuple, ManifestEntry] = {}
+    for entries in entry_lists:
+        for e in entries:
+            key = e.identifier()
+            if e.kind == FileKind.ADD:
+                live[key] = e
+            else:
+                live.pop(key, None)
+    return list(live.values())
+
+
+def merge_entries_keep_deletes(*entry_lists: Iterable[ManifestEntry]) -> list[ManifestEntry]:
+    """Like merge_entries, but a DELETE whose ADD is *outside* the merged set
+    survives — required when compacting a subset of manifests, else the DELETE
+    is lost and the ADD in an untouched manifest resurrects a dead file
+    (reference ManifestFileMeta.merge keeps unmatched deletes the same way)."""
+    live: dict[tuple, ManifestEntry] = {}
+    deletes: dict[tuple, ManifestEntry] = {}
+    for entries in entry_lists:
+        for e in entries:
+            key = e.identifier()
+            if e.kind == FileKind.ADD:
+                live[key] = e
+            elif key in live:
+                live.pop(key)  # add+delete cancel within the merged set
+            else:
+                deletes[key] = e
+    return list(deletes.values()) + list(live.values())
+
+
+@dataclass
+class CommitMessage:
+    """Per-(partition, bucket) file changes from one writer
+    (reference table/sink/CommitMessageImpl)."""
+
+    partition: tuple
+    bucket: int
+    total_buckets: int
+    new_files: list[DataFileMeta] = field(default_factory=list)
+    compact_before: list[DataFileMeta] = field(default_factory=list)
+    compact_after: list[DataFileMeta] = field(default_factory=list)
+    changelog_files: list[DataFileMeta] = field(default_factory=list)
+
+    def is_empty(self) -> bool:
+        return not (self.new_files or self.compact_before or self.compact_after or self.changelog_files)
+
+
+@dataclass
+class ManifestCommittable:
+    """Everything one commit needs (reference manifest/ManifestCommittable:
+    commitIdentifier, watermark, logOffsets, commit messages)."""
+
+    commit_identifier: int
+    watermark: int | None = None
+    log_offsets: dict[int, int] = field(default_factory=dict)
+    messages: list[CommitMessage] = field(default_factory=list)

@@ -1,0 +1,146 @@
+"""Merge-engine orchestration over the device kernel.
+
+Parity: /root/reference/paimon-core/.../mergetree/compact/MergeFunction.java
+hierarchy — DeduplicateMergeFunction, FirstRowMergeFunction,
+PartialUpdateMergeFunction.java:57, AggregateMergeFunction + factories.
+One MergeExecutor call is the batch equivalent of feeding every same-key group
+through the reference's reset/add/getResult loop: encode keys, run the sort
+plan on device, apply the engine as segment selections/reductions, and emit
+one key-sorted output row per key.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..data.batch import Column, ColumnBatch
+from ..data.keys import build_string_pool, encode_key_lanes, split_int64_lanes
+from ..options import CoreOptions, MergeEngine
+from ..ops import (
+    AggregateSpec,
+    aggregate_merge,
+    deduplicate_take,
+    first_row_take,
+    merge_plan,
+    partial_update_takes,
+)
+from ..ops.aggregates import _gather_column
+from ..types import RowKind, RowType, TypeRoot
+from .kv import KVBatch
+
+__all__ = ["MergeExecutor"]
+
+
+class MergeExecutor:
+    def __init__(
+        self,
+        value_schema: RowType,
+        key_names: Sequence[str],
+        engine: MergeEngine = MergeEngine.DEDUPLICATE,
+        options: CoreOptions | None = None,
+    ):
+        self.value_schema = value_schema
+        self.key_names = list(key_names)
+        self.engine = engine
+        self.options = options or CoreOptions()
+        self._string_keys = [
+            k
+            for k in self.key_names
+            if value_schema.field(k).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+        ]
+        self._user_seq = self.options.sequence_field
+
+    def _plan(self, kv: KVBatch):
+        pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
+        lanes = encode_key_lanes(kv.data, self.key_names, pools)
+        seq_parts = []
+        if self._user_seq:
+            # user-defined sequence fields order before the system seqno
+            # (reference: MergeSorter orders by (key, udsSeq, seqNumber))
+            useq_pools = {
+                f: build_string_pool([kv.data.column(f).values])
+                for f in self._user_seq
+                if kv.data.schema.field(f).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR)
+            }
+            seq_parts.append(encode_key_lanes(kv.data, self._user_seq, useq_pools))
+        hi, lo = split_int64_lanes(kv.seq)
+        seq_parts.append(np.stack([hi, lo], axis=1))
+        seq_lanes = np.concatenate(seq_parts, axis=1)
+        return merge_plan(lanes, seq_lanes)
+
+    def merge(self, kv: KVBatch) -> KVBatch:
+        """One output row per key, key-sorted. Dedup keeps the winning row's
+        RowKind (a -D survives compaction until the top level); partial-update
+        and aggregation emit +I rows."""
+        if kv.num_rows == 0:
+            return kv
+        if self.options.ignore_delete:
+            keep = kv.kind != int(RowKind.DELETE)
+            if not keep.all():
+                kv = kv.filter(keep)
+                if kv.num_rows == 0:
+                    return kv
+        plan = self._plan(kv)
+        if self.engine == MergeEngine.DEDUPLICATE:
+            return kv.take(deduplicate_take(plan))
+        if self.engine == MergeEngine.FIRST_ROW:
+            if np.isin(kv.kind, (int(RowKind.UPDATE_BEFORE), int(RowKind.DELETE))).any():
+                raise ValueError("first-row merge engine accepts only +I/+U records")
+            return kv.take(first_row_take(plan))
+
+        last_take = plan.perm[plan.keep_last & plan.valid_sorted]
+        out_seq = kv.seq.take(last_take)
+
+        if self.engine == MergeEngine.PARTIAL_UPDATE:
+            return self._partial_update(kv, plan, last_take, out_seq)
+        if self.engine == MergeEngine.AGGREGATE:
+            return self._aggregate(kv, plan, last_take, out_seq)
+        raise ValueError(f"unknown merge engine {self.engine}")
+
+    # ---- partial update -------------------------------------------------
+    def _partial_update(self, kv: KVBatch, plan, last_take, out_seq) -> KVBatch:
+        remove_on_delete = self.options.options.get(CoreOptions.PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE)
+        has_delete = np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE))).any()
+        if has_delete and not remove_on_delete:
+            raise ValueError(
+                "partial-update cannot handle -U/-D records; set "
+                "'partial-update.remove-record-on-delete' or 'ignore-delete'"
+            )
+        non_key = [f for f in self.value_schema.fields if f.name not in self.key_names]
+        field_valid = np.stack([kv.data.column(f.name).valid_mask() for f in non_key]) if non_key else np.zeros((0, kv.num_rows), np.bool_)
+        src, exists = partial_update_takes(plan, field_valid, kv.kind, remove_record_on_delete=remove_on_delete)
+        cols: dict[str, Column] = {}
+        for k in self.key_names:
+            cols[k] = kv.data.column(k).take(last_take)
+        for fi, f in enumerate(non_key):
+            cols[f.name] = _gather_column(kv.data.column(f.name), src[fi])
+        data = ColumnBatch(self.value_schema, cols)
+        kind = np.where(exists, int(RowKind.INSERT), int(RowKind.DELETE)).astype(np.uint8)
+        out = KVBatch(data, out_seq, kind)
+        if not exists.all() and not remove_on_delete:
+            out = out.filter(exists)
+        return out
+
+    # ---- aggregation ----------------------------------------------------
+    def _agg_spec(self, field_name: str) -> AggregateSpec:
+        fn = self.options.field_option(field_name, "aggregate-function")
+        if fn is None:
+            fn = self.options.options.get(CoreOptions.AGGREGATE_DEFAULT_FUNC) or "last_non_null_value"
+        ignore_retract = (self.options.field_option(field_name, "ignore-retract") or "false").lower() == "true"
+        delim = self.options.field_option(field_name, "list-agg-delimiter") or ","
+        distinct = (self.options.field_option(field_name, "distinct") or "false").lower() == "true"
+        return AggregateSpec(fn, ignore_retract, delim, distinct)
+
+    def _aggregate(self, kv: KVBatch, plan, last_take, out_seq) -> KVBatch:
+        cols: dict[str, Column] = {}
+        for k in self.key_names:
+            cols[k] = kv.data.column(k).take(last_take)
+        for f in self.value_schema.fields:
+            if f.name in self.key_names:
+                continue
+            cols[f.name] = aggregate_merge(plan, kv.data.column(f.name), self._agg_spec(f.name), kv.kind)
+        data = ColumnBatch(self.value_schema, cols)
+        kind = np.full(len(last_take), int(RowKind.INSERT), dtype=np.uint8)
+        return KVBatch(data, out_seq, kind)

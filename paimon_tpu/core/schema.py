@@ -1,0 +1,250 @@
+"""Table schemas: versioned, field-id based, with evolution.
+
+Parity: /root/reference/paimon-core/.../schema/ — TableSchema (versioned JSON
+with fields/ids, partition keys, primary keys, options), SchemaManager.java:76
+(commitChanges with optimistic CAS rename), SchemaChange ops (add/drop/rename/
+update column, set/remove option), SchemaValidation, SchemaEvolutionUtil.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Sequence
+
+from ..fs import FileIO
+from ..options import CoreOptions, Options
+from ..types import DataField, DataType, RowType, parse_type
+from ..utils import dumps, loads, now_millis
+from ..data.casting import can_cast
+
+__all__ = ["TableSchema", "SchemaManager", "SchemaChange"]
+
+
+@dataclass(frozen=True)
+class TableSchema:
+    id: int
+    fields: tuple[DataField, ...]
+    highest_field_id: int
+    partition_keys: tuple[str, ...]
+    primary_keys: tuple[str, ...]
+    options: dict[str, str]
+    comment: str | None = None
+    time_millis: int = 0
+
+    @property
+    def row_type(self) -> RowType:
+        return RowType(self.fields, nullable=False)
+
+    @property
+    def trimmed_primary_keys(self) -> list[str]:
+        """PK minus partition keys — the in-bucket merge key (reference
+        TableSchema.trimmedPrimaryKeys: partition values are constant within
+        a partition, so they don't discriminate)."""
+        trimmed = [k for k in self.primary_keys if k not in self.partition_keys]
+        return trimmed if trimmed else list(self.primary_keys)
+
+    @property
+    def bucket_keys(self) -> list[str]:
+        opt = self.options.get("bucket-key")
+        if opt:
+            return [s.strip() for s in opt.split(",")]
+        return self.trimmed_primary_keys if self.primary_keys else [f.name for f in self.fields]
+
+    def core_options(self) -> CoreOptions:
+        return CoreOptions(Options(dict(self.options)))
+
+    def to_json(self) -> str:
+        return dumps(
+            {
+                "version": 1,
+                "id": self.id,
+                "fields": [f.to_dict() for f in self.fields],
+                "highestFieldId": self.highest_field_id,
+                "partitionKeys": list(self.partition_keys),
+                "primaryKeys": list(self.primary_keys),
+                "options": self.options,
+                "comment": self.comment,
+                "timeMillis": self.time_millis,
+            }
+        )
+
+    @staticmethod
+    def from_json(s: str | bytes) -> "TableSchema":
+        d = loads(s)
+        return TableSchema(
+            id=d["id"],
+            fields=tuple(DataField.from_dict(f) for f in d["fields"]),
+            highest_field_id=d["highestFieldId"],
+            partition_keys=tuple(d["partitionKeys"]),
+            primary_keys=tuple(d["primaryKeys"]),
+            options=d["options"],
+            comment=d.get("comment"),
+            time_millis=d.get("timeMillis", 0),
+        )
+
+
+class SchemaChange:
+    """Declarative evolution ops (reference schema/SchemaChange.java)."""
+
+    @staticmethod
+    def add_column(name: str, dtype: DataType, description: str | None = None) -> dict:
+        return {"op": "add", "name": name, "type": dtype, "description": description}
+
+    @staticmethod
+    def drop_column(name: str) -> dict:
+        return {"op": "drop", "name": name}
+
+    @staticmethod
+    def rename_column(name: str, new_name: str) -> dict:
+        return {"op": "rename", "name": name, "newName": new_name}
+
+    @staticmethod
+    def update_column_type(name: str, dtype: DataType) -> dict:
+        return {"op": "updateType", "name": name, "type": dtype}
+
+    @staticmethod
+    def set_option(key: str, value: str) -> dict:
+        return {"op": "setOption", "key": key, "value": value}
+
+    @staticmethod
+    def remove_option(key: str) -> dict:
+        return {"op": "removeOption", "key": key}
+
+
+class SchemaManager:
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.schema_dir = f"{table_path}/schema"
+
+    def schema_path(self, schema_id: int) -> str:
+        return f"{self.schema_dir}/schema-{schema_id}"
+
+    def schema(self, schema_id: int) -> TableSchema:
+        return TableSchema.from_json(self.file_io.read_bytes(self.schema_path(schema_id)))
+
+    def _listed_ids(self) -> list[int]:
+        out = []
+        for st in self.file_io.list_files(self.schema_dir):
+            base = st.path.rsplit("/", 1)[-1]
+            if base.startswith("schema-"):
+                try:
+                    out.append(int(base[len("schema-") :]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest(self) -> TableSchema | None:
+        ids = self._listed_ids()
+        return self.schema(ids[-1]) if ids else None
+
+    def all_schemas(self) -> dict[int, TableSchema]:
+        return {i: self.schema(i) for i in self._listed_ids()}
+
+    # ---- creation & evolution ------------------------------------------
+    def create_table(
+        self,
+        row_type: RowType,
+        partition_keys: Sequence[str] = (),
+        primary_keys: Sequence[str] = (),
+        options: dict[str, str] | None = None,
+        comment: str | None = None,
+    ) -> TableSchema:
+        existing = self.latest()
+        if existing is not None:
+            return existing
+        self._validate(row_type, partition_keys, primary_keys)
+        fields = []
+        for i, f in enumerate(row_type.fields):
+            t = f.type
+            if f.name in primary_keys and t.nullable:
+                t = t.with_nullable(False)  # primary keys are NOT NULL
+            fields.append(DataField(i, f.name, t, f.description))
+        schema = TableSchema(
+            id=0,
+            fields=tuple(fields),
+            highest_field_id=len(fields) - 1,
+            partition_keys=tuple(partition_keys),
+            primary_keys=tuple(primary_keys),
+            options=dict(options or {}),
+            comment=comment,
+            time_millis=now_millis(),
+        )
+        if not self.file_io.try_atomic_write(self.schema_path(0), schema.to_json().encode()):
+            return self.latest()  # lost the race; adopt the winner
+        return schema
+
+    @staticmethod
+    def _validate(row_type: RowType, partition_keys: Sequence[str], primary_keys: Sequence[str]) -> None:
+        for k in list(partition_keys) + list(primary_keys):
+            if k not in row_type:
+                raise ValueError(f"key column {k!r} not in schema {row_type.field_names}")
+        if primary_keys and partition_keys:
+            missing = [p for p in partition_keys if p not in primary_keys]
+            if missing:
+                raise ValueError(
+                    f"primary key must contain all partition keys (missing {missing}) "
+                    f"— same constraint as the reference SchemaValidation"
+                )
+
+    def commit_changes(self, *changes: dict) -> TableSchema:
+        """Optimistic evolve-and-CAS loop (reference SchemaManager.commitChanges)."""
+        while True:
+            base = self.latest()
+            if base is None:
+                raise RuntimeError("no table schema to evolve")
+            evolved = self._apply(base, changes)
+            path = self.schema_path(evolved.id)
+            if self.file_io.try_atomic_write(path, evolved.to_json().encode()):
+                return evolved
+            # lost a race: retry against the new latest
+
+    def _apply(self, base: TableSchema, changes: Sequence[dict]) -> TableSchema:
+        fields = list(base.fields)
+        options = dict(base.options)
+        highest = base.highest_field_id
+        names = lambda: [f.name for f in fields]  # noqa: E731
+        for ch in changes:
+            op = ch["op"]
+            if op == "add":
+                if ch["name"] in names():
+                    raise ValueError(f"column {ch['name']} exists")
+                highest += 1
+                fields.append(DataField(highest, ch["name"], ch["type"], ch.get("description")))
+            elif op == "drop":
+                if ch["name"] in base.primary_keys or ch["name"] in base.partition_keys:
+                    raise ValueError(f"cannot drop key column {ch['name']}")
+                fields = [f for f in fields if f.name != ch["name"]]
+            elif op == "rename":
+                if ch["name"] in base.primary_keys or ch["name"] in base.partition_keys:
+                    raise ValueError(f"cannot rename key column {ch['name']}")
+                if ch["newName"] in names():
+                    raise ValueError(f"column {ch['newName']} exists")
+                fields = [
+                    replace(f, name=ch["newName"]) if f.name == ch["name"] else f for f in fields
+                ]
+            elif op == "updateType":
+                def upd(f: DataField) -> DataField:
+                    if f.name != ch["name"]:
+                        return f
+                    if not can_cast(f.type, ch["type"]):
+                        raise ValueError(f"cannot evolve {f.type.root} -> {ch['type'].root}")
+                    return replace(f, type=ch["type"])
+
+                fields = [upd(f) for f in fields]
+            elif op == "setOption":
+                options[ch["key"]] = ch["value"]
+            elif op == "removeOption":
+                options.pop(ch["key"], None)
+            else:
+                raise ValueError(f"unknown schema change {op}")
+        return TableSchema(
+            id=base.id + 1,
+            fields=tuple(fields),
+            highest_field_id=highest,
+            partition_keys=base.partition_keys,
+            primary_keys=base.primary_keys,
+            options=options,
+            comment=base.comment,
+            time_millis=now_millis(),
+        )

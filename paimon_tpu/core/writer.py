@@ -1,0 +1,135 @@
+"""The merge-tree writer: memtable, flush-through-merge, compaction hooks.
+
+Parity: /root/reference/paimon-core/.../mergetree/MergeTreeWriter.java:57 —
+assigns sequence numbers (:164), buffers into a sort buffer, flushes the
+buffer through the merge function into rolling level-0 files
+(flushWriteBuffer:209-260), triggers compaction, and accumulates the
+CommitIncrement returned by prepareCommit (:263-278).
+
+The memtable here is a list of column batches; "sorting the buffer" is the
+same device merge kernel used everywhere else — flush = merge(concat(buffer)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.batch import ColumnBatch
+from ..options import CoreOptions
+from ..types import RowKind
+from .compact import CompactResult, MergeTreeCompactManager
+from .datafile import DataFileMeta, KeyValueFileWriterFactory
+from .kv import KVBatch
+from .manifest import CommitMessage
+
+__all__ = ["MergeTreeWriter"]
+
+
+class MergeTreeWriter:
+    def __init__(
+        self,
+        partition: tuple,
+        bucket: int,
+        total_buckets: int,
+        writer_factory: KeyValueFileWriterFactory,
+        merge_executor,
+        compact_manager: MergeTreeCompactManager | None,
+        options: CoreOptions,
+        restored_max_seq: int = -1,
+    ):
+        self.partition = partition
+        self.bucket = bucket
+        self.total_buckets = total_buckets
+        self.writer_factory = writer_factory
+        self.merge = merge_executor
+        self.compact_manager = compact_manager
+        self.options = options
+        self.seq = restored_max_seq + 1
+        self._buffer: list[KVBatch] = []
+        self._buffered_rows = 0
+        self._new_files: list[DataFileMeta] = []
+        self._compact_before: list[DataFileMeta] = []
+        self._compact_after: list[DataFileMeta] = []
+        self._changelog: list[DataFileMeta] = []
+
+    # ---- ingest --------------------------------------------------------
+    def write(self, data: ColumnBatch, kinds: np.ndarray | None = None) -> None:
+        """Append a batch of rows; sequence numbers are assigned in arrival
+        order (MergeTreeWriter.write: newSequenceNumber per record)."""
+        n = data.num_rows
+        if n == 0:
+            return
+        kv = KVBatch.from_rows(data, self.seq, kinds)
+        self.seq += n
+        self._buffer.append(kv)
+        self._buffered_rows += n
+        if self._buffered_rows >= self.options.write_buffer_rows:
+            self.flush()
+
+    def write_kv(self, kv: KVBatch) -> None:
+        self._buffer.append(kv)
+        self.seq = max(self.seq, int(kv.seq.max()) + 1) if kv.num_rows else self.seq
+        self._buffered_rows += kv.num_rows
+        if self._buffered_rows >= self.options.write_buffer_rows:
+            self.flush()
+
+    # ---- flush ---------------------------------------------------------
+    def flush(self) -> None:
+        if not self._buffer:
+            return
+        kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
+        self._buffer.clear()
+        self._buffered_rows = 0
+        merged = self.merge.merge(kv)
+        files = self.writer_factory.write(merged, level=0, file_source="append")
+        self._new_files.extend(files)
+        if self.compact_manager is not None and not self.options.write_only:
+            for f in files:
+                self.compact_manager.levels.level0.insert(0, f)
+            self._maybe_compact()
+
+    def _maybe_compact(self, full: bool = False) -> None:
+        assert self.compact_manager is not None
+        result = self.compact_manager.trigger_compaction(full=full)
+        self._absorb(result)
+
+    def compact(self, full: bool = False) -> None:
+        """Explicit compaction (dedicated compact jobs / full-compaction)."""
+        self.flush()
+        if self.compact_manager is not None:
+            self._maybe_compact(full=full)
+
+    def _absorb(self, result: CompactResult | None) -> None:
+        if result is None or result.is_empty():
+            return
+        # cancel out files that this very commit created and then compacted
+        new_names = {f.file_name for f in self._new_files}
+        created_then_compacted = [f for f in result.before if f.file_name in new_names]
+        self._compact_before.extend(f for f in result.before if f.file_name not in new_names)
+        # files created and consumed within one commit still need ADD+DELETE
+        # to keep the manifest chain consistent — reference keeps both too
+        self._compact_before.extend(created_then_compacted)
+        self._compact_after.extend(result.after)
+        self._changelog.extend(result.changelog)
+
+    # ---- commit --------------------------------------------------------
+    def prepare_commit(self) -> CommitMessage:
+        self.flush()
+        msg = CommitMessage(
+            partition=self.partition,
+            bucket=self.bucket,
+            total_buckets=self.total_buckets,
+            new_files=list(self._new_files),
+            compact_before=list(self._compact_before),
+            compact_after=list(self._compact_after),
+            changelog_files=list(self._changelog),
+        )
+        self._new_files.clear()
+        self._compact_before.clear()
+        self._compact_after.clear()
+        self._changelog.clear()
+        return msg
+
+    @property
+    def max_sequence_number(self) -> int:
+        return self.seq - 1

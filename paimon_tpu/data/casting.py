@@ -1,0 +1,70 @@
+"""Runtime casts for schema evolution reads.
+
+Parity: /root/reference/paimon-common/.../casting/CastExecutors.java +
+CastedRow — when a data file was written under an older schema, its columns
+are cast to the current field types while reading. Vectorized: one numpy
+conversion per column, no per-row dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import DataType, TypeRoot
+from .batch import Column
+
+__all__ = ["cast_column", "can_cast"]
+
+_NUMERIC_ORDER = [
+    TypeRoot.TINYINT,
+    TypeRoot.SMALLINT,
+    TypeRoot.INT,
+    TypeRoot.BIGINT,
+    TypeRoot.FLOAT,
+    TypeRoot.DOUBLE,
+]
+
+
+def can_cast(src: DataType, dst: DataType) -> bool:
+    """Only *widening* casts are allowed — schema evolution must never
+    silently wrap or truncate stored data (reference SchemaManager rejects
+    narrowing updates the same way)."""
+    if src.root == dst.root:
+        return True
+    if src.root in _NUMERIC_ORDER and dst.root in _NUMERIC_ORDER:
+        return _NUMERIC_ORDER.index(src.root) < _NUMERIC_ORDER.index(dst.root)
+    if dst.root in (TypeRoot.VARCHAR, TypeRoot.CHAR):
+        return True  # anything can render to string
+    if src.root == TypeRoot.DATE and dst.root in (TypeRoot.TIMESTAMP, TypeRoot.TIMESTAMP_LTZ):
+        return True
+    return False
+
+
+def cast_column(col: Column, src: DataType, dst: DataType) -> Column:
+    if src.root == dst.root:
+        return col
+    if not can_cast(src, dst):
+        raise ValueError(f"cannot cast {src.root} -> {dst.root}")
+    v, validity = col.values, col.validity
+    if dst.root in (TypeRoot.VARCHAR, TypeRoot.CHAR):
+        out = np.empty(len(v), dtype=object)
+        valid = col.valid_mask()
+        for i in range(len(v)):
+            out[i] = str(v[i]) if valid[i] else None
+        return Column(out, validity)
+    if src.root in (TypeRoot.VARCHAR, TypeRoot.CHAR) and dst.root in _NUMERIC_ORDER:
+        tgt = dst.numpy_dtype()
+        out = np.zeros(len(v), dtype=tgt)
+        valid = col.valid_mask().copy()
+        for i in range(len(v)):
+            if valid[i]:
+                try:
+                    out[i] = tgt.type(float(v[i])) if tgt.kind == "f" else tgt.type(int(float(v[i])))
+                except (TypeError, ValueError):
+                    valid[i] = False
+        return Column(out, valid if not valid.all() else None)
+    if src.root == TypeRoot.DATE and dst.root in (TypeRoot.TIMESTAMP, TypeRoot.TIMESTAMP_LTZ):
+        # days -> micros since epoch
+        return Column((v.astype(np.int64) * 86_400_000_000), validity)
+    # numeric widening/narrowing
+    return Column(v.astype(dst.numpy_dtype()), validity)

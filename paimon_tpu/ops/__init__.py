@@ -1,0 +1,40 @@
+"""The TPU kernels: data-parallel replacements for the reference's hot loops.
+
+The reference's merge-on-read is a per-row k-way heap/loser-tree loop feeding
+a MergeFunction (/root/reference/paimon-core/.../mergetree/compact/
+SortMergeReader.java:41, SortMergeReaderWithMinHeap.java:122-179). On TPU that
+branchy loop is replaced by three data-parallel stages, all jit-compiled:
+
+  1. SORT   — one stable multi-operand `lax.sort` over uint32 key lanes +
+              sequence lanes (lexicographic via num_keys);
+  2. SEGMENT— same-key group detection as a shifted-compare + cumsum;
+  3. REDUCE — merge engines as segment selections/reductions
+              (dedup = keep-last, first-row = keep-first, partial-update =
+              per-field masked last-non-null, aggregation = segment sums/
+              mins/maxes with retract signs).
+
+Everything runs on fixed padded shapes (power-of-two buckets) so XLA compiles
+once per (lane-count, size-bucket) and caches.
+"""
+
+from .aggregates import AGGREGATORS, AggregateSpec, aggregate_merge
+from .merge import (
+    MergePlan,
+    deduplicate_take,
+    first_row_take,
+    merge_plan,
+    pad_size,
+    partial_update_takes,
+)
+
+__all__ = [
+    "MergePlan",
+    "merge_plan",
+    "pad_size",
+    "deduplicate_take",
+    "first_row_take",
+    "partial_update_takes",
+    "aggregate_merge",
+    "AggregateSpec",
+    "AGGREGATORS",
+]

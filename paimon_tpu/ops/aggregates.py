@@ -1,0 +1,268 @@
+"""Field aggregators for the aggregation merge engine, as segment reductions.
+
+Capability parity with the reference aggregator family
+(/root/reference/paimon-core/.../mergetree/compact/aggregate/ — 18
+FieldAggregator subclasses: sum, product, count, max, min, bool_and, bool_or,
+first_value, first_non_null_value, last_value, last_non_null_value, listagg,
+collect, merge_map, nested_update, primary-key, ignore-retract wrapper).
+
+Numeric/bool/min/max/count/sum run on device as jax segment reductions over
+the MergePlan's sorted order; first/last pick per-segment row indices (gather
+stays exact for any type, including strings); listagg/collect run host-side
+per segment (variable-length outputs cannot live on device anyway).
+
+Retract rows (-U/-D): sum and count subtract; ignore-retract drops them for
+a field; everything else raises — the same contract as the reference
+(FieldAggregator.retract throws UnsupportedOperationException).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.batch import Column
+from ..types import RowKind
+from .merge import MergePlan, pad_to
+
+__all__ = ["AggregateSpec", "aggregate_merge", "AGGREGATORS"]
+
+AGGREGATORS = (
+    "sum",
+    "product",
+    "count",
+    "max",
+    "min",
+    "bool_and",
+    "bool_or",
+    "first_value",
+    "first_non_null_value",
+    "last_value",
+    "last_non_null_value",
+    "listagg",
+    "collect",
+)
+
+_RETRACTABLE = {"sum", "count"}
+
+
+@dataclass(frozen=True)
+class AggregateSpec:
+    function: str
+    ignore_retract: bool = False
+    listagg_delimiter: str = ","
+    collect_distinct: bool = False
+
+
+@functools.lru_cache(maxsize=None)
+def _sum_fn():
+    @jax.jit
+    def f(perm, seg_id, values, valid, sign):
+        m = perm.shape[0]
+        v = values[perm]
+        ok = valid[perm]
+        s = sign[perm]
+        contrib = jnp.where(ok, v * s, jnp.zeros((), values.dtype))
+        total = jax.ops.segment_sum(contrib, seg_id, num_segments=m)
+        any_valid = jax.ops.segment_max(ok.astype(jnp.int32), seg_id, num_segments=m) > 0
+        return total, any_valid
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _minmax_fn(is_max: bool):
+    @jax.jit
+    def f(perm, seg_id, values, valid):
+        m = perm.shape[0]
+        v = values[perm]
+        ok = valid[perm]
+        if is_max:
+            fill = jnp.finfo(values.dtype).min if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo(values.dtype).min
+            masked = jnp.where(ok, v, fill)
+            agg = jax.ops.segment_max(masked, seg_id, num_segments=m)
+        else:
+            fill = jnp.finfo(values.dtype).max if jnp.issubdtype(values.dtype, jnp.floating) else jnp.iinfo(values.dtype).max
+            masked = jnp.where(ok, v, fill)
+            agg = jax.ops.segment_min(masked, seg_id, num_segments=m)
+        any_valid = jax.ops.segment_max(ok.astype(jnp.int32), seg_id, num_segments=m) > 0
+        return agg, any_valid
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _pick_fn(last: bool):
+    @jax.jit
+    def f(perm, seg_id, candidate):
+        # candidate: (m,) bool in INPUT coords — rows eligible to be picked
+        # (validity and/or retract-exclusion already folded in by the caller)
+        m = perm.shape[0]
+        pos = jnp.arange(m, dtype=jnp.int32)
+        ok = candidate[perm]
+        if last:
+            cand = jnp.where(ok, pos, -1)
+            best = jax.ops.segment_max(cand, seg_id, num_segments=m)
+        else:
+            cand = jnp.where(ok, pos, m)
+            best = jax.ops.segment_min(cand, seg_id, num_segments=m)
+            best = jnp.where(best == m, -1, best)
+        src = jnp.where(best >= 0, perm[jnp.clip(best, 0, m - 1)], -1)
+        return src
+
+    return f
+
+
+def _product_host(plan: MergePlan, values: np.ndarray, eff_valid: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Exact segmented product via np.multiply.reduceat over the sorted order
+    (cumprod-ratio tricks on device lose exactness at zeros/int division)."""
+    order = plan.perm[plan.valid_sorted]
+    v = values.take(order)
+    ok = eff_valid.take(order)
+    contrib = np.where(ok, v, np.ones((), values.dtype))
+    bounds = np.flatnonzero(plan.seg_start[plan.valid_sorted])
+    total = np.multiply.reduceat(contrib, bounds)
+    any_valid = np.maximum.reduceat(ok.astype(np.int8), bounds) > 0
+    return total, any_valid
+
+
+def _signs(row_kind: np.ndarray, spec: AggregateSpec, dtype) -> tuple[np.ndarray, np.ndarray]:
+    """(sign, include) per input row given retract semantics."""
+    retract = np.isin(row_kind, (int(RowKind.UPDATE_BEFORE), int(RowKind.DELETE)))
+    if spec.ignore_retract:
+        return np.ones(len(row_kind), dtype=dtype), ~retract
+    if spec.function in _RETRACTABLE:
+        sign = np.where(retract, -1, 1).astype(dtype)
+        return sign, np.ones(len(row_kind), dtype=np.bool_)
+    if retract.any():
+        raise ValueError(
+            f"aggregate function {spec.function!r} cannot retract; "
+            f"use ignore-retract or an input without -U/-D rows"
+        )
+    return np.ones(len(row_kind), dtype=dtype), np.ones(len(row_kind), dtype=np.bool_)
+
+
+def aggregate_merge(
+    plan: MergePlan,
+    column: Column,
+    spec: AggregateSpec,
+    row_kind: np.ndarray,
+) -> Column:
+    """Aggregate one value column over the plan's segments. Returns a Column
+    of length plan.num_segments (key order)."""
+    m, k = plan.m, plan.num_segments
+    values = column.values
+    valid = column.valid_mask()
+    fn = spec.function
+
+    if fn in ("listagg", "collect"):
+        return _host_aggregate(plan, values, valid, spec, row_kind)
+
+    sign, include = _signs(row_kind, spec, values.dtype if values.dtype != np.dtype(object) else np.int64)
+    eff_valid = valid & include
+
+    perm = jnp.asarray(plan.perm)
+    seg_id = jnp.asarray(plan.seg_id)
+
+    if fn in ("first_value", "first_non_null_value", "last_value", "last_non_null_value"):
+        # *_value picks may land on a null row; *_non_null_value requires
+        # validity. Both must respect the retract include-mask.
+        candidate = eff_valid if "non_null" in fn else include
+        src = _pick_fn(fn.startswith("last"))(perm, seg_id, jnp.asarray(pad_to(candidate, m, False)))
+        src = np.asarray(src)[:k]
+        return _gather_column(column, src)
+
+    if values.dtype == np.dtype(object):
+        raise ValueError(f"aggregate {fn!r} unsupported for string/bytes columns")
+
+    if fn in ("bool_and", "bool_or"):
+        v8 = values.astype(np.int8)
+        agg, any_valid = _minmax_fn(fn == "bool_or")(
+            perm, seg_id, jnp.asarray(pad_to(v8, m, 0)), jnp.asarray(pad_to(eff_valid, m, False))
+        )
+        out = np.asarray(agg)[:k].astype(np.bool_)
+        av = np.asarray(any_valid)[:k]
+        return Column(out, av if not av.all() else None)
+
+    if fn in ("max", "min"):
+        agg, any_valid = _minmax_fn(fn == "max")(
+            perm, seg_id, jnp.asarray(pad_to(values, m, 0)), jnp.asarray(pad_to(eff_valid, m, False))
+        )
+    elif fn == "sum":
+        agg, any_valid = _sum_fn()(
+            perm,
+            seg_id,
+            jnp.asarray(pad_to(values, m, 0)),
+            jnp.asarray(pad_to(eff_valid, m, False)),
+            jnp.asarray(pad_to(sign, m, 1)),
+        )
+    elif fn == "count":
+        ones = np.ones(len(values), dtype=np.int64)
+        agg, any_valid = _sum_fn()(
+            perm,
+            seg_id,
+            jnp.asarray(pad_to(ones, m, 0)),
+            jnp.asarray(pad_to(eff_valid, m, False)),
+            jnp.asarray(pad_to(sign.astype(np.int64), m, 1)),
+        )
+        out = np.asarray(agg)[:k]
+        return Column(out)  # count of nothing is 0, not null
+    elif fn == "product":
+        out, av = _product_host(plan, values, eff_valid)
+        return Column(out.astype(values.dtype, copy=False), av if not av.all() else None)
+    else:
+        raise ValueError(f"unknown aggregate function {fn!r}; known: {AGGREGATORS}")
+
+    out = np.asarray(agg)[:k].astype(values.dtype, copy=False)
+    av = np.asarray(any_valid)[:k]
+    return Column(out, av if not av.all() else None)
+
+
+def _gather_column(column: Column, src: np.ndarray) -> Column:
+    ok = src >= 0
+    safe = np.clip(src, 0, max(len(column.values) - 1, 0))
+    vals = column.values.take(safe)
+    validity = ok & column.valid_mask().take(safe)
+    if column.values.dtype != np.dtype(object):
+        vals = np.where(validity, vals, np.zeros((), column.values.dtype))
+    return Column(vals, validity if not validity.all() else None)
+
+
+def _host_aggregate(plan: MergePlan, values, valid, spec: AggregateSpec, row_kind) -> Column:
+    """listagg / collect: variable-length outputs, built per segment on host
+    from the sorted order (still no comparator loops — slicing only)."""
+    k = plan.num_segments
+    order = plan.perm[plan.valid_sorted]
+    seg = plan.seg_id[plan.valid_sorted]
+    v_sorted = values.take(order)
+    ok_sorted = valid.take(order)
+    retract = np.isin(row_kind, (int(RowKind.UPDATE_BEFORE), int(RowKind.DELETE))).take(order)
+    if spec.ignore_retract:
+        ok_sorted = ok_sorted & ~retract
+    elif retract.any() and spec.function == "listagg":
+        raise ValueError("listagg cannot retract; configure ignore-retract")
+    bounds = np.flatnonzero(plan.seg_start[plan.valid_sorted])
+    out = np.empty(k, dtype=object)
+    validity = np.zeros(k, dtype=np.bool_)
+    for s in range(k):
+        lo = bounds[s]
+        hi = bounds[s + 1] if s + 1 < k else len(order)
+        vals = [v_sorted[i] for i in range(lo, hi) if ok_sorted[i]]
+        if spec.function == "listagg":
+            if vals:
+                out[s] = spec.listagg_delimiter.join(str(x) for x in vals)
+                validity[s] = True
+        else:  # collect
+            if spec.collect_distinct:
+                seen = []
+                for x in vals:
+                    if x not in seen:
+                        seen.append(x)
+                vals = seen
+            out[s] = vals
+            validity[s] = True
+    return Column(out, validity if not validity.all() else None)

@@ -1,0 +1,46 @@
+"""Small shared utilities (naming, paths, json)."""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Sequence
+
+__all__ = ["new_file_name", "partition_path", "now_millis", "dumps", "loads"]
+
+
+def new_file_name(prefix: str, ext: str | None = None) -> str:
+    n = f"{prefix}-{uuid.uuid4().hex}"
+    return f"{n}.{ext}" if ext else n
+
+
+def partition_path(partition_keys: Sequence[str], partition: Sequence[Any]) -> str:
+    """Hive-style partition directory: k1=v1/k2=v2 ('' for unpartitioned)."""
+    if not partition_keys:
+        return ""
+    return "/".join(f"{k}={v}" for k, v in zip(partition_keys, partition))
+
+
+def now_millis() -> int:
+    return int(time.time() * 1000)
+
+
+def dumps(obj: Any) -> str:
+    return json.dumps(obj, separators=(",", ":"), default=_default)
+
+
+def loads(s: str | bytes) -> Any:
+    return json.loads(s)
+
+
+def _default(o):
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, (np.bool_,)):
+        return bool(o)
+    raise TypeError(f"not JSON serializable: {type(o)}")

@@ -1,0 +1,105 @@
+"""IntervalPartition / Levels / UniversalCompaction unit tests
+(mirrors reference IntervalPartitionTest, UniversalCompactionTest)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.core.compact import UniversalCompaction
+from paimon_tpu.core.datafile import DataFileMeta
+from paimon_tpu.core.levels import IntervalPartition, Levels, SortedRun
+
+
+def f(name, lo, hi, level=0, size=100, seq=0):
+    return DataFileMeta(
+        file_name=name,
+        file_size=size,
+        row_count=10,
+        min_key=(lo,),
+        max_key=(hi,),
+        key_stats={},
+        value_stats={},
+        min_sequence_number=seq,
+        max_sequence_number=seq,
+        schema_id=0,
+        level=level,
+    )
+
+
+def section_ranges(sections):
+    return [sorted((x.min_key[0], x.max_key[0]) for r in s for x in r.files) for s in sections]
+
+
+def test_interval_partition_disjoint_sections():
+    files = [f("a", 0, 10), f("b", 20, 30), f("c", 40, 50)]
+    sections = IntervalPartition(files).partition()
+    assert len(sections) == 3
+    assert all(len(s) == 1 for s in sections)
+
+
+def test_interval_partition_overlap_groups():
+    files = [f("a", 0, 10), f("b", 5, 15), f("c", 12, 20), f("d", 30, 40)]
+    sections = IntervalPartition(files).partition()
+    assert len(sections) == 2
+    # first section needs 2 runs (a overlaps b overlaps c, but a & c disjoint)
+    runs = sections[0]
+    assert len(runs) == 2
+    for r in runs:
+        r.validate()
+
+
+def test_interval_partition_minimal_runs():
+    # chain: [0,10],[11,20],[5,15] -> 2 runs ([0,10]+[11,20] and [5,15])
+    files = [f("a", 0, 10), f("b", 11, 20), f("c", 5, 15)]
+    runs = IntervalPartition(files).partition()[0]
+    assert len(runs) == 2
+    sizes = sorted(len(r.files) for r in runs)
+    assert sizes == [1, 2]
+
+
+def test_levels_structure():
+    files = [f("l0a", 0, 5, 0, seq=9), f("l0b", 0, 5, 0, seq=5), f("l1", 0, 10, 1), f("l2a", 0, 4, 2), f("l2b", 6, 9, 2)]
+    lv = Levels(files, 3)
+    assert [x.file_name for x in lv.level0] == ["l0a", "l0b"]  # newest first
+    assert lv.number_of_sorted_runs() == 4  # 2 level0 + level1 + level2
+    assert lv.non_empty_highest_level() == 2
+    runs = lv.level_sorted_runs()
+    assert runs[0][0] == 0 and runs[-1][0] == 2
+    lv.update([files[0], files[3], files[4]], [f("new", 0, 10, 2, seq=10)])
+    assert lv.number_of_sorted_runs() == 3  # l0b + level1 + new level2
+
+
+def test_levels_rejects_overlapping_run():
+    with pytest.raises(AssertionError):
+        Levels([f("x", 0, 10, 1), f("y", 5, 15, 1)], 2)
+
+
+def test_universal_size_amp_triggers_full():
+    uc = UniversalCompaction(max_size_amp_percent=100, size_ratio_percent=1, num_run_compaction_trigger=2)
+    runs = [
+        (0, SortedRun([f("a", 0, 1, 0, size=60)])),
+        (0, SortedRun([f("b", 0, 1, 0, size=50)])),
+        (2, SortedRun([f("c", 0, 1, 2, size=100)])),
+    ]
+    unit = uc.pick(3, runs)
+    assert unit is not None
+    assert unit.output_level == 2
+    assert len(unit.files) == 3
+
+
+def test_universal_size_ratio():
+    uc = UniversalCompaction(max_size_amp_percent=10000, size_ratio_percent=1, num_run_compaction_trigger=2)
+    runs = [
+        (0, SortedRun([f("a", 0, 1, 0, size=100)])),
+        (0, SortedRun([f("b", 0, 1, 0, size=100)])),
+        (3, SortedRun([f("c", 0, 1, 3, size=100000)])),
+    ]
+    unit = uc.pick(4, runs)
+    assert unit is not None
+    assert sorted(x.file_name for x in unit.files) == ["a", "b"]
+    assert unit.output_level == 2  # next run's level - 1
+
+
+def test_universal_below_trigger_no_pick():
+    uc = UniversalCompaction(num_run_compaction_trigger=5)
+    runs = [(0, SortedRun([f("a", 0, 1, 0)]))]
+    assert uc.pick(5, runs) is None

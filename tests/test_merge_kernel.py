@@ -1,0 +1,228 @@
+"""Device merge kernel vs brute-force numpy/python oracles.
+
+Mirrors the reference's SortMergeReaderTestBase + merge function tests
+(reference paimon-core/src/test/java/org/apache/paimon/mergetree/compact/):
+results must be byte-identical to a straightforward per-key interpretation.
+"""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.data.keys import encode_key_lanes, split_int64_lanes
+from paimon_tpu.ops import (
+    AggregateSpec,
+    MergePlan,
+    aggregate_merge,
+    deduplicate_take,
+    first_row_take,
+    merge_plan,
+    partial_update_takes,
+)
+from paimon_tpu.data.batch import Column
+from paimon_tpu.types import BIGINT, INT, RowKind, RowType
+
+
+def make_inputs(rng, n=500, key_space=120):
+    keys = rng.integers(0, key_space, n).astype(np.int64)
+    seq = np.arange(n, dtype=np.int64)
+    rng.shuffle(seq)  # unique but unordered sequence numbers
+    kinds = rng.choice(
+        [int(RowKind.INSERT), int(RowKind.UPDATE_AFTER), int(RowKind.DELETE)], size=n, p=[0.6, 0.3, 0.1]
+    ).astype(np.uint8)
+    vals = rng.integers(-1000, 1000, n).astype(np.int64)
+    return keys, seq, kinds, vals
+
+
+def plan_for(keys, seq):
+    schema = RowType.of(("k", BIGINT(False)))
+    b = ColumnBatch.from_pydict(schema, {"k": keys.tolist()})
+    lanes = encode_key_lanes(b, ["k"])
+    hi, lo = split_int64_lanes(seq)
+    return merge_plan(lanes, np.stack([hi, lo], axis=1))
+
+
+def test_plan_orders_and_segments(rng):
+    keys, seq, _, _ = make_inputs(rng, 300, 40)
+    plan = plan_for(keys, seq)
+    assert plan.n == 300
+    order = plan.perm[plan.valid_sorted]
+    ks = keys.take(order)
+    ss = seq.take(order)
+    # sorted by (key, seq)
+    assert all((ks[i], ss[i]) <= (ks[i + 1], ss[i + 1]) for i in range(len(ks) - 1))
+    # segments = distinct keys
+    assert plan.num_segments == len(np.unique(keys))
+    starts = plan.seg_start[plan.valid_sorted]
+    assert starts.sum() == plan.num_segments
+    assert (np.flatnonzero(np.diff(ks) != 0) + 1 == np.flatnonzero(starts)[1:]).all()
+
+
+def test_deduplicate_matches_oracle(rng):
+    keys, seq, kinds, vals = make_inputs(rng)
+    plan = plan_for(keys, seq)
+    take = deduplicate_take(plan)
+    # oracle: per key, row with max seq
+    oracle = {}
+    for i in range(len(keys)):
+        k = keys[i]
+        if k not in oracle or seq[oracle[k]] < seq[i]:
+            oracle[k] = i
+    expect = [oracle[k] for k in sorted(oracle)]
+    assert take.tolist() == expect
+
+
+def test_deduplicate_tie_break_input_order():
+    # equal (key, seq): later input wins under "last row" semantics
+    keys = np.array([5, 5, 5], dtype=np.int64)
+    seq = np.array([7, 7, 7], dtype=np.int64)
+    plan = plan_for(keys, seq)
+    assert deduplicate_take(plan).tolist() == [2]
+    assert first_row_take(plan).tolist() == [0]
+
+
+def test_first_row_matches_oracle(rng):
+    keys, seq, _, _ = make_inputs(rng)
+    plan = plan_for(keys, seq)
+    take = first_row_take(plan)
+    oracle = {}
+    for i in range(len(keys)):
+        k = keys[i]
+        if k not in oracle or seq[oracle[k]] > seq[i]:
+            oracle[k] = i
+    assert take.tolist() == [oracle[k] for k in sorted(oracle)]
+
+
+def test_partial_update_matches_oracle(rng):
+    n = 400
+    keys, seq, kinds, _ = make_inputs(rng, n, 60)
+    kinds = np.where(kinds == int(RowKind.DELETE), int(RowKind.INSERT), kinds).astype(np.uint8)  # adds only here
+    f0 = rng.integers(0, 100, n).astype(np.int64)
+    f0_valid = rng.random(n) > 0.4
+    f1 = rng.integers(0, 100, n).astype(np.int64)
+    f1_valid = rng.random(n) > 0.4
+    plan = plan_for(keys, seq)
+    src, exists = partial_update_takes(plan, np.stack([f0_valid, f1_valid]), kinds)
+    assert exists.all()
+    uniq = sorted(set(keys.tolist()))
+    assert src.shape == (2, len(uniq))
+    for fi, (fv,) in enumerate([(f0_valid,), (f1_valid,)]):
+        for si, k in enumerate(uniq):
+            rows = [i for i in range(n) if keys[i] == k and fv[i]]
+            expect = max(rows, key=lambda i: seq[i]) if rows else -1
+            assert src[fi, si] == expect, (fi, k)
+
+
+def test_partial_update_remove_record_on_delete():
+    keys = np.array([1, 1, 1, 2, 2], dtype=np.int64)
+    seq = np.array([0, 1, 2, 0, 1], dtype=np.int64)
+    kinds = np.array(
+        [RowKind.INSERT, RowKind.DELETE, RowKind.INSERT, RowKind.INSERT, RowKind.DELETE], dtype=np.uint8
+    )
+    valid = np.ones((1, 5), dtype=np.bool_)
+    plan = plan_for(keys, seq)
+    src, exists = partial_update_takes(plan, valid, kinds, remove_record_on_delete=True)
+    # key 1: delete at seq1 wipes seq0; seq2 insert survives. key 2: deleted.
+    assert exists.tolist() == [True, False]
+    assert src[0, 0] == 2
+
+
+@pytest.mark.parametrize(
+    "fn", ["sum", "count", "max", "min", "first_value", "first_non_null_value", "last_value", "last_non_null_value", "product"]
+)
+def test_aggregate_matches_oracle(rng, fn):
+    n = 300
+    keys, seq, _, vals = make_inputs(rng, n, 50)
+    kinds = np.full(n, int(RowKind.INSERT), dtype=np.uint8)
+    valid = rng.random(n) > 0.3
+    plan = plan_for(keys, seq)
+    col = Column(vals.copy(), valid.copy())
+    out = aggregate_merge(plan, col, AggregateSpec(fn), kinds)
+    uniq = sorted(set(keys.tolist()))
+    order = {k: sorted([i for i in range(n) if keys[i] == k], key=lambda i: seq[i]) for k in uniq}
+    for si, k in enumerate(uniq):
+        rows = order[k]
+        vs = [vals[i] for i in rows if valid[i]]
+        got = out.to_pylist()[si]
+        if fn == "sum":
+            assert got == (sum(vs) if vs else None)
+        elif fn == "count":
+            assert got == len(vs)
+        elif fn == "max":
+            assert got == (max(vs) if vs else None)
+        elif fn == "min":
+            assert got == (min(vs) if vs else None)
+        elif fn == "product":
+            p = 1
+            for v in vs:
+                p *= v
+            assert got == (p if vs else None)
+        elif fn == "first_value":
+            assert got == (vals[rows[0]] if valid[rows[0]] else None)
+        elif fn == "last_value":
+            assert got == (vals[rows[-1]] if valid[rows[-1]] else None)
+        elif fn == "first_non_null_value":
+            assert got == (vs[0] if vs else None)
+        elif fn == "last_non_null_value":
+            assert got == (vs[-1] if vs else None)
+
+
+def test_aggregate_sum_retract(rng):
+    keys = np.array([1, 1, 1, 1], dtype=np.int64)
+    seq = np.arange(4, dtype=np.int64)
+    kinds = np.array([RowKind.INSERT, RowKind.INSERT, RowKind.UPDATE_BEFORE, RowKind.UPDATE_AFTER], dtype=np.uint8)
+    vals = np.array([10, 5, 5, 7], dtype=np.int64)
+    plan = plan_for(keys, seq)
+    out = aggregate_merge(plan, Column(vals), AggregateSpec("sum"), kinds)
+    assert out.to_pylist() == [17]  # 10 + 5 - 5 + 7
+
+
+def test_aggregate_max_rejects_retract():
+    keys = np.array([1, 1], dtype=np.int64)
+    seq = np.arange(2, dtype=np.int64)
+    kinds = np.array([RowKind.INSERT, RowKind.DELETE], dtype=np.uint8)
+    plan = plan_for(keys, seq)
+    with pytest.raises(ValueError, match="cannot retract"):
+        aggregate_merge(plan, Column(np.array([1, 2], dtype=np.int64)), AggregateSpec("max"), kinds)
+    # ignore-retract drops the -D row
+    out = aggregate_merge(plan, Column(np.array([1, 2], dtype=np.int64)), AggregateSpec("max", ignore_retract=True), kinds)
+    assert out.to_pylist() == [1]
+
+
+def test_aggregate_bool_and_listagg_collect():
+    keys = np.array([1, 1, 2, 2, 3], dtype=np.int64)
+    seq = np.arange(5, dtype=np.int64)
+    kinds = np.full(5, int(RowKind.INSERT), dtype=np.uint8)
+    plan = plan_for(keys, seq)
+    b = Column(np.array([True, False, True, True, False]))
+    assert aggregate_merge(plan, b, AggregateSpec("bool_and"), kinds).to_pylist() == [False, True, False]
+    assert aggregate_merge(plan, b, AggregateSpec("bool_or"), kinds).to_pylist() == [True, True, False]
+    s = Column(np.array(["a", "b", "c", None, "e"], dtype=object), np.array([1, 1, 1, 0, 1], dtype=np.bool_))
+    assert aggregate_merge(plan, s, AggregateSpec("listagg"), kinds).to_pylist() == ["a,b", "c", "e"]
+    got = aggregate_merge(plan, s, AggregateSpec("collect"), kinds).to_pylist()
+    assert got == [["a", "b"], ["c"], ["e"]]
+
+
+def test_empty_and_single_row():
+    plan = merge_plan(np.zeros((0, 1), dtype=np.uint32))
+    assert plan.num_segments == 0
+    assert deduplicate_take(plan).tolist() == []
+    keys = np.array([42], dtype=np.int64)
+    plan1 = plan_for(keys, np.array([0], dtype=np.int64))
+    assert deduplicate_take(plan1).tolist() == [0]
+
+
+def test_large_merge_consistency(rng):
+    """8 'sorted runs' concatenated: dedup result == per-run oracle."""
+    runs = []
+    for r in range(8):
+        ks = np.sort(rng.choice(5000, size=2000, replace=False)).astype(np.int64)
+        runs.append(ks)
+    keys = np.concatenate(runs)
+    seq = np.arange(len(keys), dtype=np.int64)
+    plan = plan_for(keys, seq)
+    take = deduplicate_take(plan)
+    oracle = {}
+    for i, k in enumerate(keys.tolist()):
+        oracle[k] = i  # seq == input order, so last occurrence wins
+    assert take.tolist() == [oracle[k] for k in sorted(oracle)]

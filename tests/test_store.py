@@ -1,0 +1,249 @@
+"""Tier-2: the whole LSM store through LocalFileIO with real parquet files
+(mirrors reference MergeTreeTestBase / FileStoreCommitTest / TableCommitTest)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.core.commit import CommitConflictError
+from paimon_tpu.core.manifest import ManifestCommittable
+from paimon_tpu.core.schema import SchemaChange, SchemaManager
+from paimon_tpu.core.snapshot import CommitKind, SnapshotManager
+from paimon_tpu.core.store import KeyValueFileStore
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.data.predicate import between, equal, greater_than
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.types import BIGINT, DOUBLE, INT, STRING, RowKind, RowType
+
+SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()), ("name", STRING()))
+
+
+def make_store(path, options=None, user="u1"):
+    io = LocalFileIO()
+    sm = SchemaManager(io, path)
+    opts = {"bucket": "1", "file.format": "parquet"}
+    opts.update(options or {})
+    ts = sm.create_table(SCHEMA, primary_keys=["k"], options=opts)
+    return KeyValueFileStore(io, path, ts, commit_user=user)
+
+
+def write_and_commit(store, data, identifier=1, kinds=None, partition=(), bucket=0):
+    w = store.new_writer(partition, bucket)
+    w.write(ColumnBatch.from_pydict(store.value_schema, data), kinds)
+    msg = w.prepare_commit()
+    commit = store.new_commit()
+    return commit.commit(ManifestCommittable(identifier, messages=[msg]))
+
+
+def read_all(store, partition=(), bucket=0, **kw):
+    files = store.restore_files(partition, bucket)
+    return store.read_bucket(partition, bucket, files, **kw)
+
+
+def test_write_commit_read_roundtrip(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t1")
+    write_and_commit(store, {"k": [3, 1, 2], "v": [30.0, 10.0, 20.0], "name": ["c", "a", "b"]})
+    out = read_all(store)
+    assert out.to_pylist() == [(1, 10.0, "a"), (2, 20.0, "b"), (3, 30.0, "c")]
+    snap = store.snapshot_manager.latest_snapshot()
+    assert snap.id == 1 and snap.commit_kind == CommitKind.APPEND
+    assert snap.total_record_count == 3
+
+
+def test_upsert_across_commits(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t2")
+    write_and_commit(store, {"k": [1, 2], "v": [1.0, 2.0], "name": ["a", "b"]}, identifier=1)
+    write_and_commit(store, {"k": [2, 3], "v": [22.0, 3.0], "name": ["bb", "c"]}, identifier=2)
+    out = read_all(store)
+    assert out.to_pylist() == [(1, 1.0, "a"), (2, 22.0, "bb"), (3, 3.0, "c")]
+
+
+def test_delete_rows(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t3")
+    write_and_commit(store, {"k": [1, 2, 3], "v": [1.0, 2.0, 3.0], "name": ["a", "b", "c"]}, identifier=1)
+    kinds = np.array([int(RowKind.DELETE)], dtype=np.uint8)
+    write_and_commit(store, {"k": [2], "v": [None], "name": [None]}, identifier=2, kinds=kinds)
+    out = read_all(store)
+    assert [r[0] for r in out.to_pylist()] == [1, 3]
+
+
+def test_predicate_and_projection(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t4")
+    write_and_commit(store, {"k": list(range(100)), "v": [float(i) for i in range(100)], "name": [f"n{i}" for i in range(100)]})
+    out = read_all(store, predicate=between("k", 10, 12), projection=["name", "k"])
+    assert out.to_pylist() == [("n10", 10), ("n11", 11), ("n12", 12)]
+    # value predicate post-merge
+    out2 = read_all(store, predicate=greater_than("v", 97.5))
+    assert [r[0] for r in out2.to_pylist()] == [98, 99]
+
+
+def test_compaction_reduces_runs_and_preserves_data(tmp_warehouse):
+    store = make_store(
+        f"{tmp_warehouse}/t5",
+        {"num-sorted-run.compaction-trigger": "3", "target-file-size": "1 kb"},
+    )
+    oracle = {}
+    w = store.new_writer((), 0)
+    commit = store.new_commit()
+    for c in range(6):
+        ks = list(range(c * 10, c * 10 + 30))
+        vs = [float(k * c) for k in ks]
+        for k, v in zip(ks, vs):
+            oracle[k] = v
+        w.write(ColumnBatch.from_pydict(store.value_schema, {"k": ks, "v": vs, "name": [None] * len(ks)}))
+        w.flush()
+    msg = w.prepare_commit()
+    commit.commit(ManifestCommittable(1, messages=[msg]))
+    snaps = list(store.snapshot_manager.snapshots())
+    assert any(s.commit_kind == CommitKind.COMPACT for s in snaps)
+    out = read_all(store)
+    got = {r[0]: r[1] for r in out.to_pylist()}
+    assert got == oracle
+    files = store.restore_files((), 0)
+    from paimon_tpu.core.levels import Levels
+
+    lv = Levels(files, store.options.num_levels)
+    assert lv.number_of_sorted_runs() <= 3
+
+
+def test_full_compact_drops_deletes(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t6")
+    write_and_commit(store, {"k": [1, 2], "v": [1.0, 2.0], "name": ["a", "b"]}, identifier=1)
+    kinds = np.array([int(RowKind.DELETE)], dtype=np.uint8)
+    write_and_commit(store, {"k": [1], "v": [None], "name": [None]}, identifier=2, kinds=kinds)
+    w = store.new_writer((), 0)
+    w.compact(full=True)
+    msg = w.prepare_commit()
+    store.new_commit().commit(ManifestCommittable(3, messages=[msg]))
+    files = store.restore_files((), 0)
+    assert all(f.level == store.options.num_levels - 1 for f in files)
+    assert sum(f.delete_row_count for f in files) == 0
+    assert [r[0] for r in read_all(store).to_pylist()] == [2]
+
+
+def test_filter_committed_idempotence(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t7")
+    write_and_commit(store, {"k": [1], "v": [1.0], "name": ["a"]}, identifier=5)
+    commit = store.new_commit()
+    # replay of identifier 5 must be filtered out
+    remaining = commit.filter_committed([ManifestCommittable(5), ManifestCommittable(6)])
+    assert [c.commit_identifier for c in remaining] == [6]
+
+
+def test_concurrent_commits_race(tmp_warehouse):
+    """Two users committing interleaved: CAS retry must keep both."""
+    path = f"{tmp_warehouse}/t8"
+    s1 = make_store(path, user="alice")
+    s2 = KeyValueFileStore(LocalFileIO(), path, s1.schema, commit_user="bob")
+    w1 = s1.new_writer((), 0)
+    w1.write(ColumnBatch.from_pydict(s1.value_schema, {"k": [1], "v": [1.0], "name": ["a"]}))
+    m1 = w1.prepare_commit()
+    w2 = s2.new_writer((), 0)
+    w2.write(ColumnBatch.from_pydict(s2.value_schema, {"k": [2], "v": [2.0], "name": ["b"]}))
+    m2 = w2.prepare_commit()
+    s1.new_commit().commit(ManifestCommittable(1, messages=[m1]))
+    s2.new_commit().commit(ManifestCommittable(1, messages=[m2]))
+    out = read_all(s1)
+    assert [r[0] for r in out.to_pylist()] == [1, 2]
+    assert s1.snapshot_manager.latest_snapshot_id() == 2
+
+
+def test_compact_conflict_detected(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t9")
+    write_and_commit(store, {"k": [1, 2], "v": [1.0, 2.0], "name": ["a", "b"]}, identifier=1)
+    # two writers compute full compaction from the same base
+    wa = store.new_writer((), 0)
+    wa.compact(full=True)
+    ma = wa.prepare_commit()
+    wb = store.new_writer((), 0)
+    wb.compact(full=True)
+    mb = wb.prepare_commit()
+    store.new_commit().commit(ManifestCommittable(2, messages=[ma]))
+    with pytest.raises(CommitConflictError):
+        store.new_commit().commit(ManifestCommittable(3, messages=[mb]))
+
+
+def test_schema_evolution_add_column(tmp_warehouse):
+    path = f"{tmp_warehouse}/t10"
+    store = make_store(path)
+    write_and_commit(store, {"k": [1], "v": [1.0], "name": ["a"]}, identifier=1)
+    sm = SchemaManager(LocalFileIO(), path)
+    from paimon_tpu.types import INT as INT_T
+
+    new_schema = sm.commit_changes(SchemaChange.add_column("extra", INT_T()))
+    store2 = KeyValueFileStore(LocalFileIO(), path, new_schema, commit_user="u1")
+    w = store2.new_writer((), 0)
+    w.write(ColumnBatch.from_pydict(store2.value_schema, {"k": [2], "v": [2.0], "name": ["b"], "extra": [7]}))
+    store2.new_commit().commit(ManifestCommittable(2, messages=[w.prepare_commit()]))
+    out = read_all(store2)
+    assert out.to_pylist() == [(1, 1.0, "a", None), (2, 2.0, "b", 7)]
+
+
+def test_schema_evolution_rename_and_widen(tmp_warehouse):
+    path = f"{tmp_warehouse}/t11"
+    io = LocalFileIO()
+    sm = SchemaManager(io, path)
+    ts = sm.create_table(
+        RowType.of(("k", BIGINT()), ("small", INT())), primary_keys=["k"], options={"bucket": "1"}
+    )
+    store = KeyValueFileStore(io, path, ts)
+    w = store.new_writer((), 0)
+    w.write(ColumnBatch.from_pydict(store.value_schema, {"k": [1], "small": [5]}))
+    store.new_commit().commit(ManifestCommittable(1, messages=[w.prepare_commit()]))
+    s2 = sm.commit_changes(SchemaChange.rename_column("small", "wide"), SchemaChange.update_column_type("wide", BIGINT()))
+    store2 = KeyValueFileStore(io, path, s2)
+    out = read_all(store2)
+    assert out.to_pylist() == [(1, 5)]
+    assert out.schema.field("wide").type.root.value == "BIGINT"
+
+
+def test_snapshot_expire(tmp_warehouse):
+    store = make_store(
+        f"{tmp_warehouse}/t12",
+        {"snapshot.num-retained.min": "2", "snapshot.num-retained.max": "2", "snapshot.time-retained.ms": "0"},
+    )
+    for i in range(5):
+        write_and_commit(store, {"k": [i], "v": [float(i)], "name": [None]}, identifier=i + 1)
+    sm = store.snapshot_manager
+    assert sm.snapshot_count() == 5
+    expired = store.new_expire().expire()
+    assert expired == 3
+    assert sm.earliest_snapshot_id() == 4
+    # data still fully readable from the latest snapshot
+    out = read_all(store)
+    assert [r[0] for r in out.to_pylist()] == [0, 1, 2, 3, 4]
+
+
+def test_overwrite(tmp_warehouse):
+    store = make_store(f"{tmp_warehouse}/t13")
+    write_and_commit(store, {"k": [1, 2], "v": [1.0, 2.0], "name": ["a", "b"]}, identifier=1)
+    w = store.new_writer((), 0, restore=False)
+    w.write(ColumnBatch.from_pydict(store.value_schema, {"k": [9], "v": [9.0], "name": ["z"]}))
+    msg = w.prepare_commit()
+    store.new_commit().overwrite(ManifestCommittable(2, messages=[msg]))
+    out = read_all(store)
+    assert out.to_pylist() == [(9, 9.0, "z")]
+    assert store.snapshot_manager.latest_snapshot().commit_kind == CommitKind.OVERWRITE
+
+
+def test_partitioned_store(tmp_warehouse):
+    path = f"{tmp_warehouse}/t14"
+    io = LocalFileIO()
+    sm = SchemaManager(io, path)
+    ts = sm.create_table(
+        RowType.of(("region", STRING()), ("k", BIGINT()), ("v", DOUBLE())),
+        partition_keys=["region"],
+        primary_keys=["region", "k"],
+        options={"bucket": "1"},
+    )
+    store = KeyValueFileStore(io, path, ts)
+    for region, ident in (("eu", 1), ("us", 2)):
+        w = store.new_writer((region,), 0)
+        w.write(ColumnBatch.from_pydict(store.value_schema, {"region": [region] * 2, "k": [1, 2], "v": [1.0, 2.0]}))
+        store.new_commit().commit(ManifestCommittable(ident, messages=[w.prepare_commit()]))
+    plan = store.new_scan().plan()
+    assert set(plan.grouped().keys()) == {("eu",), ("us",)}
+    out = read_all(store, partition=("eu",))
+    assert [r[0] for r in out.to_pylist()] == ["eu", "eu"]
+    # partition pruning
+    plan_eu = store.new_scan().with_partition_filter(lambda p: p == ("eu",)).plan()
+    assert set(e.partition for e in plan_eu.entries) == {("eu",)}
