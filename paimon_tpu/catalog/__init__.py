@@ -1,0 +1,152 @@
+"""Catalog: databases and tables on a warehouse directory.
+
+Parity: /root/reference/paimon-core/.../catalog/ — Catalog SPI +
+FileSystemCatalog (warehouse layout `warehouse/db.db/table`), create/drop/
+list/rename, system-table routing via `table$system`.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..core.schema import SchemaManager, TableSchema
+from ..fs import FileIO, get_file_io
+from ..table import FileStoreTable, Table
+from ..types import RowType
+
+__all__ = ["Catalog", "FileSystemCatalog", "Identifier"]
+
+
+class Identifier:
+    def __init__(self, database: str, table: str):
+        self.database = database
+        self.table = table
+
+    @staticmethod
+    def parse(full: str) -> "Identifier":
+        db, _, tbl = full.partition(".")
+        if not tbl:
+            raise ValueError(f"expected db.table, got {full!r}")
+        return Identifier(db, tbl)
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.database}.{self.table}"
+
+    def __repr__(self):
+        return self.full_name
+
+
+class Catalog:
+    def list_databases(self) -> list[str]:
+        raise NotImplementedError
+
+    def create_database(self, name: str, ignore_if_exists: bool = True) -> None:
+        raise NotImplementedError
+
+    def drop_database(self, name: str, cascade: bool = False) -> None:
+        raise NotImplementedError
+
+    def list_tables(self, database: str) -> list[str]:
+        raise NotImplementedError
+
+    def create_table(self, identifier, schema, **kw) -> "Table":
+        raise NotImplementedError
+
+    def get_table(self, identifier) -> "Table":
+        raise NotImplementedError
+
+    def drop_table(self, identifier) -> None:
+        raise NotImplementedError
+
+
+class FileSystemCatalog(Catalog):
+    DB_SUFFIX = ".db"
+    SYSTEM_SEP = "$"
+
+    def __init__(self, warehouse: str, commit_user: str = "anonymous"):
+        self.warehouse = warehouse.rstrip("/")
+        self.file_io: FileIO = get_file_io(warehouse)
+        self.commit_user = commit_user
+
+    # ---- databases -----------------------------------------------------
+    def _db_path(self, name: str) -> str:
+        return f"{self.warehouse}/{name}{self.DB_SUFFIX}"
+
+    def list_databases(self) -> list[str]:
+        out = []
+        for st in self.file_io.list_status(self.warehouse):
+            base = st.path.rsplit("/", 1)[-1]
+            if st.is_dir and base.endswith(self.DB_SUFFIX):
+                out.append(base[: -len(self.DB_SUFFIX)])
+        return sorted(out)
+
+    def create_database(self, name: str, ignore_if_exists: bool = True) -> None:
+        path = self._db_path(name)
+        if self.file_io.exists(path):
+            if not ignore_if_exists:
+                raise ValueError(f"database {name} exists")
+            return
+        self.file_io.mkdirs(path)
+
+    def drop_database(self, name: str, cascade: bool = False) -> None:
+        if not cascade and self.list_tables(name):
+            raise ValueError(f"database {name} is not empty")
+        self.file_io.delete(self._db_path(name), recursive=True)
+
+    # ---- tables --------------------------------------------------------
+    def table_path(self, identifier: "Identifier | str") -> str:
+        ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
+        return f"{self._db_path(ident.database)}/{ident.table}"
+
+    def list_tables(self, database: str) -> list[str]:
+        out = []
+        for st in self.file_io.list_status(self._db_path(database)):
+            if st.is_dir and self.file_io.exists(f"{st.path}/schema"):
+                out.append(st.path.rsplit("/", 1)[-1])
+        return sorted(out)
+
+    def create_table(
+        self,
+        identifier: "Identifier | str",
+        row_type: RowType,
+        partition_keys: Sequence[str] = (),
+        primary_keys: Sequence[str] = (),
+        options: dict | None = None,
+        ignore_if_exists: bool = False,
+    ) -> FileStoreTable:
+        ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
+        self.create_database(ident.database)
+        path = self.table_path(ident)
+        sm = SchemaManager(self.file_io, path)
+        if sm.latest() is not None and not ignore_if_exists:
+            raise ValueError(f"table {ident} exists")
+        schema = sm.create_table(row_type, partition_keys, primary_keys, options)
+        return FileStoreTable(self.file_io, path, schema, self.commit_user)
+
+    def get_table(self, identifier: "Identifier | str") -> Table:
+        ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
+        if self.SYSTEM_SEP in ident.table:
+            base, _, sys_name = ident.table.partition(self.SYSTEM_SEP)
+            data_table = self.get_table(Identifier(ident.database, base))
+            from ..table.system import system_table
+
+            return system_table(data_table, sys_name)
+        path = self.table_path(ident)
+        sm = SchemaManager(self.file_io, path)
+        schema = sm.latest()
+        if schema is None:
+            raise FileNotFoundError(f"table {ident} does not exist")
+        return FileStoreTable(self.file_io, path, schema, self.commit_user)
+
+    def drop_table(self, identifier: "Identifier | str") -> None:
+        self.file_io.delete(self.table_path(identifier), recursive=True)
+
+    def rename_table(self, src: "Identifier | str", dst: "Identifier | str") -> None:
+        ok = self.file_io.rename(self.table_path(src), self.table_path(dst))
+        if not ok:
+            raise ValueError(f"cannot rename {src} -> {dst} (destination exists)")
+
+    def alter_table(self, identifier: "Identifier | str", *changes: dict) -> TableSchema:
+        path = self.table_path(identifier)
+        return SchemaManager(self.file_io, path).commit_changes(*changes)

@@ -14,7 +14,7 @@ re-applies the missing COMPACT part via commit-identifier filtering.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Callable, Sequence
 
 from ..fs import FileIO
@@ -71,8 +71,8 @@ class FileStoreCommit:
             if c.commit_identifier > done:
                 out.append(c)
             elif c.commit_identifier == done:
-                # the APPEND snapshot landed; keep the committable if its
-                # COMPACT phase is still missing (commit() will skip APPEND)
+                # the APPEND snapshot landed; keep the committable (flagged to
+                # skip its APPEND phase) if its COMPACT half is still missing
                 has_compact = any(m.compact_before or m.compact_after for m in c.messages)
                 if has_compact:
                     kinds = {
@@ -82,7 +82,7 @@ class FileStoreCommit:
                         )
                     }
                     if CommitKind.COMPACT not in kinds:
-                        out.append(c)
+                        out.append(replace(c, skip_append=True))
         return out
 
     # ---- commit ---------------------------------------------------------
@@ -97,20 +97,16 @@ class FileStoreCommit:
                 compact_entries.append(ManifestEntry(FileKind.DELETE, msg.partition, msg.bucket, msg.total_buckets, f))
             for f in msg.compact_after:
                 compact_entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
-        # crash-replay: if this identifier already produced some snapshots,
-        # re-apply only the missing phase (APPEND landed, COMPACT did not)
-        done_kinds = {
-            s.commit_kind
-            for s in self.snapshot_manager.snapshots_of_user_with_identifier(
-                self.commit_user, committable.commit_identifier
-            )
-        }
         written: list[int] = []
-        if CommitKind.APPEND not in done_kinds and (append_entries or not compact_entries):
+        if not committable.skip_append and (append_entries or not compact_entries):
             written.append(
                 self._try_commit(CommitKind.APPEND, append_entries, committable, check_conflicts=False)
             )
-        if compact_entries and CommitKind.COMPACT not in done_kinds:
+            # from here the APPEND snapshot is durable: flag the committable so
+            # a caller retrying it (or replaying via filter_committed) cannot
+            # double-apply the APPEND phase if COMPACT fails below
+            committable.skip_append = True
+        if compact_entries:
             written.append(
                 self._try_commit(CommitKind.COMPACT, compact_entries, committable, check_conflicts=True)
             )

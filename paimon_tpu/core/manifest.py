@@ -192,3 +192,6 @@ class ManifestCommittable:
     watermark: int | None = None
     log_offsets: dict[int, int] = field(default_factory=dict)
     messages: list[CommitMessage] = field(default_factory=list)
+    # set by filter_committed on crash replay: the APPEND snapshot already
+    # landed, only the COMPACT phase is outstanding
+    skip_append: bool = False

@@ -1,0 +1,134 @@
+"""L4: the engine-neutral Table API.
+
+Parity: /root/reference/paimon-core/.../table/Table.java:41 —
+newReadBuilder() / newBatchWriteBuilder() / newStreamWriteBuilder(), tags,
+rollback; PrimaryKeyFileStoreTable / AppendOnlyFileStoreTable over the L3
+store. This is the surface engines (and users) program against.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..core.schema import SchemaManager, TableSchema
+from ..core.store import KeyValueFileStore
+from ..fs import FileIO, get_file_io
+from ..options import CoreOptions
+from ..types import RowType
+from .read import ReadBuilder
+from .write import BatchWriteBuilder, StreamWriteBuilder
+
+__all__ = ["Table", "FileStoreTable", "load_table"]
+
+
+class Table:
+    """A lake table: immutable snapshot-versioned data with builders for
+    reading and writing."""
+
+    name: str
+
+    def new_read_builder(self) -> ReadBuilder:
+        raise NotImplementedError
+
+    def new_batch_write_builder(self) -> BatchWriteBuilder:
+        raise NotImplementedError
+
+    def new_stream_write_builder(self) -> StreamWriteBuilder:
+        raise NotImplementedError
+
+
+class FileStoreTable(Table):
+    def __init__(self, file_io: FileIO, path: str, schema: TableSchema, commit_user: str = "anonymous"):
+        self.file_io = file_io
+        self.path = path
+        self.schema = schema
+        self.name = path.rstrip("/").rsplit("/", 1)[-1]
+        self.store = KeyValueFileStore(file_io, path, schema, commit_user=commit_user)
+
+    # ---- metadata ------------------------------------------------------
+    @property
+    def row_type(self) -> RowType:
+        return self.store.value_schema
+
+    @property
+    def primary_keys(self) -> list[str]:
+        return list(self.schema.primary_keys)
+
+    @property
+    def partition_keys(self) -> list[str]:
+        return list(self.schema.partition_keys)
+
+    @property
+    def options(self) -> CoreOptions:
+        return self.store.options
+
+    def copy(self, dynamic_options: dict[str, str]) -> "FileStoreTable":
+        """Same table with option overrides (reference Table.copy)."""
+        merged = dict(self.schema.options)
+        merged.update(dynamic_options)
+        from dataclasses import replace
+
+        schema = replace(self.schema, options=merged)
+        return FileStoreTable(self.file_io, self.path, schema, self.store.commit_user)
+
+    def with_user(self, commit_user: str) -> "FileStoreTable":
+        return FileStoreTable(self.file_io, self.path, self.schema, commit_user)
+
+    # ---- builders ------------------------------------------------------
+    def new_read_builder(self) -> ReadBuilder:
+        return ReadBuilder(self)
+
+    def new_batch_write_builder(self) -> BatchWriteBuilder:
+        return BatchWriteBuilder(self)
+
+    def new_stream_write_builder(self) -> StreamWriteBuilder:
+        return StreamWriteBuilder(self)
+
+    # ---- maintenance ---------------------------------------------------
+    def create_tag(self, name: str, snapshot_id: int | None = None) -> None:
+        from .tags import TagManager
+
+        TagManager(self.file_io, self.path).create(name, snapshot_id)
+
+    def delete_tag(self, name: str) -> None:
+        from .tags import TagManager
+
+        TagManager(self.file_io, self.path).delete(name)
+
+    def tags(self) -> dict[str, int]:
+        from .tags import TagManager
+
+        return TagManager(self.file_io, self.path).list_tags()
+
+    def rollback_to(self, snapshot_id: int | str) -> None:
+        from .rollback import rollback_to
+
+        rollback_to(self, snapshot_id)
+
+    def expire_snapshots(self) -> int:
+        from .tags import TagManager
+
+        tag_ids = lambda: TagManager(self.file_io, self.path).tagged_snapshot_ids()  # noqa: E731
+        from .consumer import ConsumerManager
+
+        def protected():
+            ids = set(tag_ids())
+            nxt = ConsumerManager(self.file_io, self.path).min_next_snapshot()
+            if nxt is not None:
+                latest = self.store.snapshot_manager.latest_snapshot_id() or 0
+                ids |= set(range(nxt, latest + 1))
+            return ids
+
+        return self.store.new_expire(protected).expire()
+
+
+def load_table(path: str, commit_user: str = "anonymous", dynamic_options: dict[str, str] | None = None) -> FileStoreTable:
+    """Open an existing table from its path."""
+    file_io = get_file_io(path)
+    schema = SchemaManager(file_io, path).latest()
+    if schema is None:
+        raise FileNotFoundError(f"no table at {path}")
+    table = FileStoreTable(file_io, path, schema, commit_user)
+    return table.copy(dynamic_options) if dynamic_options else table

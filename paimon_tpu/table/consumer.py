@@ -1,0 +1,52 @@
+"""Consumers: durable reader progress that blocks snapshot expiry.
+
+Parity: /root/reference/paimon-core/.../consumer/ConsumerManager.java — a
+consumer file holds the reader's next snapshot id; expiry must retain every
+snapshot >= the minimum consumer position.
+"""
+
+from __future__ import annotations
+
+from ..fs import FileIO
+from ..utils import dumps, loads
+
+__all__ = ["ConsumerManager"]
+
+
+class ConsumerManager:
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.consumer_dir = f"{table_path}/consumer"
+
+    def _path(self, consumer_id: str) -> str:
+        return f"{self.consumer_dir}/consumer-{consumer_id}"
+
+    def consumer(self, consumer_id: str) -> int | None:
+        try:
+            return loads(self.file_io.read_bytes(self._path(consumer_id)))["nextSnapshot"]
+        except Exception:
+            return None
+
+    def record(self, consumer_id: str, next_snapshot: int) -> None:
+        self.file_io.try_overwrite(self._path(consumer_id), dumps({"nextSnapshot": next_snapshot}).encode())
+
+    def delete(self, consumer_id: str) -> None:
+        self.file_io.delete(self._path(consumer_id))
+
+    def reset(self, consumer_id: str, next_snapshot: int) -> None:
+        self.record(consumer_id, next_snapshot)
+
+    def list_consumers(self) -> dict[str, int]:
+        out = {}
+        for st in self.file_io.list_files(self.consumer_dir):
+            base = st.path.rsplit("/", 1)[-1]
+            if base.startswith("consumer-"):
+                cid = base[len("consumer-") :]
+                nxt = self.consumer(cid)
+                if nxt is not None:
+                    out[cid] = nxt
+        return out
+
+    def min_next_snapshot(self) -> int | None:
+        vals = list(self.list_consumers().values())
+        return min(vals) if vals else None

@@ -1,0 +1,78 @@
+"""Rollback: move the table back to an earlier snapshot or tag.
+
+Parity: /root/reference/paimon-core/.../table/RollbackHelper.java — delete
+snapshots newer than the target, then purge files they referenced that the
+target does not (so the rolled-back table is physically clean).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..core.manifest import ManifestFile, ManifestList, merge_entries
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["rollback_to"]
+
+
+def rollback_to(table: "FileStoreTable", target: "int | str") -> None:
+    file_io = table.file_io
+    sm = table.store.snapshot_manager
+    if isinstance(target, str):
+        from .tags import TagManager
+
+        snap = TagManager(file_io, table.path).get(target)
+        target_id = snap.id
+        if not sm.snapshot_exists(target_id):
+            # re-materialize the tagged snapshot as the table head
+            file_io.try_atomic_write(sm.snapshot_path(target_id), snap.to_json().encode())
+    else:
+        target_id = target
+    latest = sm.latest_snapshot_id()
+    if latest is None or latest <= target_id:
+        return
+    if not sm.snapshot_exists(target_id):
+        raise ValueError(f"rollback target snapshot {target_id} does not exist")
+
+    manifest_file = ManifestFile(file_io, f"{table.path}/manifest")
+    manifest_list = ManifestList(file_io, f"{table.path}/manifest")
+
+    def live_set(snapshot_id: int):
+        snap = sm.snapshot(snapshot_id)
+        metas = manifest_list.read(snap.base_manifest_list) + manifest_list.read(snap.delta_manifest_list)
+        entries = merge_entries(*(manifest_file.read(m.file_name) for m in metas))
+        files = {(e.partition, e.bucket, e.file.file_name, e.file.extra_files) for e in entries}
+        manifests = {m.file_name for m in metas} | {snap.base_manifest_list, snap.delta_manifest_list}
+        return files, manifests
+
+    keep_files, keep_manifests = live_set(target_id)
+    # also keep anything referenced by snapshots older than the target
+    # (they share manifests with the target's history) — only purge what is
+    # exclusively reachable from the rolled-back snapshots
+    drop_files: set = set()
+    drop_manifests: set = set()
+    for sid in range(target_id + 1, latest + 1):
+        if not sm.snapshot_exists(sid):
+            continue
+        files, manifests = live_set(sid)
+        drop_files |= files - keep_files
+        drop_manifests |= manifests - keep_manifests
+    earliest = sm.earliest_snapshot_id() or target_id
+    for sid in range(earliest, target_id):
+        if sm.snapshot_exists(sid):
+            files, manifests = live_set(sid)
+            drop_files -= files
+            drop_manifests -= manifests
+
+    for partition, bucket, name, extra in drop_files:
+        bucket_dir = table.store.bucket_dir(partition, bucket)
+        file_io.delete(f"{bucket_dir}/{name}")
+        for x in extra:
+            file_io.delete(f"{bucket_dir}/{x}")
+    for name in drop_manifests:
+        file_io.delete(f"{table.path}/manifest/{name}")
+    for sid in range(target_id + 1, latest + 1):
+        file_io.delete(sm.snapshot_path(sid))
+    sm.commit_latest_hint(target_id)

@@ -1,0 +1,134 @@
+"""Streaming scans: starting scanners + snapshot-by-snapshot follow-up.
+
+Parity: /root/reference/paimon-core/.../table/source/DataTableStreamScan.java:51
+with the StartingScanner variants (table/source/snapshot/: full, latest,
+from-snapshot, from-timestamp, compacted-full) and DeltaFollowUpScanner.
+A StreamTableScan yields (splits, checkpoint): first the starting plan, then
+one delta plan per new snapshot; `restore(next)` resumes from a checkpoint
+(the consumer-id mechanism persists it).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..core.levels import IntervalPartition
+from ..options import CoreOptions, StartupMode
+from ..data.predicate import Predicate
+from .consumer import ConsumerManager
+from .read import DataSplit
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["StreamTableScan"]
+
+
+class StreamTableScan:
+    def __init__(self, table: "FileStoreTable", predicate: Predicate | None = None):
+        self.table = table
+        self.predicate = predicate
+        self.store = table.store
+        opts = self.store.options.options
+        self.mode: StartupMode = opts.get(CoreOptions.SCAN_MODE)
+        self.consumer_id = opts.get(CoreOptions.CONSUMER_ID)
+        self._next: int | None = None  # next snapshot id to read
+        self._started = False
+        if self.consumer_id:
+            saved = ConsumerManager(table.file_io, table.path).consumer(self.consumer_id)
+            if saved is not None:
+                self._next = saved
+                self._started = True  # consumer progress wins over startup mode
+
+    # ---- checkpointing -------------------------------------------------
+    def checkpoint(self) -> int | None:
+        """The next snapshot to process (restore token)."""
+        return self._next
+
+    def restore(self, next_snapshot: int | None) -> None:
+        self._next = next_snapshot
+        self._started = next_snapshot is not None
+
+    def notify_checkpoint_complete(self) -> None:
+        if self.consumer_id and self._next is not None:
+            ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, self._next)
+
+    # ---- planning ------------------------------------------------------
+    def plan(self) -> list[DataSplit] | None:
+        """None = nothing new yet. First call obeys the startup mode; later
+        calls return the delta of one new snapshot each."""
+        sm = self.store.snapshot_manager
+        if not self._started:
+            self._started = True
+            splits = self._starting_plan()
+            if splits is not None:
+                return splits
+        latest = sm.latest_snapshot_id()
+        if latest is None or self._next is None or self._next > latest:
+            return None
+        snap = sm.snapshot(self._next)
+        splits = self._delta_splits(self._next, snap)
+        self._next += 1
+        return splits
+
+    def _starting_plan(self) -> list[DataSplit] | None:
+        sm = self.store.snapshot_manager
+        opts = self.store.options.options
+        latest = sm.latest_snapshot_id()
+        mode = self.mode
+        if mode == StartupMode.DEFAULT:
+            mode = StartupMode.LATEST_FULL if opts.get(CoreOptions.SCAN_SNAPSHOT_ID) is None else StartupMode.FROM_SNAPSHOT
+        if mode in (StartupMode.LATEST_FULL, StartupMode.COMPACTED_FULL):
+            if latest is None:
+                self._next = 1
+                return None
+            self._next = latest + 1
+            return self._full_splits(latest, compacted=mode == StartupMode.COMPACTED_FULL)
+        if mode == StartupMode.LATEST:
+            self._next = (latest + 1) if latest is not None else 1
+            return None
+        if mode == StartupMode.FROM_SNAPSHOT:
+            sid = opts.get(CoreOptions.SCAN_SNAPSHOT_ID) or 1
+            self._next = sid
+            return None
+        if mode == StartupMode.FROM_SNAPSHOT_FULL:
+            sid = opts.get(CoreOptions.SCAN_SNAPSHOT_ID) or latest
+            if sid is None:
+                self._next = 1
+                return None
+            self._next = sid + 1
+            return self._full_splits(sid)
+        if mode == StartupMode.FROM_TIMESTAMP:
+            ts = opts.get(CoreOptions.SCAN_TIMESTAMP_MILLIS) or 0
+            snap = sm.earlier_or_equal_time_millis(ts)
+            self._next = (snap.id + 1) if snap else (sm.earliest_snapshot_id() or 1)
+            return None
+        raise ValueError(f"unsupported startup mode {mode}")
+
+    def _full_splits(self, snapshot_id: int, compacted: bool = False) -> list[DataSplit]:
+        scan = self.store.new_scan().with_snapshot(snapshot_id)
+        if compacted:
+            # read-optimized: only the highest level (no merge cost)
+            max_level = self.store.options.num_levels - 1
+            scan = scan.with_level(max_level)
+        plan = scan.plan()
+        out = []
+        for partition, buckets in sorted(plan.grouped().items()):
+            for bucket, files in sorted(buckets.items()):
+                sections = IntervalPartition(files).partition()
+                out.append(
+                    DataSplit(partition, bucket, files, snapshot_id, raw_convertible=all(len(s) == 1 for s in sections))
+                )
+        return out
+
+    def _delta_splits(self, snapshot_id: int, snap) -> list[DataSplit]:
+        from ..core.snapshot import CommitKind
+
+        if snap.commit_kind != CommitKind.APPEND:
+            return []  # compaction produces no new records (delta follow-up rule)
+        plan = self.store.new_scan().with_snapshot(snapshot_id).with_kind("delta").plan()
+        out = []
+        for partition, buckets in sorted(plan.grouped().items()):
+            for bucket, files in sorted(buckets.items()):
+                out.append(DataSplit(partition, bucket, files, snapshot_id, raw_convertible=True))
+        return out

@@ -1,0 +1,56 @@
+"""Tags: named retained snapshots for time travel.
+
+Parity: /root/reference/paimon-core/.../tag/ — Tag.java (a snapshot copy
+stored under table/tag/tag-<name>), TagManager, and the expire protection
+that keeps tagged snapshots' files alive.
+"""
+
+from __future__ import annotations
+
+from ..core.snapshot import Snapshot, SnapshotManager
+from ..fs import FileIO
+
+__all__ = ["TagManager"]
+
+
+class TagManager:
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.tag_dir = f"{table_path}/tag"
+        self.snapshot_manager = SnapshotManager(file_io, table_path)
+
+    def tag_path(self, name: str) -> str:
+        return f"{self.tag_dir}/tag-{name}"
+
+    def create(self, name: str, snapshot_id: int | None = None) -> None:
+        if self.file_io.exists(self.tag_path(name)):
+            raise ValueError(f"tag {name!r} already exists")
+        if snapshot_id is None:
+            snapshot_id = self.snapshot_manager.latest_snapshot_id()
+            if snapshot_id is None:
+                raise ValueError("cannot tag an empty table")
+        snap = self.snapshot_manager.snapshot(snapshot_id)
+        if not self.file_io.try_atomic_write(self.tag_path(name), snap.to_json().encode()):
+            raise ValueError(f"tag {name!r} already exists")
+
+    def delete(self, name: str) -> None:
+        self.file_io.delete(self.tag_path(name))
+
+    def get(self, name: str) -> Snapshot:
+        return Snapshot.from_json(self.file_io.read_bytes(self.tag_path(name)))
+
+    def snapshot_id(self, name: str) -> int:
+        return self.get(name).id
+
+    def list_tags(self) -> dict[str, int]:
+        out = {}
+        for st in self.file_io.list_files(self.tag_dir):
+            base = st.path.rsplit("/", 1)[-1]
+            if base.startswith("tag-"):
+                name = base[len("tag-") :]
+                out[name] = self.get(name).id
+        return out
+
+    def tagged_snapshot_ids(self) -> set[int]:
+        return set(self.list_tags().values())

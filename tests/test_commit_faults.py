@@ -40,9 +40,10 @@ def test_commit_crash_safety_under_random_failures(tmp_path):
             w.write(ColumnBatch.from_pydict(store.value_schema, {"k": ks, "v": vs}))
             msg = w.prepare_commit()
             commit = store.new_commit()
-            if not commit.filter_committed([ManifestCommittable(ident, messages=[msg])]):
+            remaining = commit.filter_committed([ManifestCommittable(ident, messages=[msg])])
+            if not remaining:
                 continue
-            commit.commit(ManifestCommittable(ident, messages=[msg]))
+            commit.commit(remaining[0])
         except ArtificialException:
             # crashed somewhere: check whether the commit actually landed
             FailingFileIO.reset(domain, max_fails=0, possibility=0)

@@ -239,3 +239,28 @@ def test_log_offsets_int_keys_roundtrip():
     s = Snapshot(1, 0, "b", "d", None, "u", 1, CommitKind.APPEND, 0, log_offsets={3: 77})
     back = Snapshot.from_json(s.to_json())
     assert back.log_offsets == {3: 77}
+
+
+def test_direct_commit_retry_skips_landed_append(tmp_path):
+    """commit() marks skip_append once APPEND lands, so retrying the same
+    committable after a COMPACT failure cannot double-apply APPEND."""
+    from paimon_tpu.core.manifest import ManifestCommittable
+    from paimon_tpu.core.schema import SchemaManager
+    from paimon_tpu.core.store import KeyValueFileStore
+    from paimon_tpu.fs import LocalFileIO
+    from paimon_tpu.types import BIGINT, DOUBLE
+
+    io = LocalFileIO()
+    path = str(tmp_path / "t")
+    sm = SchemaManager(io, path)
+    ts = sm.create_table(RowType.of(("k", BIGINT()), ("v", DOUBLE())), primary_keys=["k"], options={"bucket": "1"})
+    store = KeyValueFileStore(io, path, ts, commit_user="retrier")
+    w = store.new_writer((), 0)
+    w.write(ColumnBatch.from_pydict(store.value_schema, {"k": [1], "v": [1.0]}))
+    c = ManifestCommittable(1, messages=[w.prepare_commit()])
+    commit = store.new_commit()
+    commit.commit(c)
+    assert c.skip_append  # landed APPEND is recorded on the committable
+    # a blind retry with the same object adds nothing
+    commit.commit(c)
+    assert store.snapshot_manager.latest_snapshot().total_record_count == 1
