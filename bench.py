@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Benchmark: merge-on-read throughput (BASELINE.json config #1).
+
+Mirrors the reference micro-benchmark (paimon-micro-benchmarks
+TableReadBenchmark: 1M-row primary-key table, single bucket, full scan
+through the Table API — write, then scan -> plan -> merge-read). The table is
+written as 4 overlapping sorted runs (write-only mode, no compaction), so the
+read path genuinely k-way-merges 1M keyed rows: columnar decode -> key-lane
+encode -> device sort+segment kernel -> gather.
+
+Baseline denominator: Parquet full scan 975.4 Krows/s on Apple M1 Pro JDK8
+(reference TableReadBenchmark.java:62-68; see /root/repo/BASELINE.md).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+BASELINE_ROWS_PER_SEC = 975_400.0
+N_ROWS = 1_000_000
+N_RUNS = 4
+
+
+def build_table(path: str):
+    import paimon_tpu as pt
+    from paimon_tpu.catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(path, commit_user="bench")
+    schema = pt.RowType.of(
+        ("id", pt.BIGINT(False)),
+        ("c1", pt.BIGINT()),
+        ("c2", pt.BIGINT()),
+        ("c3", pt.BIGINT()),
+        ("d1", pt.DOUBLE()),
+        ("d2", pt.DOUBLE()),
+        ("s1", pt.STRING()),
+        ("s2", pt.STRING()),
+    )
+    table = cat.create_table(
+        "bench.t",
+        schema,
+        primary_keys=["id"],
+        options={"bucket": "1", "file.format": "parquet", "write-only": "true"},
+    )
+    rng = np.random.default_rng(7)
+    ids = rng.permutation(N_ROWS).astype(np.int64)
+    per = N_ROWS // N_RUNS
+    for r in range(N_RUNS):
+        chunk = np.sort(ids[r * per : (r + 1) * per])
+        n = len(chunk)
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(
+            {
+                "id": chunk,
+                "c1": chunk * 3,
+                "c2": chunk % 97,
+                "c3": chunk // 7,
+                "d1": chunk.astype(np.float64) * 0.5,
+                "d2": chunk.astype(np.float64) + 0.25,
+                "s1": np.array([f"val-{int(x) % 1000:04d}" for x in chunk], dtype=object),
+                "s2": np.array([f"tag-{int(x) % 10}" for x in chunk], dtype=object),
+            }
+        )
+        wb.new_commit().commit(w.prepare_commit())
+    return table
+
+
+def bench_read(table) -> float:
+    rb = table.new_read_builder()
+    best = float("inf")
+    for it in range(4):  # first iteration warms jit caches
+        t0 = time.perf_counter()
+        splits = rb.new_scan().plan()
+        out = rb.new_read().read_all(splits)
+        dt = time.perf_counter() - t0
+        assert out.num_rows == N_ROWS, out.num_rows
+        if it > 0:
+            best = min(best, dt)
+    return N_ROWS / best
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="paimon_tpu_bench_")
+    try:
+        table = build_table(tmp)
+        rows_per_sec = bench_read(table)
+        print(
+            json.dumps(
+                {
+                    "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
+                    "value": round(rows_per_sec, 1),
+                    "unit": "rows/s",
+                    "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

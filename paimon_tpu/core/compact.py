@@ -129,14 +129,17 @@ class MergeTreeCompactRewriter:
         self.merge = merge_executor
 
     def rewrite(self, sections: list[list[SortedRun]], output_level: int, drop_delete: bool) -> list[DataFileMeta]:
+        from .read import order_runs_for_merge
+
         out: list[DataFileMeta] = []
         for section in sections:
+            runs, seq_ascending = order_runs_for_merge(section)
             batches = []
-            for run in section:
+            for run in runs:
                 for f in run.files:
                     batches.append(self.reader_factory.read(f))
             kv = KVBatch.concat(batches)
-            merged = self.merge.merge(kv)
+            merged = self.merge.merge(kv, seq_ascending=seq_ascending)
             if drop_delete:
                 merged = merged.drop_deletes()
             out.extend(self.writer_factory.write(merged, output_level, file_source="compact"))

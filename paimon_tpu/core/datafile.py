@@ -200,14 +200,30 @@ class KeyValueFileReaderFactory:
         self.schemas_by_id = schemas_by_id
         self.format_id = file_format
 
-    def read(self, meta: DataFileMeta, predicate: Predicate | None = None) -> KVBatch:
+    def read(
+        self,
+        meta: DataFileMeta,
+        predicate: Predicate | None = None,
+        fields: Sequence[str] | None = None,
+        system_columns: bool = True,
+    ) -> KVBatch:
+        """fields: optional subset of read-schema fields to materialize (the
+        returned KVBatch's data schema is projected accordingly). Row-group
+        skipping depends only on `predicate`, so two reads of the same file
+        with the same predicate but different `fields` are row-aligned —
+        the pipelined merge path relies on that."""
         data_schema = self.schemas_by_id[meta.schema_id]
         disk_schema = kv_disk_schema(data_schema)
+        read_fields = (
+            self.read_schema.fields
+            if fields is None
+            else tuple(self.read_schema.field(n) for n in fields)
+        )
         # project to the file columns that exist for the read schema
         by_id = {f.id: f for f in data_schema.fields}
-        wanted_cols = [SEQUENCE_FIELD_NAME, VALUE_KIND_FIELD_NAME]
+        wanted_cols = [SEQUENCE_FIELD_NAME, VALUE_KIND_FIELD_NAME] if system_columns else []
         mapping: list[tuple[DataField, DataField | None]] = []
-        for f in self.read_schema.fields:
+        for f in read_fields:
             src = by_id.get(f.id)
             mapping.append((f, src))
             if src is not None:
@@ -232,7 +248,12 @@ class KeyValueFileReaderFactory:
             else:
                 col = disk.column(src.name)
                 cols[f.name] = cast_column(col, src.type, f.type) if src.type != f.type else col
-        data = ColumnBatch(self.read_schema, cols)
-        seq = disk.column(SEQUENCE_FIELD_NAME).values.astype(np.int64, copy=False)
-        kind = disk.column(VALUE_KIND_FIELD_NAME).values.astype(np.uint8)
+        out_schema = self.read_schema if fields is None else RowType(read_fields)
+        data = ColumnBatch(out_schema, cols)
+        if system_columns:
+            seq = disk.column(SEQUENCE_FIELD_NAME).values.astype(np.int64, copy=False)
+            kind = disk.column(VALUE_KIND_FIELD_NAME).values.astype(np.uint8)
+        else:  # caller already holds seq/kind from the key pass
+            seq = np.zeros(n, dtype=np.int64)
+            kind = np.zeros(n, dtype=np.uint8)
         return KVBatch(data, seq, kind)

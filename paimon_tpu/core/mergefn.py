@@ -21,6 +21,7 @@ from ..options import CoreOptions, MergeEngine
 from ..ops import (
     AggregateSpec,
     aggregate_merge,
+    deduplicate_select,
     deduplicate_take,
     first_row_take,
     merge_plan,
@@ -52,7 +53,7 @@ class MergeExecutor:
         ]
         self._user_seq = self.options.sequence_field
 
-    def _plan(self, kv: KVBatch):
+    def _lanes(self, kv: KVBatch, seq_ascending: bool) -> tuple[np.ndarray, np.ndarray | None]:
         pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
         lanes = encode_key_lanes(kv.data, self.key_names, pools)
         seq_parts = []
@@ -65,15 +66,28 @@ class MergeExecutor:
                 if kv.data.schema.field(f).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR)
             }
             seq_parts.append(encode_key_lanes(kv.data, self._user_seq, useq_pools))
-        hi, lo = split_int64_lanes(kv.seq)
-        seq_parts.append(np.stack([hi, lo], axis=1))
-        seq_lanes = np.concatenate(seq_parts, axis=1)
+        if not seq_ascending:
+            # explicit seqno lanes only when input order doesn't already
+            # encode them (stability of the device sort covers the rest)
+            hi, lo = split_int64_lanes(kv.seq)
+            seq_parts.append(np.stack([hi, lo], axis=1))
+        seq_lanes = np.concatenate(seq_parts, axis=1) if seq_parts else None
+        return lanes, seq_lanes
+
+    def _plan(self, kv: KVBatch, seq_ascending: bool = False):
+        lanes, seq_lanes = self._lanes(kv, seq_ascending)
         return merge_plan(lanes, seq_lanes)
 
-    def merge(self, kv: KVBatch) -> KVBatch:
+    def merge(self, kv: KVBatch, seq_ascending: bool = False) -> KVBatch:
         """One output row per key, key-sorted. Dedup keeps the winning row's
         RowKind (a -D survives compaction until the top level); partial-update
-        and aggregation emit +I rows."""
+        and aggregation emit +I rows.
+
+        seq_ascending=True asserts that rows with equal keys appear in
+        ascending sequence-number order in the input (true for memtable
+        flushes and for runs with disjoint seq ranges concatenated in seq
+        order) — the kernel then skips uploading sequence lanes entirely.
+        """
         if kv.num_rows == 0:
             return kv
         if self.options.ignore_delete:
@@ -82,9 +96,40 @@ class MergeExecutor:
                 kv = kv.filter(keep)
                 if kv.num_rows == 0:
                     return kv
-        plan = self._plan(kv)
         if self.engine == MergeEngine.DEDUPLICATE:
-            return kv.take(deduplicate_take(plan))
+            lanes, seq_lanes = self._lanes(kv, seq_ascending)
+            return kv.take(deduplicate_select(lanes, seq_lanes))
+        plan = self._plan(kv, seq_ascending)
+        return self._merge_with_plan(kv, plan)
+
+    def supports_keys_only_pipeline(self) -> bool:
+        """True when merge needs only (key cols, seq, kind) to pick winners —
+        lets the read path dispatch the kernel before value columns decode."""
+        return self.engine == MergeEngine.DEDUPLICATE and not self.options.ignore_delete and not self._user_seq
+
+    def dedup_select_async(self, kv_keys: KVBatch, seq_ascending: bool, run_offsets=None):
+        """kv_keys carries only the key columns. Returns an opaque handle.
+        With run_offsets and no explicit seq lanes, dispatches key-range tiles
+        so transfers of one tile overlap the device sort of another."""
+        lanes, seq_lanes = self._lanes(kv_keys, seq_ascending)
+        from ..ops.merge import deduplicate_select_async, deduplicate_tiled_dispatch, drop_constant_lanes
+
+        if seq_lanes is None and run_offsets is not None:
+            tile_rows = self.options.options.get(CoreOptions.MERGE_READ_BATCH_ROWS)
+            kl = drop_constant_lanes(lanes)
+            if kl.shape[1] == 0 and lanes.shape[1]:
+                kl = lanes[:, :1]
+            return ("tiled", deduplicate_tiled_dispatch(kl, run_offsets, tile_rows))
+        return ("single", deduplicate_select_async(lanes, seq_lanes))
+
+    @staticmethod
+    def dedup_resolve(handle) -> np.ndarray:
+        from ..ops.merge import deduplicate_resolve, deduplicate_resolve_tiled
+
+        tag, h = handle
+        return deduplicate_resolve_tiled(h) if tag == "tiled" else deduplicate_resolve(h)
+
+    def _merge_with_plan(self, kv: KVBatch, plan) -> KVBatch:
         if self.engine == MergeEngine.FIRST_ROW:
             if np.isin(kv.kind, (int(RowKind.UPDATE_BEFORE), int(RowKind.DELETE))).any():
                 raise ValueError("first-row merge engine accepts only +I/+U records")

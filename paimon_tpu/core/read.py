@@ -20,7 +20,25 @@ from .kv import KVBatch
 from .levels import IntervalPartition
 from .mergefn import MergeExecutor
 
-__all__ = ["MergeFileSplitRead"]
+__all__ = ["MergeFileSplitRead", "order_runs_for_merge"]
+
+
+def order_runs_for_merge(section) -> tuple[list, bool]:
+    """Order a section's runs by ascending sequence range and report whether
+    the ranges are pairwise disjoint. Disjoint + ordered means equal keys
+    appear in ascending seq order after concatenation, so the merge kernel
+    can rely on sort stability instead of uploading sequence lanes."""
+    runs = sorted(section, key=lambda r: min(f.min_sequence_number for f in r.files))
+    disjoint = True
+    prev_max = None
+    for r in runs:
+        lo = min(f.min_sequence_number for f in r.files)
+        hi = max(f.max_sequence_number for f in r.files)
+        if prev_max is not None and lo <= prev_max:
+            disjoint = False
+            break
+        prev_max = hi
+    return runs, disjoint
 
 
 class MergeFileSplitRead:
@@ -58,13 +76,14 @@ class MergeFileSplitRead:
                 kv_parts = [self.reader_factory.read(f, predicate=predicate) for f in section[0].files]
                 kv = KVBatch.concat(kv_parts)
             else:
-                batches = [
-                    self.reader_factory.read(f, predicate=key_filter)
-                    for run in section
-                    for f in run.files
-                ]
-                kv = KVBatch.concat(batches)
-                kv = self.merge.merge(kv)
+                runs, seq_ascending = order_runs_for_merge(section)
+                ordered_files = [f for run in runs for f in run.files]
+                if self.merge.supports_keys_only_pipeline():
+                    kv = self._pipelined_dedup(ordered_files, key_filter, seq_ascending)
+                else:
+                    batches = [self.reader_factory.read(f, predicate=key_filter) for f in ordered_files]
+                    kv = KVBatch.concat(batches)
+                    kv = self.merge.merge(kv, seq_ascending=seq_ascending)
             if drop_delete:
                 kv = kv.drop_deletes()
             data = kv.data
@@ -82,15 +101,58 @@ class MergeFileSplitRead:
             return ColumnBatch.empty(schema)
         return concat_batches(out)
 
+    def _pipelined_dedup(self, ordered_files, key_filter, seq_ascending: bool) -> KVBatch:
+        """Overlap host decode with the device merge: decode just the key
+        columns, dispatch the dedup kernel (async), decode the value columns
+        while the device sorts, then gather. The two decode passes share the
+        predicate, so their row sets are identical (datafile.read contract)."""
+        key_names = [n for n in self.reader_factory.read_schema.field_names if n in self.key_names]
+        rest_names = [n for n in self.reader_factory.read_schema.field_names if n not in self.key_names]
+        heads = [self.reader_factory.read(f, predicate=key_filter, fields=key_names) for f in ordered_files]
+        kv_keys = KVBatch.concat(heads)
+        if kv_keys.num_rows == 0:
+            return KVBatch(
+                ColumnBatch.empty(self.reader_factory.read_schema),
+                np.empty(0, dtype=np.int64),
+                np.empty(0, dtype=np.uint8),
+            )
+        # file -> run offsets for key-range tiling (files of one run are
+        # consecutive in ordered_files and key-sorted)
+        run_offsets = [0]
+        for h in heads:
+            run_offsets.append(run_offsets[-1] + h.num_rows)
+        handle = self.merge.dedup_select_async(kv_keys, seq_ascending, run_offsets=run_offsets)
+        if rest_names:
+            tails = [
+                self.reader_factory.read(f, predicate=key_filter, fields=rest_names, system_columns=False)
+                for f in ordered_files
+            ]
+            full_schema = self.reader_factory.read_schema
+            cols = {}
+            for name in full_schema.field_names:
+                if name in self.key_names:
+                    cols[name] = kv_keys.data.column(name)
+                else:
+                    from ..data.batch import Column
+
+                    cols[name] = Column.concat([t.data.column(name) for t in tails])
+            data = ColumnBatch(full_schema, cols)
+        else:
+            data = kv_keys.data
+        kv = KVBatch(data, kv_keys.seq, kv_keys.kind)
+        take = self.merge.dedup_resolve(handle)
+        return kv.take(take)
+
     def read_kv(self, files: list[DataFileMeta], drop_delete: bool = False) -> KVBatch:
         """Raw merged KeyValues (used by compaction tests / changelog)."""
         sections = IntervalPartition(files).partition()
         parts: list[KVBatch] = []
         for section in sections:
-            batches = [self.reader_factory.read(f) for run in section for f in run.files]
+            runs, seq_ascending = order_runs_for_merge(section)
+            batches = [self.reader_factory.read(f) for run in runs for f in run.files]
             kv = KVBatch.concat(batches)
             if len(section) > 1:
-                kv = self.merge.merge(kv)
+                kv = self.merge.merge(kv, seq_ascending=seq_ascending)
             if drop_delete:
                 kv = kv.drop_deletes()
             parts.append(kv)

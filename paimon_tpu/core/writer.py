@@ -47,6 +47,7 @@ class MergeTreeWriter:
         self.seq = restored_max_seq + 1
         self._buffer: list[KVBatch] = []
         self._buffered_rows = 0
+        self._buffer_seq_ordered = True
         self._new_files: list[DataFileMeta] = []
         self._compact_before: list[DataFileMeta] = []
         self._compact_after: list[DataFileMeta] = []
@@ -67,8 +68,13 @@ class MergeTreeWriter:
             self.flush()
 
     def write_kv(self, kv: KVBatch) -> None:
+        if kv.num_rows == 0:
+            return
+        # externally assigned seqs may interleave: disable the stability
+        # shortcut for this memtable generation
+        self._buffer_seq_ordered = False
         self._buffer.append(kv)
-        self.seq = max(self.seq, int(kv.seq.max()) + 1) if kv.num_rows else self.seq
+        self.seq = max(self.seq, int(kv.seq.max()) + 1)
         self._buffered_rows += kv.num_rows
         if self._buffered_rows >= self.options.write_buffer_rows:
             self.flush()
@@ -80,7 +86,9 @@ class MergeTreeWriter:
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
         self._buffer.clear()
         self._buffered_rows = 0
-        merged = self.merge.merge(kv)
+        # memtable rows arrive in seq order: stability replaces seq lanes
+        merged = self.merge.merge(kv, seq_ascending=self._buffer_seq_ordered)
+        self._buffer_seq_ordered = True
         files = self.writer_factory.write(merged, level=0, file_source="append")
         self._new_files.extend(files)
         if self.compact_manager is not None and not self.options.write_only:

@@ -20,6 +20,7 @@ once per (lane-count, size-bucket) and caches.
 from .aggregates import AGGREGATORS, AggregateSpec, aggregate_merge
 from .merge import (
     MergePlan,
+    deduplicate_select,
     deduplicate_take,
     first_row_take,
     merge_plan,
@@ -31,6 +32,7 @@ __all__ = [
     "MergePlan",
     "merge_plan",
     "pad_size",
+    "deduplicate_select",
     "deduplicate_take",
     "first_row_take",
     "partial_update_takes",

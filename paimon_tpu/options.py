@@ -223,8 +223,11 @@ class CoreOptions:
     )
     WRITE_MAX_WRITERS_TO_SPILL = ConfigOption.int_("write-max-writers-to-spill", 5, "Writers before spill.")
     SORT_SPILL_THRESHOLD = ConfigOption.int_("sort-spill-threshold", None, "Merge fan-in before spill.")
+    # tiles keep one merge step within device memory; per-dispatch latency
+    # makes small tiles counterproductive, so the default only kicks in for
+    # genuinely large sections
     MERGE_READ_BATCH_ROWS = ConfigOption.int_(
-        "merge.read-batch-rows", 1 << 20, "Row tile per device merge step (key-range tiling)."
+        "merge.read-batch-rows", 8 << 20, "Row tile per device merge step (key-range tiling)."
     )
     CONSUMER_ID = ConfigOption.string("consumer-id", None, "Consumer id protecting read progress.")
     CONSUMER_EXPIRATION_TIME_MS = ConfigOption.int_("consumer.expiration-time.ms", None, "Consumer expiry.")

@@ -1,0 +1,25 @@
+"""Distributed execution over jax device meshes.
+
+The reference scales by running one task per (partition, bucket) on a Flink/
+Spark cluster and shuffling rows by bucket hash over the engine's network
+stack (SURVEY §2.9). The TPU-native mapping:
+
+  * mesh axis "bucket"  — data parallelism: buckets are key-disjoint, so
+    per-bucket merges run embarrassingly parallel, one shard each
+    (shard_map; no collectives on this axis);
+  * mesh axis "key"     — the long-context analog: one bucket's key space is
+    range-partitioned across devices; a distributed merge/sort first
+    redistributes rows to their range owner with an all_to_all over ICI
+    (Paimon's RangeShuffle for sort-compact), then merges locally;
+  * the commit protocol stays host-side (snapshot CAS on the shared FS) —
+    exactly like the reference, where the filesystem is the metadata plane.
+
+Multi-host: the same mesh spans hosts via jax.distributed; the all_to_all
+rides ICI within a slice and DCN across slices — no NCCL/MPI analog needed,
+XLA owns the collectives.
+"""
+
+from .mesh import make_mesh
+from .merge import bucket_parallel_dedup, distributed_merge_step, range_partition_lanes
+
+__all__ = ["make_mesh", "bucket_parallel_dedup", "distributed_merge_step", "range_partition_lanes"]

@@ -1,0 +1,27 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh"]
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    bucket_parallel: int | None = None,
+    axis_names: tuple[str, str] = ("bucket", "key"),
+) -> Mesh:
+    """A 2D (bucket, key) mesh. bucket_parallel defaults to all devices
+    (key axis 1 — pure bucket data-parallelism); set it lower to give each
+    bucket a key-range-parallel group."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    bp = bucket_parallel if bucket_parallel is not None else n
+    assert n % bp == 0, f"{n} devices not divisible into bucket_parallel={bp}"
+    arr = np.array(devices).reshape(bp, n // bp)
+    return Mesh(arr, axis_names)

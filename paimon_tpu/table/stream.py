@@ -42,7 +42,12 @@ class StreamTableScan:
 
     # ---- checkpointing -------------------------------------------------
     def checkpoint(self) -> int | None:
-        """The next snapshot to process (restore token)."""
+        """The next snapshot to process (restore token). The value is
+        remembered so notify_checkpoint_complete records exactly what the
+        framework durably checkpointed — not whatever the scan advanced to
+        since (the consumer must never run ahead of the restore token, or
+        expiry could delete a snapshot the restore still needs)."""
+        self._last_checkpoint = self._next
         return self._next
 
     def restore(self, next_snapshot: int | None) -> None:
@@ -50,8 +55,9 @@ class StreamTableScan:
         self._started = next_snapshot is not None
 
     def notify_checkpoint_complete(self) -> None:
-        if self.consumer_id and self._next is not None:
-            ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, self._next)
+        cp = getattr(self, "_last_checkpoint", None)
+        if self.consumer_id and cp is not None:
+            ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, cp)
 
     # ---- planning ------------------------------------------------------
     def plan(self) -> list[DataSplit] | None:

@@ -199,11 +199,13 @@ class _AuditLogTable:
         )
 
     def read(self) -> ColumnBatch:
+        from ..core.read import MergeFileSplitRead
+
         store = self.table.store
         splits = self.table.new_read_builder().new_scan().plan()
         batches = []
         for s in splits:
-            read = __import__("paimon_tpu.core.read", fromlist=["MergeFileSplitRead"]).MergeFileSplitRead(
+            read = MergeFileSplitRead(
                 store.reader_factory(s.partition, s.bucket), store.merge_executor(), store.key_names
             )
             kv = read.read_kv(s.files)

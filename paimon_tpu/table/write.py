@@ -76,6 +76,14 @@ class TableCommit:
 
     def commit_messages(self, identifier: int, messages: list[CommitMessage], watermark: int | None = None) -> list[int]:
         c = ManifestCommittable(identifier, watermark=watermark, messages=messages)
+        if identifier != BatchWriteBuilder.COMMIT_IDENTIFIER:
+            # streaming identifiers are monotonic per user: route through the
+            # replay filter so a crash-retry with a rebuilt committable (same
+            # identifier) cannot double-apply a phase that already landed
+            remaining = self._commit.filter_committed([c])
+            if not remaining:
+                return []
+            c = remaining[0]
         snapshot_ids = self._commit.commit(c)
         self._post_commit()
         return snapshot_ids

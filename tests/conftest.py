@@ -4,10 +4,16 @@ separately dry-runs the real multi-chip path via __graft_entry__)."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force CPU even if the env preset axon/tpu
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the environment's sitecustomize may programmatically pin jax to the real
+# TPU (axon) — override via config, which wins over both
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

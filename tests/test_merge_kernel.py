@@ -226,3 +226,19 @@ def test_large_merge_consistency(rng):
     for i, k in enumerate(keys.tolist()):
         oracle[k] = i  # seq == input order, so last occurrence wins
     assert take.tolist() == [oracle[k] for k in sorted(oracle)]
+
+
+def test_tiled_dedup_matches_single(rng):
+    """Key-range tiled dispatch == single-shot dedup, for key-sorted runs."""
+    from paimon_tpu.ops.merge import deduplicate_select, deduplicate_select_tiled
+
+    runs = []
+    for r in range(4):
+        ks = np.sort(rng.choice(3000, size=1000, replace=False)).astype(np.int32)
+        runs.append(ks)
+    keys = np.concatenate(runs)
+    lanes = (keys.view(np.uint32) ^ np.uint32(0x80000000)).reshape(-1, 1)
+    offsets = [0, 1000, 2000, 3000, 4000]
+    tiled = deduplicate_select_tiled(lanes, offsets, tile_rows=512)
+    single = deduplicate_select(lanes)
+    assert tiled.tolist() == single.tolist()

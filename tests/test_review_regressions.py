@@ -264,3 +264,55 @@ def test_direct_commit_retry_skips_landed_append(tmp_path):
     # a blind retry with the same object adds nothing
     commit.commit(c)
     assert store.snapshot_manager.latest_snapshot().total_record_count == 1
+
+
+def test_consumer_records_checkpoint_not_current(tmp_path):
+    """notify_checkpoint_complete persists the last checkpoint() value, even
+    if the scan advanced since."""
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.table.consumer import ConsumerManager
+    from paimon_tpu.types import BIGINT, DOUBLE
+
+    cat = FileSystemCatalog(str(tmp_path), commit_user="c")
+    t = cat.create_table(
+        "db.s", RowType.of(("k", BIGINT()), ("v", DOUBLE())), primary_keys=["k"],
+        options={"bucket": "1", "consumer-id": "cid"},
+    )
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [1], "v": [1.0]}); wb.new_commit().commit(w.prepare_commit())
+    scan = t.new_read_builder().new_stream_scan()
+    scan.plan()
+    cp = scan.checkpoint()
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [2], "v": [2.0]}); wb.new_commit().commit(w.prepare_commit())
+    scan.plan()  # advances past cp
+    scan.notify_checkpoint_complete()
+    assert ConsumerManager(t.file_io, t.path).consumer("cid") == cp
+
+
+def test_nested_array_column_roundtrip():
+    import pyarrow as pa
+
+    from paimon_tpu.types import ArrayType, INT as INT_T
+
+    schema = RowType.of(("a", INT_T()), ("arr", ArrayType(INT_T())))
+    t = pa.table({"a": [1], "arr": [[1, 2]]})
+    b = ColumnBatch.from_arrow(t, schema)
+    assert b.to_pylist() == [(1, [1, 2])]  # python list, not ndarray
+
+
+def test_streaming_commit_messages_replay_safe(tmp_path):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE
+
+    cat = FileSystemCatalog(str(tmp_path), commit_user="s")
+    t = cat.create_table("db.r", RowType.of(("k", BIGINT()), ("v", DOUBLE())), primary_keys=["k"], options={"bucket": "1"})
+    wb = t.new_stream_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1], "v": [1.0]})
+    msgs = w.prepare_commit()
+    tc = wb.new_commit()
+    assert tc.commit_messages(1, msgs) != []
+    # crash-replay with a REBUILT committable: must be a no-op
+    assert tc.commit_messages(1, msgs) == []
+    assert t.store.snapshot_manager.latest_snapshot().total_record_count == 1

@@ -159,6 +159,7 @@ def test_stream_scan_consumer_id(catalog):
     write_batch(t, {"id": [1], "region": ["a"], "amount": [1.0]})
     scan = t.new_read_builder().new_stream_scan()
     scan.plan()
+    scan.checkpoint()  # the framework checkpoints, then acks
     scan.notify_checkpoint_complete()
     from paimon_tpu.table.consumer import ConsumerManager
 
