@@ -1,0 +1,173 @@
+"""Append-only (no primary key) tables: writer + small-file compaction.
+
+Parity: /root/reference/paimon-core/.../append/ — AppendOnlyWriter.java:62
+(direct row buffer, rolling files), AppendOnlyCompactManager (concatenate
+consecutive small files until target size; no merge function — order is
+preserved), AppendOnlyFileStoreTable.java:50. Bucket modes: fixed (hash of
+bucket key) or unaware (bucket -1: one shared bucket-0 namespace, compaction
+planned separately — reference AppendOnlyTableCompactionCoordinator).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.batch import ColumnBatch
+from ..options import CoreOptions
+from ..types import RowKind
+from .datafile import DataFileMeta, KeyValueFileReaderFactory, KeyValueFileWriterFactory
+from .kv import KVBatch
+from .manifest import CommitMessage
+
+__all__ = ["AppendOnlyWriter", "AppendOnlyCompactManager"]
+
+
+class AppendOnlyCompactManager:
+    """Pick consecutive small files and concatenate them (order-preserving)."""
+
+    def __init__(
+        self,
+        reader_factory: KeyValueFileReaderFactory,
+        writer_factory: KeyValueFileWriterFactory,
+        options: CoreOptions,
+        deletion_vectors: dict | None = None,
+    ):
+        self.reader_factory = reader_factory
+        self.writer_factory = writer_factory
+        self.options = options
+        self.deletion_vectors = deletion_vectors or {}
+
+    def pick(self, files: list[DataFileMeta], full: bool = False) -> list[DataFileMeta] | None:
+        """Consecutive (in sequence order) run of small files whose total
+        reaches the target size (reference AppendOnlyCompactManager#
+        pickCompactBefore); full=True rewrites everything into target-size
+        files."""
+        files = sorted(files, key=lambda f: (f.min_sequence_number, f.file_name))
+        if full:
+            return files if len(files) > 1 else None
+        target = self.options.target_file_size
+        min_count = self.options.compaction_min_file_num
+        small: list[DataFileMeta] = []
+        for f in files:
+            if f.file_size < target:
+                small.append(f)
+                if len(small) >= min_count or sum(x.file_size for x in small) >= target:
+                    return small
+            else:
+                small = []
+        return None
+
+    def compact(self, files: list[DataFileMeta], full: bool = False) -> tuple[list[DataFileMeta], list[DataFileMeta]]:
+        pick = self.pick(files, full)
+        if not pick:
+            return [], []
+        batches = []
+        for f in pick:
+            kv = self.reader_factory.read(f)
+            dv = self.deletion_vectors.get(f.file_name)
+            if dv is not None:
+                mask = ~dv.deleted_mask(kv.num_rows)
+                if not mask.all():
+                    kv = kv.filter(mask)
+            batches.append(kv)
+        kv = KVBatch.concat(batches)
+        # keyed=False readers surface no per-row seqs; re-derive an in-range
+        # sequence span so ordering and writer restore stay correct
+        base = min(f.min_sequence_number for f in pick)
+        kv = KVBatch(kv.data, np.arange(base, base + kv.num_rows, dtype=np.int64), kv.kind)
+        out = self.writer_factory.write(kv, level=0, file_source="compact")
+        return pick, out
+
+
+class AppendOnlyWriter:
+    """Buffers row batches and rolls them into data files — no keys, no
+    merge; sequence numbers order files for streaming reads."""
+
+    def __init__(
+        self,
+        partition: tuple,
+        bucket: int,
+        total_buckets: int,
+        writer_factory: KeyValueFileWriterFactory,
+        compact_manager: AppendOnlyCompactManager | None,
+        options: CoreOptions,
+        existing_files: list[DataFileMeta] | None = None,
+        restored_max_seq: int = -1,
+    ):
+        self.partition = partition
+        self.bucket = bucket
+        self.total_buckets = total_buckets
+        self.writer_factory = writer_factory
+        self.compact_manager = compact_manager
+        self.options = options
+        self.seq = restored_max_seq + 1
+        self._existing = list(existing_files or [])
+        self._buffer: list[ColumnBatch] = []
+        self._buffered_rows = 0
+        self._new_files: list[DataFileMeta] = []
+        self._compact_before: list[DataFileMeta] = []
+        self._compact_after: list[DataFileMeta] = []
+
+    def write(self, data: ColumnBatch, kinds: np.ndarray | None = None) -> None:
+        if kinds is not None and (np.asarray(kinds) != int(RowKind.INSERT)).any():
+            raise ValueError("append-only tables accept only +I records")
+        if data.num_rows == 0:
+            return
+        self._buffer.append(data)
+        self._buffered_rows += data.num_rows
+        if self._buffered_rows >= self.options.write_buffer_rows:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._buffer:
+            return
+        from ..data.batch import concat_batches
+
+        data = concat_batches(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
+        self._buffer.clear()
+        self._buffered_rows = 0
+        kv = KVBatch.from_rows(data, self.seq)
+        self.seq += data.num_rows
+        files = self.writer_factory.write(kv, level=0, file_source="append")
+        self._new_files.extend(files)
+        if self.compact_manager is not None and not self.options.write_only:
+            self._maybe_compact()
+
+    def _maybe_compact(self, full: bool = False) -> None:
+        assert self.compact_manager is not None
+        consumed = {f.file_name for f in self._compact_before}
+        current = [f for f in self._existing if f.file_name not in consumed] + [
+            f for f in self._new_files if f.file_name not in consumed
+        ] + [f for f in self._compact_after if f.file_name not in consumed]
+        before, after = self.compact_manager.compact(current, full=full)
+        self._compact_before.extend(before)
+        self._compact_after.extend(after)
+
+    def compact(self, full: bool = False) -> None:
+        self.flush()
+        if self.compact_manager is not None:
+            self._maybe_compact(full=full)
+
+    def prepare_commit(self) -> CommitMessage:
+        self.flush()
+        # files created AND consumed by compaction within this commit cancel
+        before_names = {f.file_name for f in self._compact_before}
+        after_names = {f.file_name for f in self._compact_after}
+        cancel = before_names & after_names
+        before = [f for f in self._compact_before if f.file_name not in cancel]
+        after = [f for f in self._compact_after if f.file_name not in cancel]
+        msg = CommitMessage(
+            partition=self.partition,
+            bucket=self.bucket,
+            total_buckets=self.total_buckets,
+            new_files=list(self._new_files),
+            compact_before=before,
+            compact_after=after,
+        )
+        consumed = {f.file_name for f in before}
+        self._existing = [f for f in self._existing if f.file_name not in consumed] + list(self._new_files) + after
+        self._existing = [f for f in self._existing if f.file_name not in consumed]
+        self._new_files.clear()
+        self._compact_before.clear()
+        self._compact_after.clear()
+        return msg

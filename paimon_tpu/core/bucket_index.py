@@ -1,0 +1,112 @@
+"""Dynamic bucket mode: durable key-hash -> bucket assignment.
+
+Parity: /root/reference/paimon-core/.../index/ — HashBucketAssigner.java:37 /
+SimpleHashBucketAssigner (single-writer), PartitionIndex (key-hash set per
+bucket persisted as hash index files in the index manifest). A PK table with
+bucket = -1 assigns each new key to a non-full bucket and pins it there
+forever; the per-bucket hash sets are the durable record.
+
+Vectorized: assignment of a batch is one membership probe (np.isin against
+each bucket's sorted hash array) + one allocation pass for the misses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import zstandard
+
+from ..fs import FileIO
+from ..utils import new_file_name
+from .deletionvectors import IndexFileEntry
+
+__all__ = ["HashIndexFile", "SimpleHashBucketAssigner"]
+
+
+class HashIndexFile:
+    """One file per (partition, bucket): the sorted uint64 key hashes living
+    in that bucket (reference index/HashIndexFile — int hashes in sequence)."""
+
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.index_dir = f"{table_path}/index"
+
+    def write(self, hashes: np.ndarray) -> str:
+        name = new_file_name("index-hash")
+        payload = zstandard.ZstdCompressor(level=3).compress(np.sort(hashes.astype(np.uint64)).tobytes())
+        self.file_io.write_bytes(f"{self.index_dir}/{name}", payload)
+        return name
+
+    def read(self, name: str) -> np.ndarray:
+        raw = zstandard.ZstdDecompressor().decompress(self.file_io.read_bytes(f"{self.index_dir}/{name}"))
+        return np.frombuffer(raw, dtype=np.uint64).copy()
+
+
+@dataclass
+class _PartitionIndex:
+    buckets: dict[int, np.ndarray]  # bucket -> sorted uint64 hashes
+    dirty: set
+
+
+class SimpleHashBucketAssigner:
+    """Single-writer assigner (reference SimpleHashBucketAssigner): suitable
+    whenever one process owns all buckets of the partitions it writes."""
+
+    def __init__(self, index_file: HashIndexFile, target_bucket_rows: int):
+        self.index_file = index_file
+        self.target = target_bucket_rows
+        self._partitions: dict[tuple, _PartitionIndex] = {}
+
+    def bootstrap(self, partition: tuple, bucket_indexes: dict[int, np.ndarray]) -> None:
+        self._partitions[partition] = _PartitionIndex(
+            {b: np.sort(h.astype(np.uint64)) for b, h in bucket_indexes.items()}, set()
+        )
+
+    def assign(self, partition: tuple, hashes: np.ndarray) -> np.ndarray:
+        """(n,) uint64 key hashes -> (n,) int32 buckets."""
+        pi = self._partitions.setdefault(partition, _PartitionIndex({}, set()))
+        n = len(hashes)
+        out = np.full(n, -1, dtype=np.int32)
+        # existing membership
+        for b, hs in pi.buckets.items():
+            if len(hs) == 0:
+                continue
+            unassigned = out == -1
+            if not unassigned.any():
+                break
+            idx = np.searchsorted(hs, hashes)
+            hit = (idx < len(hs)) & (hs[np.minimum(idx, len(hs) - 1)] == hashes)
+            out = np.where(unassigned & hit, b, out)
+        # allocate the rest (duplicates within the batch share one slot)
+        missing = np.flatnonzero(out == -1)
+        if len(missing):
+            uniq, inv = np.unique(hashes[missing], return_inverse=True)
+            alloc = np.empty(len(uniq), dtype=np.int32)
+            counts = {b: len(hs) for b, hs in pi.buckets.items()}
+            cursor = 0
+            for i in range(len(uniq)):
+                while counts.get(cursor, 0) >= self.target:
+                    cursor += 1
+                alloc[i] = cursor
+                counts[cursor] = counts.get(cursor, 0) + 1
+            out[missing] = alloc[inv]
+            for b in np.unique(alloc):
+                new_hashes = uniq[alloc == b]
+                old = pi.buckets.get(b, np.empty(0, np.uint64))
+                pi.buckets[b] = np.unique(np.concatenate([old, new_hashes]))
+                pi.dirty.add(int(b))
+        return out
+
+    def prepare_commit(self, total_buckets_hint: int = -1) -> dict[tuple, list[IndexFileEntry]]:
+        """Write updated hash index files for dirty buckets."""
+        out: dict[tuple, list[IndexFileEntry]] = {}
+        for partition, pi in self._partitions.items():
+            entries = []
+            for b in sorted(pi.dirty):
+                name = self.index_file.write(pi.buckets[b])
+                entries.append(IndexFileEntry("HASH_INDEX", partition, b, name, len(pi.buckets[b])))
+            if entries:
+                out[partition] = entries
+            pi.dirty.clear()
+        return out

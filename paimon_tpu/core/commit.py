@@ -19,7 +19,7 @@ from typing import Callable, Sequence
 
 from ..fs import FileIO
 from ..options import CoreOptions
-from ..utils import now_millis
+from ..utils import dumps, loads, new_file_name, now_millis
 from .manifest import (
     CommitMessage,
     FileKind,
@@ -97,18 +97,36 @@ class FileStoreCommit:
                 compact_entries.append(ManifestEntry(FileKind.DELETE, msg.partition, msg.bucket, msg.total_buckets, f))
             for f in msg.compact_after:
                 compact_entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
+        index_entries = [e for msg in committable.messages for e in msg.new_index_files]
         written: list[int] = []
-        if not committable.skip_append and (append_entries or not compact_entries):
+        if not committable.skip_append and (append_entries or index_entries or not compact_entries):
             written.append(
-                self._try_commit(CommitKind.APPEND, append_entries, committable, check_conflicts=False)
+                self._try_commit(
+                    CommitKind.APPEND, append_entries, committable, check_conflicts=False, index_entries=index_entries
+                )
             )
             # from here the APPEND snapshot is durable: flag the committable so
             # a caller retrying it (or replaying via filter_committed) cannot
             # double-apply the APPEND phase if COMPACT fails below
             committable.skip_append = True
         if compact_entries:
+            # purge DVs only for files that truly disappear: an upgrade emits
+            # DELETE+ADD with the SAME file name (level change only) and its
+            # DV must survive
+            added_names = {e.file.file_name for e in compact_entries if e.kind == FileKind.ADD}
+            removed = [
+                e
+                for e in compact_entries
+                if e.kind == FileKind.DELETE and e.file.file_name not in added_names
+            ]
             written.append(
-                self._try_commit(CommitKind.COMPACT, compact_entries, committable, check_conflicts=True)
+                self._try_commit(
+                    CommitKind.COMPACT,
+                    compact_entries,
+                    committable,
+                    check_conflicts=True,
+                    removed_files=removed,
+                )
             )
         return [w for w in written if w >= 0]
 
@@ -137,12 +155,57 @@ class FileStoreCommit:
         )
         return merge_entries(*(self.manifest_file.read(m.file_name) for m in metas))
 
+    def _index_manifest(
+        self, latest: Snapshot | None, index_entries: list, removed_files: list[ManifestEntry] | None = None
+    ) -> str | None:
+        """New index manifest = previous entries with same-(partition, bucket,
+        kind) slots replaced by this commit's entries (a maintainer always
+        emits the complete replacement set for its bucket). For commits that
+        remove data files (COMPACT/OVERWRITE), deletion vectors of the dead
+        files are purged — their rows were physically dropped during the
+        rewrite, and keeping stale DVs would desynchronize the index."""
+        from .deletionvectors import DeletionVectorsIndexFile
+        from .indexmanifest import read_index_manifest, write_index_manifest
+
+        prev: list = []
+        if latest is not None and latest.index_manifest:
+            prev = read_index_manifest(self.file_io, self.table_path, latest.index_manifest)
+        dead_by_pb: dict[tuple, set] = {}
+        for e in removed_files or []:
+            dead_by_pb.setdefault((e.partition, e.bucket), set()).add(e.file.file_name)
+        if not index_entries and not dead_by_pb:
+            return latest.index_manifest if latest else None
+        replaced = {(e.partition, e.bucket, e.kind) for e in index_entries}
+        out = []
+        dv_io = DeletionVectorsIndexFile(self.file_io, self.table_path)
+        for e in prev:
+            if (e.partition, e.bucket, e.kind) in replaced:
+                continue
+            dead = dead_by_pb.get((e.partition, e.bucket))
+            if dead and e.kind == "DELETION_VECTORS":
+                dvs = dv_io.read_all(e.file_name)
+                live = {f: dv for f, dv in dvs.items() if f not in dead}
+                if not live:
+                    continue
+                if len(live) != len(dvs):
+                    name, total = dv_io.write(live)
+                    from .deletionvectors import IndexFileEntry
+
+                    e = IndexFileEntry(e.kind, e.partition, e.bucket, name, total)
+            out.append(e)
+        out.extend(index_entries)
+        if not out:
+            return None
+        return write_index_manifest(self.file_io, self.table_path, out)
+
     def _try_commit(
         self,
         kind: CommitKind,
         entries: list[ManifestEntry],
         committable: ManifestCommittable,
         check_conflicts: bool,
+        index_entries: list | None = None,
+        removed_files: list[ManifestEntry] | None = None,
     ) -> int:
         retries = 0
         while True:
@@ -168,6 +231,7 @@ class FileStoreCommit:
                 added = sum(e.file.row_count for e in entries if e.kind == FileKind.ADD)
                 deleted = sum(e.file.row_count for e in entries if e.kind == FileKind.DELETE)
                 prev_total = (latest.total_record_count or 0) if latest else 0
+                index_manifest = self._index_manifest(latest, index_entries or [], removed_files)
                 snapshot = Snapshot(
                     id=snapshot_id,
                     schema_id=self.schema_id,
@@ -178,6 +242,7 @@ class FileStoreCommit:
                     commit_identifier=committable.commit_identifier,
                     commit_kind=kind,
                     time_millis=now_millis(),
+                    index_manifest=index_manifest,
                     total_record_count=prev_total + added - deleted,
                     delta_record_count=added - deleted,
                     watermark=committable.watermark,

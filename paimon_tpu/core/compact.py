@@ -123,10 +123,23 @@ class MergeTreeCompactRewriter:
         reader_factory: KeyValueFileReaderFactory,
         writer_factory: KeyValueFileWriterFactory,
         merge_executor: MergeExecutor,
+        deletion_vectors: dict | None = None,
     ):
         self.reader_factory = reader_factory
         self.writer_factory = writer_factory
         self.merge = merge_executor
+        # DV'd rows must be dropped during the rewrite (the commit purges the
+        # dead files' DVs afterwards) — else compaction resurrects them
+        self.deletion_vectors = deletion_vectors or {}
+
+    def _read(self, f: DataFileMeta) -> KVBatch:
+        kv = self.reader_factory.read(f)
+        dv = self.deletion_vectors.get(f.file_name)
+        if dv is not None:
+            mask = ~dv.deleted_mask(kv.num_rows)
+            if not mask.all():
+                kv = kv.filter(mask)
+        return kv
 
     def rewrite(self, sections: list[list[SortedRun]], output_level: int, drop_delete: bool) -> list[DataFileMeta]:
         from .read import order_runs_for_merge
@@ -137,7 +150,7 @@ class MergeTreeCompactRewriter:
             batches = []
             for run in runs:
                 for f in run.files:
-                    batches.append(self.reader_factory.read(f))
+                    batches.append(self._read(f))
             kv = KVBatch.concat(batches)
             merged = self.merge.merge(kv, seq_ascending=seq_ascending)
             if drop_delete:
@@ -191,10 +204,14 @@ class MergeTreeCompactManager:
         sections = IntervalPartition(unit.files).partition()
         rewrite_sections: list[list[SortedRun]] = []
         min_rewrite_size = self.options.target_file_size  # files below target get merged together
+        dv_files = set(self.rewriter.deletion_vectors)
         for section in sections:
             if len(section) == 1:
                 for f in section[0].files:
-                    if self._can_upgrade(f, unit.output_level, drop_delete, min_rewrite_size):
+                    if f.file_name in dv_files:
+                        # physically drop DV'd rows (the commit purges the DV)
+                        rewrite_sections.append([SortedRun([f])])
+                    elif self._can_upgrade(f, unit.output_level, drop_delete, min_rewrite_size):
                         if f.level != unit.output_level:
                             up = self.rewriter.upgrade(f, unit.output_level)
                             result.before.append(f)

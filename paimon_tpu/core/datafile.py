@@ -112,6 +112,7 @@ class KeyValueFileWriterFactory:
         target_file_size: int = 128 << 20,
         bloom_columns: Sequence[str] = (),
         bloom_fpp: float = 0.05,
+        keyed: bool = True,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
@@ -123,6 +124,10 @@ class KeyValueFileWriterFactory:
         self.target_file_size = target_file_size
         self.bloom_columns = list(bloom_columns)
         self.bloom_fpp = bloom_fpp
+        # keyed=False: append-only tables — plain rows on disk, no
+        # _SEQUENCE_NUMBER/_VALUE_KIND columns, no key range
+        # (reference AppendOnlyFileStore / AppendOnlyWriter)
+        self.keyed = keyed
 
     def _estimate_row_bytes(self, batch: ColumnBatch) -> int:
         total = 0
@@ -149,7 +154,7 @@ class KeyValueFileWriterFactory:
         fmt = get_format(self.format_id)
         name = new_file_name("data", self.format_id)
         path = f"{self.bucket_dir}/{name}"
-        disk = kv.to_disk_batch()
+        disk = kv.to_disk_batch() if self.keyed else kv.data
         fmt.write(self.file_io, path, disk, self.compression)
         extra: list[str] = []
         if self.bloom_columns:
@@ -165,8 +170,8 @@ class KeyValueFileWriterFactory:
             file_name=name,
             file_size=self.file_io.get_status(path).size,
             row_count=kv.num_rows,
-            min_key=_to_py_tuple(_key_tuple(kv.data, self.key_names, 0)),
-            max_key=_to_py_tuple(_key_tuple(kv.data, self.key_names, kv.num_rows - 1)),
+            min_key=_to_py_tuple(_key_tuple(kv.data, self.key_names, 0)) if self.keyed else (),
+            max_key=_to_py_tuple(_key_tuple(kv.data, self.key_names, kv.num_rows - 1)) if self.keyed else (),
             key_stats=key_stats,
             value_stats=value_stats,
             min_sequence_number=int(kv.seq.min()),
@@ -193,12 +198,14 @@ class KeyValueFileReaderFactory:
         read_schema: RowType,
         schemas_by_id: dict[int, RowType],
         file_format: str = "parquet",
+        keyed: bool = True,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
         self.read_schema = read_schema
         self.schemas_by_id = schemas_by_id
         self.format_id = file_format
+        self.keyed = keyed
 
     def read(
         self,
@@ -213,7 +220,9 @@ class KeyValueFileReaderFactory:
         with the same predicate but different `fields` are row-aligned —
         the pipelined merge path relies on that."""
         data_schema = self.schemas_by_id[meta.schema_id]
-        disk_schema = kv_disk_schema(data_schema)
+        disk_schema = kv_disk_schema(data_schema) if self.keyed else data_schema
+        if not self.keyed:
+            system_columns = False
         read_fields = (
             self.read_schema.fields
             if fields is None

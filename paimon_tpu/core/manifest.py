@@ -178,9 +178,16 @@ class CommitMessage:
     compact_before: list[DataFileMeta] = field(default_factory=list)
     compact_after: list[DataFileMeta] = field(default_factory=list)
     changelog_files: list[DataFileMeta] = field(default_factory=list)
+    new_index_files: list = field(default_factory=list)  # IndexFileEntry
 
     def is_empty(self) -> bool:
-        return not (self.new_files or self.compact_before or self.compact_after or self.changelog_files)
+        return not (
+            self.new_files
+            or self.compact_before
+            or self.compact_after
+            or self.changelog_files
+            or self.new_index_files
+        )
 
 
 @dataclass

@@ -58,6 +58,7 @@ class MergeFileSplitRead:
         predicate: Predicate | None = None,
         projection: Sequence[str] | None = None,
         drop_delete: bool = True,
+        deletion_vectors: dict | None = None,
     ) -> ColumnBatch:
         """Merge-read one bucket's files. Returns the value rows (projected),
         key-sorted within each section."""
@@ -67,21 +68,23 @@ class MergeFileSplitRead:
             key_parts = PredicateBuilder.pick_by_fields(parts, self.key_names)
         key_filter = and_(*key_parts) if key_parts else None
 
+        dvs = deletion_vectors or {}
         sections = IntervalPartition(files).partition()
         out: list[ColumnBatch] = []
         for section in sections:
             if len(section) == 1:
                 # single sorted run: keys are unique — no merge needed; full
                 # predicate pushdown is safe (reference RawFileSplitRead)
-                kv_parts = [self.reader_factory.read(f, predicate=predicate) for f in section[0].files]
+                kv_parts = [self._read_file(f, predicate, dvs) for f in section[0].files]
                 kv = KVBatch.concat(kv_parts)
             else:
                 runs, seq_ascending = order_runs_for_merge(section)
                 ordered_files = [f for run in runs for f in run.files]
-                if self.merge.supports_keys_only_pipeline():
+                has_dv = any(f.file_name in dvs for f in ordered_files)
+                if self.merge.supports_keys_only_pipeline() and not has_dv:
                     kv = self._pipelined_dedup(ordered_files, key_filter, seq_ascending)
                 else:
-                    batches = [self.reader_factory.read(f, predicate=key_filter) for f in ordered_files]
+                    batches = [self._read_file(f, key_filter, dvs) for f in ordered_files]
                     kv = KVBatch.concat(batches)
                     kv = self.merge.merge(kv, seq_ascending=seq_ascending)
             if drop_delete:
@@ -100,6 +103,17 @@ class MergeFileSplitRead:
                 schema = schema.project(projection)
             return ColumnBatch.empty(schema)
         return concat_batches(out)
+
+    def _read_file(self, f: DataFileMeta, predicate, dvs: dict) -> KVBatch:
+        """Read one file, applying its deletion vector if present. DV
+        positions are absolute file row positions, so a DV'd file is read
+        without row-group skipping (which would shift positions)."""
+        dv = dvs.get(f.file_name)
+        if dv is None:
+            return self.reader_factory.read(f, predicate=predicate)
+        kv = self.reader_factory.read(f, predicate=None)
+        mask = ~dv.deleted_mask(kv.num_rows)
+        return kv.filter(mask) if not mask.all() else kv
 
     def _pipelined_dedup(self, ordered_files, key_filter, seq_ascending: bool) -> KVBatch:
         """Overlap host decode with the device merge: decode just the key

@@ -24,6 +24,7 @@ __all__ = ["ScanPlan", "FileStoreScan"]
 class ScanPlan:
     snapshot: Snapshot | None
     entries: list[ManifestEntry] = field(default_factory=list)
+    index_entries: list = field(default_factory=list)  # IndexFileEntry
 
     def grouped(self) -> dict[tuple, dict[int, list]]:
         """{partition: {bucket: [DataFileMeta...]}}"""
@@ -31,6 +32,12 @@ class ScanPlan:
         for e in self.entries:
             out.setdefault(e.partition, {}).setdefault(e.bucket, []).append(e.file)
         return out
+
+    def dv_index_for(self, partition: tuple, bucket: int) -> str | None:
+        for e in self.index_entries:
+            if e.kind == "DELETION_VECTORS" and e.partition == partition and e.bucket == bucket:
+                return e.file_name
+        return None
 
 
 class FileStoreScan:
@@ -99,7 +106,17 @@ class FileStoreScan:
             )
             entries = merge_entries(*(self.manifest_file.read(m.file_name) for m in metas))
         entries = [e for e in entries if self._accept(e)]
-        return ScanPlan(snapshot, entries)
+        index_entries = []
+        if snapshot.index_manifest:
+            from .indexmanifest import read_index_manifest
+
+            for e in read_index_manifest(self.file_io, self.table_path, snapshot.index_manifest):
+                if self._partition_filter is not None and not self._partition_filter(e.partition):
+                    continue
+                if self._bucket is not None and e.bucket != self._bucket:
+                    continue
+                index_entries.append(e)
+        return ScanPlan(snapshot, entries, index_entries)
 
     def _accept(self, e: ManifestEntry) -> bool:
         if self._partition_filter is not None and not self._partition_filter(e.partition):

@@ -65,6 +65,8 @@ class KeyValueFileStore:
     def merge_executor(self) -> MergeExecutor:
         return MergeExecutor(self.value_schema, self.key_names, self.options.merge_engine, self.options)
 
+    keyed = True
+
     def writer_factory(self, partition: tuple, bucket: int) -> KeyValueFileWriterFactory:
         co = self.options
         bloom_cols = co.options.get(CoreOptions.FILE_INDEX_BLOOM_COLUMNS)
@@ -79,6 +81,7 @@ class KeyValueFileStore:
             target_file_size=co.target_file_size,
             bloom_columns=[c.strip() for c in bloom_cols.split(",")] if bloom_cols else (),
             bloom_fpp=co.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+            keyed=self.keyed,
         )
 
     def reader_factory(self, partition: tuple, bucket: int, read_schema: RowType | None = None) -> KeyValueFileReaderFactory:
@@ -88,6 +91,7 @@ class KeyValueFileStore:
             read_schema or self.value_schema,
             self.schemas_by_id(),
             file_format=self.options.file_format,
+            keyed=self.keyed,
         )
 
     def new_scan(self) -> FileStoreScan:
@@ -108,8 +112,20 @@ class KeyValueFileStore:
         plan = self.new_scan().with_bucket(bucket).with_partition_filter(lambda p: p == partition).plan()
         return [e.file for e in plan.entries]
 
+    def restore_state(self, partition: tuple, bucket: int):
+        """(files, deletion_vectors) for one bucket from the latest snapshot."""
+        plan = self.new_scan().with_bucket(bucket).with_partition_filter(lambda p: p == partition).plan()
+        files = [e.file for e in plan.entries]
+        dvs: dict = {}
+        dv_index = plan.dv_index_for(partition, bucket)
+        if dv_index:
+            from .deletionvectors import DeletionVectorsIndexFile
+
+            dvs = DeletionVectorsIndexFile(self.file_io, self.table_path).read_all(dv_index)
+        return files, dvs
+
     def new_writer(self, partition: tuple, bucket: int, total_buckets: int | None = None, restore: bool = True) -> MergeTreeWriter:
-        existing = self.restore_files(partition, bucket) if restore else []
+        existing, dvs = self.restore_state(partition, bucket) if restore else ([], {})
         max_seq = max((f.max_sequence_number for f in existing), default=-1)
         levels = Levels(existing, self.options.num_levels)
         merge = self.merge_executor()
@@ -122,7 +138,7 @@ class KeyValueFileStore:
                 self.options.num_sorted_runs_compaction_trigger,
                 self.options.options.get(CoreOptions.COMPACTION_OPTIMIZATION_INTERVAL),
             )
-            rewriter = MergeTreeCompactRewriter(self.reader_factory(partition, bucket), wf, merge)
+            rewriter = MergeTreeCompactRewriter(self.reader_factory(partition, bucket), wf, merge, deletion_vectors=dvs)
             compact_manager = MergeTreeCompactManager(levels, strategy, rewriter, self.options)
         return MergeTreeWriter(
             partition,
@@ -144,6 +160,70 @@ class KeyValueFileStore:
         predicate=None,
         projection: Sequence[str] | None = None,
         drop_delete: bool = True,
+        deletion_vectors: dict | None = None,
     ):
         read = MergeFileSplitRead(self.reader_factory(partition, bucket), self.merge_executor(), self.key_names)
-        return read.read_split(files, predicate, projection, drop_delete)
+        return read.read_split(files, predicate, projection, drop_delete, deletion_vectors)
+
+
+class AppendOnlyFileStore(KeyValueFileStore):
+    """No-PK store: plain rows, concat reads, small-file compaction
+    (reference AppendOnlyFileStore.java:44)."""
+
+    keyed = False
+
+    def new_writer(self, partition: tuple, bucket: int, total_buckets: int | None = None, restore: bool = True):
+        from .append import AppendOnlyCompactManager, AppendOnlyWriter
+
+        existing = self.restore_files(partition, bucket) if restore else []
+        max_seq = max((f.max_sequence_number for f in existing), default=-1)
+        wf = self.writer_factory(partition, bucket)
+        compact_manager = None
+        if not self.options.write_only:
+            compact_manager = AppendOnlyCompactManager(self.reader_factory(partition, bucket), wf, self.options)
+        return AppendOnlyWriter(
+            partition,
+            bucket,
+            total_buckets if total_buckets is not None else max(self.options.bucket, 1),
+            wf,
+            compact_manager,
+            self.options,
+            existing_files=existing,
+            restored_max_seq=max_seq,
+        )
+
+    def read_bucket(
+        self,
+        partition: tuple,
+        bucket: int,
+        files: list[DataFileMeta],
+        predicate=None,
+        projection: Sequence[str] | None = None,
+        drop_delete: bool = True,
+        deletion_vectors: dict | None = None,
+    ):
+        from ..data.batch import ColumnBatch, concat_batches
+
+        dvs = deletion_vectors or {}
+        rf = self.reader_factory(partition, bucket)
+        ordered = sorted(files, key=lambda f: (f.min_sequence_number, f.file_name))
+        out = []
+        for f in ordered:
+            dv = dvs.get(f.file_name)
+            kv = rf.read(f, predicate=None if dv is not None else predicate)
+            if dv is not None:
+                mask = ~dv.deleted_mask(kv.num_rows)
+                if not mask.all():
+                    kv = kv.filter(mask)
+            data = kv.data
+            if predicate is not None and data.num_rows:
+                mask = predicate.eval(data)
+                if not mask.all():
+                    data = data.filter(mask)
+            if projection is not None:
+                data = data.select(projection)
+            out.append(data)
+        if not out:
+            schema = self.value_schema if projection is None else self.value_schema.project(projection)
+            return ColumnBatch.empty(schema)
+        return concat_batches(out)

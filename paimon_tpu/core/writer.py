@@ -123,13 +123,18 @@ class MergeTreeWriter:
     # ---- commit --------------------------------------------------------
     def prepare_commit(self) -> CommitMessage:
         self.flush()
+        # a file produced by one compaction round and consumed by a later
+        # round within the same commit cancels out of the message
+        before_names = {f.file_name for f in self._compact_before}
+        after_names = {f.file_name for f in self._compact_after}
+        cancel = before_names & after_names
         msg = CommitMessage(
             partition=self.partition,
             bucket=self.bucket,
             total_buckets=self.total_buckets,
             new_files=list(self._new_files),
-            compact_before=list(self._compact_before),
-            compact_after=list(self._compact_after),
+            compact_before=[f for f in self._compact_before if f.file_name not in cancel],
+            compact_after=[f for f in self._compact_after if f.file_name not in cancel],
             changelog_files=list(self._changelog),
         )
         self._new_files.clear()

@@ -45,7 +45,22 @@ class FileStoreTable(Table):
         self.path = path
         self.schema = schema
         self.name = path.rstrip("/").rsplit("/", 1)[-1]
-        self.store = KeyValueFileStore(file_io, path, schema, commit_user=commit_user)
+        if schema.primary_keys:
+            self.store = KeyValueFileStore(file_io, path, schema, commit_user=commit_user)
+        else:
+            from ..core.store import AppendOnlyFileStore
+
+            self.store = AppendOnlyFileStore(file_io, path, schema, commit_user=commit_user)
+
+    @property
+    def is_primary_key_table(self) -> bool:
+        return bool(self.schema.primary_keys)
+
+    @property
+    def bucket_mode(self) -> str:
+        if not self.schema.primary_keys:
+            return "unaware" if self.store.options.bucket == -1 else "fixed"
+        return "dynamic" if self.store.options.bucket == -1 else "fixed"
 
     # ---- metadata ------------------------------------------------------
     @property
@@ -106,6 +121,13 @@ class FileStoreTable(Table):
         from .rollback import rollback_to
 
         rollback_to(self, snapshot_id)
+
+    def delete_where(self, predicate) -> int:
+        """DELETE FROM ... WHERE predicate (deletion-vector, -D retract, or
+        copy-on-write rewrite depending on table configuration)."""
+        from .delete import delete_where
+
+        return delete_where(self, predicate)
 
     def expire_snapshots(self) -> int:
         from .tags import TagManager

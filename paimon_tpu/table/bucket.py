@@ -18,12 +18,17 @@ from ..format.fileindex import _hash64
 __all__ = ["bucket_ids", "group_by_partition_bucket"]
 
 
+def key_hashes(batch: ColumnBatch, key_names: Sequence[str]) -> np.ndarray:
+    """(n,) uint64 combined hash of the key columns."""
+    h = np.zeros(batch.num_rows, dtype=np.uint64)
+    for name in key_names:
+        h = h * np.uint64(0x100000001B3) ^ _hash64(batch.column(name).values)
+    return h
+
+
 def bucket_ids(batch: ColumnBatch, bucket_keys: Sequence[str], num_buckets: int) -> np.ndarray:
     """(n,) int32 bucket per row: combined column hashes mod num_buckets."""
-    h = np.zeros(batch.num_rows, dtype=np.uint64)
-    for name in bucket_keys:
-        h = h * np.uint64(0x100000001B3) ^ _hash64(batch.column(name).values)
-    return (h % np.uint64(num_buckets)).astype(np.int32)
+    return (key_hashes(batch, bucket_keys) % np.uint64(num_buckets)).astype(np.int32)
 
 
 def group_by_partition_bucket(

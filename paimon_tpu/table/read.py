@@ -33,6 +33,7 @@ class DataSplit:
     files: list[DataFileMeta]
     snapshot_id: int | None = None
     raw_convertible: bool = False  # single-run: no merge needed
+    dv_index_file: str | None = None  # deletion-vector index for this bucket
 
     @property
     def row_count(self) -> int:
@@ -45,6 +46,7 @@ class DataSplit:
             "files": [f.to_dict() for f in self.files],
             "snapshotId": self.snapshot_id,
             "rawConvertible": self.raw_convertible,
+            "dvIndexFile": self.dv_index_file,
         }
 
     @staticmethod
@@ -55,6 +57,7 @@ class DataSplit:
             [DataFileMeta.from_dict(f) for f in d["files"]],
             d.get("snapshotId"),
             d.get("rawConvertible", False),
+            d.get("dvIndexFile"),
         )
 
 
@@ -125,6 +128,10 @@ class TableScan:
             key_parts = PredicateBuilder.pick_by_fields(parts, set(store.key_names))
             if key_parts:
                 scan = scan.with_key_filter(and_(*key_parts))
+            if not self.table.schema.primary_keys:
+                # append tables: every row is final — value filters can
+                # safely skip whole files (reference AppendOnlyFileStoreScan)
+                scan = scan.with_value_filter(self.predicate)
             # partition predicate -> partition pruning
             part_fields = set(store.partition_keys)
             part_parts = PredicateBuilder.pick_by_fields(parts, part_fields)
@@ -154,6 +161,7 @@ class TableScan:
                         files,
                         snapshot_id=plan.snapshot.id if plan.snapshot else None,
                         raw_convertible=raw,
+                        dv_index_file=plan.dv_index_for(partition, bucket),
                     )
                 )
         return splits
@@ -173,12 +181,20 @@ class TableRead:
         self.limit = limit
 
     def read(self, split: DataSplit):
+        dvs = None
+        if split.dv_index_file:
+            from ..core.deletionvectors import DeletionVectorsIndexFile
+
+            all_dvs = DeletionVectorsIndexFile(self.table.file_io, self.table.path).read_all(split.dv_index_file)
+            names = {f.file_name for f in split.files}
+            dvs = {k: v for k, v in all_dvs.items() if k in names}
         out = self.table.store.read_bucket(
             split.partition,
             split.bucket,
             split.files,
             predicate=self.predicate,
             projection=self.projection,
+            deletion_vectors=dvs,
         )
         if self.limit is not None and out.num_rows > self.limit:
             out = out.slice(0, self.limit)

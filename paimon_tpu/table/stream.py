@@ -123,7 +123,14 @@ class StreamTableScan:
             for bucket, files in sorted(buckets.items()):
                 sections = IntervalPartition(files).partition()
                 out.append(
-                    DataSplit(partition, bucket, files, snapshot_id, raw_convertible=all(len(s) == 1 for s in sections))
+                    DataSplit(
+                        partition,
+                        bucket,
+                        files,
+                        snapshot_id,
+                        raw_convertible=all(len(s) == 1 for s in sections),
+                        dv_index_file=plan.dv_index_for(partition, bucket),
+                    )
                 )
         return out
 
@@ -136,5 +143,14 @@ class StreamTableScan:
         out = []
         for partition, buckets in sorted(plan.grouped().items()):
             for bucket, files in sorted(buckets.items()):
-                out.append(DataSplit(partition, bucket, files, snapshot_id, raw_convertible=True))
+                out.append(
+                    DataSplit(
+                        partition,
+                        bucket,
+                        files,
+                        snapshot_id,
+                        raw_convertible=True,
+                        dv_index_file=plan.dv_index_for(partition, bucket),
+                    )
+                )
         return out

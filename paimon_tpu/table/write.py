@@ -32,8 +32,17 @@ class TableWrite:
         store = table.store
         self.partition_keys = store.partition_keys
         self.bucket_keys = table.schema.bucket_keys
+        self.dynamic = table.is_primary_key_table and store.options.bucket == -1
         self.num_buckets = max(store.options.bucket, 1)
         self._writers: dict[tuple, object] = {}
+        self._assigner = None
+        if self.dynamic:
+            from ..core.bucket_index import HashIndexFile, SimpleHashBucketAssigner
+            from ..options import CoreOptions
+
+            target = store.options.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
+            self._assigner = SimpleHashBucketAssigner(HashIndexFile(table.file_io, table.path), target)
+            self._bootstrapped: set[tuple] = set()
 
     def write(self, data: ColumnBatch | dict, kinds: np.ndarray | Sequence[str] | None = None) -> None:
         if isinstance(data, dict):
@@ -42,6 +51,9 @@ class TableWrite:
             kinds = np.array([int(RowKind.from_short_string(k)) for k in kinds], dtype=np.uint8)
         from .bucket import group_by_partition_bucket
 
+        if self.dynamic:
+            self._write_dynamic(data, kinds)
+            return
         for partition, bucket, rows in group_by_partition_bucket(
             data, self.partition_keys, self.bucket_keys, self.num_buckets
         ):
@@ -50,19 +62,70 @@ class TableWrite:
             sub_kinds = kinds.take(rows) if kinds is not None and len(rows) != data.num_rows else kinds
             w.write(sub, sub_kinds)
 
+    def _write_dynamic(self, data: ColumnBatch, kinds) -> None:
+        """Dynamic bucket: assign each key a durable bucket via the hash
+        index (reference DynamicBucketSink: assigner stage before writers)."""
+        from .bucket import group_by_partition_bucket, key_hashes
+
+        store = self.table.store
+        for partition, _, rows in group_by_partition_bucket(data, self.partition_keys, [], 1):
+            sub = data.take(rows) if len(rows) != data.num_rows else data
+            sub_kinds = kinds.take(rows) if kinds is not None and len(rows) != data.num_rows else kinds
+            self._bootstrap_partition(partition)
+            hashes = key_hashes(sub, store.key_names)
+            buckets = self._assigner.assign(partition, hashes)
+            for b in np.unique(buckets):
+                mask = buckets == b
+                w = self._writer(partition, int(b))
+                w.write(sub.filter(mask), sub_kinds[mask] if sub_kinds is not None else None)
+
+    def _bootstrap_partition(self, partition: tuple) -> None:
+        if partition in self._bootstrapped:
+            return
+        self._bootstrapped.add(partition)
+        from ..core.bucket_index import HashIndexFile
+
+        plan = self.table.store.new_scan().with_partition_filter(lambda p: p == partition).plan()
+        hif = HashIndexFile(self.table.file_io, self.table.path)
+        indexes = {
+            e.bucket: hif.read(e.file_name)
+            for e in plan.index_entries
+            if e.kind == "HASH_INDEX" and e.partition == partition
+        }
+        if indexes:
+            self._assigner.bootstrap(partition, indexes)
+
     def _writer(self, partition: tuple, bucket: int):
         key = (partition, bucket)
         if key not in self._writers:
-            self._writers[key] = self.table.store.new_writer(partition, bucket, self.num_buckets)
+            total = -1 if self.dynamic else self.num_buckets
+            self._writers[key] = self.table.store.new_writer(partition, bucket, total)
         return self._writers[key]
 
     def compact(self, full: bool = False) -> None:
+        """Compact every bucket this write touched — or, when no rows were
+        written (dedicated compact job), every live bucket of the table."""
+        if not self._writers:
+            plan = self.table.store.new_scan().plan()
+            for partition, buckets in plan.grouped().items():
+                for bucket in buckets:
+                    self._writer(partition, bucket)
         for w in self._writers.values():
             w.compact(full=full)
 
     def prepare_commit(self) -> list[CommitMessage]:
-        msgs = [w.prepare_commit() for w in self._writers.values()]
-        return [m for m in msgs if not m.is_empty()]
+        msgs = [m for m in (w.prepare_commit() for w in self._writers.values()) if not m.is_empty()]
+        if self._assigner is not None:
+            by_pb = {(m.partition, m.bucket): m for m in msgs}
+            for partition, entries in self._assigner.prepare_commit().items():
+                for e in entries:
+                    msg = by_pb.get((partition, e.bucket))
+                    if msg is None:
+                        msg = CommitMessage(partition, e.bucket, -1)
+                        msgs.append(msg)
+                        by_pb[(partition, e.bucket)] = msg
+                    msg.new_index_files.append(e)
+        return msgs
 
     def close(self) -> None:
         self._writers.clear()

@@ -1,0 +1,99 @@
+"""Append-only (no-PK) table behavior (reference AppendOnlyFileStoreTable,
+AppendOnlyWriter, AppendOnlyCompactManager tests)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.data.predicate import equal, greater_than
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("payload", STRING()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="ao")
+
+
+def write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def read(t, predicate=None, projection=None):
+    rb = t.new_read_builder()
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    if projection is not None:
+        rb = rb.with_projection(projection)
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+def test_append_only_keeps_duplicates(catalog):
+    t = catalog.create_table("db.log", SCHEMA, options={"bucket": "1"})
+    assert not t.is_primary_key_table
+    write(t, {"id": [1, 1, 2], "payload": ["a", "a", "b"], "v": [1.0, 1.0, 2.0]})
+    write(t, {"id": [1], "payload": ["a"], "v": [1.0]})
+    out = read(t)
+    assert out.num_rows == 4  # duplicates preserved — no merge
+    assert sorted(r[0] for r in out.to_pylist()) == [1, 1, 1, 2]
+
+
+def test_append_only_rejects_deletes(catalog):
+    t = catalog.create_table("db.log2", SCHEMA, options={"bucket": "1"})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    with pytest.raises(ValueError, match="only \\+I"):
+        w.write({"id": [1], "payload": ["x"], "v": [1.0]}, kinds=["-D"])
+
+
+def test_append_only_value_filter_prunes_files(catalog):
+    t = catalog.create_table("db.log3", SCHEMA, options={"bucket": "1"})
+    write(t, {"id": [1, 2], "payload": ["a", "b"], "v": [1.0, 2.0]})
+    write(t, {"id": [100, 200], "payload": ["c", "d"], "v": [3.0, 4.0]})
+    rb = t.new_read_builder().with_filter(greater_than("id", 50))
+    splits = rb.new_scan().plan()
+    # the first file (ids 1..2) is pruned by value stats
+    assert sum(len(s.files) for s in splits) == 1
+    out = rb.new_read().read_all(splits)
+    assert sorted(r[0] for r in out.to_pylist()) == [100, 200]
+
+
+def test_append_only_small_file_compaction(catalog):
+    t = catalog.create_table(
+        "db.log4", SCHEMA, options={"bucket": "1", "compaction.min.file-num": "3"}
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    for i in range(5):
+        w.write({"id": [i], "payload": [f"p{i}"], "v": [float(i)]})
+        # flush each write into its own small file
+        for writer in w._writers.values():
+            writer.flush()
+    wb.new_commit().commit(w.prepare_commit())
+    files = t.store.restore_files((), 0)
+    assert len(files) < 5  # small files concatenated
+    out = read(t)
+    assert sorted(r[0] for r in out.to_pylist()) == [0, 1, 2, 3, 4]
+
+
+def test_append_only_multi_bucket_with_bucket_key(catalog):
+    t = catalog.create_table("db.log5", SCHEMA, options={"bucket": "4", "bucket-key": "id"})
+    n = 100
+    write(t, {"id": list(range(n)), "payload": ["x"] * n, "v": [float(i) for i in range(n)]})
+    splits = t.new_read_builder().new_scan().plan()
+    assert len(splits) > 1  # spread across buckets
+    out = read(t)
+    assert out.num_rows == n
+
+
+def test_append_only_projection_and_order(catalog):
+    t = catalog.create_table("db.log6", SCHEMA, options={"bucket": "1"})
+    write(t, {"id": [3, 1], "payload": ["c", "a"], "v": [3.0, 1.0]})
+    write(t, {"id": [2], "payload": ["b"], "v": [2.0]})
+    out = read(t, projection=["payload"])
+    # arrival order within bucket (files ordered by sequence)
+    assert [r[0] for r in out.to_pylist()] == ["c", "a", "b"]

@@ -1,0 +1,78 @@
+"""Dynamic bucket mode (reference index/HashBucketAssigner + DynamicBucketSink)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.data.predicate import equal
+from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="dyn")
+
+
+def write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def read(t, predicate=None):
+    rb = t.new_read_builder()
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+def test_dynamic_bucket_spills_to_new_buckets(catalog):
+    t = catalog.create_table(
+        "db.dyn",
+        SCHEMA,
+        primary_keys=["id"],
+        options={"bucket": "-1", "dynamic-bucket.target-row-num": "100"},
+    )
+    assert t.bucket_mode == "dynamic"
+    n = 350
+    write(t, {"id": list(range(n)), "v": [float(i) for i in range(n)]})
+    plan = t.store.new_scan().plan()
+    buckets = {e.bucket for e in plan.entries}
+    assert len(buckets) == 4  # 350 keys / 100 per bucket
+    # hash index files registered
+    hash_entries = [e for e in plan.index_entries if e.kind == "HASH_INDEX"]
+    assert len(hash_entries) == 4
+    assert sum(e.row_count for e in hash_entries) == n
+    out = read(t)
+    assert out.num_rows == n
+
+
+def test_dynamic_bucket_upsert_sticks_to_bucket(catalog):
+    t = catalog.create_table(
+        "db.dyn2",
+        SCHEMA,
+        primary_keys=["id"],
+        options={"bucket": "-1", "dynamic-bucket.target-row-num": "10"},
+    )
+    write(t, {"id": list(range(25)), "v": [0.0] * 25})
+    # second writer session: must route updates to the original buckets
+    write(t, {"id": list(range(25)), "v": [1.0] * 25})
+    out = read(t)
+    assert out.num_rows == 25  # upserts, not duplicates
+    assert all(r[1] == 1.0 for r in out.to_pylist())
+    # updating existing keys must not create new buckets
+    plan = t.store.new_scan().plan()
+    assert len({e.bucket for e in plan.entries}) == 3  # ceil(25/10)
+
+
+def test_dynamic_bucket_delete(catalog):
+    t = catalog.create_table(
+        "db.dyn3", SCHEMA, primary_keys=["id"], options={"bucket": "-1", "dynamic-bucket.target-row-num": "5"}
+    )
+    write(t, {"id": list(range(12)), "v": [float(i) for i in range(12)]})
+    write(t, {"id": [3], "v": [None]}, kinds=["-D"])
+    out = read(t)
+    assert sorted(r[0] for r in out.to_pylist()) == [i for i in range(12) if i != 3]

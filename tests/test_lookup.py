@@ -1,0 +1,88 @@
+"""Lookup store + LocalTableQuery (reference lookup/hash, LookupLevels,
+LocalTableQuery tests)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("name", STRING()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="lq")
+
+
+def write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def test_local_table_query_basic(catalog):
+    from paimon_tpu.table.query import LocalTableQuery
+
+    t = catalog.create_table("db.q", SCHEMA, primary_keys=["id"], options={"bucket": "2"})
+    write(t, {"id": [1, 2, 3], "name": ["a", "b", "c"], "v": [1.0, 2.0, 3.0]})
+    q = LocalTableQuery(t)
+    assert q.lookup((), 2).to_pylist() == [(2, "b", 2.0)]
+    assert q.lookup((), 99) is None
+    # upsert + delete, then refresh
+    write(t, {"id": [2], "name": ["b2"], "v": [22.0]})
+    write(t, {"id": [3], "name": [None], "v": [None]}, kinds=["-D"])
+    q.refresh()
+    assert q.lookup((), 2).to_pylist() == [(2, "b2", 22.0)]
+    assert q.lookup((), 3) is None  # deleted
+    assert q.lookup((), 1).to_pylist() == [(1, "a", 1.0)]
+
+
+def test_lookup_after_compaction_levels(catalog):
+    from paimon_tpu.table.query import LocalTableQuery
+
+    t = catalog.create_table("db.q2", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": list(range(50)), "name": [f"n{i}" for i in range(50)], "v": [float(i) for i in range(50)]})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [7], "name": ["seven"], "v": [77.0]})
+    w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    q = LocalTableQuery(t)
+    assert q.lookup((), 7).to_pylist() == [(7, "seven", 77.0)]
+    assert q.lookup((), 49).to_pylist()[0][1] == "n49"
+
+
+def test_lookup_string_key(catalog):
+    from paimon_tpu.table.query import LocalTableQuery
+
+    schema = RowType.of(("code", STRING()), ("v", DOUBLE()))
+    t = catalog.create_table("db.q3", schema, primary_keys=["code"], options={"bucket": "2"})
+    write(t, {"code": ["aa", "bb", "cc"], "v": [1.0, 2.0, 3.0]})
+    q = LocalTableQuery(t)
+    assert q.lookup((), "bb").to_pylist() == [("bb", 2.0)]
+    assert q.lookup((), "zz") is None
+
+
+def test_lookup_dynamic_bucket(catalog):
+    from paimon_tpu.table.query import LocalTableQuery
+
+    t = catalog.create_table(
+        "db.q4", SCHEMA, primary_keys=["id"], options={"bucket": "-1", "dynamic-bucket.target-row-num": "10"}
+    )
+    write(t, {"id": list(range(30)), "name": ["x"] * 30, "v": [float(i) for i in range(30)]})
+    q = LocalTableQuery(t)
+    assert q.lookup((), 17).to_pylist()[0][2] == 17.0
+
+
+def test_lookup_cache_eviction(catalog):
+    from paimon_tpu.table.query import LocalTableQuery
+
+    t = catalog.create_table("db.q5", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": [1], "name": ["a"], "v": [1.0]})
+    write(t, {"id": [2], "name": ["b"], "v": [2.0]})
+    q = LocalTableQuery(t, cache_bytes=1)  # force eviction churn
+    assert q.lookup((), 1) is not None
+    assert q.lookup((), 2) is not None
+    assert q.lookup((), 1) is not None  # reload after eviction still works
