@@ -90,6 +90,8 @@ class FileStoreCommit:
         """Returns the snapshot ids written (0, 1, or 2)."""
         append_entries: list[ManifestEntry] = []
         compact_entries: list[ManifestEntry] = []
+        append_changelog: list[ManifestEntry] = []
+        compact_changelog: list[ManifestEntry] = []
         for msg in committable.messages:
             for f in msg.new_files:
                 append_entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
@@ -97,12 +99,23 @@ class FileStoreCommit:
                 compact_entries.append(ManifestEntry(FileKind.DELETE, msg.partition, msg.bucket, msg.total_buckets, f))
             for f in msg.compact_after:
                 compact_entries.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
+            for f in msg.changelog_files:
+                append_changelog.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
+            for f in msg.compact_changelog_files:
+                compact_changelog.append(ManifestEntry(FileKind.ADD, msg.partition, msg.bucket, msg.total_buckets, f))
         index_entries = [e for msg in committable.messages for e in msg.new_index_files]
         written: list[int] = []
-        if not committable.skip_append and (append_entries or index_entries or not compact_entries):
+        if not committable.skip_append and (
+            append_entries or index_entries or append_changelog or not compact_entries
+        ):
             written.append(
                 self._try_commit(
-                    CommitKind.APPEND, append_entries, committable, check_conflicts=False, index_entries=index_entries
+                    CommitKind.APPEND,
+                    append_entries,
+                    committable,
+                    check_conflicts=False,
+                    index_entries=index_entries,
+                    changelog_entries=append_changelog,
                 )
             )
             # from here the APPEND snapshot is durable: flag the committable so
@@ -126,6 +139,7 @@ class FileStoreCommit:
                     committable,
                     check_conflicts=True,
                     removed_files=removed,
+                    changelog_entries=compact_changelog,
                 )
             )
         return [w for w in written if w >= 0]
@@ -206,8 +220,16 @@ class FileStoreCommit:
         check_conflicts: bool,
         index_entries: list | None = None,
         removed_files: list[ManifestEntry] | None = None,
+        changelog_entries: list[ManifestEntry] | None = None,
+        statistics: str | None = None,
     ) -> int:
+        import time
+
+        from ..metrics import registry
+
+        g = registry.group("commit")
         retries = 0
+        t_start = time.perf_counter()
         while True:
             latest = self.snapshot_manager.latest_snapshot()
             if check_conflicts and latest is not None:
@@ -228,6 +250,14 @@ class FileStoreCommit:
                 tmp_files.append(base_name)
                 delta_name = self.manifest_list.write([delta_meta])
                 tmp_files.append(delta_name)
+                changelog_list = None
+                changelog_rows = None
+                if changelog_entries:
+                    cl_meta = self.manifest_file.write(changelog_entries, self.schema_id)
+                    tmp_files.append(cl_meta.file_name)
+                    changelog_list = self.manifest_list.write([cl_meta])
+                    tmp_files.append(changelog_list)
+                    changelog_rows = sum(e.file.row_count for e in changelog_entries)
                 added = sum(e.file.row_count for e in entries if e.kind == FileKind.ADD)
                 deleted = sum(e.file.row_count for e in entries if e.kind == FileKind.DELETE)
                 prev_total = (latest.total_record_count or 0) if latest else 0
@@ -237,7 +267,7 @@ class FileStoreCommit:
                     schema_id=self.schema_id,
                     base_manifest_list=base_name,
                     delta_manifest_list=delta_name,
-                    changelog_manifest_list=None,
+                    changelog_manifest_list=changelog_list,
                     commit_user=self.commit_user,
                     commit_identifier=committable.commit_identifier,
                     commit_kind=kind,
@@ -245,11 +275,16 @@ class FileStoreCommit:
                     index_manifest=index_manifest,
                     total_record_count=prev_total + added - deleted,
                     delta_record_count=added - deleted,
+                    changelog_record_count=changelog_rows,
+                    statistics=statistics,
                     watermark=committable.watermark,
                     log_offsets=dict(committable.log_offsets),
                 )
                 path = self.snapshot_manager.snapshot_path(snapshot_id)
                 if self.file_io.try_atomic_write(path, snapshot.to_json().encode()):
+                    g.counter("commits").inc()
+                    g.counter("retries").inc(retries)
+                    g.histogram("duration_ms").update((time.perf_counter() - t_start) * 1000)
                     # committed: the snapshot now references these manifests —
                     # they must never be cleaned up, even if hints fail
                     tmp_files.clear()

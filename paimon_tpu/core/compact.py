@@ -124,13 +124,20 @@ class MergeTreeCompactRewriter:
         writer_factory: KeyValueFileWriterFactory,
         merge_executor: MergeExecutor,
         deletion_vectors: dict | None = None,
+        emit_full_changelog: bool = False,
+        expire_predicate=None,
     ):
         self.reader_factory = reader_factory
         self.writer_factory = writer_factory
         self.merge = merge_executor
+        # record-level TTL: expired rows are physically dropped on rewrite
+        self.expire_predicate = expire_predicate
         # DV'd rows must be dropped during the rewrite (the commit purges the
         # dead files' DVs afterwards) — else compaction resurrects them
         self.deletion_vectors = deletion_vectors or {}
+        # full-compaction changelog producer (reference
+        # FullChangelogMergeTreeCompactRewriter:43)
+        self.emit_full_changelog = emit_full_changelog
 
     def _read(self, f: DataFileMeta) -> KVBatch:
         kv = self.reader_factory.read(f)
@@ -139,24 +146,58 @@ class MergeTreeCompactRewriter:
             mask = ~dv.deleted_mask(kv.num_rows)
             if not mask.all():
                 kv = kv.filter(mask)
+        if self.expire_predicate is not None and kv.num_rows:
+            keep = self.expire_predicate.eval(kv.data)
+            if not keep.all():
+                kv = kv.filter(keep)
         return kv
 
-    def rewrite(self, sections: list[list[SortedRun]], output_level: int, drop_delete: bool) -> list[DataFileMeta]:
+    def rewrite(
+        self, sections: list[list[SortedRun]], output_level: int, drop_delete: bool
+    ) -> tuple[list[DataFileMeta], list[DataFileMeta]]:
+        """Returns (new files, changelog files)."""
         from .read import order_runs_for_merge
 
         out: list[DataFileMeta] = []
+        changelog: list[DataFileMeta] = []
         for section in sections:
             runs, seq_ascending = order_runs_for_merge(section)
             batches = []
+            old_top: list[KVBatch] = []
             for run in runs:
                 for f in run.files:
-                    batches.append(self._read(f))
+                    b = self._read(f)
+                    batches.append(b)
+                    if f.level == output_level:
+                        old_top.append(b)
             kv = KVBatch.concat(batches)
             merged = self.merge.merge(kv, seq_ascending=seq_ascending)
             if drop_delete:
                 merged = merged.drop_deletes()
+            if self.emit_full_changelog and drop_delete:
+                cl = self._section_changelog(old_top, merged)
+                if cl.num_rows:
+                    changelog.extend(
+                        self.writer_factory.write(cl, level=0, file_source="compact", prefix="changelog")
+                    )
             out.extend(self.writer_factory.write(merged, output_level, file_source="compact"))
-        return out
+        return out, changelog
+
+    def _section_changelog(self, old_top: list[KVBatch], merged: KVBatch) -> KVBatch:
+        from ..data.keys import build_string_pool, encode_key_lanes
+        from ..types import TypeRoot
+        from .changelog import full_compaction_changelog
+
+        before = KVBatch.concat(old_top) if old_top else merged.slice(0, 0)
+        key_names = self.merge.key_names
+        pools = {}
+        for k in key_names:
+            root = merged.data.schema.field(k).type.root
+            if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
+                pools[k] = build_string_pool([before.data.column(k).values, merged.data.column(k).values])
+        lanes_before = encode_key_lanes(before.data, key_names, pools)
+        lanes_after = encode_key_lanes(merged.data, key_names, pools)
+        return full_compaction_changelog(before, merged, lanes_before, lanes_after)
 
     def upgrade(self, file: DataFileMeta, output_level: int) -> DataFileMeta:
         return file.upgrade(output_level)
@@ -183,6 +224,17 @@ class MergeTreeCompactManager:
         return self.levels.number_of_sorted_runs() > self.options.num_sorted_runs_stop_trigger
 
     def trigger_compaction(self, full: bool = False) -> CompactResult | None:
+        from ..metrics import registry, timed
+
+        g = registry.group("compaction")
+        with timed(g.histogram("duration_ms")):
+            result = self._trigger(full)
+        if result is not None and not result.is_empty():
+            g.counter("compactions").inc()
+            g.counter("files_rewritten").inc(len(result.before))
+        return result
+
+    def _trigger(self, full: bool) -> CompactResult | None:
         runs = self.levels.level_sorted_runs()
         if full:
             unit = self.strategy.force_full(self.levels.num_levels, runs)
@@ -205,10 +257,14 @@ class MergeTreeCompactManager:
         rewrite_sections: list[list[SortedRun]] = []
         min_rewrite_size = self.options.target_file_size  # files below target get merged together
         dv_files = set(self.rewriter.deletion_vectors)
+        # full-compaction changelog must SEE every row reaching the top level:
+        # upgrades bypass rewrite() and would emit nothing (reference forces
+        # rewrite when upgrading to maxLevel under the full changelog producer)
+        force_rewrite = self.rewriter.emit_full_changelog and drop_delete
         for section in sections:
             if len(section) == 1:
                 for f in section[0].files:
-                    if f.file_name in dv_files:
+                    if f.file_name in dv_files or (force_rewrite and f.level != unit.output_level):
                         # physically drop DV'd rows (the commit purges the DV)
                         rewrite_sections.append([SortedRun([f])])
                     elif self._can_upgrade(f, unit.output_level, drop_delete, min_rewrite_size):
@@ -223,9 +279,10 @@ class MergeTreeCompactManager:
                 rewrite_sections.append(section)
         if rewrite_sections:
             flat_before = [f for sec in rewrite_sections for r in sec for f in r.files]
-            after = self.rewriter.rewrite(rewrite_sections, unit.output_level, drop_delete)
+            after, changelog = self.rewriter.rewrite(rewrite_sections, unit.output_level, drop_delete)
             result.before.extend(flat_before)
             result.after.extend(after)
+            result.changelog.extend(changelog)
         return result
 
     @staticmethod

@@ -139,20 +139,45 @@ class KeyValueFileWriterFactory:
                 total += dt.itemsize
         return max(total, 1)
 
-    def write(self, kv: KVBatch, level: int, file_source: str = "append") -> list[DataFileMeta]:
-        """Rolls into multiple files at target size. Input must be key-sorted."""
+    def write(
+        self, kv: KVBatch, level: int, file_source: str = "append", prefix: str = "data",
+        sorted_input: bool = True,
+    ) -> list[DataFileMeta]:
+        """Rolls into multiple files at target size. Input must be key-sorted
+        unless sorted_input=False (changelog files preserve event order; key
+        min/max are then computed instead of taken from the edges)."""
         n = kv.num_rows
         if n == 0:
             return []
         rows_per_file = max(1, self.target_file_size // self._estimate_row_bytes(kv.data))
         out: list[DataFileMeta] = []
         for start in range(0, n, rows_per_file):
-            out.append(self._write_one(kv.slice(start, min(start + rows_per_file, n)), level, file_source))
+            out.append(
+                self._write_one(
+                    kv.slice(start, min(start + rows_per_file, n)), level, file_source, prefix, sorted_input
+                )
+            )
         return out
 
-    def _write_one(self, kv: KVBatch, level: int, file_source: str) -> DataFileMeta:
+    def _key_min_max(self, batch: ColumnBatch, sorted_input: bool) -> tuple[tuple, tuple]:
+        if not self.key_names:
+            return (), ()
+        if sorted_input:
+            return (
+                _to_py_tuple(_key_tuple(batch, self.key_names, 0)),
+                _to_py_tuple(_key_tuple(batch, self.key_names, batch.num_rows - 1)),
+            )
+        order = np.lexsort([batch.column(k).values for k in reversed(self.key_names)])
+        return (
+            _to_py_tuple(_key_tuple(batch, self.key_names, int(order[0]))),
+            _to_py_tuple(_key_tuple(batch, self.key_names, int(order[-1]))),
+        )
+
+    def _write_one(
+        self, kv: KVBatch, level: int, file_source: str, prefix: str = "data", sorted_input: bool = True
+    ) -> DataFileMeta:
         fmt = get_format(self.format_id)
-        name = new_file_name("data", self.format_id)
+        name = new_file_name(prefix, self.format_id)
         path = f"{self.bucket_dir}/{name}"
         disk = kv.to_disk_batch() if self.keyed else kv.data
         fmt.write(self.file_io, path, disk, self.compression)
@@ -170,8 +195,8 @@ class KeyValueFileWriterFactory:
             file_name=name,
             file_size=self.file_io.get_status(path).size,
             row_count=kv.num_rows,
-            min_key=_to_py_tuple(_key_tuple(kv.data, self.key_names, 0)) if self.keyed else (),
-            max_key=_to_py_tuple(_key_tuple(kv.data, self.key_names, kv.num_rows - 1)) if self.keyed else (),
+            min_key=self._key_min_max(kv.data, sorted_input)[0] if self.keyed else (),
+            max_key=self._key_min_max(kv.data, sorted_input)[1] if self.keyed else (),
             key_stats=key_stats,
             value_stats=value_stats,
             min_sequence_number=int(kv.seq.min()),

@@ -177,7 +177,8 @@ class CommitMessage:
     new_files: list[DataFileMeta] = field(default_factory=list)
     compact_before: list[DataFileMeta] = field(default_factory=list)
     compact_after: list[DataFileMeta] = field(default_factory=list)
-    changelog_files: list[DataFileMeta] = field(default_factory=list)
+    changelog_files: list[DataFileMeta] = field(default_factory=list)  # input producer (append phase)
+    compact_changelog_files: list[DataFileMeta] = field(default_factory=list)  # full-compaction producer
     new_index_files: list = field(default_factory=list)  # IndexFileEntry
 
     def is_empty(self) -> bool:
@@ -186,6 +187,7 @@ class CommitMessage:
             or self.compact_before
             or self.compact_after
             or self.changelog_files
+            or self.compact_changelog_files
             or self.new_index_files
         )
 

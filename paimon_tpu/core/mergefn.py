@@ -145,6 +145,16 @@ class MergeExecutor:
         raise ValueError(f"unknown merge engine {self.engine}")
 
     # ---- partial update -------------------------------------------------
+    def _sequence_groups(self) -> dict[str, list[str]]:
+        """{seq-column: [fields it governs]} from fields.<col>.sequence-group
+        options (reference PartialUpdateMergeFunction sequence groups)."""
+        groups: dict[str, list[str]] = {}
+        for key, value in self.options.options._data.items():
+            if key.startswith("fields.") and key.endswith(".sequence-group"):
+                seq_col = key[len("fields.") : -len(".sequence-group")]
+                groups[seq_col] = [s.strip() for s in str(value).split(",")]
+        return groups
+
     def _partial_update(self, kv: KVBatch, plan, last_take, out_seq) -> KVBatch:
         remove_on_delete = self.options.options.get(CoreOptions.PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE)
         has_delete = np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE))).any()
@@ -153,20 +163,85 @@ class MergeExecutor:
                 "partial-update cannot handle -U/-D records; set "
                 "'partial-update.remove-record-on-delete' or 'ignore-delete'"
             )
+        groups = self._sequence_groups()
+        grouped_fields = {f for fields in groups.values() for f in fields} | set(groups)
         non_key = [f for f in self.value_schema.fields if f.name not in self.key_names]
-        field_valid = np.stack([kv.data.column(f.name).valid_mask() for f in non_key]) if non_key else np.zeros((0, kv.num_rows), np.bool_)
+        default_fields = [f for f in non_key if f.name not in grouped_fields]
+        field_valid = (
+            np.stack([kv.data.column(f.name).valid_mask() for f in default_fields])
+            if default_fields
+            else np.zeros((0, kv.num_rows), np.bool_)
+        )
         src, exists = partial_update_takes(plan, field_valid, kv.kind, remove_record_on_delete=remove_on_delete)
         cols: dict[str, Column] = {}
         for k in self.key_names:
             cols[k] = kv.data.column(k).take(last_take)
-        for fi, f in enumerate(non_key):
+        for fi, f in enumerate(default_fields):
             cols[f.name] = _gather_column(kv.data.column(f.name), src[fi])
+        # sequence groups: each group's fields are taken atomically from the
+        # row with the highest (group seq, system seq) whose group seq is
+        # non-null — ordering by the group's own sequence column, not arrival
+        for seq_col, fields in groups.items():
+            cols.update(self._group_take(kv, seq_col, fields))
         data = ColumnBatch(self.value_schema, cols)
         kind = np.where(exists, int(RowKind.INSERT), int(RowKind.DELETE)).astype(np.uint8)
         out = KVBatch(data, out_seq, kind)
         if not exists.all() and not remove_on_delete:
             out = out.filter(exists)
         return out
+
+    def _group_take(self, kv: KVBatch, seq_col: str, fields: Sequence[str]) -> dict[str, Column]:
+        from ..ops.aggregates import _pick_fn
+        from ..ops.merge import pad_to
+
+        import jax.numpy as jnp
+
+        pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
+        key_lanes = encode_key_lanes(kv.data, self.key_names, pools)
+        # order: (key, group seq, system seq); null group seq sorts first and
+        # is excluded from candidacy
+        gcol = kv.data.column(seq_col)
+        g_valid = gcol.valid_mask()
+        root = kv.data.schema.field(seq_col).type.root
+        from ..types import TypeRoot
+
+        gpool = None
+        if root in (TypeRoot.CHAR, TypeRoot.VARCHAR):
+            gpool = {seq_col: build_string_pool([gcol.values[g_valid]])}
+        g_lanes = self._lanes_nullsafe(gcol, root, gpool, seq_col)
+        hi, lo = split_int64_lanes(kv.seq)
+        seq_lanes = np.concatenate([g_lanes, np.stack([hi, lo], axis=1)], axis=1)
+        gplan = merge_plan(key_lanes, seq_lanes)
+        candidate = g_valid & np.isin(kv.kind, (int(RowKind.INSERT), int(RowKind.UPDATE_AFTER)))
+        src = _pick_fn(True)(
+            jnp.asarray(gplan.perm), jnp.asarray(gplan.seg_id), jnp.asarray(pad_to(candidate, gplan.m, False))
+        )
+        src = np.asarray(src)[: gplan.num_segments]
+        out = {}
+        for name in [seq_col, *fields]:
+            out[name] = _gather_column(kv.data.column(name), src)
+        return out
+
+    @staticmethod
+    def _lanes_nullsafe(col: Column, root, pool, name: str) -> np.ndarray:
+        """Lane-encode a possibly-null sequence column (nulls get the minimal
+        lane value, so they lose every comparison)."""
+        from ..data.keys import _encode_column
+
+        valid = col.valid_mask()
+        values = col.values
+        if values.dtype == np.dtype(object):
+            ranks = np.zeros(len(values), dtype=np.uint32)
+            if valid.any():
+                p = pool[name] if pool else np.unique(values[valid])
+                # ranks offset by 1 so nulls (0) sort below every real value
+                ranks[valid] = np.searchsorted(p, values[valid]).astype(np.uint32) + 1
+            return ranks.reshape(-1, 1)
+        filled = values.copy()
+        filled[~valid] = 0
+        lanes = np.stack(_encode_column(filled, root, None), axis=1)
+        lanes[~valid] = 0
+        return lanes
 
     # ---- aggregation ----------------------------------------------------
     def _agg_spec(self, field_name: str) -> AggregateSpec:

@@ -62,7 +62,7 @@ class FileStoreScan:
         return self
 
     def with_kind(self, kind: str) -> "FileStoreScan":
-        assert kind in ("all", "delta")
+        assert kind in ("all", "delta", "changelog")
         self._kind = kind
         return self
 
@@ -88,13 +88,28 @@ class FileStoreScan:
 
     # ---- plan ----------------------------------------------------------
     def plan(self) -> ScanPlan:
+        from ..metrics import registry, timed
+
+        g = registry.group("scan")
+        with timed(g.histogram("duration_ms")):
+            plan = self._plan()
+        g.counter("plans").inc()
+        g.counter("resulted_table_files").inc(len(plan.entries))
+        return plan
+
+    def _plan(self) -> ScanPlan:
         if self._snapshot_id is not None:
             snapshot = self.snapshot_manager.snapshot(self._snapshot_id)
         else:
             snapshot = self.snapshot_manager.latest_snapshot()
         if snapshot is None:
             return ScanPlan(None, [])
-        if self._kind == "delta":
+        if self._kind == "changelog":
+            if not snapshot.changelog_manifest_list:
+                return ScanPlan(snapshot, [])
+            metas = self.manifest_list.read(snapshot.changelog_manifest_list)
+            entries = [e for m in metas for e in self.manifest_file.read(m.file_name)]
+        elif self._kind == "delta":
             metas = self.manifest_list.read(snapshot.delta_manifest_list)
             entries = [e for m in metas for e in self.manifest_file.read(m.file_name)]
             # delta scans surface ADDs only (changelog semantics come from

@@ -138,7 +138,16 @@ class KeyValueFileStore:
                 self.options.num_sorted_runs_compaction_trigger,
                 self.options.options.get(CoreOptions.COMPACTION_OPTIMIZATION_INTERVAL),
             )
-            rewriter = MergeTreeCompactRewriter(self.reader_factory(partition, bucket), wf, merge, deletion_vectors=dvs)
+            from ..options import ChangelogProducer
+
+            rewriter = MergeTreeCompactRewriter(
+                self.reader_factory(partition, bucket),
+                wf,
+                merge,
+                deletion_vectors=dvs,
+                emit_full_changelog=self.options.changelog_producer == ChangelogProducer.FULL_COMPACTION,
+                expire_predicate=self.record_expire_predicate(),
+            )
             compact_manager = MergeTreeCompactManager(levels, strategy, rewriter, self.options)
         return MergeTreeWriter(
             partition,
@@ -152,6 +161,24 @@ class KeyValueFileStore:
         )
 
     # ---- read ----------------------------------------------------------
+    def record_expire_predicate(self):
+        """Row TTL (reference io/RecordLevelExpire): rows whose time field is
+        older than record-level.expire-time.ms are dropped on read and during
+        compaction rewrites. The column unit comes from
+        record-level.time-field-type (seconds | millis | micros)."""
+        ttl = self.options.options.get(CoreOptions.RECORD_LEVEL_EXPIRE_TIME_MS)
+        field = self.options.options.get(CoreOptions.RECORD_LEVEL_TIME_FIELD)
+        if ttl is None or field is None:
+            return None
+        from ..data.predicate import greater_than
+        from ..utils import now_millis
+
+        unit = self.options.options.get(CoreOptions.RECORD_LEVEL_TIME_FIELD_TYPE)
+        cutoff_ms = now_millis() - ttl
+        scale = {"seconds": 1000, "millis": 1, "micros": None}.get(unit, 1000)
+        cutoff = cutoff_ms * 1000 if scale is None else cutoff_ms // scale
+        return greater_than(field, cutoff)
+
     def read_bucket(
         self,
         partition: tuple,
@@ -162,6 +189,11 @@ class KeyValueFileStore:
         drop_delete: bool = True,
         deletion_vectors: dict | None = None,
     ):
+        expire = self.record_expire_predicate()
+        if expire is not None:
+            from ..data.predicate import and_
+
+            predicate = expire if predicate is None else and_(predicate, expire)
         read = MergeFileSplitRead(self.reader_factory(partition, bucket), self.merge_executor(), self.key_names)
         return read.read_split(files, predicate, projection, drop_delete, deletion_vectors)
 

@@ -52,6 +52,7 @@ class MergeTreeWriter:
         self._compact_before: list[DataFileMeta] = []
         self._compact_after: list[DataFileMeta] = []
         self._changelog: list[DataFileMeta] = []
+        self._compact_changelog: list[DataFileMeta] = []
 
     # ---- ingest --------------------------------------------------------
     def write(self, data: ColumnBatch, kinds: np.ndarray | None = None) -> None:
@@ -86,6 +87,16 @@ class MergeTreeWriter:
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
         self._buffer.clear()
         self._buffered_rows = 0
+        from ..options import ChangelogProducer
+
+        if self.options.changelog_producer == ChangelogProducer.INPUT:
+            # the raw input IS the changelog (reference: input producer
+            # persists the flushed buffer as changelog files)
+            self._changelog.extend(
+                self.writer_factory.write(
+                    kv, level=0, file_source="append", prefix="changelog", sorted_input=False
+                )
+            )
         # memtable rows arrive in seq order: stability replaces seq lanes
         merged = self.merge.merge(kv, seq_ascending=self._buffer_seq_ordered)
         self._buffer_seq_ordered = True
@@ -118,7 +129,7 @@ class MergeTreeWriter:
         # to keep the manifest chain consistent — reference keeps both too
         self._compact_before.extend(created_then_compacted)
         self._compact_after.extend(result.after)
-        self._changelog.extend(result.changelog)
+        self._compact_changelog.extend(result.changelog)
 
     # ---- commit --------------------------------------------------------
     def prepare_commit(self) -> CommitMessage:
@@ -136,11 +147,13 @@ class MergeTreeWriter:
             compact_before=[f for f in self._compact_before if f.file_name not in cancel],
             compact_after=[f for f in self._compact_after if f.file_name not in cancel],
             changelog_files=list(self._changelog),
+            compact_changelog_files=list(self._compact_changelog),
         )
         self._new_files.clear()
         self._compact_before.clear()
         self._compact_after.clear()
         self._changelog.clear()
+        self._compact_changelog.clear()
         return msg
 
     @property

@@ -1,0 +1,140 @@
+"""Engine-neutral metrics kernel.
+
+Parity: /root/reference/paimon-core/.../metrics/ — MetricRegistry, groups,
+Counter/Gauge/Histogram; instrumented scan/commit/compaction
+(operation/metrics/ScanMetrics, CommitMetrics, CompactionMetrics). External
+engines bridge this registry to their own metric systems, exactly like the
+reference bridges to Flink/Spark.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricGroup", "MetricRegistry", "registry", "timed"]
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def count(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self, fn: Callable[[], float] | None = None):
+        self._fn = fn
+        self._v: float = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._fn() if self._fn is not None else self._v
+
+
+class Histogram:
+    """Sliding-window histogram (reference uses a 100-sample window)."""
+
+    def __init__(self, window: int = 100):
+        self.window = window
+        self._values: list[float] = []
+        self._lock = threading.Lock()
+
+    def update(self, v: float) -> None:
+        with self._lock:
+            self._values.append(v)
+            if len(self._values) > self.window:
+                self._values.pop(0)
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+    @property
+    def mean(self) -> float:
+        return sum(self._values) / len(self._values) if self._values else 0.0
+
+    @property
+    def max(self) -> float:
+        return max(self._values) if self._values else 0.0
+
+    @property
+    def min(self) -> float:
+        return min(self._values) if self._values else 0.0
+
+
+class MetricGroup:
+    def __init__(self, name: str, tags: dict[str, str] | None = None):
+        self.name = name
+        self.tags = tags or {}
+        self.metrics: dict[str, object] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.metrics.setdefault(name, Counter())  # type: ignore[return-value]
+
+    def gauge(self, name: str, fn: Callable[[], float] | None = None) -> Gauge:
+        return self.metrics.setdefault(name, Gauge(fn))  # type: ignore[return-value]
+
+    def histogram(self, name: str, window: int = 100) -> Histogram:
+        return self.metrics.setdefault(name, Histogram(window))  # type: ignore[return-value]
+
+
+class MetricRegistry:
+    def __init__(self):
+        self.groups: dict[tuple, MetricGroup] = {}
+        self._lock = threading.Lock()
+
+    def group(self, name: str, **tags: str) -> MetricGroup:
+        key = (name, tuple(sorted(tags.items())))
+        with self._lock:
+            if key not in self.groups:
+                self.groups[key] = MetricGroup(name, tags)
+            return self.groups[key]
+
+    def snapshot(self) -> dict:
+        out: dict = {}
+        for (name, tags), group in self.groups.items():
+            entry = {}
+            for mname, m in group.metrics.items():
+                if isinstance(m, Counter):
+                    entry[mname] = m.count
+                elif isinstance(m, Gauge):
+                    entry[mname] = m.value
+                elif isinstance(m, Histogram):
+                    entry[mname] = {"count": m.count, "mean": m.mean, "max": m.max}
+            out[name if not tags else f"{name}{dict(tags)}"] = entry
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self.groups.clear()
+
+
+registry = MetricRegistry()
+
+
+class timed:
+    """Context manager recording wall millis into a histogram."""
+
+    def __init__(self, histogram: Histogram):
+        self.histogram = histogram
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.histogram.update((time.perf_counter() - self._t0) * 1000)
+        return False

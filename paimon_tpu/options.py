@@ -241,6 +241,9 @@ class CoreOptions:
     PARTITION_TIMESTAMP_PATTERN = ConfigOption.string("partition.timestamp-pattern", None)
     RECORD_LEVEL_EXPIRE_TIME_MS = ConfigOption.int_("record-level.expire-time.ms", None, "Row TTL on read/compact.")
     RECORD_LEVEL_TIME_FIELD = ConfigOption.string("record-level.time-field", None, "Row TTL time column.")
+    RECORD_LEVEL_TIME_FIELD_TYPE = ConfigOption.string(
+        "record-level.time-field-type", "seconds", "Row TTL column unit: seconds|millis|micros."
+    )
     FILE_INDEX_BLOOM_COLUMNS = ConfigOption.string(
         "file-index.bloom-filter.columns", None, "Columns with bloom file index."
     )

@@ -1,0 +1,140 @@
+"""Branches: independent snapshot lineages sharing data files.
+
+Parity: /root/reference/paimon-core/.../utils/BranchManager.java — a branch
+lives under table/branch/branch-<name>/ with its own snapshot/ and schema/
+dirs (data + manifest files are shared with main, since they are immutable);
+create from a tag/snapshot, delete, and fast-forward main to a branch.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..core.schema import SchemaManager
+from ..core.snapshot import Snapshot, SnapshotManager
+from ..fs import FileIO
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["BranchManager", "branch_table"]
+
+
+class BranchManager:
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.table_path = table_path
+        self.branch_root = f"{table_path}/branch"
+
+    def branch_path(self, name: str) -> str:
+        return f"{self.branch_root}/branch-{name}"
+
+    def create(self, name: str, from_snapshot: int | None = None, from_tag: str | None = None) -> None:
+        if self.file_io.exists(self.branch_path(name)):
+            raise ValueError(f"branch {name!r} already exists")
+        sm = SnapshotManager(self.file_io, self.table_path)
+        if from_tag is not None:
+            from .tags import TagManager
+
+            snap = TagManager(self.file_io, self.table_path).get(from_tag)
+        else:
+            sid = from_snapshot if from_snapshot is not None else sm.latest_snapshot_id()
+            if sid is None:
+                snap = None
+            else:
+                snap = sm.snapshot(sid)
+        bp = self.branch_path(name)
+        # copy the schema lineage (schemas are tiny; data files stay shared)
+        schema_manager = SchemaManager(self.file_io, self.table_path)
+        for sid_, ts in schema_manager.all_schemas().items():
+            if snap is None or sid_ <= snap.schema_id:
+                self.file_io.write_bytes(f"{bp}/schema/schema-{sid_}", ts.to_json().encode())
+        if snap is not None:
+            self._copy_metadata(snap, bp)
+            self.file_io.write_bytes(f"{bp}/snapshot/snapshot-{snap.id}", snap.to_json().encode())
+            bsm = SnapshotManager(self.file_io, bp)
+            bsm.commit_latest_hint(snap.id)
+            bsm.commit_earliest_hint(snap.id)
+
+    def _copy_metadata(self, snap: Snapshot, dst: str, src: str | None = None) -> None:
+        """Copy a snapshot's manifest tree + index files between metadata
+        roots (data files stay shared — they are immutable and resolved
+        through the main bucket dirs)."""
+        from ..core.manifest import ManifestList
+
+        src = src or self.table_path
+        ml = ManifestList(self.file_io, f"{src}/manifest")
+        names: set[str] = set()
+        for lst in (snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list):
+            if not lst:
+                continue
+            names.add(lst)
+            for meta in ml.read(lst):
+                names.add(meta.file_name)
+        if snap.index_manifest:
+            names.add(snap.index_manifest)
+            from ..core.indexmanifest import read_index_manifest
+
+            for e in read_index_manifest(self.file_io, src, snap.index_manifest):
+                self._copy_file(f"{src}/index/{e.file_name}", f"{dst}/index/{e.file_name}")
+        for n in names:
+            self._copy_file(f"{src}/manifest/{n}", f"{dst}/manifest/{n}")
+
+    def _copy_file(self, src: str, dst: str) -> None:
+        if not self.file_io.exists(dst):
+            self.file_io.write_bytes(dst, self.file_io.read_bytes(src))
+
+    def delete(self, name: str) -> None:
+        self.file_io.delete(self.branch_path(name), recursive=True)
+
+    def list_branches(self) -> list[str]:
+        out = []
+        for st in self.file_io.list_status(self.branch_root):
+            base = st.path.rsplit("/", 1)[-1]
+            if st.is_dir and base.startswith("branch-"):
+                out.append(base[len("branch-") :])
+        return sorted(out)
+
+    def fast_forward(self, name: str) -> None:
+        """Make main's head the branch's head (reference fastForward): copies
+        the branch's snapshots/schemas above main's latest back into main."""
+        bp = self.branch_path(name)
+        bsm = SnapshotManager(self.file_io, bp)
+        main_sm = SnapshotManager(self.file_io, self.table_path)
+        b_latest = bsm.latest_snapshot_id()
+        if b_latest is None:
+            return
+        main_latest = main_sm.latest_snapshot_id() or 0
+        # main must not have diverged past the branch point
+        for sid in range(bsm.earliest_snapshot_id() or b_latest, b_latest + 1):
+            if bsm.snapshot_exists(sid) and not main_sm.snapshot_exists(sid):
+                snap = bsm.snapshot(sid)
+                self._copy_metadata(snap, self.table_path, src=bp)
+                self.file_io.try_atomic_write(main_sm.snapshot_path(sid), snap.to_json().encode())
+        bschemas = SchemaManager(self.file_io, bp)
+        mschemas = SchemaManager(self.file_io, self.table_path)
+        for sid_, ts in bschemas.all_schemas().items():
+            if not self.file_io.exists(mschemas.schema_path(sid_)):
+                self.file_io.write_bytes(mschemas.schema_path(sid_), ts.to_json().encode())
+        main_sm.commit_latest_hint(max(b_latest, main_latest))
+
+
+def branch_table(table: "FileStoreTable", name: str) -> "FileStoreTable":
+    """A Table view rooted at the branch directory. Data file paths are
+    resolved relative to the MAIN table (files are shared), so the branch
+    store overrides bucket_dir back to the main tree."""
+    from . import FileStoreTable
+
+    bm = BranchManager(table.file_io, table.path)
+    bp = bm.branch_path(name)
+    if not table.file_io.exists(bp):
+        raise ValueError(f"branch {name!r} does not exist")
+    schema = SchemaManager(table.file_io, bp).latest() or table.schema
+    bt = FileStoreTable(table.file_io, bp, schema, table.store.commit_user)
+    main_store = table.store
+
+    def shared_bucket_dir(partition: tuple, bucket: int) -> str:
+        return main_store.bucket_dir(partition, bucket)
+
+    bt.store.bucket_dir = shared_bucket_dir  # type: ignore[method-assign]
+    return bt

@@ -1,0 +1,124 @@
+"""Schema-evolving CDC ingestion.
+
+Parity: the core semantic of paimon-flink-cdc (reference paimon-flink/
+paimon-flink-cdc/.../sink/cdc/ — RichCdcMultiplexRecord pipelines apply
+schema changes mid-stream: new columns are added, types are widened via
+SchemaMergingUtils, then records write under the updated schema). Sources
+(mysql/kafka/...) are engine-side; this is the engine-neutral sink half:
+feed it dict-records with row kinds, it evolves the table as needed.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Mapping, Sequence
+
+import numpy as np
+
+from ..core.schema import SchemaChange, SchemaManager
+from ..data.batch import ColumnBatch
+from ..data.casting import can_cast
+from ..types import BIGINT, BOOLEAN, DOUBLE, STRING, DataType, RowKind, TypeRoot
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["CdcRecord", "CdcTableWrite", "infer_type"]
+
+
+class CdcRecord(dict):
+    """A change record: field map + row kind (+I default)."""
+
+    def __init__(self, fields: Mapping[str, Any], kind: str = "+I"):
+        super().__init__(fields)
+        self.kind = kind
+
+
+def infer_type(value: Any) -> DataType:
+    if isinstance(value, bool):
+        return BOOLEAN()
+    if isinstance(value, int):
+        return BIGINT()
+    if isinstance(value, float):
+        return DOUBLE()
+    return STRING()
+
+
+class CdcTableWrite:
+    """Buffers CDC records, evolving the table schema when records carry new
+    columns or wider types, then writes through the normal Table API."""
+
+    def __init__(self, table: "FileStoreTable"):
+        self.table = table
+        self._records: list[CdcRecord] = []
+
+    def write(self, record: CdcRecord | Mapping[str, Any], kind: str = "+I") -> None:
+        if not isinstance(record, CdcRecord):
+            record = CdcRecord(record, kind)
+        self._records.append(record)
+
+    def flush(self, commit_identifier: int) -> int:
+        """Evolve schema if needed, write all buffered records, commit."""
+        if not self._records:
+            return 0
+        self._evolve_schema()
+        table = self.table
+        schema = table.row_type
+        data: dict[str, list] = {f.name: [] for f in schema.fields}
+        kinds = []
+        for r in self._records:
+            for f in schema.fields:
+                data[f.name].append(self._coerce(r.get(f.name), f.type))
+            kinds.append(int(RowKind.from_short_string(r.kind)))
+        n = len(self._records)
+        self._records = []
+        wb = table.new_stream_write_builder()
+        w = wb.new_write()
+        w.write(ColumnBatch.from_pydict(schema, data), np.array(kinds, dtype=np.uint8))
+        wb.new_commit().commit_messages(commit_identifier, w.prepare_commit())
+        return n
+
+    @staticmethod
+    def _coerce(value: Any, dtype: DataType):
+        if value is None:
+            return None
+        root = dtype.root
+        if root in (TypeRoot.VARCHAR, TypeRoot.CHAR):
+            return str(value)
+        if root in (TypeRoot.TINYINT, TypeRoot.SMALLINT, TypeRoot.INT, TypeRoot.BIGINT):
+            return int(value)
+        if root in (TypeRoot.FLOAT, TypeRoot.DOUBLE):
+            return float(value)
+        if root == TypeRoot.BOOLEAN:
+            return bool(value)
+        return value
+
+    def _evolve_schema(self) -> None:
+        table = self.table
+        schema = table.row_type
+        changes = []
+        seen_new: dict[str, DataType] = {}
+        for r in self._records:
+            for name, value in r.items():
+                if value is None:
+                    continue
+                inferred = infer_type(value)
+                if name not in schema:
+                    prev = seen_new.get(name)
+                    if prev is None or (prev != inferred and can_cast(prev, inferred)):
+                        seen_new[name] = inferred
+                else:
+                    current = schema.field(name).type
+                    if current.root != inferred.root and can_cast(current, inferred):
+                        changes.append(SchemaChange.update_column_type(name, inferred))
+        for name, t in seen_new.items():
+            changes.append(SchemaChange.add_column(name, t))
+        if changes:
+            # dedupe type updates, last wins
+            dedup: dict[tuple, dict] = {}
+            for ch in changes:
+                dedup[(ch["op"], ch["name"])] = ch
+            sm = SchemaManager(table.file_io, table.path)
+            new_schema = sm.commit_changes(*dedup.values())
+            from . import FileStoreTable
+
+            self.table = FileStoreTable(table.file_io, table.path, new_schema, table.store.commit_user)

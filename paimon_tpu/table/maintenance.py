@@ -1,0 +1,118 @@
+"""Maintenance actions: orphan file cleanup, partition expiry.
+
+Parity: /root/reference/paimon-core/.../operation/OrphanFilesClean (delete
+files no snapshot/tag references, older than a safety TTL) and
+PartitionExpire (drop whole partitions past their time-to-live based on a
+partition-value timestamp).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import TYPE_CHECKING
+
+from ..core.manifest import CommitMessage, ManifestCommittable
+from ..utils import now_millis
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["remove_orphan_files", "expire_partitions"]
+
+
+def remove_orphan_files(table: "FileStoreTable", older_than_millis: int = 24 * 3600_000, dry_run: bool = False) -> list[str]:
+    """Delete data/manifest/index files referenced by NO snapshot, changelog,
+    or tag. Only files older than the TTL are touched — an in-flight commit's
+    freshly written files must survive (reference OrphanFilesClean default:
+    1 day)."""
+    from ..core.indexmanifest import read_index_manifest
+    from ..core.manifest import ManifestFile, ManifestList
+    from .tags import TagManager
+
+    io = table.file_io
+    path = table.path
+    sm = table.store.snapshot_manager
+    manifest_file = ManifestFile(io, f"{path}/manifest")
+    manifest_list = ManifestList(io, f"{path}/manifest")
+
+    live_data: set[tuple] = set()  # (bucket_dir_relative, file_name)
+    live_meta: set[str] = set()  # manifest dir file names
+    live_index: set[str] = set()
+
+    snapshots = list(sm.snapshots())
+    tags = TagManager(io, path)
+    for name in tags.list_tags():
+        snapshots.append(tags.get(name))
+    for snap in snapshots:
+        lists = [snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list]
+        for lst in lists:
+            if not lst:
+                continue
+            live_meta.add(lst)
+            for meta in manifest_list.read(lst):
+                live_meta.add(meta.file_name)
+                for e in manifest_file.read(meta.file_name):
+                    bucket_dir = table.store.bucket_dir(e.partition, e.bucket)
+                    live_data.add((bucket_dir, e.file.file_name))
+                    for x in e.file.extra_files:
+                        live_data.add((bucket_dir, x))
+        if snap.index_manifest:
+            live_meta.add(snap.index_manifest)
+            for ie in read_index_manifest(io, path, snap.index_manifest):
+                live_index.add(ie.file_name)
+
+    cutoff = now_millis() - older_than_millis
+    removed: list[str] = []
+
+    def sweep_dir(directory: str, keep: set[str]):
+        for st in io.list_files(directory):
+            base = st.path.rsplit("/", 1)[-1]
+            if base in keep or st.mtime_millis >= cutoff:
+                continue
+            removed.append(st.path)
+            if not dry_run:
+                io.delete(st.path)
+
+    sweep_dir(f"{path}/manifest", live_meta)
+    sweep_dir(f"{path}/index", live_index)
+    # bucket dirs: walk partitions via the live set's dirs plus table root
+    seen_dirs = {d for d, _ in live_data}
+    for st in io.list_status(path):
+        base = st.path.rsplit("/", 1)[-1]
+        if st.is_dir and base.startswith("bucket-"):
+            seen_dirs.add(st.path)
+    for d in seen_dirs:
+        keep = {f for dd, f in live_data if dd == d}
+        sweep_dir(d, keep)
+    return removed
+
+
+def expire_partitions(table: "FileStoreTable", expiration_millis: int, time_col: str | None = None, pattern: str = "%Y-%m-%d") -> list[tuple]:
+    """Drop partitions whose timestamp value is older than the TTL (reference
+    PartitionExpire; partition.timestamp-pattern). The partition's files are
+    logically deleted in one OVERWRITE-style commit."""
+    keys = table.partition_keys
+    if not keys:
+        return []
+    col = time_col or keys[0]
+    idx = keys.index(col)
+    cutoff = now_millis() - expiration_millis
+    store = table.store
+    plan = store.new_scan().plan()
+    expired: list[tuple] = []
+    for partition in plan.grouped():
+        value = partition[idx]
+        try:
+            ts = datetime.datetime.strptime(str(value), pattern).timestamp() * 1000
+        except ValueError:
+            continue
+        if ts < cutoff:
+            expired.append(partition)
+    if expired:
+        dead = set(expired)
+        commit = store.new_commit()
+        commit.overwrite(
+            ManifestCommittable((1 << 63) - 4, messages=[]),
+            partition_filter=lambda p: p in dead,
+        )
+    return expired

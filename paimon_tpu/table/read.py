@@ -34,6 +34,7 @@ class DataSplit:
     snapshot_id: int | None = None
     raw_convertible: bool = False  # single-run: no merge needed
     dv_index_file: str | None = None  # deletion-vector index for this bucket
+    is_changelog: bool = False  # files are changelog (-U/+U kinds preserved)
 
     @property
     def row_count(self) -> int:
@@ -180,7 +181,35 @@ class TableRead:
         self.projection = projection
         self.limit = limit
 
+    def read_with_kinds(self, split: DataSplit):
+        """(rows, RowKind uint8 vector) — the changelog-aware read used by
+        streaming consumers. For data splits every merged row is +I."""
+        import numpy as np
+
+        from ..types import RowKind
+
+        if split.is_changelog:
+            store = self.table.store
+            rf = store.reader_factory(split.partition, split.bucket)
+            from ..core.kv import KVBatch
+
+            ordered = sorted(split.files, key=lambda f: (f.min_sequence_number, f.file_name))
+            kv = KVBatch.concat([rf.read(f) for f in ordered])
+            data = kv.data
+            kinds = kv.kind
+            if self.predicate is not None and data.num_rows:
+                mask = self.predicate.eval(data)
+                if not mask.all():
+                    data, kinds = data.filter(mask), kinds[mask]
+            if self.projection is not None:
+                data = data.select(self.projection)
+            return data, kinds
+        out = self.read(split)
+        return out, np.full(out.num_rows, int(RowKind.INSERT), dtype=np.uint8)
+
     def read(self, split: DataSplit):
+        if split.is_changelog:
+            return self.read_with_kinds(split)[0]
         dvs = None
         if split.dv_index_file:
             from ..core.deletionvectors import DeletionVectorsIndexFile

@@ -1,0 +1,80 @@
+"""Sort-compaction: rewrite a table clustered by a space-filling curve.
+
+Parity: the reference's SortCompactAction + TableSorter (flink/sorter/:
+ZorderSorter, HilbertSorter, order) over RangeShuffle — here the single-host
+path sorts the whole table through the device sort kernel; the distributed
+path is paimon_tpu.parallel.range_partition_lanes over the "key" mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Sequence
+
+import numpy as np
+
+from ..core.kv import KVBatch
+from ..core.manifest import CommitMessage, ManifestCommittable
+from ..data.keys import build_string_pool, encode_key_lanes
+from ..ops.merge import merge_plan
+from ..ops.zorder import hilbert_lanes, z_order_lanes
+from ..types import TypeRoot
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["sort_compact"]
+
+
+def sort_compact(
+    table: "FileStoreTable",
+    columns: Sequence[str],
+    order: str = "zorder",
+    commit_identifier: int | None = None,
+) -> int:
+    """Rewrites every bucket clustered by `columns` under the given curve
+    (zorder | hilbert | order). Returns rows rewritten. Append tables only —
+    PK tables are already key-clustered by the LSM."""
+    if table.is_primary_key_table:
+        raise ValueError("sort-compact applies to append-only tables (PK tables are key-clustered)")
+    if order not in ("zorder", "hilbert", "order"):
+        raise ValueError(f"unknown sort order {order!r}")
+    store = table.store
+    plan = store.new_scan().plan()
+    messages: list[CommitMessage] = []
+    total = 0
+    for partition, buckets in plan.grouped().items():
+        for bucket, files in buckets.items():
+            rf = store.reader_factory(partition, bucket)
+            ordered = sorted(files, key=lambda f: (f.min_sequence_number, f.file_name))
+            kv = KVBatch.concat([rf.read(f) for f in ordered])
+            if kv.num_rows == 0:
+                continue
+            pools = {
+                c: build_string_pool([kv.data.column(c).values])
+                for c in columns
+                if kv.data.schema.field(c).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+            }
+            lanes = encode_key_lanes(kv.data, columns, pools)
+            if order == "zorder":
+                lanes = z_order_lanes(lanes)
+            elif order == "hilbert":
+                lanes = hilbert_lanes(lanes)
+            p = merge_plan(lanes)  # device sort; stability keeps arrival order on ties
+            perm = p.perm[p.valid_sorted]
+            sorted_kv = kv.take(perm)
+            wf = store.writer_factory(partition, bucket)
+            after = wf.write(sorted_kv, level=0, file_source="compact")
+            messages.append(
+                CommitMessage(
+                    partition,
+                    bucket,
+                    max(store.options.bucket, 1),
+                    compact_before=list(files),
+                    compact_after=after,
+                )
+            )
+            total += kv.num_rows
+    if messages:
+        ident = commit_identifier if commit_identifier is not None else (1 << 63) - 3
+        store.new_commit().commit(ManifestCommittable(ident, messages=messages))
+    return total

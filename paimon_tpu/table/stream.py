@@ -136,7 +136,23 @@ class StreamTableScan:
 
     def _delta_splits(self, snapshot_id: int, snap) -> list[DataSplit]:
         from ..core.snapshot import CommitKind
+        from ..options import ChangelogProducer
 
+        producer = self.store.options.changelog_producer
+        if producer == ChangelogProducer.INPUT:
+            # input producer: the raw +I/-U/+U/-D input rides APPEND snapshots
+            if snap.commit_kind != CommitKind.APPEND:
+                return []
+            return self._changelog_splits(snapshot_id)
+        if producer == ChangelogProducer.LOOKUP:
+            raise NotImplementedError(
+                "changelog-producer=lookup is not implemented yet; use 'input' or 'full-compaction'"
+            )
+        if producer == ChangelogProducer.FULL_COMPACTION:
+            # exact changelog is produced by compaction snapshots
+            if snap.commit_kind != CommitKind.COMPACT:
+                return []
+            return self._changelog_splits(snapshot_id)
         if snap.commit_kind != CommitKind.APPEND:
             return []  # compaction produces no new records (delta follow-up rule)
         plan = self.store.new_scan().with_snapshot(snapshot_id).with_kind("delta").plan()
@@ -152,5 +168,15 @@ class StreamTableScan:
                         raw_convertible=True,
                         dv_index_file=plan.dv_index_for(partition, bucket),
                     )
+                )
+        return out
+
+    def _changelog_splits(self, snapshot_id: int) -> list[DataSplit]:
+        plan = self.store.new_scan().with_snapshot(snapshot_id).with_kind("changelog").plan()
+        out = []
+        for partition, buckets in sorted(plan.grouped().items()):
+            for bucket, files in sorted(buckets.items()):
+                out.append(
+                    DataSplit(partition, bucket, files, snapshot_id, raw_convertible=True, is_changelog=True)
                 )
         return out

@@ -1,0 +1,146 @@
+"""Auxiliary subsystems: branches, CDC ingestion, statistics, maintenance,
+metrics (reference BranchManager, paimon-flink-cdc sink, stats/,
+OrphanFilesClean, metrics/)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.data.predicate import equal
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="aux")
+
+
+def write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def read(t):
+    rb = t.new_read_builder()
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+def test_branch_create_write_fast_forward(catalog):
+    from paimon_tpu.table.branch import BranchManager, branch_table
+
+    t = catalog.create_table("db.br", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": [1], "v": [1.0]})
+    bm = BranchManager(t.file_io, t.path)
+    bm.create("dev")
+    assert bm.list_branches() == ["dev"]
+    bt = branch_table(t, "dev")
+    # branch sees the branch point
+    assert read(bt).to_pylist() == [(1, 1.0)]
+    # write on the branch: main unaffected
+    write(bt, {"id": [2], "v": [2.0]})
+    assert sorted(r[0] for r in read(bt).to_pylist()) == [1, 2]
+    assert [r[0] for r in read(t).to_pylist()] == [1]
+    # fast-forward main to the branch
+    bm.fast_forward("dev")
+    assert sorted(r[0] for r in read(t).to_pylist()) == [1, 2]
+    bm.delete("dev")
+    assert bm.list_branches() == []
+
+
+def test_cdc_schema_evolving_ingestion(catalog):
+    from paimon_tpu.table.cdc import CdcTableWrite
+
+    t = catalog.create_table("db.cdc", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    w = CdcTableWrite(t)
+    w.write({"id": 1, "v": 1.5})
+    w.write({"id": 2, "v": 2.5, "city": "berlin"})  # new column arrives
+    assert w.flush(1) == 2
+    t2 = catalog.get_table("db.cdc")
+    assert "city" in t2.row_type
+    out = read(t2)
+    assert sorted(out.to_pylist()) == [(1, 1.5, None), (2, 2.5, "berlin")]
+    # delete via CDC
+    w2 = CdcTableWrite(t2)
+    w2.write({"id": 1, "v": 1.5}, kind="-D")
+    w2.flush(2)
+    assert [r[0] for r in read(catalog.get_table("db.cdc")).to_pylist()] == [2]
+
+
+def test_analyze_statistics(catalog):
+    from paimon_tpu.table.statistics import analyze_table, read_statistics
+
+    t = catalog.create_table("db.an", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": [1, 2, 3], "v": [1.0, 2.0, None]})
+    stats = analyze_table(t)
+    assert stats.merged_record_count == 3
+    assert stats.col_stats["v"]["nullCount"] == 1
+    back = read_statistics(t)
+    assert back is not None and back.merged_record_count == 3
+    from paimon_tpu.core.snapshot import CommitKind
+
+    assert t.store.snapshot_manager.latest_snapshot().commit_kind == CommitKind.ANALYZE
+
+
+def test_orphan_files_clean(catalog):
+    from paimon_tpu.table.maintenance import remove_orphan_files
+
+    t = catalog.create_table("db.orph", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": [1], "v": [1.0]})
+    # plant an orphan data file and an orphan manifest
+    t.file_io.write_bytes(f"{t.path}/bucket-0/data-orphan.parquet", b"junk")
+    t.file_io.write_bytes(f"{t.path}/manifest/manifest-orphan", b"junk")
+    removed = remove_orphan_files(t, older_than_millis=-1000)  # no TTL for the test
+    names = {p.rsplit("/", 1)[-1] for p in removed}
+    assert names == {"data-orphan.parquet", "manifest-orphan"}
+    # table intact
+    assert read(t).to_pylist() == [(1, 1.0)]
+
+
+def test_partition_expire(catalog):
+    from paimon_tpu.table.maintenance import expire_partitions
+
+    schema = RowType.of(("dt", STRING()), ("id", BIGINT()), ("v", DOUBLE()))
+    t = catalog.create_table(
+        "db.pexp", schema, partition_keys=["dt"], primary_keys=["dt", "id"], options={"bucket": "1"}
+    )
+    write(t, {"dt": ["2000-01-01", "2999-01-01"], "id": [1, 2], "v": [1.0, 2.0]})
+    expired = expire_partitions(t, expiration_millis=365 * 24 * 3600_000)
+    assert expired == [("2000-01-01",)]
+    out = read(t)
+    assert [r[0] for r in out.to_pylist()] == ["2999-01-01"]
+
+
+def test_metrics_instrumented(catalog):
+    from paimon_tpu.metrics import registry
+
+    registry.reset()
+    t = catalog.create_table("db.met", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": [1], "v": [1.0]})
+    read(t)
+    snap = registry.snapshot()
+    assert snap["commit"]["commits"] >= 1
+    assert snap["scan"]["plans"] >= 1
+    assert snap["commit"]["duration_ms"]["count"] >= 1
+
+
+def test_record_level_expire(catalog):
+    import time
+
+    t = catalog.create_table(
+        "db.rexp",
+        RowType.of(("id", BIGINT()), ("created", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "record-level.expire-time.ms": "3600000",
+            "record-level.time-field": "created",
+        },
+    )
+    now_s = int(time.time())
+    write(t, {"id": [1, 2], "created": [now_s, now_s - 7200], "v": [1.0, 2.0]})
+    out = read(t)
+    assert [r[0] for r in out.to_pylist()] == [1]  # the 2h-old row is expired

@@ -1,0 +1,124 @@
+"""Changelog producers (reference ChangelogProducer: input / full-compaction)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowKind, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="cl")
+
+
+def write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def changelog_of(t, scan, read):
+    splits = scan.plan()
+    if not splits:
+        return None
+    out = []
+    for s in splits:
+        data, kinds = read.read_with_kinds(s)
+        for row, k in zip(data.to_pylist(), kinds.tolist()):
+            out.append((RowKind(k).short_string, *row))
+    return out
+
+
+def test_input_changelog_producer(catalog):
+    t = catalog.create_table(
+        "db.cin", SCHEMA, primary_keys=["id"], options={"bucket": "1", "changelog-producer": "input"}
+    )
+    write(t, {"id": [1], "v": [1.0]})
+    scan = t.new_read_builder().new_stream_scan()
+    read = t.new_read_builder().new_read()
+    first = scan.plan()  # starting full scan
+    assert read.read_all(first).num_rows == 1
+    # second commit carries raw input incl. the -D row
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [2], "v": [2.0]})
+    w.write({"id": [1], "v": [None]}, kinds=["-D"])
+    wb.new_commit().commit(w.prepare_commit())
+    events = changelog_of(t, scan, read)
+    assert sorted(events) == [("+I", 2, 2.0), ("-D", 1, None)]
+    snap = t.store.snapshot_manager.latest_snapshot()
+    assert snap.changelog_record_count == 2
+
+
+def test_full_compaction_changelog_producer(catalog):
+    t = catalog.create_table(
+        "db.cfc",
+        SCHEMA,
+        primary_keys=["id"],
+        options={"bucket": "1", "changelog-producer": "full-compaction"},
+    )
+    write(t, {"id": [1, 2], "v": [1.0, 2.0]})
+    scan = t.new_read_builder().new_stream_scan()
+    read = t.new_read_builder().new_read()
+    scan.plan()  # starting point
+    # full compaction #1: baseline becomes {1,2} -> changelog +I for both
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    events = changelog_of(t, scan, read)
+    assert sorted(events) == [("+I", 1, 1.0), ("+I", 2, 2.0)]
+    # upsert id=2, delete id=1, insert id=3, then full compaction #2
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [2, 3], "v": [22.0, 3.0]})
+    w.write({"id": [1], "v": [None]}, kinds=["-D"])
+    wb.new_commit().commit(w.prepare_commit())
+    assert changelog_of(t, scan, read) is None or changelog_of(t, scan, read) == []  # APPEND emits nothing
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    events = None
+    while events in (None, []):
+        events = changelog_of(t, scan, read)
+    assert sorted(events) == [
+        ("+I", 3, 3.0),
+        ("+U", 2, 22.0),
+        ("-D", 1, 1.0),
+        ("-U", 2, 2.0),
+    ]
+
+
+def test_full_compaction_changelog_no_change_is_silent(catalog):
+    t = catalog.create_table(
+        "db.cnc", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "changelog-producer": "full-compaction"},
+    )
+    write(t, {"id": [1], "v": [1.0]})
+    wb = t.new_batch_write_builder(); w = wb.new_write(); w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    scan = t.new_read_builder().new_stream_scan()
+    read = t.new_read_builder().new_read()
+    scan.plan()
+    # compact again with no data change: no spurious changelog rows
+    wb = t.new_batch_write_builder(); w = wb.new_write(); w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    events = changelog_of(t, scan, read)
+    assert events in (None, [])
+
+
+def test_input_changelog_unsorted_key_stats(catalog):
+    """Changelog files preserve event order; their key range must still be
+    correct for key-filtered changelog scans."""
+    t = catalog.create_table(
+        "db.cks", SCHEMA, primary_keys=["id"], options={"bucket": "1", "changelog-producer": "input"}
+    )
+    write(t, {"id": [9, 1, 5], "v": [9.0, 1.0, 5.0]})  # unsorted arrival
+    plan = t.store.new_scan().with_kind("changelog").plan()
+    f = plan.entries[0].file
+    assert f.min_key == (1,) and f.max_key == (9,)
