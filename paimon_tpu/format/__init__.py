@@ -72,7 +72,7 @@ def register_format(identifier: str, factory: Callable[[], FileFormat]) -> None:
 def get_format(identifier: str) -> FileFormat:
     if identifier not in _FORMATS:
         # lazy import of built-ins
-        from . import orc, parquet  # noqa: F401
+        from . import avro, orc, parquet  # noqa: F401
 
     if identifier not in _FORMATS:
         raise ValueError(f"unknown file format {identifier!r}; known: {sorted(_FORMATS)}")

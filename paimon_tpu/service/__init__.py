@@ -1,0 +1,177 @@
+"""Remote KV point-query service.
+
+Parity: /root/reference/paimon-service/ — the reference's only custom network
+protocol: NetworkServer/NetworkClient (Netty) carrying KvRequest/KvResponse,
+KvQueryServer dispatching to a TableQuery, KvQueryClient used by lookup joins
+(RemoteTableQuery). Here: a threaded socket server speaking a length-prefixed
+JSON protocol over TCP, dispatching to LocalTableQuery; the address registers
+on the filesystem like the reference's ServiceManager address files.
+
+Wire format (both directions): 4-byte big-endian length + UTF-8 JSON.
+Request:  {"id": n, "method": "lookup", "partition": [...], "key": [...]}
+          {"id": n, "method": "refresh"} | {"id": n, "method": "ping"}
+Response: {"id": n, "ok": true, "row": [...] | null} | {"id": n, "ok": false, "error": "..."}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import TYPE_CHECKING
+
+from ..fs import FileIO
+from ..utils import dumps, loads
+
+if TYPE_CHECKING:
+    from ..table import FileStoreTable
+
+__all__ = ["KvQueryServer", "KvQueryClient", "ServiceManager"]
+
+
+def _send(sock: socket.socket, obj: dict) -> None:
+    payload = dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> dict | None:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    body = _recv_exact(sock, length)
+    return None if body is None else loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class ServiceManager:
+    """Service address files on the table filesystem (reference
+    core service/ServiceManager.java)."""
+
+    PRIMARY_KEY_LOOKUP = "primary-key-lookup"
+
+    def __init__(self, file_io: FileIO, table_path: str):
+        self.file_io = file_io
+        self.service_dir = f"{table_path}/service"
+
+    def register(self, service: str, host: str, port: int) -> None:
+        self.file_io.try_overwrite(f"{self.service_dir}/{service}", dumps({"host": host, "port": port}).encode())
+
+    def address(self, service: str) -> tuple[str, int] | None:
+        try:
+            d = loads(self.file_io.read_bytes(f"{self.service_dir}/{service}"))
+            return d["host"], d["port"]
+        except Exception:
+            return None
+
+    def unregister(self, service: str) -> None:
+        self.file_io.delete(f"{self.service_dir}/{service}")
+
+
+class KvQueryServer:
+    def __init__(self, table: "FileStoreTable", host: str = "127.0.0.1", port: int = 0):
+        from ..table.query import LocalTableQuery
+
+        self.table = table
+        self.query = LocalTableQuery(table)
+        self._lock = threading.Lock()
+        query = self.query
+        lock = self._lock
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    req = _recv(self.request)
+                    if req is None:
+                        return
+                    rid = req.get("id")
+                    try:
+                        method = req["method"]
+                        if method == "ping":
+                            _send(self.request, {"id": rid, "ok": True})
+                        elif method == "refresh":
+                            with lock:
+                                query.refresh()
+                            _send(self.request, {"id": rid, "ok": True})
+                        elif method == "lookup":
+                            with lock:
+                                row = query.lookup(tuple(req.get("partition", ())), tuple(req["key"]))
+                            _send(
+                                self.request,
+                                {"id": rid, "ok": True, "row": None if row is None else list(row.to_pylist()[0])},
+                            )
+                        else:
+                            _send(self.request, {"id": rid, "ok": False, "error": f"unknown method {method}"})
+                    except Exception as e:  # noqa: BLE001 — surface to the client
+                        _send(self.request, {"id": rid, "ok": False, "error": str(e)})
+
+        self._server = socketserver.ThreadingTCPServer((host, port), Handler, bind_and_activate=True)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[0], self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> tuple[str, int]:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        ServiceManager(self.table.file_io, self.table.path).register(
+            ServiceManager.PRIMARY_KEY_LOOKUP, self.host, self.port
+        )
+        return self.host, self.port
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        ServiceManager(self.table.file_io, self.table.path).unregister(ServiceManager.PRIMARY_KEY_LOOKUP)
+
+
+class KvQueryClient:
+    """Blocking client (reference KvQueryClient + RemoteTableQuery)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._id = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def for_table(table: "FileStoreTable") -> "KvQueryClient":
+        addr = ServiceManager(table.file_io, table.path).address(ServiceManager.PRIMARY_KEY_LOOKUP)
+        if addr is None:
+            raise ConnectionError("no primary-key-lookup service registered for this table")
+        return KvQueryClient(*addr)
+
+    def _call(self, method: str, **kw) -> dict:
+        with self._lock:
+            self._id += 1
+            _send(self._sock, {"id": self._id, "method": method, **kw})
+            resp = _recv(self._sock)
+        if resp is None:
+            raise ConnectionError("server closed the connection")
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "unknown server error"))
+        return resp
+
+    def ping(self) -> bool:
+        return self._call("ping")["ok"]
+
+    def refresh(self) -> None:
+        self._call("refresh")
+
+    def lookup(self, partition: tuple, key) -> tuple | None:
+        if not isinstance(key, tuple):
+            key = (key,)
+        row = self._call("lookup", partition=list(partition), key=list(key)).get("row")
+        return None if row is None else tuple(row)
+
+    def close(self) -> None:
+        self._sock.close()

@@ -1,0 +1,97 @@
+"""In-place migration: adopt existing parquet/orc files as a table.
+
+Parity: /root/reference/paimon-core/.../migrate/Migrator.java + FileMetaUtils
+— Hive-table migration reuses the existing ORC/Parquet data files and
+synthesizes manifests around them; no data rewrite. Here: point at a
+directory (optionally hive-partitioned `k=v` subdirs) of parquet/orc files
+and commit them as an append-only table.
+"""
+
+from __future__ import annotations
+
+from ..catalog import Catalog, Identifier
+from ..core.datafile import DataFileMeta
+from ..core.manifest import CommitMessage, ManifestCommittable
+from ..format import collect_stats, get_format
+from ..fs import get_file_io
+from ..types import RowType
+from ..utils import now_millis
+
+__all__ = ["migrate_files"]
+
+
+def migrate_files(
+    catalog: Catalog,
+    identifier: "Identifier | str",
+    source_dir: str,
+    row_type: RowType,
+    file_format: str = "parquet",
+    partition_keys: tuple = (),
+    options: dict | None = None,
+):
+    """Create an append-only table whose data files are the existing files
+    under source_dir (moved, not rewritten)."""
+    file_io = get_file_io(source_dir)
+    opts = {"bucket": "1", "file.format": file_format}
+    opts.update(options or {})
+    table = catalog.create_table(
+        identifier, row_type, partition_keys=partition_keys, options=opts, ignore_if_exists=False
+    )
+    fmt = get_format(file_format)
+    messages = []
+    seq = 0
+
+    def adopt_dir(directory: str, partition: tuple):
+        nonlocal seq
+        files = []
+        for st in sorted(file_io.list_files(directory), key=lambda s: s.path):
+            if not st.path.endswith(f".{file_format}"):
+                continue
+            # read once to derive row count + stats (metadata-only pass would
+            # need footer parsing; stats make the planner useful immediately)
+            batches = list(fmt.read(file_io, st.path, row_type))
+            rows = sum(b.num_rows for b in batches)
+            if rows == 0:
+                continue
+            from ..data.batch import concat_batches
+
+            stats = collect_stats(concat_batches(batches))
+            name = st.path.rsplit("/", 1)[-1]
+            bucket_dir = table.store.bucket_dir(partition, 0)
+            file_io.mkdirs(bucket_dir)
+            ok = file_io.rename(st.path, f"{bucket_dir}/{name}")
+            if not ok:
+                raise RuntimeError(f"cannot move {st.path} into the table (name collision)")
+            files.append(
+                DataFileMeta(
+                    file_name=name,
+                    file_size=st.size,
+                    row_count=rows,
+                    min_key=(),
+                    max_key=(),
+                    key_stats={},
+                    value_stats=stats,
+                    min_sequence_number=seq,
+                    max_sequence_number=seq + rows - 1,
+                    schema_id=table.schema.id,
+                    level=0,
+                    creation_time_millis=now_millis(),
+                    file_source="append",
+                )
+            )
+            seq += rows
+        if files:
+            messages.append(CommitMessage(partition, 0, 1, new_files=files))
+
+    if partition_keys:
+        for st in file_io.list_status(source_dir):
+            if not st.is_dir:
+                continue
+            parts = st.path.rsplit("/", 1)[-1].split("=")
+            if len(parts) == 2 and parts[0] == partition_keys[0]:
+                adopt_dir(st.path, (parts[1],))
+    else:
+        adopt_dir(source_dir, ())
+    if messages:
+        table.store.new_commit().commit(ManifestCommittable(1, messages=messages))
+    return table

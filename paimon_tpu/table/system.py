@@ -2,7 +2,8 @@
 
 Parity: /root/reference/paimon-core/.../table/system/ (21 virtual tables,
 SystemTableLoader) — here: snapshots, schemas, options, files, manifests,
-tags, consumers, partitions, buckets, audit_log, read_optimized.
+tags, consumers, partitions, buckets, audit_log, read_optimized, statistics,
+aggregation_fields.
 Accessed as `table$snapshots` through the catalog or `system_table(t, name)`.
 """
 
@@ -249,8 +250,50 @@ class _ReadOptimizedTable:
         return self.read().to_pylist()
 
 
+def _statistics(table: "FileStoreTable") -> _StaticTable:
+    from .statistics import read_statistics
+
+    schema = RowType.of(
+        ("snapshot_id", BIGINT(False)),
+        ("schema_id", BIGINT(False)),
+        ("mergedRecordCount", BIGINT()),
+        ("mergedRecordSize", BIGINT()),
+        ("colstat", STRING()),
+    )
+    stats = read_statistics(table)
+    rows = []
+    if stats is not None:
+        from ..utils import dumps
+
+        rows = [(stats.snapshot_id, stats.schema_id, stats.merged_record_count, stats.merged_record_size, dumps(stats.col_stats))]
+    return _StaticTable("statistics", ColumnBatch.from_pylist(schema, rows))
+
+
+def _aggregation_fields(table: "FileStoreTable") -> _StaticTable:
+    schema = RowType.of(
+        ("field_name", STRING(False)),
+        ("field_type", STRING(False)),
+        ("function", STRING()),
+        ("function_options", STRING()),
+        ("comment", STRING()),
+    )
+    co = table.options
+    rows = []
+    for f in table.row_type.fields:
+        fn = co.field_option(f.name, "aggregate-function")
+        opts = []
+        for suffix in ("ignore-retract", "distinct", "list-agg-delimiter", "sequence-group"):
+            v = co.field_option(f.name, suffix)
+            if v is not None:
+                opts.append(f"{suffix}={v}")
+        rows.append((f.name, str(f.type), fn, ",".join(opts) or None, f.description))
+    return _StaticTable("aggregation_fields", ColumnBatch.from_pylist(schema, rows))
+
+
 SYSTEM_TABLES = {
     "snapshots": _snapshots,
+    "statistics": _statistics,
+    "aggregation_fields": _aggregation_fields,
     "schemas": _schemas,
     "options": _options,
     "files": _files,

@@ -1,0 +1,116 @@
+"""Avro codec, migration, privileges (reference paimon-format avro/,
+migrate/Migrator, privilege/)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.catalog.privilege import AccessDeniedError, PrivilegeManager, PrivilegedCatalog
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.format import get_format
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.types import BIGINT, BOOLEAN, DOUBLE, INT, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT(False)), ("name", STRING()), ("v", DOUBLE()), ("ok", BOOLEAN()))
+
+
+def test_avro_roundtrip(tmp_path):
+    io = LocalFileIO()
+    fmt = get_format("avro")
+    b = ColumnBatch.from_pydict(
+        SCHEMA,
+        {
+            "id": [1, 2, 3],
+            "name": ["a", None, "c"],
+            "v": [1.5, 2.5, None],
+            "ok": [True, False, None],
+        },
+    )
+    p = str(tmp_path / "f.avro")
+    fmt.write(io, p, b)
+    out = list(fmt.read(io, p, SCHEMA))
+    assert len(out) == 1
+    assert out[0].to_pydict() == b.to_pydict()
+    # projection
+    proj = next(iter(fmt.read(io, p, SCHEMA, projection=["name", "id"])))
+    assert proj.schema.field_names == ["name", "id"]
+    assert proj.to_pylist() == [("a", 1), (None, 2), ("c", 3)]
+
+
+def test_avro_table_end_to_end(tmp_path):
+    cat = FileSystemCatalog(str(tmp_path), commit_user="av")
+    t = cat.create_table(
+        "db.av", RowType.of(("k", BIGINT()), ("s", STRING())), primary_keys=["k"],
+        options={"bucket": "1", "file.format": "avro"},
+    )
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [2, 1], "s": ["b", "a"]}); wb.new_commit().commit(w.prepare_commit())
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [2], "s": ["b2"]}); wb.new_commit().commit(w.prepare_commit())
+    rb = t.new_read_builder()
+    assert rb.new_read().read_all(rb.new_scan().plan()).to_pylist() == [(1, "a"), (2, "b2")]
+
+
+def test_migrate_parquet_dir(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    src = tmp_path / "legacy"
+    src.mkdir()
+    pq.write_table(pa.table({"x": [1, 2], "y": ["a", "b"]}), str(src / "part-0.parquet"))
+    pq.write_table(pa.table({"x": [3], "y": ["c"]}), str(src / "part-1.parquet"))
+    cat = FileSystemCatalog(str(tmp_path / "wh"), commit_user="mig")
+    from paimon_tpu.table.migrate import migrate_files
+
+    rt = RowType.of(("x", BIGINT()), ("y", STRING()))
+    t = migrate_files(cat, "db.legacy", str(src), rt)
+    rb = t.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert sorted(out.to_pylist()) == [(1, "a"), (2, "b"), (3, "c")]
+    # files were moved, not copied
+    assert not list(src.glob("*.parquet"))
+
+
+def test_privileged_catalog(tmp_warehouse):
+    pm = PrivilegeManager(tmp_warehouse)
+    pm.init("rootpw")
+    root = PrivilegedCatalog(tmp_warehouse, "root", "rootpw")
+    t = root.create_table("db.secure", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    pm.create_user("bob", "pw")
+    # bob: no SELECT yet
+    bob = PrivilegedCatalog(tmp_warehouse, "bob", "pw")
+    with pytest.raises(AccessDeniedError):
+        bob.get_table("db.secure")
+    pm.grant("bob", "db.secure", "SELECT")
+    assert bob.get_table("db.secure").name == "secure"
+    with pytest.raises(AccessDeniedError):
+        bob.writable_table("db.secure")
+    with pytest.raises(AccessDeniedError):
+        bob.drop_table("db.secure")
+    pm.grant("bob", "db", "ADMIN")  # db-level admin inherits down
+    assert bob.writable_table("db.secure") is not None
+    # wrong password
+    with pytest.raises(AccessDeniedError):
+        PrivilegedCatalog(tmp_warehouse, "bob", "wrong")
+    pm.revoke("bob", "db", "ADMIN")
+    with pytest.raises(AccessDeniedError):
+        bob.writable_table("db.secure")
+
+
+def test_more_system_tables(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="st")
+    t = cat.create_table(
+        "db.agg",
+        RowType.of(("k", BIGINT()), ("total", DOUBLE())),
+        primary_keys=["k"],
+        options={"bucket": "1", "merge-engine": "aggregation", "fields.total.aggregate-function": "sum"},
+    )
+    rows = cat.get_table("db.agg$aggregation_fields").to_pylist()
+    assert ("total", "DOUBLE", "sum", None, None) in rows
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [1, 1], "total": [2.0, 3.0]}); wb.new_commit().commit(w.prepare_commit())
+    from paimon_tpu.table.statistics import analyze_table
+
+    analyze_table(t)
+    srows = cat.get_table("db.agg$statistics").to_pylist()
+    assert srows and srows[0][2] == 1  # one merged row (sum=5.0)

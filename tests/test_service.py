@@ -1,0 +1,78 @@
+"""Remote KV query service over real sockets (reference paimon-service
+KvQueryServer/KvQueryClient tests)."""
+
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.service import KvQueryClient, KvQueryServer, ServiceManager
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("name", STRING()), ("v", DOUBLE()))
+
+
+def test_kv_query_service_end_to_end(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="svc")
+    t = cat.create_table("db.kv", SCHEMA, primary_keys=["id"], options={"bucket": "2"})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [1, 2, 3], "name": ["a", "b", "c"], "v": [1.0, 2.0, 3.0]})
+    wb.new_commit().commit(w.prepare_commit())
+
+    server = KvQueryServer(t)
+    host, port = server.start()
+    try:
+        # address registered on the filesystem
+        assert ServiceManager(t.file_io, t.path).address(ServiceManager.PRIMARY_KEY_LOOKUP) == (host, port)
+        client = KvQueryClient.for_table(t)
+        assert client.ping()
+        assert client.lookup((), 2) == (2, "b", 2.0)
+        assert client.lookup((), 404) is None
+        # update + refresh
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write({"id": [2], "name": ["b2"], "v": [22.0]})
+        wb.new_commit().commit(w.prepare_commit())
+        client.refresh()
+        assert client.lookup((), 2) == (2, "b2", 22.0)
+        # bad request surfaces as an error, connection stays usable
+        with pytest.raises(RuntimeError):
+            client._call("nope")
+        assert client.ping()
+        client.close()
+    finally:
+        server.shutdown()
+    assert ServiceManager(t.file_io, t.path).address(ServiceManager.PRIMARY_KEY_LOOKUP) is None
+
+
+def test_two_clients_concurrently(tmp_warehouse):
+    import threading
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="svc2")
+    t = cat.create_table("db.kv2", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    n = 200
+    w.write({"id": list(range(n)), "name": [f"n{i}" for i in range(n)], "v": [float(i) for i in range(n)]})
+    wb.new_commit().commit(w.prepare_commit())
+    server = KvQueryServer(t)
+    host, port = server.start()
+    errors = []
+
+    def worker(offset):
+        try:
+            c = KvQueryClient(host, port)
+            for i in range(offset, n, 4):
+                assert c.lookup((), i) == (i, f"n{i}", float(i))
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(o,)) for o in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+    finally:
+        server.shutdown()
