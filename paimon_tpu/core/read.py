@@ -157,13 +157,16 @@ class MergeFileSplitRead:
         take = self.merge.dedup_resolve(handle)
         return kv.take(take)
 
-    def read_kv(self, files: list[DataFileMeta], drop_delete: bool = False) -> KVBatch:
+    def read_kv(
+        self, files: list[DataFileMeta], drop_delete: bool = False, deletion_vectors: dict | None = None
+    ) -> KVBatch:
         """Raw merged KeyValues (used by compaction tests / changelog)."""
+        dvs = deletion_vectors or {}
         sections = IntervalPartition(files).partition()
         parts: list[KVBatch] = []
         for section in sections:
             runs, seq_ascending = order_runs_for_merge(section)
-            batches = [self.reader_factory.read(f) for run in runs for f in run.files]
+            batches = [self._read_file(f, None, dvs) for run in runs for f in run.files]
             kv = KVBatch.concat(batches)
             if len(section) > 1:
                 kv = self.merge.merge(kv, seq_ascending=seq_ascending)

@@ -125,6 +125,13 @@ class KeyValueFileStore:
         return files, dvs
 
     def new_writer(self, partition: tuple, bucket: int, total_buckets: int | None = None, restore: bool = True) -> MergeTreeWriter:
+        from ..options import ChangelogProducer
+
+        if self.options.write_only and self.options.changelog_producer == ChangelogProducer.LOOKUP:
+            raise ValueError(
+                "changelog-producer=lookup needs the writer's levels view and cannot run with "
+                "write-only=true (produce the changelog in the writing job, not a dedicated compactor)"
+            )
         existing, dvs = self.restore_state(partition, bucket) if restore else ([], {})
         max_seq = max((f.max_sequence_number for f in existing), default=-1)
         levels = Levels(existing, self.options.num_levels)

@@ -89,7 +89,8 @@ class MergeTreeWriter:
         self._buffered_rows = 0
         from ..options import ChangelogProducer
 
-        if self.options.changelog_producer == ChangelogProducer.INPUT:
+        producer = self.options.changelog_producer
+        if producer == ChangelogProducer.INPUT:
             # the raw input IS the changelog (reference: input producer
             # persists the flushed buffer as changelog files)
             self._changelog.extend(
@@ -98,14 +99,67 @@ class MergeTreeWriter:
                 )
             )
         # memtable rows arrive in seq order: stability replaces seq lanes
-        merged = self.merge.merge(kv, seq_ascending=self._buffer_seq_ordered)
+        buffer_seq_ordered = self._buffer_seq_ordered
+        merged = self.merge.merge(kv, seq_ascending=buffer_seq_ordered)
         self._buffer_seq_ordered = True
+        if producer == ChangelogProducer.LOOKUP:
+            # exact changelog at WRITE time: look up the previous visible
+            # value of each incoming key (reference LookupChangelogMerge-
+            # FunctionWrapper / LookupMergeTreeCompactRewriter — here the
+            # "lookup" is a vectorized merge-read of the overlapping files
+            # diffed against the new state with the same kernel as the
+            # full-compaction producer)
+            cl = self._lookup_changelog(merged, buffer_seq_ordered)
+            if cl.num_rows:
+                self._changelog.extend(
+                    self.writer_factory.write(
+                        cl, level=0, file_source="append", prefix="changelog", sorted_input=False
+                    )
+                )
         files = self.writer_factory.write(merged, level=0, file_source="append")
         self._new_files.extend(files)
         if self.compact_manager is not None and not self.options.write_only:
             for f in files:
                 self.compact_manager.levels.level0.insert(0, f)
             self._maybe_compact()
+
+    def _lookup_changelog(self, merged: KVBatch, buffer_seq_ordered: bool = True) -> KVBatch:
+        """Diff the bucket's visible state before vs after this flush,
+        restricted to the flushed key range."""
+        from ..data.keys import build_string_pool, encode_key_lanes
+        from ..types import TypeRoot
+        from .changelog import full_compaction_changelog
+        from .read import MergeFileSplitRead
+
+        if merged.num_rows == 0 or self.compact_manager is None:
+            return merged.slice(0, 0)
+        key_names = self.merge.key_names
+        lo = tuple(merged.data.column(k).values[0] for k in key_names)
+        hi = tuple(merged.data.column(k).values[-1] for k in key_names)
+        overlapping = [
+            f
+            for f in self.compact_manager.levels.all_files()
+            if not (f.max_key < lo or f.min_key > hi)
+        ]
+        reader = MergeFileSplitRead(
+            self.compact_manager.rewriter.reader_factory, self.merge, key_names
+        )
+        before = reader.read_kv(
+            overlapping, drop_delete=True, deletion_vectors=self.compact_manager.rewriter.deletion_vectors
+        )
+        # after = before + new batch merged; stability only applies when the
+        # buffer's seqs were monotone (write_kv may interleave external seqs)
+        after = self.merge.merge(
+            KVBatch.concat([before, merged]), seq_ascending=buffer_seq_ordered
+        ).drop_deletes()
+        pools = {}
+        for k in key_names:
+            root = merged.data.schema.field(k).type.root
+            if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
+                pools[k] = build_string_pool([before.data.column(k).values, after.data.column(k).values])
+        lanes_before = encode_key_lanes(before.data, key_names, pools)
+        lanes_after = encode_key_lanes(after.data, key_names, pools)
+        return full_compaction_changelog(before, after, lanes_before, lanes_after)
 
     def _maybe_compact(self, full: bool = False) -> None:
         assert self.compact_manager is not None

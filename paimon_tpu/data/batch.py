@@ -29,22 +29,40 @@ from ..types import DataField, DataType, RowType, TypeRoot
 __all__ = ["Column", "ColumnBatch", "concat_batches"]
 
 
-@dataclass
 class Column:
-    """values + optional validity (True = present). validity None = all valid."""
+    """values + optional validity (True = present). validity None = all valid.
 
-    values: np.ndarray
-    validity: np.ndarray | None = None
+    String/bytes columns may additionally be backed by a pyarrow array
+    (`arrow`): structural ops (take/slice/filter/concat) then run in arrow's
+    C++ and the object ndarray materializes lazily only when `.values` is
+    actually touched (predicates, key pools, python access)."""
 
-    def __post_init__(self):
-        if self.validity is not None:
-            assert self.validity.dtype == np.bool_
-            assert len(self.validity) == len(self.values)
-            if bool(self.validity.all()):
-                self.validity = None
+    __slots__ = ("_values", "validity", "arrow", "_len")
+
+    def __init__(self, values: np.ndarray | None = None, validity: np.ndarray | None = None, arrow=None):
+        assert values is not None or arrow is not None
+        self._values = values
+        self.arrow = arrow
+        self._len = len(values) if values is not None else len(arrow)
+        if validity is not None:
+            assert validity.dtype == np.bool_
+            assert len(validity) == self._len
+            if bool(validity.all()):
+                validity = None
+        self.validity = validity
+
+    @property
+    def values(self) -> np.ndarray:
+        if self._values is None:
+            arr = self.arrow
+            v = arr.to_numpy(zero_copy_only=False)
+            if v.dtype != np.dtype(object):
+                v = v.astype(object)
+            self._values = v
+        return self._values
 
     def __len__(self) -> int:
-        return len(self.values)
+        return self._len
 
     @property
     def null_count(self) -> int:
@@ -52,28 +70,39 @@ class Column:
 
     def is_null(self) -> np.ndarray:
         if self.validity is None:
-            return np.zeros(len(self.values), dtype=np.bool_)
+            return np.zeros(self._len, dtype=np.bool_)
         return ~self.validity
 
     def valid_mask(self) -> np.ndarray:
         if self.validity is None:
-            return np.ones(len(self.values), dtype=np.bool_)
+            return np.ones(self._len, dtype=np.bool_)
         return self.validity
 
     def take(self, indices: np.ndarray) -> "Column":
-        v = self.values.take(indices)
         m = None if self.validity is None else self.validity.take(indices)
-        return Column(v, m)
+        if self._values is None:
+            import pyarrow.compute as pc
+
+            return Column(validity=m, arrow=pc.take(self.arrow, indices))
+        return Column(self.values.take(indices), m)
 
     def slice(self, start: int, stop: int) -> "Column":
         m = None if self.validity is None else self.validity[start:stop]
+        if self._values is None:
+            return Column(validity=m, arrow=self.arrow.slice(start, stop - start))
         return Column(self.values[start:stop], m)
 
     def filter(self, mask: np.ndarray) -> "Column":
         m = None if self.validity is None else self.validity[mask]
+        if self._values is None:
+            import pyarrow.compute as pc
+
+            return Column(validity=m, arrow=pc.filter(self.arrow, mask))
         return Column(self.values[mask], m)
 
     def to_pylist(self) -> list:
+        if self._values is None and self.validity is None:
+            return self.arrow.to_pylist()
         if self.validity is None:
             return self.values.tolist()
         return [v if ok else None for v, ok in zip(self.values.tolist(), self.validity.tolist())]
@@ -93,10 +122,23 @@ class Column:
 
     @staticmethod
     def concat(cols: Sequence["Column"]) -> "Column":
+        validity = None
+        if not all(c.validity is None for c in cols):
+            validity = np.concatenate([c.valid_mask() for c in cols])
+        if cols and all(c._values is None for c in cols):
+            import pyarrow as pa
+
+            chunks = []
+            for c in cols:
+                a = c.arrow
+                chunks.extend(a.chunks if isinstance(a, pa.ChunkedArray) else [a])
+            types = {c.type for c in chunks if not pa.types.is_null(c.type)}
+            if len(types) == 1:
+                t = types.pop()
+                chunks = [c.cast(t) if pa.types.is_null(c.type) else c for c in chunks]
+                return Column(validity=validity, arrow=pa.concat_arrays(chunks))
+            # all-null or mixed types: fall through to the numpy path
         values = np.concatenate([c.values for c in cols])
-        if all(c.validity is None for c in cols):
-            return Column(values)
-        validity = np.concatenate([c.valid_mask() for c in cols])
         return Column(values, validity)
 
 
@@ -191,6 +233,9 @@ class ColumnBatch:
         arrays = []
         for f in self.schema.fields:
             c = self.columns[f.name]
+            if c._values is None:
+                arrays.append(c.arrow)  # zero-conversion passthrough
+                continue
             mask = None if c.validity is None else ~c.validity
             arrays.append(pa.array(c.values, from_pandas=True, mask=mask))
         return pa.table(dict(zip(self.schema.field_names, arrays)))
@@ -223,10 +268,9 @@ def _arrow_to_column(arr, dtype: DataType) -> Column:
             for i, x in enumerate(arr.to_pylist()):
                 values[i] = x
         else:
-            # C-implemented conversion (~20x the to_pylist python loop)
-            values = arr.to_numpy(zero_copy_only=False)
-            if values.dtype != np.dtype(object):
-                values = values.astype(object)
+            # keep the arrow backing: structural ops stay in C++ and the
+            # object ndarray materializes only if python-level access happens
+            return Column(validity=validity, arrow=arr)
     else:
         if arr.null_count:
             arr = arr.fill_null(_zero_value(dtype))
@@ -254,6 +298,8 @@ def concat_batches(batches: Sequence[ColumnBatch]) -> ColumnBatch:
         raise ValueError("no batches")
     non_empty = [b for b in batches if b.num_rows]
     batches = non_empty or [batches[0]]
+    if len(batches) == 1:
+        return batches[0]
     schema = batches[0].schema
     cols = {
         n: Column.concat([b.columns[n] for b in batches]) for n in schema.field_names

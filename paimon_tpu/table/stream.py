@@ -139,15 +139,12 @@ class StreamTableScan:
         from ..options import ChangelogProducer
 
         producer = self.store.options.changelog_producer
-        if producer == ChangelogProducer.INPUT:
-            # input producer: the raw +I/-U/+U/-D input rides APPEND snapshots
+        if producer in (ChangelogProducer.INPUT, ChangelogProducer.LOOKUP):
+            # input: raw +I/-U/+U/-D input rides APPEND snapshots;
+            # lookup: exact diffs computed at write time ride them too
             if snap.commit_kind != CommitKind.APPEND:
                 return []
             return self._changelog_splits(snapshot_id)
-        if producer == ChangelogProducer.LOOKUP:
-            raise NotImplementedError(
-                "changelog-producer=lookup is not implemented yet; use 'input' or 'full-compaction'"
-            )
         if producer == ChangelogProducer.FULL_COMPACTION:
             # exact changelog is produced by compaction snapshots
             if snap.commit_kind != CommitKind.COMPACT:

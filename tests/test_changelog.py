@@ -122,3 +122,38 @@ def test_input_changelog_unsorted_key_stats(catalog):
     plan = t.store.new_scan().with_kind("changelog").plan()
     f = plan.entries[0].file
     assert f.min_key == (1,) and f.max_key == (9,)
+
+
+def test_lookup_changelog_producer(catalog):
+    t = catalog.create_table(
+        "db.clk", SCHEMA, primary_keys=["id"], options={"bucket": "1", "changelog-producer": "lookup"}
+    )
+    write(t, {"id": [1, 2], "v": [1.0, 2.0]})
+    scan = t.new_read_builder().new_stream_scan()
+    read = t.new_read_builder().new_read()
+    # starting full scan
+    first = scan.plan()
+    assert read.read_all(first).num_rows == 2
+    # upsert + delete + insert: exact changelog WITH old values, immediately
+    # (no waiting for a full compaction)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [2, 3], "v": [22.0, 3.0]})
+    w.write({"id": [1], "v": [None]}, kinds=["-D"])
+    wb.new_commit().commit(w.prepare_commit())
+    events = changelog_of(t, scan, read)
+    assert sorted(events) == [
+        ("+I", 3, 3.0),
+        ("+U", 2, 22.0),
+        ("-D", 1, 1.0),   # old value resolved by lookup
+        ("-U", 2, 2.0),   # old value resolved by lookup
+    ]
+
+
+def test_lookup_changelog_first_commit_all_inserts(catalog):
+    t = catalog.create_table(
+        "db.clk2", SCHEMA, primary_keys=["id"], options={"bucket": "1", "changelog-producer": "lookup"}
+    )
+    write(t, {"id": [5], "v": [5.0]})
+    plan = t.store.new_scan().with_kind("changelog").plan()
+    assert sum(e.file.row_count for e in plan.entries) == 1
