@@ -104,6 +104,15 @@ class AppendOnlyWriter:
         self._existing = list(existing_files or [])
         self._buffer: list[ColumnBatch] = []
         self._buffered_rows = 0
+        self._spill = None
+        self._io_manager = None
+        if options.options.get(CoreOptions.WRITE_BUFFER_SPILLABLE):
+            from .disk import IOManager, SpillableBuffer
+
+            self._io_manager = IOManager()
+            self._spill = SpillableBuffer(
+                self._io_manager, in_memory_rows=options.options.get(CoreOptions.WRITE_BUFFER_SPILL_ROWS)
+            )
         self._new_files: list[DataFileMeta] = []
         self._compact_before: list[DataFileMeta] = []
         self._compact_after: list[DataFileMeta] = []
@@ -113,24 +122,37 @@ class AppendOnlyWriter:
             raise ValueError("append-only tables accept only +I records")
         if data.num_rows == 0:
             return
-        self._buffer.append(data)
-        self._buffered_rows += data.num_rows
+        if self._spill is not None:
+            self._spill.add(data)  # spills to local disk beyond the cap
+            self._buffered_rows = self._spill.num_rows
+        else:
+            self._buffer.append(data)
+            self._buffered_rows += data.num_rows
         if self._buffered_rows >= self.options.write_buffer_rows:
             self.flush()
 
     def flush(self) -> None:
-        if not self._buffer:
-            return
         from ..data.batch import concat_batches
 
-        data = concat_batches(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
+        wrote = False
+        if self._spill is not None:
+            # stream segments straight to files: peak memory stays at the
+            # spill cap instead of re-materializing the whole buffer
+            for segment in self._spill.batches():
+                kv = KVBatch.from_rows(segment, self.seq)
+                self.seq += segment.num_rows
+                self._new_files.extend(self.writer_factory.write(kv, level=0, file_source="append"))
+                wrote = True
+            self._spill.clear()
+        elif self._buffer:
+            data = concat_batches(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
+            kv = KVBatch.from_rows(data, self.seq)
+            self.seq += data.num_rows
+            self._new_files.extend(self.writer_factory.write(kv, level=0, file_source="append"))
+            wrote = True
         self._buffer.clear()
         self._buffered_rows = 0
-        kv = KVBatch.from_rows(data, self.seq)
-        self.seq += data.num_rows
-        files = self.writer_factory.write(kv, level=0, file_source="append")
-        self._new_files.extend(files)
-        if self.compact_manager is not None and not self.options.write_only:
+        if wrote and self.compact_manager is not None and not self.options.write_only:
             self._maybe_compact()
 
     def _maybe_compact(self, full: bool = False) -> None:
@@ -171,3 +193,9 @@ class AppendOnlyWriter:
         self._compact_before.clear()
         self._compact_after.clear()
         return msg
+
+    def close(self) -> None:
+        if self._spill is not None:
+            self._spill.clear()
+        if self._io_manager is not None:
+            self._io_manager.close()

@@ -1,0 +1,102 @@
+"""Spill infrastructure: host-memory pressure relief for write buffers.
+
+Parity: /root/reference/paimon-core/.../disk/ — IOManagerImpl (temp spill
+dirs + file channels) and ExternalBuffer/RowBuffer (the spillable row buffer
+behind AppendOnlyWriter and local merge; the keyed path's analog is
+BinaryExternalSortBuffer). Batches spill as arrow IPC streams (fast,
+zero-schema-loss) and read back lazily at flush.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import uuid
+from typing import Iterator
+
+from ..data.batch import ColumnBatch
+
+__all__ = ["IOManager", "SpillableBuffer"]
+
+
+class IOManager:
+    """Owns a temp spill directory tree (reference disk/IOManagerImpl)."""
+
+    def __init__(self, base_dir: str | None = None):
+        self.base = base_dir or tempfile.mkdtemp(prefix="paimon_tpu_spill_")
+        os.makedirs(self.base, exist_ok=True)
+
+    def create_channel(self) -> str:
+        return os.path.join(self.base, f"spill-{uuid.uuid4().hex}.arrow")
+
+    def close(self) -> None:
+        shutil.rmtree(self.base, ignore_errors=True)
+
+
+class SpillableBuffer:
+    """Buffers ColumnBatches in memory; beyond `in_memory_rows` they spill to
+    arrow IPC files. Iteration replays spilled segments then memory, in
+    insertion order (reference ExternalBuffer semantics)."""
+
+    def __init__(self, io_manager: IOManager, in_memory_rows: int = 1 << 20):
+        self.io_manager = io_manager
+        self.in_memory_rows = in_memory_rows
+        self._memory: list[ColumnBatch] = []
+        self._memory_rows = 0
+        self._spilled: list[str] = []
+        self._spilled_rows = 0
+
+    @property
+    def num_rows(self) -> int:
+        return self._memory_rows + self._spilled_rows
+
+    @property
+    def spilled_bytes(self) -> int:
+        return sum(os.path.getsize(p) for p in self._spilled if os.path.exists(p))
+
+    def add(self, batch: ColumnBatch) -> None:
+        if batch.num_rows == 0:
+            return
+        self._memory.append(batch)
+        self._memory_rows += batch.num_rows
+        if self._memory_rows > self.in_memory_rows:
+            self._spill()
+
+    def _spill(self) -> None:
+        import pyarrow as pa
+
+        path = self.io_manager.create_channel()
+        schema_holder = self._memory[0]
+        with pa.OSFile(path, "wb") as sink:
+            table = schema_holder.to_arrow()
+            with pa.ipc.new_stream(sink, table.schema) as writer:
+                for b in self._memory:
+                    writer.write_table(b.to_arrow())
+        # remember the logical schema to rebuild batches on read
+        self._spilled.append(path)
+        self._schema = schema_holder.schema
+        self._spilled_rows += self._memory_rows
+        self._memory.clear()
+        self._memory_rows = 0
+
+    def batches(self) -> Iterator[ColumnBatch]:
+        import pyarrow as pa
+
+        for path in self._spilled:
+            with pa.OSFile(path, "rb") as f:
+                reader = pa.ipc.open_stream(f)
+                table = reader.read_all()
+            yield ColumnBatch.from_arrow(table, self._schema)
+        yield from self._memory
+
+    def clear(self) -> None:
+        for p in self._spilled:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self._spilled.clear()
+        self._spilled_rows = 0
+        self._memory.clear()
+        self._memory_rows = 0

@@ -153,7 +153,7 @@ class SchemaManager:
         existing = self.latest()
         if existing is not None:
             return existing
-        self._validate(row_type, partition_keys, primary_keys)
+        self._validate(row_type, partition_keys, primary_keys, options)
         fields = []
         for i, f in enumerate(row_type.fields):
             t = f.type
@@ -175,15 +175,24 @@ class SchemaManager:
         return schema
 
     @staticmethod
-    def _validate(row_type: RowType, partition_keys: Sequence[str], primary_keys: Sequence[str]) -> None:
+    def _validate(
+        row_type: RowType,
+        partition_keys: Sequence[str],
+        primary_keys: Sequence[str],
+        options: dict | None = None,
+    ) -> None:
         for k in list(partition_keys) + list(primary_keys):
             if k not in row_type:
                 raise ValueError(f"key column {k!r} not in schema {row_type.field_names}")
         if primary_keys and partition_keys:
             missing = [p for p in partition_keys if p not in primary_keys]
-            if missing:
+            from ..options import CoreOptions
+
+            cross_partition = CoreOptions(options or {}).bucket == -1
+            if missing and not cross_partition:
                 raise ValueError(
                     f"primary key must contain all partition keys (missing {missing}) "
+                    f"unless bucket=-1 enables cross-partition upsert "
                     f"— same constraint as the reference SchemaValidation"
                 )
 

@@ -179,6 +179,12 @@ class CoreOptions:
     WRITE_BUFFER_SIZE = ConfigOption.memory("write-buffer-size", "256 mb", "Memtable size before flush.")
     WRITE_BUFFER_ROWS = ConfigOption.int_("write-buffer-rows", 1_000_000, "Memtable row cap before flush.")
     WRITE_ONLY = ConfigOption.bool_("write-only", False, "Skip compaction (dedicated compact job mode).")
+    WRITE_BUFFER_SPILLABLE = ConfigOption.bool_(
+        "write-buffer-spillable", False, "Spill the write buffer to local disk under memory pressure."
+    )
+    WRITE_BUFFER_SPILL_ROWS = ConfigOption.int_(
+        "write-buffer-spill.rows", 256 * 1024, "In-memory rows before a spill segment is written."
+    )
     MERGE_ENGINE = ConfigOption.enum("merge-engine", MergeEngine, MergeEngine.DEDUPLICATE, "How same-key records merge.")
     IGNORE_DELETE = ConfigOption.bool_("ignore-delete", False, "Ignore -D records on write/merge.")
     SORT_ENGINE = ConfigOption.enum("sort-engine", SortEngine, SortEngine.XLA_SEGMENTED, "Merge kernel backend.")

@@ -135,9 +135,16 @@ class FileStoreTable(Table):
         tag_ids = lambda: TagManager(self.file_io, self.path).tagged_snapshot_ids()  # noqa: E731
         from .consumer import ConsumerManager
 
+        from ..options import CoreOptions
+
+        cm = ConsumerManager(self.file_io, self.path)
+        ttl = self.options.options.get(CoreOptions.CONSUMER_EXPIRATION_TIME_MS)
+        if ttl is not None:
+            cm.expire_stale(ttl)  # abandoned readers stop pinning snapshots
+
         def protected():
             ids = set(tag_ids())
-            nxt = ConsumerManager(self.file_io, self.path).min_next_snapshot()
+            nxt = cm.min_next_snapshot()
             if nxt is not None:
                 latest = self.store.snapshot_manager.latest_snapshot_id() or 0
                 ids |= set(range(nxt, latest + 1))

@@ -50,3 +50,17 @@ class ConsumerManager:
     def min_next_snapshot(self) -> int | None:
         vals = list(self.list_consumers().values())
         return min(vals) if vals else None
+
+    def expire_stale(self, expiration_millis: int) -> list[str]:
+        """Drop consumers not updated within the TTL so abandoned readers stop
+        pinning snapshots (reference consumer.expiration-time handling)."""
+        from ..utils import now_millis
+
+        cutoff = now_millis() - expiration_millis
+        removed = []
+        for st in self.file_io.list_files(self.consumer_dir):
+            base = st.path.rsplit("/", 1)[-1]
+            if base.startswith("consumer-") and st.mtime_millis < cutoff:
+                removed.append(base[len("consumer-") :])
+                self.file_io.delete(st.path)
+        return removed

@@ -1,0 +1,132 @@
+"""Cross-partition upsert: primary key does NOT contain the partition key.
+
+Parity: /root/reference/paimon-core/.../crosspartition/ —
+GlobalIndexAssigner.java:76 (a global key -> (partition, bucket) index,
+RocksDB-backed in the reference; bootstrap via IndexBootstrap reads the key
+columns of existing files) wired by GlobalDynamicBucketSink. Semantics: when
+an incoming key already lives in a DIFFERENT partition, the old row is
+retracted (-D to the old location) and the new row wins.
+
+Here the index is a host hash map bootstrapped by a key-column-only scan;
+assignment of a batch is vectorized around dictionary probes.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from ..types import RowKind
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["GlobalIndexAssigner", "CrossPartitionUpsertWrite"]
+
+
+class GlobalIndexAssigner:
+    def __init__(self, table: "FileStoreTable", target_bucket_rows: int):
+        self.table = table
+        self.key_names = table.store.key_names
+        self.target = target_bucket_rows
+        self.index: dict[tuple, tuple] = {}  # key -> (partition, bucket)
+        self._bucket_counts: dict[tuple, int] = {}  # (partition, bucket) -> rows
+
+    def bootstrap(self) -> None:
+        """Read only the key columns of every live file (reference
+        IndexBootstrap: key + partition + bucket projection)."""
+        store = self.table.store
+        plan = store.new_scan().plan()
+        for partition, buckets in plan.grouped().items():
+            for bucket, files in buckets.items():
+                rf = store.reader_factory(partition, bucket)
+                for f in files:
+                    kv = rf.read(f, fields=self.key_names)
+                    keep = ~np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)))
+                    cols = [kv.data.column(k).values for k in self.key_names]
+                    for i in np.flatnonzero(keep):
+                        key = tuple(c[i] for c in cols)
+                        self.index[key] = (partition, bucket)
+                self._bucket_counts[(partition, bucket)] = sum(f.row_count for f in files)
+
+    def assign(self, key: tuple, partition: tuple) -> tuple[tuple, int, tuple | None]:
+        """(target_partition, bucket, old_location_or_None_if_same)."""
+        existing = self.index.get(key)
+        if existing is not None:
+            old_partition, old_bucket = existing
+            if old_partition == partition:
+                return partition, old_bucket, None
+            # partition changed: new row goes to the new partition; caller
+            # retracts the old copy
+            bucket = self._allocate(partition)
+            self.index[key] = (partition, bucket)
+            return partition, bucket, existing
+        bucket = self._allocate(partition)
+        self.index[key] = (partition, bucket)
+        return partition, bucket, None
+
+    def _allocate(self, partition: tuple) -> int:
+        b = 0
+        while self._bucket_counts.get((partition, b), 0) >= self.target:
+            b += 1
+        self._bucket_counts[(partition, b)] = self._bucket_counts.get((partition, b), 0) + 1
+        return b
+
+    def delete(self, key: tuple) -> tuple | None:
+        return self.index.pop(key, None)
+
+
+class CrossPartitionUpsertWrite:
+    """Write path for PK tables whose primary key omits the partition key
+    (reference GlobalDynamicBucketSink: assigner stage -> writers)."""
+
+    def __init__(self, table: "FileStoreTable"):
+        from ..options import CoreOptions
+
+        if not table.is_primary_key_table:
+            raise ValueError("cross-partition upsert needs a primary-key table")
+        store = table.store
+        self.table = table
+        self.partition_keys = store.partition_keys
+        self.key_names = store.key_names
+        target = store.options.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
+        self.assigner = GlobalIndexAssigner(table, target)
+        self.assigner.bootstrap()
+        self._writers: dict[tuple, object] = {}
+
+    def _writer(self, partition: tuple, bucket: int):
+        key = (partition, bucket)
+        if key not in self._writers:
+            self._writers[key] = self.table.store.new_writer(partition, bucket, -1)
+        return self._writers[key]
+
+    def write(self, data, kinds=None) -> None:
+        from ..data.batch import ColumnBatch
+
+        if isinstance(data, dict):
+            data = ColumnBatch.from_pydict(self.table.row_type, data)
+        if kinds is not None and not isinstance(kinds, np.ndarray):
+            kinds = np.array([int(RowKind.from_short_string(k)) for k in kinds], dtype=np.uint8)
+        n = data.num_rows
+        key_cols = [data.column(k).values for k in self.key_names]
+        part_cols = [data.column(p).values for p in self.partition_keys]
+        for i in range(n):
+            key = tuple(c[i] for c in key_cols)
+            partition = tuple(c.item() if hasattr((c := pc[i]), "item") else c for pc in part_cols)
+            kind = int(kinds[i]) if kinds is not None else int(RowKind.INSERT)
+            row = data.slice(i, i + 1)
+            if kind in (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)):
+                old = self.assigner.delete(key)
+                if old is not None:
+                    self._writer(*old).write(row, np.array([kind], dtype=np.uint8))
+                continue
+            target_partition, bucket, old = self.assigner.assign(key, partition)
+            if old is not None:
+                # key moved partitions: retract the old copy
+                self._writer(*old).write(row, np.array([int(RowKind.DELETE)], dtype=np.uint8))
+            self._writer(target_partition, bucket).write(row)
+
+    def prepare_commit(self):
+        msgs = [w.prepare_commit() for w in self._writers.values()]
+        return [m for m in msgs if not m.is_empty()]

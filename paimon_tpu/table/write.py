@@ -128,6 +128,10 @@ class TableWrite:
         return msgs
 
     def close(self) -> None:
+        for w in self._writers.values():
+            close = getattr(w, "close", None)
+            if close is not None:
+                close()
         self._writers.clear()
 
 

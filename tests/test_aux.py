@@ -144,3 +144,46 @@ def test_record_level_expire(catalog):
     write(t, {"id": [1, 2], "created": [now_s, now_s - 7200], "v": [1.0, 2.0]})
     out = read(t)
     assert [r[0] for r in out.to_pylist()] == [1]  # the 2h-old row is expired
+
+
+def test_spillable_write_buffer(catalog, tmp_path):
+    from paimon_tpu.core.disk import IOManager, SpillableBuffer
+    from paimon_tpu.data import ColumnBatch
+
+    # unit: buffer spills beyond the cap and replays in order
+    io_mgr = IOManager(str(tmp_path / "spill"))
+    buf = SpillableBuffer(io_mgr, in_memory_rows=100)
+    s = RowType.of(("a", BIGINT()), ("t", STRING()))
+    for i in range(5):
+        buf.add(ColumnBatch.from_pydict(s, {"a": list(range(i * 60, i * 60 + 60)), "t": [f"x{i}"] * 60}))
+    assert buf.num_rows == 300
+    assert buf.spilled_bytes > 0
+    got = [r for b in buf.batches() for r in b.to_pylist()]
+    assert [r[0] for r in got] == list(range(300))
+    buf.clear()
+    assert buf.num_rows == 0
+    io_mgr.close()
+    # integration: append table with spillable buffer
+    t = catalog.create_table(
+        "db.spill",
+        RowType.of(("x", BIGINT())),
+        options={"bucket": "1", "write-buffer-spillable": "true", "write-buffer-spill.rows": "50"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    for i in range(4):
+        w.write({"x": list(range(i * 40, i * 40 + 40))})
+    wb.new_commit().commit(w.prepare_commit())
+    assert sorted(r[0] for r in read(t).to_pylist()) == list(range(160))
+
+
+def test_consumer_expiration(catalog):
+    from paimon_tpu.table.consumer import ConsumerManager
+
+    t = catalog.create_table("db.cexp", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    cm = ConsumerManager(t.file_io, t.path)
+    cm.record("stale", 3)
+    cm.record("fresh", 5)
+    removed = cm.expire_stale(expiration_millis=-1000)  # everything is "stale"
+    assert sorted(removed) == ["fresh", "stale"]
+    assert cm.list_consumers() == {}
