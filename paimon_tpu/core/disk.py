@@ -67,15 +67,15 @@ class SpillableBuffer:
         import pyarrow as pa
 
         path = self.io_manager.create_channel()
-        schema_holder = self._memory[0]
+        first = self._memory[0].to_arrow()
         with pa.OSFile(path, "wb") as sink:
-            table = schema_holder.to_arrow()
-            with pa.ipc.new_stream(sink, table.schema) as writer:
-                for b in self._memory:
+            with pa.ipc.new_stream(sink, first.schema) as writer:
+                writer.write_table(first)
+                for b in self._memory[1:]:
                     writer.write_table(b.to_arrow())
         # remember the logical schema to rebuild batches on read
         self._spilled.append(path)
-        self._schema = schema_holder.schema
+        self._schema = self._memory[0].schema
         self._spilled_rows += self._memory_rows
         self._memory.clear()
         self._memory_rows = 0

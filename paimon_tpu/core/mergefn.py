@@ -97,8 +97,13 @@ class MergeExecutor:
                 if kv.num_rows == 0:
                     return kv
         if self.engine == MergeEngine.DEDUPLICATE:
+            from ..options import SortEngine
+
             lanes, seq_lanes = self._lanes(kv, seq_ascending)
-            return kv.take(deduplicate_select(lanes, seq_lanes))
+            backend = "pallas" if self.options.sort_engine == SortEngine.PALLAS else "xla"
+            from ..ops.merge import deduplicate_resolve, deduplicate_select_async
+
+            return kv.take(deduplicate_resolve(deduplicate_select_async(lanes, seq_lanes, backend=backend)))
         plan = self._plan(kv, seq_ascending)
         return self._merge_with_plan(kv, plan)
 
@@ -114,13 +119,16 @@ class MergeExecutor:
         lanes, seq_lanes = self._lanes(kv_keys, seq_ascending)
         from ..ops.merge import deduplicate_select_async, deduplicate_tiled_dispatch, drop_constant_lanes
 
+        from ..options import SortEngine
+
+        backend = "pallas" if self.options.sort_engine == SortEngine.PALLAS else "xla"
         if seq_lanes is None and run_offsets is not None:
             tile_rows = self.options.options.get(CoreOptions.MERGE_READ_BATCH_ROWS)
             kl = drop_constant_lanes(lanes)
             if kl.shape[1] == 0 and lanes.shape[1]:
                 kl = lanes[:, :1]
-            return ("tiled", deduplicate_tiled_dispatch(kl, run_offsets, tile_rows))
-        return ("single", deduplicate_select_async(lanes, seq_lanes))
+            return ("tiled", deduplicate_tiled_dispatch(kl, run_offsets, tile_rows, backend=backend))
+        return ("single", deduplicate_select_async(lanes, seq_lanes, backend=backend))
 
     @staticmethod
     def dedup_resolve(handle) -> np.ndarray:

@@ -1,0 +1,57 @@
+"""Config documentation generator.
+
+Parity: /root/reference/paimon-docs/.../ConfigOptionsDocGenerator.java — the
+reference auto-generates its option tables from the annotated ConfigOptions;
+here the same table is derived by introspecting CoreOptions.
+
+Usage: python -m paimon_tpu.docs_gen > docs/options.md
+"""
+
+from __future__ import annotations
+
+import enum
+
+from .options import ConfigOption, CoreOptions
+
+__all__ = ["generate_options_doc"]
+
+
+def _fmt_default(v) -> str:
+    if v is None:
+        return "(none)"
+    if isinstance(v, enum.Enum):
+        return v.value
+    if isinstance(v, bool):
+        return str(v).lower()
+    return str(v)
+
+
+def generate_options_doc() -> str:
+    rows = []
+    for name in dir(CoreOptions):
+        opt = getattr(CoreOptions, name)
+        if isinstance(opt, ConfigOption):
+            rows.append((opt.key, _fmt_default(opt.default), opt.description))
+    rows.sort()
+    out = [
+        "# Table options",
+        "",
+        "Auto-generated from `paimon_tpu.options.CoreOptions`",
+        "(the analog of the reference's ConfigOptionsDocGenerator).",
+        "",
+        "| Key | Default | Description |",
+        "|---|---|---|",
+    ]
+    for key, default, desc in rows:
+        out.append(f"| `{key}` | {default} | {desc} |")
+    out.append("")
+    out.append(
+        "Per-field options use the `fields.<name>.<suffix>` pattern: "
+        "`aggregate-function`, `sequence-group`, `ignore-retract`, "
+        "`list-agg-delimiter`, `distinct`."
+    )
+    return "\n".join(out) + "\n"
+
+
+if __name__ == "__main__":
+    print(generate_options_doc(), end="")

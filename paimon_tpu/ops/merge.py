@@ -169,10 +169,12 @@ def deduplicate_take(plan: MergePlan) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int):
+def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla"):
     """Sort + keep-last + device-side compaction: returns ONLY the selected
     input indices (packed to the front) and their count — the minimal
-    device->host transfer for the dominant dedup path."""
+    device->host transfer for the dominant dedup path. backend="pallas"
+    computes the boundary mask with the fused pallas sweep
+    (ops/pallas_kernels.keep_last_mask)."""
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
@@ -186,10 +188,16 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int):
         )
         out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
         perm = out[-1]
-        seg_keys = jnp.stack(out[: 1 + num_key_lanes], axis=0)
-        neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
-        keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
-        sel = keep_last & (out[0] == 0)  # exclude pad rows
+        if backend == "pallas":
+            from .pallas_kernels import keep_last_mask
+
+            stacked = jnp.stack(out[: 1 + num_key_lanes], axis=0)
+            sel = keep_last_mask(stacked, interpret=jax.default_backend() == "cpu").astype(jnp.bool_)
+        else:
+            seg_keys = jnp.stack(out[: 1 + num_key_lanes], axis=0)
+            neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
+            keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
+            sel = keep_last & (out[0] == 0)  # exclude pad rows
         # pack selected perms to the front, preserving key order
         not_sel = (~sel).astype(jnp.uint32)
         _, packed = jax.lax.sort([not_sel, perm], num_keys=1, is_stable=True)
@@ -198,7 +206,7 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int):
     return f
 
 
-def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None):
+def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None, backend: str = "xla"):
     """Dispatch the dedup kernel without blocking: returns (packed_device,
     count_device). jax's async dispatch lets the host keep decoding value
     columns while the device sorts — resolve with deduplicate_resolve()."""
@@ -217,7 +225,7 @@ def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None
         slp[:, :n] = sl.T
     pad = np.zeros(m, dtype=np.uint32)
     pad[n:] = 1
-    return _dedup_select_fn(k, s)(klp, slp, pad)
+    return _dedup_select_fn(k, s, backend)(klp, slp, pad)
 
 
 def deduplicate_resolve(handle) -> np.ndarray:
@@ -236,6 +244,7 @@ def deduplicate_select_tiled(
     key_lanes: np.ndarray,
     run_offsets: Sequence[int],
     tile_rows: int = 256 * 1024,
+    backend: str = "xla",
 ) -> np.ndarray:
     """Key-range tiled dedup for runs concatenated in ascending-seq order
     (stability replaces seq lanes; see merge_plan docstring).
@@ -248,13 +257,14 @@ def deduplicate_select_tiled(
     blockwise path for sections larger than device memory (the reference
     spills via MergeSorter :110-116; we tile by key range instead).
     Returns selected input-row indices in global key order."""
-    return deduplicate_resolve_tiled(deduplicate_tiled_dispatch(key_lanes, run_offsets, tile_rows))
+    return deduplicate_resolve_tiled(deduplicate_tiled_dispatch(key_lanes, run_offsets, tile_rows, backend))
 
 
 def deduplicate_tiled_dispatch(
     key_lanes: np.ndarray,
     run_offsets: Sequence[int],
     tile_rows: int = 256 * 1024,
+    backend: str = "xla",
 ):
     """Async version: dispatches every tile, returns a handle for
     deduplicate_resolve_tiled."""
@@ -264,7 +274,7 @@ def deduplicate_tiled_dispatch(
     if n == 0:
         return []
     if n <= tile_rows or len(offsets) < 3:
-        return [(deduplicate_select_async(key_lanes, None), np.arange(n, dtype=np.int32))]
+        return [(deduplicate_select_async(key_lanes, None, backend=backend), np.arange(n, dtype=np.int32))]
     lane0_runs = [key_lanes[offsets[r] : offsets[r + 1], 0] for r in range(len(offsets) - 1)]
     largest = max(lane0_runs, key=len)
     num_tiles = max(2, (n + tile_rows - 1) // tile_rows)
@@ -287,7 +297,7 @@ def deduplicate_tiled_dispatch(
             continue
         tile_lanes = np.concatenate(slices) if len(slices) > 1 else slices[0]
         tile_global = np.concatenate(rows) if len(rows) > 1 else rows[0]
-        handles.append((deduplicate_select_async(tile_lanes, None), tile_global))
+        handles.append((deduplicate_select_async(tile_lanes, None, backend=backend), tile_global))
     return handles
 
 

@@ -1,0 +1,74 @@
+"""Pallas TPU kernels for the merge hot path.
+
+The sort itself stays `lax.sort` (XLA's TPU sort is already tiled onto the
+hardware), but the post-sort phase — detecting segment boundaries across all
+key lanes at once — is a bandwidth-bound elementwise pass that pallas
+expresses as one fused VMEM-resident sweep: each grid step loads a block of
+the stacked lanes plus a one-element lookahead (the same operand bound a
+second time with a +1 block index map) and emits the keep-last mask directly.
+
+Enabled via table option `sort-engine=pallas` (CoreOptions.SortEngine);
+`interpret=True` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+__all__ = ["keep_last_mask"]
+
+_BLOCK = 2048
+
+
+def _keep_last_kernel(cur_ref, nxt_ref, out_ref):
+    cur = cur_ref[...]  # (L, B) — stacked pad+key lanes
+    nxt = nxt_ref[...]  # (L, B) — the following block (clamped at the end)
+    # "next element" of each position: shift left, last column from the
+    # lookahead block's first column
+    shifted = jnp.concatenate([cur[:, 1:], nxt[:, :1]], axis=1)
+    # stay 2D throughout (mosaic wants tiled vectors) and avoid reductions
+    # (unsigned reductions are unimplemented): fold lanes with bitwise-or,
+    # the lane count is static and small
+    xor = cur ^ shifted
+    diff = xor[0:1, :]
+    for i in range(1, xor.shape[0]):
+        diff = diff | xor[i : i + 1, :]
+    neq = jnp.where(diff != 0, jnp.uint32(1), jnp.uint32(0))
+    not_pad = jnp.where(cur[0:1, :] == 0, jnp.uint32(1), jnp.uint32(0))
+    out_ref[...] = neq * not_pad  # (1, B) uint32
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def keep_last_mask(stacked: jax.Array, interpret: bool = False) -> jax.Array:
+    """stacked: (L, m) uint32, lane 0 = pad flag, lanes 1.. = key lanes,
+    rows sorted. Returns (m,) uint32: 1 where the row is the last of its
+    segment and not padding. m must be a multiple of 128 (pad_size ensures
+    powers of two >= 128)."""
+    l, m = stacked.shape
+    block = min(_BLOCK, m)
+    grid = m // block
+    last_block = grid - 1
+
+    out = pl.pallas_call(
+        _keep_last_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((l, block), lambda i: (0, i)),
+            # lookahead: the next block (the final block reads itself; the
+            # wrapper forces the true last element below)
+            pl.BlockSpec((l, block), lambda i: (0, jnp.minimum(i + 1, last_block))),
+        ],
+        out_specs=pl.BlockSpec((1, block), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, m), jnp.uint32),
+        interpret=interpret,
+    )(stacked, stacked)
+    out = out[0]
+    # the global last element has no successor: it always closes its segment
+    # (unless it is padding)
+    last_valid = jnp.where(stacked[0, m - 1] == 0, jnp.uint32(1), jnp.uint32(0))
+    return out.at[m - 1].set(last_valid)

@@ -34,21 +34,30 @@ class GlobalIndexAssigner:
         self._bucket_counts: dict[tuple, int] = {}  # (partition, bucket) -> rows
 
     def bootstrap(self) -> None:
-        """Read only the key columns of every live file (reference
-        IndexBootstrap: key + partition + bucket projection)."""
+        """Read the key columns of every live file and resolve each key to its
+        LATEST location by sequence number — applying -D/-U rows, so a moved
+        or deleted key never resurrects its stale copy (reference
+        IndexBootstrap projects key + partition + bucket the same way)."""
         store = self.table.store
         plan = store.new_scan().plan()
+        latest: dict[tuple, tuple] = {}  # key -> (seq, partition, bucket, alive)
         for partition, buckets in plan.grouped().items():
             for bucket, files in buckets.items():
                 rf = store.reader_factory(partition, bucket)
                 for f in files:
                     kv = rf.read(f, fields=self.key_names)
-                    keep = ~np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)))
+                    alive = ~np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)))
                     cols = [kv.data.column(k).values for k in self.key_names]
-                    for i in np.flatnonzero(keep):
+                    seqs = kv.seq
+                    for i in range(kv.num_rows):
                         key = tuple(c[i] for c in cols)
-                        self.index[key] = (partition, bucket)
+                        prev = latest.get(key)
+                        if prev is None or seqs[i] > prev[0]:
+                            latest[key] = (int(seqs[i]), partition, bucket, bool(alive[i]))
                 self._bucket_counts[(partition, bucket)] = sum(f.row_count for f in files)
+        for key, (_, partition, bucket, alive) in latest.items():
+            if alive:
+                self.index[key] = (partition, bucket)
 
     def assign(self, key: tuple, partition: tuple) -> tuple[tuple, int, tuple | None]:
         """(target_partition, bucket, old_location_or_None_if_same)."""
@@ -111,21 +120,30 @@ class CrossPartitionUpsertWrite:
         n = data.num_rows
         key_cols = [data.column(k).values for k in self.key_names]
         part_cols = [data.column(p).values for p in self.partition_keys]
+        # the index probe is per key (hash-map), but the WRITES are batched:
+        # per (partition, bucket), rows + kinds collect in input order and go
+        # out as one sub-batch, so same-batch insert/delete chains keep their
+        # sequence ordering
+        ops: dict[tuple, list[tuple[int, int]]] = {}  # loc -> [(row, kind)]
         for i in range(n):
             key = tuple(c[i] for c in key_cols)
             partition = tuple(c.item() if hasattr((c := pc[i]), "item") else c for pc in part_cols)
             kind = int(kinds[i]) if kinds is not None else int(RowKind.INSERT)
-            row = data.slice(i, i + 1)
             if kind in (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)):
                 old = self.assigner.delete(key)
                 if old is not None:
-                    self._writer(*old).write(row, np.array([kind], dtype=np.uint8))
+                    ops.setdefault(old, []).append((i, kind))
                 continue
             target_partition, bucket, old = self.assigner.assign(key, partition)
             if old is not None:
                 # key moved partitions: retract the old copy
-                self._writer(*old).write(row, np.array([int(RowKind.DELETE)], dtype=np.uint8))
-            self._writer(target_partition, bucket).write(row)
+                ops.setdefault(old, []).append((i, int(RowKind.DELETE)))
+            ops.setdefault((target_partition, bucket), []).append((i, kind))
+        for loc, pairs in ops.items():
+            pairs.sort(key=lambda p: p[0])  # input (sequence) order
+            idx = np.array([r for r, _ in pairs], dtype=np.int64)
+            ks = np.array([k for _, k in pairs], dtype=np.uint8)
+            self._writer(*loc).write(data.take(idx), ks)
 
     def prepare_commit(self):
         msgs = [w.prepare_commit() for w in self._writers.values()]

@@ -36,6 +36,19 @@ class TableWrite:
         self.num_buckets = max(store.options.bucket, 1)
         self._writers: dict[tuple, object] = {}
         self._assigner = None
+        self._cross = None
+        if (
+            self.dynamic
+            and table.partition_keys
+            and not set(table.partition_keys) <= set(table.primary_keys)
+        ):
+            # primary key omits the partition key: the standard dynamic path
+            # cannot keep keys unique across partitions — delegate to the
+            # global-index writer (reference GlobalDynamicBucketSink)
+            from .crosspartition import CrossPartitionUpsertWrite
+
+            self._cross = CrossPartitionUpsertWrite(table)
+            return
         if self.dynamic:
             from ..core.bucket_index import HashIndexFile, SimpleHashBucketAssigner
             from ..options import CoreOptions
@@ -49,6 +62,9 @@ class TableWrite:
             data = ColumnBatch.from_pydict(self.table.row_type, data)
         if kinds is not None and not isinstance(kinds, np.ndarray):
             kinds = np.array([int(RowKind.from_short_string(k)) for k in kinds], dtype=np.uint8)
+        if self._cross is not None:
+            self._cross.write(data, kinds)
+            return
         from .bucket import group_by_partition_bucket
 
         if self.dynamic:
@@ -114,6 +130,8 @@ class TableWrite:
             w.compact(full=full)
 
     def prepare_commit(self) -> list[CommitMessage]:
+        if self._cross is not None:
+            return self._cross.prepare_commit()
         msgs = [m for m in (w.prepare_commit() for w in self._writers.values()) if not m.is_empty()]
         if self._assigner is not None:
             by_pb = {(m.partition, m.bucket): m for m in msgs}

@@ -75,3 +75,40 @@ def test_bootstrap_after_restart(table):
     w2.write({"region": ["ap"], "id": [5], "v": [55.0]})
     commit(table, w2, 2)
     assert sorted(read(table).to_pylist()) == [("ap", 5, 55.0)]
+
+
+def test_standard_table_write_routes_cross_partition(table):
+    """The plain Table API write path must keep keys globally unique."""
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"region": ["eu"], "id": [1], "v": [1.0]})
+    wb.new_commit().commit(w.prepare_commit())
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"region": ["us"], "id": [1], "v": [10.0]})
+    wb.new_commit().commit(w.prepare_commit())
+    out = read(table)
+    assert out.to_pylist() == [("us", 1, 10.0)]  # no duplicate pk across partitions
+
+
+def test_bootstrap_resolves_moves_by_sequence(tmp_warehouse):
+    """A key that moved partitions must bootstrap to its LATEST location,
+    regardless of partition scan order."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="xp3")
+    t = cat.create_table(
+        "db.mv", SCHEMA, partition_keys=["region"], primary_keys=["id"],
+        options={"bucket": "-1", "dynamic-bucket.target-row-num": "100"},
+    )
+    w = CrossPartitionUpsertWrite(t)
+    w.write({"region": ["us", "eu"], "id": [9, 1], "v": [9.0, 1.0]})
+    commit(t, w, 1)
+    w2 = CrossPartitionUpsertWrite(t)
+    w2.write({"region": ["us"], "id": [1], "v": [10.0]})  # eu -> us
+    commit(t, w2, 2)
+    # fresh session: index must say id=1 lives in us
+    w3 = CrossPartitionUpsertWrite(t)
+    assert w3.assigner.index[(1,)][0] == ("us",)
+    w3.write({"region": ["ap"], "id": [1], "v": [100.0]})  # us -> ap
+    commit(t, w3, 3)
+    out = sorted(read(t).to_pylist())
+    assert out == [("ap", 1, 100.0), ("us", 9, 9.0)]
