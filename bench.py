@@ -24,6 +24,10 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
+from paimon_tpu.utils import enable_compile_cache
+
+enable_compile_cache()
+
 BASELINE_ROWS_PER_SEC = 975_400.0
 N_ROWS = 1_000_000
 N_RUNS = 4

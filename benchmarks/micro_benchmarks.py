@@ -20,6 +20,10 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
+from paimon_tpu.utils import enable_compile_cache
+
+enable_compile_cache()
+
 BASELINES = {
     # reference numbers from BASELINE.md (rows/s)
     "write.parquet": 64_800.0,

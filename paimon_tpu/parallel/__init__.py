@@ -19,7 +19,16 @@ rides ICI within a slice and DCN across slices — no NCCL/MPI analog needed,
 XLA owns the collectives.
 """
 
+from .distributed import global_mesh, init_multi_host, is_commit_coordinator
 from .mesh import make_mesh
 from .merge import bucket_parallel_dedup, distributed_merge_step, range_partition_lanes
 
-__all__ = ["make_mesh", "bucket_parallel_dedup", "distributed_merge_step", "range_partition_lanes"]
+__all__ = [
+    "make_mesh",
+    "bucket_parallel_dedup",
+    "distributed_merge_step",
+    "range_partition_lanes",
+    "init_multi_host",
+    "is_commit_coordinator",
+    "global_mesh",
+]

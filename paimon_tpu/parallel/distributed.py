@@ -1,0 +1,43 @@
+"""Multi-host initialization.
+
+The reference scales out with Flink/Spark clusters over NCCL-free engine
+shuffle (SURVEY §2.9); the TPU-native equivalent is one jax.distributed
+process group per host, a global mesh spanning every host's devices, and XLA
+placing collectives on ICI within a slice / DCN across slices. The commit
+protocol needs no changes: it is a filesystem CAS, and only the coordinator
+(process_index 0) runs commits — exactly the reference's single-parallelism
+committer operator.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .mesh import make_mesh
+
+__all__ = ["init_multi_host", "is_commit_coordinator", "global_mesh"]
+
+
+def init_multi_host(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the jax distributed runtime (env-driven on TPU pods: with
+    no args, jax discovers the topology from the TPU metadata)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_commit_coordinator() -> bool:
+    """Only one process commits (the reference's single-parallelism
+    CommitterOperator); everyone else ships CommitMessages to it."""
+    return jax.process_index() == 0
+
+
+def global_mesh(bucket_parallel: int | None = None):
+    """A (bucket, key) mesh over every device of every host."""
+    return make_mesh(n_devices=None, bucket_parallel=bucket_parallel)

@@ -7,7 +7,22 @@ import time
 import uuid
 from typing import Any, Sequence
 
-__all__ = ["new_file_name", "partition_path", "now_millis", "dumps", "loads"]
+__all__ = ["new_file_name", "partition_path", "now_millis", "dumps", "loads", "enable_compile_cache"]
+
+
+def enable_compile_cache(path: str = "/root/.cache/jax") -> None:
+    """Persistent XLA compile cache: remote compiles through the device
+    tunnel cost 15-40s each; repeat runs become compile-free."""
+    import jax
+
+    for key, value in (
+        ("jax_compilation_cache_dir", path),
+        ("jax_persistent_cache_min_compile_time_secs", 0.5),
+    ):
+        try:
+            jax.config.update(key, value)
+        except Exception:
+            pass
 
 
 def new_file_name(prefix: str, ext: str | None = None) -> str:
