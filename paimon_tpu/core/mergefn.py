@@ -56,6 +56,9 @@ class MergeExecutor:
     def _lanes(self, kv: KVBatch, seq_ascending: bool) -> tuple[np.ndarray, np.ndarray | None]:
         pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
         lanes = encode_key_lanes(kv.data, self.key_names, pools)
+        return lanes, self._seq_lanes(kv, seq_ascending)
+
+    def _seq_lanes(self, kv: KVBatch, seq_ascending: bool) -> np.ndarray | None:
         seq_parts = []
         if self._user_seq:
             # user-defined sequence fields order before the system seqno
@@ -71,8 +74,22 @@ class MergeExecutor:
             # encode them (stability of the device sort covers the rest)
             hi, lo = split_int64_lanes(kv.seq)
             seq_parts.append(np.stack([hi, lo], axis=1))
-        seq_lanes = np.concatenate(seq_parts, axis=1) if seq_parts else None
-        return lanes, seq_lanes
+        return np.concatenate(seq_parts, axis=1) if seq_parts else None
+
+    @staticmethod
+    def _strictly_increasing(lanes: np.ndarray) -> bool:
+        """O(n) host check: are the key tuples strictly ascending? Compare
+        lane-wise: row i < row i+1 lexicographically for every i."""
+        if lanes.shape[0] <= 1:
+            return True
+        a, b = lanes[:-1], lanes[1:]
+        k = lanes.shape[1]
+        lt = np.zeros(len(a), dtype=np.bool_)
+        eq = np.ones(len(a), dtype=np.bool_)
+        for i in range(k):
+            lt |= eq & (a[:, i] < b[:, i])
+            eq &= a[:, i] == b[:, i]
+        return bool(lt.all())
 
     def _plan(self, kv: KVBatch, seq_ascending: bool = False):
         lanes, seq_lanes = self._lanes(kv, seq_ascending)
@@ -99,7 +116,14 @@ class MergeExecutor:
         if self.engine == MergeEngine.DEDUPLICATE:
             from ..options import SortEngine
 
-            lanes, seq_lanes = self._lanes(kv, seq_ascending)
+            pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
+            lanes = encode_key_lanes(kv.data, self.key_names, pools)
+            if self._strictly_increasing(lanes):
+                # already key-sorted with unique keys (bulk loads, replayed
+                # sorted runs): dedup is the identity — skip the device trip
+                # (sequence lanes are never built on this path)
+                return kv
+            seq_lanes = self._seq_lanes(kv, seq_ascending)
             backend = "pallas" if self.options.sort_engine == SortEngine.PALLAS else "xla"
             from ..ops.merge import deduplicate_resolve, deduplicate_select_async
 
