@@ -34,6 +34,20 @@ from .kv import KVBatch
 __all__ = ["MergeExecutor"]
 
 
+def _numpy_dedup_select(lanes: np.ndarray, seq_lanes: np.ndarray | None) -> np.ndarray:
+    """sort-engine=numpy: the pure-host oracle path (useful when no
+    accelerator is attached, and as the reference implementation the device
+    kernels are tested against)."""
+    from ..data.keys import lexsort_rows
+
+    tiebreakers = [] if seq_lanes is None else [seq_lanes[:, i] for i in range(seq_lanes.shape[1])]
+    order = lexsort_rows(lanes, *tiebreakers)
+    sorted_lanes = lanes[order]
+    neq = (sorted_lanes[1:] != sorted_lanes[:-1]).any(axis=1)
+    keep_last = np.concatenate([neq, np.ones(1, dtype=np.bool_)])
+    return order[keep_last]
+
+
 class MergeExecutor:
     def __init__(
         self,
@@ -53,10 +67,12 @@ class MergeExecutor:
         ]
         self._user_seq = self.options.sequence_field
 
-    def _lanes(self, kv: KVBatch, seq_ascending: bool) -> tuple[np.ndarray, np.ndarray | None]:
+    def _key_lanes(self, kv: KVBatch) -> np.ndarray:
         pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
-        lanes = encode_key_lanes(kv.data, self.key_names, pools)
-        return lanes, self._seq_lanes(kv, seq_ascending)
+        return encode_key_lanes(kv.data, self.key_names, pools)
+
+    def _lanes(self, kv: KVBatch, seq_ascending: bool) -> tuple[np.ndarray, np.ndarray | None]:
+        return self._key_lanes(kv), self._seq_lanes(kv, seq_ascending)
 
     def _seq_lanes(self, kv: KVBatch, seq_ascending: bool) -> np.ndarray | None:
         seq_parts = []
@@ -116,14 +132,15 @@ class MergeExecutor:
         if self.engine == MergeEngine.DEDUPLICATE:
             from ..options import SortEngine
 
-            pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
-            lanes = encode_key_lanes(kv.data, self.key_names, pools)
+            lanes = self._key_lanes(kv)
             if self._strictly_increasing(lanes):
                 # already key-sorted with unique keys (bulk loads, replayed
                 # sorted runs): dedup is the identity — skip the device trip
                 # (sequence lanes are never built on this path)
                 return kv
             seq_lanes = self._seq_lanes(kv, seq_ascending)
+            if self.options.sort_engine == SortEngine.NUMPY:
+                return kv.take(_numpy_dedup_select(lanes, seq_lanes))
             backend = "pallas" if self.options.sort_engine == SortEngine.PALLAS else "xla"
             from ..ops.merge import deduplicate_resolve, deduplicate_select_async
 
@@ -134,6 +151,10 @@ class MergeExecutor:
     def supports_keys_only_pipeline(self) -> bool:
         """True when merge needs only (key cols, seq, kind) to pick winners —
         lets the read path dispatch the kernel before value columns decode."""
+        from ..options import SortEngine
+
+        if self.options.sort_engine == SortEngine.NUMPY:
+            return False  # host-oracle engine: merge() handles it device-free
         return self.engine == MergeEngine.DEDUPLICATE and not self.options.ignore_delete and not self._user_seq
 
     def dedup_select_async(self, kv_keys: KVBatch, seq_ascending: bool, run_offsets=None):

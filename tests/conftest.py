@@ -4,16 +4,23 @@ separately dry-runs the real multi-chip path via __graft_entry__)."""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# PAIMON_TEST_PLATFORM=tpu runs the kernel suites on the real chip
+_platform = os.environ.get("PAIMON_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+if _platform == "cpu" and "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 # the environment's sitecustomize may programmatically pin jax to the real
 # TPU (axon) — override via config, which wins over both
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+else:
+    from paimon_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
 
 import numpy as np
 import pytest

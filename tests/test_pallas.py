@@ -45,3 +45,49 @@ def test_pallas_end_to_end_table(tmp_warehouse):
     w.write({"k": [2], "v": [22.0]}); wb.new_commit().commit(w.prepare_commit())
     rb = t.new_read_builder()
     assert rb.new_read().read_all(rb.new_scan().plan()).to_pylist() == [(1, 1.0), (2, 22.0), (3, 3.0)]
+
+
+def test_numpy_sort_engine_end_to_end(tmp_warehouse):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="np")
+    t = cat.create_table(
+        "db.np",
+        RowType.of(("k", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["k"],
+        options={"bucket": "1", "sort-engine": "numpy"},
+    )
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [3, 1], "v": [3.0, 1.0]}); wb.new_commit().commit(w.prepare_commit())
+    wb = t.new_batch_write_builder(); w = wb.new_write()
+    w.write({"k": [1], "v": [11.0]}); wb.new_commit().commit(w.prepare_commit())
+    rb = t.new_read_builder()
+    assert rb.new_read().read_all(rb.new_scan().plan()).to_pylist() == [(1, 11.0), (3, 3.0)]
+
+
+def test_numpy_sort_engine_stays_on_host(tmp_warehouse, monkeypatch):
+    """sort-engine=numpy must never dispatch device kernels, even on the
+    multi-run read path."""
+    import paimon_tpu.ops.merge as m
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    def boom(*a, **k):
+        raise AssertionError("device kernel dispatched under sort-engine=numpy")
+
+    monkeypatch.setattr(m, "_dedup_select_fn", boom)
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="nph")
+    t = cat.create_table(
+        "db.nph",
+        RowType.of(("k", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["k"],
+        options={"bucket": "1", "sort-engine": "numpy"},
+    )
+    for vals in ([3, 1], [1]):
+        wb = t.new_batch_write_builder(); w = wb.new_write()
+        w.write({"k": vals, "v": [float(x) for x in vals]})
+        wb.new_commit().commit(w.prepare_commit())
+    rb = t.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert out.to_pylist() == [(1, 1.0), (3, 3.0)]
