@@ -249,8 +249,7 @@ class MergeExecutor:
 
         import jax.numpy as jnp
 
-        pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
-        key_lanes = encode_key_lanes(kv.data, self.key_names, pools)
+        key_lanes = self._key_lanes(kv)
         # order: (key, group seq, system seq); null group seq sorts first and
         # is excluded from candidacy
         gcol = kv.data.column(seq_col)
@@ -271,8 +270,25 @@ class MergeExecutor:
         )
         src = np.asarray(src)[: gplan.num_segments]
         out = {}
-        for name in [seq_col, *fields]:
-            out[name] = _gather_column(kv.data.column(name), src)
+        out[seq_col] = _gather_column(kv.data.column(seq_col), src)
+        default_fn = self.options.options.get(CoreOptions.AGGREGATE_DEFAULT_FUNC)
+        for name in fields:
+            # per-field aggregators INSIDE a sequence group aggregate over the
+            # group's ordering (reference PartialUpdateMergeFunction supports
+            # fields.<f>.aggregate-function within sequence groups, falling
+            # back to fields.default-aggregate-function); fields without
+            # either take the winning row's snapshot value
+            fn = self.options.field_option(name, "aggregate-function") or default_fn
+            if fn is not None:
+                col = kv.data.column(name)
+                # rows whose group sequence is null do not participate in the
+                # group at all (reference isEmptySequenceGroup :150) — mask
+                # them out of the aggregation via validity
+                if not g_valid.all():
+                    col = Column(col.values, col.valid_mask() & g_valid)
+                out[name] = aggregate_merge(gplan, col, self._agg_spec(name), kv.kind)
+            else:
+                out[name] = _gather_column(kv.data.column(name), src)
         return out
 
     @staticmethod

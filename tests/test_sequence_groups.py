@@ -64,3 +64,57 @@ def test_sequence_group_ties_resolved_by_system_seq(table):
     write(t, {"k": [1, 1], "a": [10, 11], "seq_a": [7, 7], "b": [None, None], "seq_b": [None, None]})
     out = read(t)
     assert out.to_pylist()[0][1] == 11  # same group seq: later arrival wins
+
+
+def test_aggregation_within_sequence_group(tmp_warehouse):
+    """fields.<f>.aggregate-function inside a sequence group aggregates over
+    the group's rows instead of taking the winner's snapshot."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="sga")
+    schema = RowType.of(("k", BIGINT()), ("total", INT()), ("g", BIGINT()))
+    t = cat.create_table(
+        "db.sga", schema, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "merge-engine": "partial-update",
+            "fields.g.sequence-group": "total",
+            "fields.total.aggregate-function": "sum",
+        },
+    )
+    write(t, {"k": [1, 1], "total": [10, 5], "g": [1, 2]})
+    write(t, {"k": [1], "total": [7], "g": [3]})
+    out = read(t)
+    assert out.to_pylist() == [(1, 22, 3)]  # sum over the group, latest g
+
+
+def test_group_aggregation_skips_null_group_rows(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="sgn")
+    schema = RowType.of(("k", BIGINT()), ("total", INT()), ("g", BIGINT()))
+    t = cat.create_table(
+        "db.sgn", schema, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "merge-engine": "partial-update",
+            "fields.g.sequence-group": "total",
+            "fields.total.aggregate-function": "sum",
+        },
+    )
+    write(t, {"k": [1, 1], "total": [10, 5], "g": [1, None]})
+    out = read(t)
+    assert out.to_pylist() == [(1, 10, 1)]  # null-g row excluded from the group
+
+
+def test_group_aggregation_default_function(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="sgd")
+    schema = RowType.of(("k", BIGINT()), ("total", INT()), ("g", BIGINT()))
+    t = cat.create_table(
+        "db.sgd", schema, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "merge-engine": "partial-update",
+            "fields.g.sequence-group": "total",
+            "fields.default-aggregate-function": "sum",
+        },
+    )
+    write(t, {"k": [1, 1], "total": [10, 5], "g": [1, 2]})
+    out = read(t)
+    assert out.to_pylist() == [(1, 15, 2)]  # default agg applies inside groups
