@@ -37,7 +37,7 @@ BASELINES = {
 }
 
 
-def make_table(tmp, fmt, rows, runs=1, write_only=False):
+def make_table(tmp, fmt, rows, runs=1, write_only=False, merge_engine=None, extra_options=None, overlap=False):
     import paimon_tpu as pt
     from paimon_tpu.catalog import FileSystemCatalog
 
@@ -51,14 +51,22 @@ def make_table(tmp, fmt, rows, runs=1, write_only=False):
     opts = {"bucket": "1", "file.format": fmt}
     if write_only:
         opts["write-only"] = "true"
-    name = f"bench.t_{fmt}_{runs}"
+    if merge_engine:
+        opts["merge-engine"] = merge_engine
+    opts.update(extra_options or {})
+    name = f"bench.t_{fmt}_{runs}_{merge_engine or 'dedup'}"
     t = cat.create_table(name, schema, primary_keys=["id"], options=opts)
     rng = np.random.default_rng(7)
-    ids = rng.permutation(rows).astype(np.int64)
     per = rows // runs
+    if overlap:
+        # every run re-draws from the SAME key space: the merge truly
+        # combines versions across all runs
+        key_space = np.arange(per, dtype=np.int64)
+    else:
+        ids = rng.permutation(rows).astype(np.int64)
     elapsed = 0.0
     for r in range(runs):
-        chunk = np.sort(ids[r * per : (r + 1) * per])
+        chunk = key_space if overlap else np.sort(ids[r * per : (r + 1) * per])
         data = {"id": chunk}
         for i in range(6):
             data[f"c{i}"] = chunk * (i + 1)
@@ -75,7 +83,7 @@ def make_table(tmp, fmt, rows, runs=1, write_only=False):
     return t, rows / elapsed
 
 
-def bench_scan(t, rows, projection=None, iters=3):
+def bench_scan(t, rows, projection=None, iters=3, expect_rows=None):
     rb = t.new_read_builder()
     if projection:
         rb = rb.with_projection(projection)
@@ -84,7 +92,7 @@ def bench_scan(t, rows, projection=None, iters=3):
         t0 = time.perf_counter()
         out = rb.new_read().read_all(rb.new_scan().plan())
         dt = time.perf_counter() - t0
-        assert out.num_rows == rows
+        assert out.num_rows == (expect_rows if expect_rows is not None else rows)
         if i > 0:
             best = min(best, dt)
     return rows / best
@@ -134,6 +142,22 @@ def main():
         emit("merge-read.parquet", bench_scan(t, rows))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    # BASELINE.json configs 2-3: partial-update and aggregation merge engines
+    # over overlapping runs (no published reference numbers -> vs_baseline null)
+    for engine, extra in (
+        ("partial-update", {}),
+        ("aggregation", {"fields.c0.aggregate-function": "sum", "fields.d0.aggregate-function": "max"}),
+    ):
+        tmp = tempfile.mkdtemp(prefix="ptb_eng_")
+        try:
+            # 4 fully-overlapping runs: every key has 4 versions to combine
+            t, _ = make_table(
+                tmp, "parquet", rows, runs=4, write_only=True,
+                merge_engine=engine, extra_options=extra, overlap=True,
+            )
+            emit(f"merge-read.{engine}", bench_scan(t, rows, expect_rows=rows // 4))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

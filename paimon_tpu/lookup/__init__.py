@@ -25,7 +25,10 @@ __all__ = ["LookupFile", "LookupFileCache", "LookupLevels"]
 
 
 class LookupFile:
-    """One data file, indexed for point probes."""
+    """One data file, indexed for point probes. Persistable as an immutable
+    on-disk hash store — arrow IPC rows + sorted hash/row-id sidecar arrays
+    (reference HashLookupStoreWriter/Reader: the same shape, a hash table
+    over serialized rows, written once and mmap-read)."""
 
     def __init__(self, kv: KVBatch, key_names: Sequence[str]):
         self.kv = kv
@@ -33,6 +36,44 @@ class LookupFile:
         hashes = _key_hashes_of(kv.data, key_names)
         self.order = np.argsort(hashes, kind="stable").astype(np.int32)
         self.sorted_hashes = hashes[self.order]
+
+    def save(self, file_io, path: str) -> None:
+        """Persist rows + index: `<path>` (arrow IPC) and `<path>.hidx`."""
+        import io as _io
+
+        import pyarrow as pa
+
+        buf = _io.BytesIO()
+        table = self.kv.to_disk_batch().to_arrow()
+        with pa.ipc.new_stream(buf, table.schema) as w:
+            w.write_table(table)
+        file_io.write_bytes(path, buf.getvalue(), overwrite=True)
+        idx = self.sorted_hashes.tobytes() + self.order.tobytes()
+        file_io.write_bytes(f"{path}.hidx", idx, overwrite=True)
+
+    @staticmethod
+    def load(file_io, path: str, value_schema, key_names: Sequence[str]) -> "LookupFile":
+        import io as _io
+
+        import pyarrow as pa
+
+        from ..core.kv import KVBatch as _KVBatch
+        from ..data.batch import ColumnBatch
+
+        reader = pa.ipc.open_stream(_io.BytesIO(file_io.read_bytes(path)))
+        table = reader.read_all()
+        from ..core.kv import kv_disk_schema
+
+        disk = ColumnBatch.from_arrow(table, kv_disk_schema(value_schema))
+        kv = _KVBatch.from_disk_batch(disk, value_schema)
+        lf = LookupFile.__new__(LookupFile)
+        lf.kv = kv
+        lf.key_names = list(key_names)
+        raw = file_io.read_bytes(f"{path}.hidx")
+        n = kv.num_rows
+        lf.sorted_hashes = np.frombuffer(raw[: n * 8], dtype=np.uint64).copy()
+        lf.order = np.frombuffer(raw[n * 8 : n * 8 + n * 4], dtype=np.int32).copy()
+        return lf
 
     @property
     def num_bytes(self) -> int:
@@ -91,6 +132,8 @@ class LookupLevels:
         key_names: Sequence[str],
         cache: LookupFileCache | None = None,
         deletion_vectors: dict | None = None,
+        local_store_dir: str | None = None,
+        file_io=None,
     ):
         from ..core.levels import Levels
 
@@ -99,15 +142,29 @@ class LookupLevels:
         self.key_names = list(key_names)
         self.cache = cache or LookupFileCache()
         self.deletion_vectors = deletion_vectors or {}
+        # optional disk tier: converted lookup files persist locally so a
+        # restart (or memory-cache eviction) re-reads the local store instead
+        # of the remote data file (reference LookupLevels.createLookupFile)
+        self.local_store_dir = local_store_dir
+        self.file_io = file_io
 
     def _load(self, meta: DataFileMeta) -> LookupFile:
+        local = (
+            f"{self.local_store_dir}/{meta.file_name}.lookup" if self.local_store_dir and self.file_io else None
+        )
+        has_dv = meta.file_name in self.deletion_vectors
+        if local and not has_dv and self.file_io.exists(local):
+            return LookupFile.load(self.file_io, local, self.reader_factory.read_schema, self.key_names)
         kv = self.reader_factory.read(meta)
         dv = self.deletion_vectors.get(meta.file_name)
         if dv is not None:
             mask = ~dv.deleted_mask(kv.num_rows)
             if not mask.all():
                 kv = kv.filter(mask)
-        return LookupFile(kv, self.key_names)
+        lf = LookupFile(kv, self.key_names)
+        if local and not has_dv:  # DV'd files change between snapshots
+            lf.save(self.file_io, local)
+        return lf
 
     def _lookup_file(self, meta: DataFileMeta) -> LookupFile:
         return self.cache.get(meta.file_name, lambda: self._load(meta))

@@ -55,6 +55,7 @@ class BranchManager:
             bsm = SnapshotManager(self.file_io, bp)
             bsm.commit_latest_hint(snap.id)
             bsm.commit_earliest_hint(snap.id)
+        self.file_io.write_bytes(f"{bp}/CREATED_FROM", str(snap.id if snap else -1).encode())
 
     def _copy_metadata(self, snap: Snapshot, dst: str, src: str | None = None) -> None:
         """Copy a snapshot's manifest tree + index files between metadata
@@ -86,6 +87,13 @@ class BranchManager:
 
     def delete(self, name: str) -> None:
         self.file_io.delete(self.branch_path(name), recursive=True)
+
+    def created_from(self, name: str) -> int | None:
+        try:
+            v = int(self.file_io.read_text(f"{self.branch_path(name)}/CREATED_FROM"))
+            return None if v < 0 else v
+        except Exception:
+            return None
 
     def list_branches(self) -> list[str]:
         out = []

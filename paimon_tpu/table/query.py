@@ -19,12 +19,13 @@ __all__ = ["LocalTableQuery"]
 
 
 class LocalTableQuery:
-    def __init__(self, table: "FileStoreTable", cache_bytes: int = 256 << 20):
+    def __init__(self, table: "FileStoreTable", cache_bytes: int = 256 << 20, local_store_dir: str | None = None):
         if not table.is_primary_key_table:
             raise ValueError("point lookup requires a primary-key table")
         self.table = table
         self.store = table.store
         self.cache = LookupFileCache(cache_bytes)
+        self.local_store_dir = local_store_dir
         self._levels: dict[tuple, LookupLevels] = {}
         self._snapshot_id: int | None = None
         self.refresh()
@@ -53,6 +54,8 @@ class LocalTableQuery:
                     self.store.key_names,
                     cache=self.cache,
                     deletion_vectors=dvs,
+                    local_store_dir=self.local_store_dir,
+                    file_io=self.table.file_io,
                 )
 
     def lookup(self, partition: tuple, key: "tuple | object"):

@@ -2,8 +2,8 @@
 
 Parity: /root/reference/paimon-core/.../table/system/ (21 virtual tables,
 SystemTableLoader) — here: snapshots, schemas, options, files, manifests,
-tags, consumers, partitions, buckets, audit_log, read_optimized, statistics,
-aggregation_fields.
+tags, branches, consumers, partitions, buckets, audit_log, read_optimized,
+statistics, aggregation_fields.
 Accessed as `table$snapshots` through the catalog or `system_table(t, name)`.
 """
 
@@ -139,6 +139,29 @@ def _tags(table: "FileStoreTable") -> _StaticTable:
     schema = RowType.of(("tag_name", STRING(False)), ("snapshot_id", BIGINT(False)))
     rows = sorted(table.tags().items())
     return _StaticTable("tags", ColumnBatch.from_pylist(schema, rows))
+
+
+def _branches(table: "FileStoreTable") -> _StaticTable:
+    from ..core.schema import SchemaManager
+    from ..core.snapshot import SnapshotManager
+    from .branch import BranchManager
+
+    schema = RowType.of(
+        ("branch_name", STRING(False)),
+        ("created_from_snapshot", BIGINT()),
+        ("latest_snapshot", BIGINT()),
+        ("latest_schema_id", BIGINT()),
+    )
+    bm = BranchManager(table.file_io, table.path)
+    rows = []
+    for name in bm.list_branches():
+        bp = bm.branch_path(name)
+        bsm = SnapshotManager(table.file_io, bp)
+        latest_schema = SchemaManager(table.file_io, bp).latest()
+        rows.append(
+            (name, bm.created_from(name), bsm.latest_snapshot_id(), latest_schema.id if latest_schema else None)
+        )
+    return _StaticTable("branches", ColumnBatch.from_pylist(schema, rows))
 
 
 def _consumers(table: "FileStoreTable") -> _StaticTable:
@@ -299,6 +322,7 @@ SYSTEM_TABLES = {
     "files": _files,
     "manifests": _manifests,
     "tags": _tags,
+    "branches": _branches,
     "consumers": _consumers,
     "partitions": _partitions,
     "buckets": _buckets,
