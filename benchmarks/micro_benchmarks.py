@@ -104,7 +104,7 @@ def emit(metric, value, unit="rows/s"):
         json.dumps(
             {
                 "metric": metric,
-                "value": round(value, 1),
+                "value": round(value, 4 if value < 10 else 1),
                 "unit": unit,
                 "vs_baseline": round(value / base, 3) if base else None,
             }
@@ -135,11 +135,23 @@ def main():
                     emit(f"scan.projected.{fmt}", bench_scan(t, rows, projection=["id", "c0", "d0", "s0"]))
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
-    # merge-read with 4 overlapping runs (the headline config, see bench.py)
+    # merge-read with 4 overlapping runs (the headline config, see bench.py),
+    # then BASELINE.json headline #2 on the same table: full-compaction
+    # throughput (GB/s of input rewritten through the merge kernel)
     tmp = tempfile.mkdtemp(prefix="ptb_mr_")
     try:
         t, _ = make_table(tmp, "parquet", rows, runs=4, write_only=True)
         emit("merge-read.parquet", bench_scan(t, rows))
+        input_bytes = sum(f.file_size for f in t.store.restore_files((), 0))
+        t2 = t.copy({"write-only": "false"})
+        wb = t2.new_batch_write_builder()
+        w = wb.new_write()
+        t0 = time.perf_counter()
+        w.compact(full=True)
+        wb.new_commit().commit(w.prepare_commit())
+        dt = time.perf_counter() - t0
+        emit("full-compaction.gbps", input_bytes / dt / (1 << 30), unit="GB/s")
+        emit("full-compaction.rows", rows / dt)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     # BASELINE.json configs 2-3: partial-update and aggregation merge engines
