@@ -180,8 +180,11 @@ class AvroFormat(FileFormat):
         codec = meta.get("avro.codec", b"null")
         file_schema = json.loads(meta["avro.schema"].decode())
         pos += 16  # sync
-        rows: list[list] = []
         field_types = self._field_types(file_schema)
+        names = [f["name"] for f in file_schema["fields"]]
+        out_names = list(projection) if projection is not None else [n for n in schema.field_names if n in names]
+        read_schema = schema.project(out_names)
+        block_cols: list[dict[str, Column]] = []
         while pos < len(buf):
             count, pos = _read_long(buf, pos)
             size, pos = _read_long(buf, pos)
@@ -189,16 +192,82 @@ class AvroFormat(FileFormat):
             pos += size + 16  # skip sync
             if codec == b"deflate":
                 payload = zlib.decompress(payload, -15)
-            rows.extend(self._decode_block(payload, count, field_types))
-        names = [f["name"] for f in file_schema["fields"]]
-        cols_data: dict[str, list] = {n: [] for n in names}
-        for r in rows:
-            for n, v in zip(names, r):
-                cols_data[n].append(v)
-        out_names = list(projection) if projection is not None else [n for n in schema.field_names if n in cols_data]
-        read_schema = schema.project(out_names)
-        batch = ColumnBatch.from_pydict(read_schema, {n: cols_data[n] for n in out_names})
-        yield batch
+            decoded = self._decode_block_native(payload, count, field_types, names, read_schema)
+            if decoded is None:
+                # per-block python fallback (no compiler / input the C decoder
+                # rejects) — converted to columns so paths merge in order
+                rows = self._decode_block(payload, count, field_types)
+                cols_data: dict[str, list] = {n: [] for n in names}
+                for r in rows:
+                    for n, v in zip(names, r):
+                        cols_data[n].append(v)
+                decoded = dict(
+                    ColumnBatch.from_pydict(read_schema, {n: cols_data[n] for n in out_names}).columns
+                )
+            block_cols.append(decoded)
+        if not block_cols:
+            yield ColumnBatch.empty(read_schema)
+            return
+        merged = {
+            n: Column.concat([blk[n] for blk in block_cols]) for n in out_names
+        }
+        yield ColumnBatch(read_schema, merged)
+
+    @staticmethod
+    def _decode_block_native(payload, count, field_types, names, read_schema):
+        """C-decoder fast path: columnar buffers straight out of the block
+        (paimon_tpu.native.avrodec); None -> caller uses the python loop."""
+        from ..native import (
+            CODE_BOOL,
+            CODE_DOUBLE,
+            CODE_FLOAT,
+            CODE_LONG,
+            CODE_STRING,
+            avro_decoder,
+        )
+
+        code_of = {"int": CODE_LONG, "long": CODE_LONG, "float": CODE_FLOAT, "double": CODE_DOUBLE,
+                   "boolean": CODE_BOOL, "string": CODE_STRING, "bytes": CODE_STRING}
+        specs = []
+        for nullable, t in field_types:
+            code = code_of.get(t)
+            if code is None:
+                return None
+            specs.append((code, nullable))
+        out = avro_decoder(payload, count, specs)
+        if out is None:
+            return None
+        import pyarrow as pa
+
+        cols: dict[str, Column] = {}
+        wanted = set(read_schema.field_names)
+        for f, (name, (nullable, t)) in enumerate(zip(names, field_types)):
+            if name not in wanted:
+                continue
+            res = out[f]
+            target = read_schema.field(name).type
+            if t in ("string", "bytes"):
+                offsets, data, validity = res
+                total = int(offsets[count])
+                arr_type = pa.binary() if t == "bytes" else pa.utf8()
+                vbuf = None
+                valid = validity.astype(np.bool_)
+                if not valid.all():
+                    vbuf = pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+                arr = pa.Array.from_buffers(
+                    arr_type,
+                    count,
+                    [vbuf, pa.py_buffer(offsets[: count + 1].tobytes()), pa.py_buffer(data[:total].tobytes())],
+                )
+                cols[name] = Column(validity=None if valid.all() else valid, arrow=arr)
+            else:
+                values, validity = res
+                valid = validity.astype(np.bool_)
+                np_dtype = target.numpy_dtype()
+                if values.dtype != np_dtype:
+                    values = values.astype(np_dtype)
+                cols[name] = Column(values, None if valid.all() else valid)
+        return cols
 
     @staticmethod
     def _field_types(file_schema: dict) -> list[tuple[bool, str]]:

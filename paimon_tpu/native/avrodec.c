@@ -1,0 +1,137 @@
+/* Native Avro block decoder.
+ *
+ * The pure-python codec in format/avro.py parses records byte-by-byte in the
+ * interpreter; this decoder walks one decompressed Avro block in C and fills
+ * columnar output buffers directly:
+ *   - int-family fields  -> int64 values + uint8 validity
+ *   - float/double       -> float64 values + uint8 validity
+ *   - boolean            -> uint8 values + uint8 validity
+ *   - string/bytes       -> int32 offsets (n+1) + contiguous data bytes +
+ *                           uint8 validity (arrow StringArray layout)
+ *
+ * Built on demand with `cc -O3 -shared -fPIC` and loaded via ctypes
+ * (paimon_tpu/native/__init__.py); the python codec is the fallback.
+ *
+ * Field type codes (must match native/__init__.py):
+ *   0 int/long   1 float   2 double   3 boolean   4 string/bytes
+ * Each field additionally carries a nullable flag (["null", T] union with
+ * null as branch 0, the layout format/avro.py writes).
+ */
+
+#include <stdint.h>
+#include <stddef.h>
+#include <string.h>
+
+/* zigzag varint; returns new position or (size_t)-1 on overrun */
+static size_t read_long(const uint8_t *buf, size_t pos, size_t len, int64_t *out) {
+    uint64_t acc = 0;
+    int shift = 0;
+    while (pos < len) {
+        uint8_t b = buf[pos++];
+        acc |= ((uint64_t)(b & 0x7f)) << shift;
+        if (!(b & 0x80)) {
+            *out = (int64_t)(acc >> 1) ^ -(int64_t)(acc & 1);
+            return pos;
+        }
+        shift += 7;
+        if (shift > 63) return (size_t)-1;
+    }
+    return (size_t)-1;
+}
+
+/* Decode `count` records of `nfields` fields from buf[0:len].
+ *
+ * type_codes[f], nullable[f]: per-field schema.
+ * num_out[f]: int64* or double* or uint8* target (length count), or NULL for
+ *             string fields.
+ * valid_out[f]: uint8* validity target (length count).
+ * str_offsets[f]: int32* (length count+1), only for string fields.
+ * str_data[f]: uint8* contiguous string bytes target, capacity str_cap[f].
+ *
+ * Returns 0 on success, -1 on malformed input, -2 if a string data buffer
+ * would overflow (caller retries with a larger buffer).
+ */
+int decode_block(const uint8_t *buf, size_t len, int64_t count, int nfields,
+                 const int32_t *type_codes, const uint8_t *nullable,
+                 void **num_out, uint8_t **valid_out,
+                 int32_t **str_offsets, uint8_t **str_data,
+                 const int64_t *str_cap) {
+    size_t pos = 0;
+    int64_t str_used[64];
+    if (nfields > 64) return -1;
+    for (int f = 0; f < nfields; f++) {
+        str_used[f] = 0;
+        if (type_codes[f] == 4 && str_offsets[f]) str_offsets[f][0] = 0;
+    }
+    for (int64_t r = 0; r < count; r++) {
+        for (int f = 0; f < nfields; f++) {
+            int present = 1;
+            if (nullable[f]) {
+                int64_t branch;
+                pos = read_long(buf, pos, len, &branch);
+                if (pos == (size_t)-1) return -1;
+                present = branch != 0;
+            }
+            valid_out[f][r] = (uint8_t)present;
+            switch (type_codes[f]) {
+            case 0: { /* int/long */
+                int64_t v = 0;
+                if (present) {
+                    pos = read_long(buf, pos, len, &v);
+                    if (pos == (size_t)-1) return -1;
+                }
+                ((int64_t *)num_out[f])[r] = v;
+                break;
+            }
+            case 1: { /* float -> double */
+                double v = 0;
+                if (present) {
+                    if (pos + 4 > len) return -1;
+                    float fv;
+                    memcpy(&fv, buf + pos, 4);
+                    pos += 4;
+                    v = (double)fv;
+                }
+                ((double *)num_out[f])[r] = v;
+                break;
+            }
+            case 2: { /* double */
+                double v = 0;
+                if (present) {
+                    if (pos + 8 > len) return -1;
+                    memcpy(&v, buf + pos, 8);
+                    pos += 8;
+                }
+                ((double *)num_out[f])[r] = v;
+                break;
+            }
+            case 3: { /* boolean */
+                uint8_t v = 0;
+                if (present) {
+                    if (pos + 1 > len) return -1;
+                    v = buf[pos++] ? 1 : 0;
+                }
+                ((uint8_t *)num_out[f])[r] = v;
+                break;
+            }
+            case 4: { /* string/bytes */
+                int64_t n = 0;
+                if (present) {
+                    pos = read_long(buf, pos, len, &n);
+                    if (pos == (size_t)-1 || n < 0 || pos + (size_t)n > len) return -1;
+                    if (str_used[f] + n > str_cap[f]) return -2;
+                    if (str_used[f] + n > 0x7fffffff) return -1; /* int32 offsets */
+                    memcpy(str_data[f] + str_used[f], buf + pos, (size_t)n);
+                    pos += (size_t)n;
+                    str_used[f] += n;
+                }
+                str_offsets[f][r + 1] = (int32_t)str_used[f];
+                break;
+            }
+            default:
+                return -1;
+            }
+        }
+    }
+    return 0;
+}
