@@ -111,7 +111,12 @@ class AvroFormat(FileFormat):
             out += v
         _write_long(out, 0)  # end of metadata map
         out += sync
-        block = self._encode_block(batch)
+        try:
+            block = self._encode_block_native(batch)
+        except Exception:
+            block = None  # anything the fast path can't express
+        if block is None:
+            block = self._encode_block(batch)
         if meta["avro.codec"] == b"deflate":
             block = zlib.compress(block)[2:-4]  # raw deflate per avro spec
         _write_long(out, batch.num_rows)
@@ -119,6 +124,61 @@ class AvroFormat(FileFormat):
         out += block
         out += sync
         file_io.write_bytes(path, bytes(out))
+
+    @staticmethod
+    def _encode_block_native(batch: ColumnBatch) -> bytes | None:
+        """C encoder fast path: numeric columns pass through as arrays,
+        strings as arrow offsets/data buffers (built by arrow's C++)."""
+        from ..native import (
+            CODE_BOOL,
+            CODE_DOUBLE,
+            CODE_FLOAT,
+            CODE_LONG,
+            CODE_STRING,
+            avro_encoder,
+        )
+
+        import pyarrow as pa
+
+        code_of = {
+            TypeRoot.TINYINT: CODE_LONG, TypeRoot.SMALLINT: CODE_LONG, TypeRoot.INT: CODE_LONG,
+            TypeRoot.BIGINT: CODE_LONG, TypeRoot.DATE: CODE_LONG, TypeRoot.TIME: CODE_LONG,
+            TypeRoot.TIMESTAMP: CODE_LONG, TypeRoot.TIMESTAMP_LTZ: CODE_LONG, TypeRoot.DECIMAL: CODE_LONG,
+            TypeRoot.FLOAT: CODE_FLOAT, TypeRoot.DOUBLE: CODE_DOUBLE, TypeRoot.BOOLEAN: CODE_BOOL,
+            TypeRoot.CHAR: CODE_STRING, TypeRoot.VARCHAR: CODE_STRING,
+            TypeRoot.BINARY: CODE_STRING, TypeRoot.VARBINARY: CODE_STRING,
+        }
+        specs = []
+        cols = []
+        for f in batch.schema.fields:
+            code = code_of.get(f.type.root)
+            if code is None:
+                return None
+            specs.append((code, f.type.nullable))
+            col = batch.column(f.name)
+            validity = col.validity
+            if code == CODE_STRING:
+                arr = col.arrow if col._values is None else pa.array(col.values, from_pandas=True)
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                target = pa.binary() if f.type.root in (TypeRoot.BINARY, TypeRoot.VARBINARY) else pa.utf8()
+                if arr.type != target:
+                    arr = arr.cast(target)
+                if arr.offset != 0:
+                    arr = pa.concat_arrays([arr])  # rebase to offset 0
+                bufs = arr.buffers()
+                offsets = np.frombuffer(bufs[1], dtype=np.int32, count=len(arr) + 1)
+                data = (
+                    np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None else np.empty(0, np.uint8)
+                )
+                if validity is None and arr.null_count:
+                    import pyarrow.compute as pc
+
+                    validity = np.asarray(pc.is_valid(arr))
+                cols.append((offsets, data, validity))
+            else:
+                cols.append((col.values, validity))
+        return avro_encoder(batch.num_rows, specs, cols)
 
     @staticmethod
     def _encode_block(batch: ColumnBatch) -> bytes:

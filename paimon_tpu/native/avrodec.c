@@ -135,3 +135,65 @@ int decode_block(const uint8_t *buf, size_t len, int64_t count, int nfields,
     }
     return 0;
 }
+
+/* Encode `count` records into out[0:out_cap]. Inputs mirror decode_block:
+ * numeric columns as int64/double/uint8 arrays + validity, strings as arrow
+ * offsets + contiguous data. Writes the block body (no count/size header).
+ * Returns bytes written, or -1 if out_cap is too small / nfields > 64. */
+static size_t write_long(uint8_t *out, size_t pos, int64_t v) {
+    uint64_t z = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    while (z & ~0x7fULL) {
+        out[pos++] = (uint8_t)(z & 0x7f) | 0x80;
+        z >>= 7;
+    }
+    out[pos++] = (uint8_t)z;
+    return pos;
+}
+
+int64_t encode_block(uint8_t *out, size_t out_cap, int64_t count, int nfields,
+                     const int32_t *type_codes, const uint8_t *nullable,
+                     void **num_in, uint8_t **valid_in,
+                     int32_t **str_offsets, uint8_t **str_data) {
+    if (nfields > 64) return -1;
+    size_t pos = 0;
+    /* worst case per scalar is 10 varint bytes + 1 branch byte */
+    for (int64_t r = 0; r < count; r++) {
+        for (int f = 0; f < nfields; f++) {
+            int present = valid_in[f] ? valid_in[f][r] : 1;
+            if (pos + 32 > out_cap) return -1;
+            if (nullable[f]) pos = write_long(out, pos, present ? 1 : 0);
+            if (!present) continue;
+            switch (type_codes[f]) {
+            case 0:
+                pos = write_long(out, pos, ((const int64_t *)num_in[f])[r]);
+                break;
+            case 1: { /* float */
+                float fv = (float)((const double *)num_in[f])[r];
+                memcpy(out + pos, &fv, 4);
+                pos += 4;
+                break;
+            }
+            case 2:
+                memcpy(out + pos, &((const double *)num_in[f])[r], 8);
+                pos += 8;
+                break;
+            case 3:
+                out[pos++] = ((const uint8_t *)num_in[f])[r] ? 1 : 0;
+                break;
+            case 4: {
+                int32_t lo = str_offsets[f][r];
+                int32_t hi = str_offsets[f][r + 1];
+                int64_t n = hi - lo;
+                pos = write_long(out, pos, n);
+                if (pos + (size_t)n > out_cap) return -1;
+                memcpy(out + pos, str_data[f] + lo, (size_t)n);
+                pos += (size_t)n;
+                break;
+            }
+            default:
+                return -1;
+            }
+        }
+    }
+    return (int64_t)pos;
+}

@@ -114,3 +114,37 @@ def test_more_system_tables(tmp_warehouse):
     analyze_table(t)
     srows = cat.get_table("db.agg$statistics").to_pylist()
     assert srows and srows[0][2] == 1  # one merged row (sum=5.0)
+
+
+def test_avro_native_fallback_on_weird_values(tmp_path):
+    """Values the arrow conversion rejects must fall back to the python
+    encoder, not crash the write."""
+    io = LocalFileIO()
+    fmt = get_format("avro")
+    schema = RowType.of(("k", BIGINT(False)), ("s", STRING()))
+    import numpy as np
+
+    vals = np.empty(2, dtype=object)
+    vals[0] = "ok"
+    vals[1] = 12345  # non-string in a VARCHAR column
+    from paimon_tpu.data.batch import Column
+
+    b = ColumnBatch(schema, {"k": Column(np.array([1, 2], dtype=np.int64)), "s": Column(vals)})
+    p = str(tmp_path / "weird.avro")
+    fmt.write(io, p, b)  # must not raise
+    out = next(iter(fmt.read(io, p, schema)))
+    assert out.to_pylist() == [(1, "ok"), (2, "12345")]
+
+
+def test_avro_skewed_string_field_retry(tmp_path):
+    """One string field owning nearly all payload bytes triggers the
+    decoder's capacity retry path."""
+    io = LocalFileIO()
+    fmt = get_format("avro")
+    schema = RowType.of(("a", STRING()), ("b", STRING()), ("c", STRING()))
+    big = "x" * 50_000
+    b = ColumnBatch.from_pydict(schema, {"a": [big, big], "b": ["t", "u"], "c": ["v", "w"]})
+    p = str(tmp_path / "skew.avro")
+    fmt.write(io, p, b, compression="null")
+    out = next(iter(fmt.read(io, p, schema)))
+    assert out.to_pydict() == b.to_pydict()
