@@ -81,7 +81,9 @@ def build_table(path: str):
 def bench_read(table) -> float:
     rb = table.new_read_builder()
     best = float("inf")
-    for it in range(4):  # first iteration warms jit caches
+    # first iteration warms jit caches; best-of-6 damps the tunnel's
+    # bandwidth variance
+    for it in range(7):
         t0 = time.perf_counter()
         splits = rb.new_scan().plan()
         out = rb.new_read().read_all(splits)

@@ -10,6 +10,7 @@ one delta plan per new snapshot; `restore(next)` resumes from a checkpoint
 
 from __future__ import annotations
 
+import time
 from typing import TYPE_CHECKING
 
 from ..core.levels import IntervalPartition
@@ -60,6 +61,21 @@ class StreamTableScan:
             ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, cp)
 
     # ---- planning ------------------------------------------------------
+    def plan_aligned(self, timeout_seconds: float = 60.0, poll_seconds: float = 0.5) -> list[DataSplit] | None:
+        """Checkpoint-aligned variant (reference flink/source/align/): blocks
+        until the next snapshot is available or the timeout passes, so every
+        checkpoint lands exactly on a snapshot boundary. Returns None only on
+        timeout."""
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            splits = self.plan()
+            if splits is not None:
+                return splits
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            time.sleep(min(poll_seconds, remaining))
+
     def plan(self) -> list[DataSplit] | None:
         """None = nothing new yet. First call obeys the startup mode; later
         calls return the delta of one new snapshot each."""

@@ -252,3 +252,27 @@ def test_expire_respects_tags_and_consumers(catalog):
     t_tag = t.copy({"scan.tag-name": "keep"})
     out = read_batch(t_tag)
     assert sorted(r[0] for r in out.to_pylist()) == [0, 1]
+
+
+def test_stream_plan_aligned(catalog):
+    import threading
+    import time
+
+    t = create(catalog, "db.aligned", options={"bucket": "1"})
+    write_batch(t, {"id": [1], "region": ["a"], "amount": [1.0]})
+    scan = t.new_read_builder().new_stream_scan()
+    scan.plan()  # consume the starting plan
+    # nothing new: aligned plan times out cleanly
+    assert scan.plan_aligned(timeout_seconds=0.3, poll_seconds=0.1) is None
+    # a commit arriving mid-wait unblocks the aligned plan
+    def later_write():
+        time.sleep(0.3)
+        write_batch(t, {"id": [2], "region": ["b"], "amount": [2.0]})
+
+    th = threading.Thread(target=later_write)
+    th.start()
+    splits = scan.plan_aligned(timeout_seconds=10.0, poll_seconds=0.1)
+    th.join()
+    assert splits is not None
+    read = t.new_read_builder().new_read()
+    assert [r[0] for r in read.read_all(splits).to_pylist()] == [2]
