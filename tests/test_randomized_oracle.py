@@ -76,3 +76,58 @@ def test_random_ops_match_dict_oracle(tmp_warehouse, seed):
         if snap.commit_kind.value == "APPEND":
             logical += 1
         assert got == history[logical - 1], f"time travel divergence at snapshot {snap.id}"
+
+
+def test_random_ops_partitioned_dynamic_bucket(tmp_warehouse):
+    """Combined paths: partitions + dynamic buckets + deletes + compactions
+    against the dict oracle."""
+    rng = np.random.default_rng(5)
+    cat = FileSystemCatalog(f"{tmp_warehouse}/pdyn", commit_user="oracle2")
+    schema = RowType.of(("region", STRING()), ("k", BIGINT()), ("v", DOUBLE()))
+    t = cat.create_table(
+        "db.p",
+        schema,
+        partition_keys=["region"],
+        primary_keys=["region", "k"],
+        options={"bucket": "-1", "dynamic-bucket.target-row-num": "40", "num-sorted-run.compaction-trigger": "3"},
+    )
+    regions = ["eu", "us", "ap"]
+    oracle: dict[tuple, tuple] = {}
+    for step in range(10):
+        n = int(rng.integers(1, 50))
+        ks = rng.integers(0, 150, n)
+        rs = [regions[i] for i in rng.integers(0, 3, n)]
+        rows = {}
+        for r, k in zip(rs, ks):
+            rows[(r, int(k))] = (r, int(k), float(step))
+        deletes = (
+            [key for key in map(tuple, rng.choice(list(oracle), size=min(len(oracle), 4), replace=False))]
+            if oracle and rng.random() < 0.5
+            else []
+        )
+        deletes = [(r, int(k)) for r, k in deletes]
+        rows = {key: v for key, v in rows.items() if key not in deletes}
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        if rows:
+            w.write(
+                {
+                    "region": [v[0] for v in rows.values()],
+                    "k": [v[1] for v in rows.values()],
+                    "v": [v[2] for v in rows.values()],
+                }
+            )
+        if deletes:
+            w.write(
+                {"region": [d[0] for d in deletes], "k": [d[1] for d in deletes], "v": [None] * len(deletes)},
+                kinds=["-D"] * len(deletes),
+            )
+        if rng.random() < 0.3:
+            w.compact(full=True)
+        wb.new_commit().commit(w.prepare_commit())
+        oracle.update(rows)
+        for d in deletes:
+            oracle.pop(d, None)
+        rb = t.new_read_builder()
+        got = {(r[0], r[1]): r for r in rb.new_read().read_all(rb.new_scan().plan()).to_pylist()}
+        assert got == oracle, f"divergence at step {step}"
