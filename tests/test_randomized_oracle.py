@@ -100,12 +100,12 @@ def test_random_ops_partitioned_dynamic_bucket(tmp_warehouse):
         rows = {}
         for r, k in zip(rs, ks):
             rows[(r, int(k))] = (r, int(k), float(step))
-        deletes = (
-            [key for key in map(tuple, rng.choice(list(oracle), size=min(len(oracle), 4), replace=False))]
-            if oracle and rng.random() < 0.5
-            else []
-        )
-        deletes = [(r, int(k)) for r, k in deletes]
+        if oracle and rng.random() < 0.5:
+            keys = list(oracle)
+            idx = rng.choice(len(keys), size=min(len(keys), 4), replace=False)
+            deletes = [keys[i] for i in idx]  # sample indices: no key coercion
+        else:
+            deletes = []
         rows = {key: v for key, v in rows.items() if key not in deletes}
         wb = t.new_batch_write_builder()
         w = wb.new_write()
