@@ -8,6 +8,12 @@ import jax
 from paimon_tpu.ops.merge import pad_size
 from paimon_tpu.parallel import bucket_parallel_dedup, distributed_merge_step, make_mesh, range_partition_lanes
 
+# these tests need the 8-device mesh (virtual CPU devices in the default test
+# config); on a single real chip they have nothing to shard over
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 devices (virtual CPU mesh or a pod slice)"
+)
+
 
 def lanes_for(keys: np.ndarray) -> np.ndarray:
     return (keys.astype(np.int64).astype(np.int32).view(np.uint32) ^ np.uint32(0x80000000)).reshape(-1, 1)
