@@ -103,10 +103,24 @@ class UniversalCompaction:
 
     @staticmethod
     def _unit(runs, max_level: int, count: int, file_num_based: bool = False) -> CompactUnit:
+        """Choose the output level for the first `count` runs (reference
+        UniversalCompaction.createUnit:179-205). The tentative output is one
+        level below the first excluded run; when that floor is level 0 the
+        unit is extended through the remaining level-0 runs AND the first
+        non-zero-level run (else its level would end up holding two runs,
+        breaking the one-run-per-level invariant), outputting at that run's
+        level — or max_level when everything got absorbed."""
+        if count < len(runs):
+            output = runs[count][0] - 1
+            if output <= 0:
+                while count < len(runs):
+                    level = runs[count][0]
+                    count += 1
+                    if level != 0:
+                        output = level
+                        break
         if count == len(runs):
             output = max_level
-        else:
-            output = max(1, runs[count][0] - 1)
         files = [f for _, r in runs[:count] for f in r.files]
         return CompactUnit(output, files, file_num_based)
 

@@ -104,11 +104,20 @@ class SnapshotExpire:
             self.file_io.delete(f"{self.table_path}/manifest/{name}")
         for sid in expire_ids:
             self.file_io.delete(sm.snapshot_path(sid))
-        sm.commit_earliest_hint(end)
+        # the hint must point at the smallest SURVIVING snapshot: protected
+        # (tag/consumer) snapshots inside the expired range stay on disk, and
+        # walks that trust the hint (earliest_snapshot_id, user scans) would
+        # otherwise never see them again once unprotected
+        sm.commit_earliest_hint(min(retained_ids))
         return len(expire_ids)
 
     def _snapshot_manifests(self, snap: Snapshot):
-        for lst in (snap.base_manifest_list, snap.delta_manifest_list):
+        # changelog manifests included: their manifest files AND the
+        # changelog data files they reference die with the snapshot (the
+        # reference's SnapshotDeletion cleans changelog files the same way)
+        for lst in (snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list):
+            if not lst:
+                continue
             for meta in self.manifest_list.read(lst):
                 yield meta.file_name, self.manifest_file.read(meta.file_name)
 

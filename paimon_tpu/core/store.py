@@ -177,14 +177,18 @@ class KeyValueFileStore:
         field = self.options.options.get(CoreOptions.RECORD_LEVEL_TIME_FIELD)
         if ttl is None or field is None:
             return None
-        from ..data.predicate import greater_than
+        from ..data.predicate import greater_than, is_null, or_
         from ..utils import now_millis
 
         unit = self.options.options.get(CoreOptions.RECORD_LEVEL_TIME_FIELD_TYPE)
         cutoff_ms = now_millis() - ttl
         scale = {"seconds": 1000, "millis": 1, "micros": None}.get(unit, 1000)
         cutoff = cutoff_ms * 1000 if scale is None else cutoff_ms // scale
-        return greater_than(field, cutoff)
+        # rows with a NULL time field are KEPT, never silently expired: the
+        # reference's contract is that the field must be non-null
+        # (RecordLevelExpire.java:86-87 checkArgument) — eval would collapse
+        # NULL > cutoff to False and permanently drop the row otherwise
+        return or_(greater_than(field, cutoff), is_null(field))
 
     def read_bucket(
         self,

@@ -133,6 +133,27 @@ class FileIO:
         return io.BytesIO(self.read_bytes(path))
 
 
+def _rename_noreplace(src: str, dst: str) -> bool:
+    """renameat2(AT_FDCWD, src, AT_FDCWD, dst, RENAME_NOREPLACE): atomically
+    publish src at dst iff dst does not exist. True on win, False when dst
+    already exists, OSError when the kernel/filesystem lacks the flag."""
+    import ctypes
+    import errno as _errno
+
+    libc = ctypes.CDLL(None, use_errno=True)
+    AT_FDCWD = -100
+    RENAME_NOREPLACE = 1
+    r = libc.renameat2(
+        AT_FDCWD, os.fsencode(src), AT_FDCWD, os.fsencode(dst), RENAME_NOREPLACE
+    )
+    if r == 0:
+        return True
+    e = ctypes.get_errno()
+    if e == _errno.EEXIST:
+        return False
+    raise OSError(e, os.strerror(e))
+
+
 class LocalFileIO(FileIO):
     """Local/POSIX filesystem. os.rename within one FS is atomic; we emulate
     rename-fails-if-exists with os.link+unlink to get true no-clobber CAS."""
@@ -183,10 +204,44 @@ class LocalFileIO(FileIO):
         except FileExistsError:
             return False
         except OSError:
-            # filesystems without hard links: best-effort non-clobber rename
-            if os.path.exists(d):
+            if os.path.isdir(s):
+                # directory rename (catalog-level, not the commit CAS):
+                # os.rename refuses to clobber a non-empty dst on POSIX
+                if os.path.exists(d):
+                    return False
+                os.rename(s, d)
+                return True
+            # Filesystems without hard links (some FUSE/NFS mounts). Two
+            # invariants must survive: (a) CAS — exactly one of N racing
+            # committers wins; (b) dst only ever appears FULLY formed (a
+            # reader polling for snapshot-N must never parse a partial
+            # file, and a crash must never wedge the path). So: stage a
+            # complete same-directory copy, then publish it with
+            # renameat2(RENAME_NOREPLACE) — one atomic syscall does both.
+            import shutil
+
+            tmp = f"{d}.tmp-{uuid.uuid4().hex}"
+            shutil.copyfile(s, tmp)
+            tf = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(tf)
+            finally:
+                os.close(tf)
+            try:
+                won = _rename_noreplace(tmp, d)
+            except OSError:
+                # kernel/FS without renameat2 flags: content atomicity still
+                # holds (rename of a complete temp), exclusivity degrades to
+                # best-effort check-then-rename
+                if os.path.exists(d):
+                    won = False
+                else:
+                    os.rename(tmp, d)
+                    won = True
+            if not won:
+                os.unlink(tmp)
                 return False
-            os.rename(s, d)
+            os.unlink(s)
             return True
         os.unlink(s)
         return True

@@ -157,7 +157,10 @@ def distributed_merge_step(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndar
     buckets sharded over "bucket" (pure data parallel), each bucket's rows
     sharded over "key" (range exchange + local merge). Shapes:
     key_lanes (B, n, K), seq_lanes (B, n, S), pad (B, n); B divisible by the
-    bucket axis, n by the key axis."""
+    bucket axis, n by the key axis. Returns (out_key_lanes, out_seq_lanes,
+    perm, merged_valid) all in the post-exchange sorted coordinate system, so
+    callers can check not just WHICH keys survived but which sequence number
+    (i.e. which original row) won each key's merge."""
     b, n, k = key_lanes.shape
     s = seq_lanes.shape[2]
     p_key = mesh.shape["key"]
@@ -169,7 +172,7 @@ def distributed_merge_step(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndar
             perm, _, keep_last, _ = _local_plan(k, s, rk, rs, rp)
             merged_valid = keep_last & (rp[perm] == 0)
             # sorted order: lanes[i] corresponds to merged_valid[i]
-            return rk[:, perm].T, perm, merged_valid
+            return rk[:, perm].T, rs[:, perm].T, perm, merged_valid
 
         return jax.vmap(one_bucket)(kl, sl, pf)
 
@@ -179,6 +182,11 @@ def distributed_merge_step(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndar
         shard_fn,
         mesh=mesh,
         in_specs=(P("bucket", "key", None), P("bucket", "key", None), P("bucket", "key")),
-        out_specs=(P("bucket", "key", None), P("bucket", "key"), P("bucket", "key")),
+        out_specs=(
+            P("bucket", "key", None),
+            P("bucket", "key", None),
+            P("bucket", "key"),
+            P("bucket", "key"),
+        ),
     )
     return jax.jit(fn)(key_lanes, seq_lanes, pad)

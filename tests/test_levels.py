@@ -103,3 +103,35 @@ def test_universal_below_trigger_no_pick():
     uc = UniversalCompaction(num_run_compaction_trigger=5)
     runs = [(0, SortedRun([f("a", 0, 1, 0)]))]
     assert uc.pick(5, runs) is None
+
+
+def test_universal_unit_absorbs_occupied_level():
+    """Round-2 advisor fix: when size-ratio stops right before a level-1 run,
+    the tentative output level (1) is already occupied by an excluded run —
+    the unit must absorb it (reference UniversalCompaction.createUnit:179-205)
+    instead of producing two overlapping level-1 runs."""
+    uc = UniversalCompaction(max_size_amp_percent=10_000_000, size_ratio_percent=1, num_run_compaction_trigger=4)
+    runs = [(0, SortedRun([f(f"l0{i}", 0, 1, 0, size=100, seq=10 - i)])) for i in range(5)]
+    runs.append((1, SortedRun([f("l1", 0, 1, 1, size=600)])))
+    unit = uc.pick(3, runs)
+    assert unit is not None
+    # the level-1 run is inside the unit, and everything got absorbed -> max level
+    assert sorted(x.file_name for x in unit.files) == ["l00", "l01", "l02", "l03", "l04", "l1"]
+    assert unit.output_level == 2
+
+
+def test_universal_unit_outputs_at_first_nonzero_level():
+    """Absorption stops at the first non-zero-level run and outputs AT its
+    level; deeper runs stay out of the unit."""
+    uc = UniversalCompaction(max_size_amp_percent=10_000_000, size_ratio_percent=1, num_run_compaction_trigger=3)
+    runs = [
+        (0, SortedRun([f("a", 0, 1, 0, size=100, seq=3)])),
+        (0, SortedRun([f("b", 0, 1, 0, size=100, seq=2)])),
+        (0, SortedRun([f("big", 0, 1, 0, size=10_000, seq=1)])),
+        (1, SortedRun([f("c", 0, 1, 1, size=20_000)])),
+        (3, SortedRun([f("deep", 0, 1, 3, size=10_000_000)])),
+    ]
+    unit = uc.pick(4, runs)
+    assert unit is not None
+    assert sorted(x.file_name for x in unit.files) == ["a", "b", "big", "c"]
+    assert unit.output_level == 1

@@ -56,16 +56,23 @@ def test_distributed_merge_step_matches_oracle(rng):
     kl = np.stack([lanes_for(keys[b].ravel()).reshape(n, 1) for b in range(B)])
     sl = np.stack([seq_lanes_for(seq[b]).reshape(n, 1) for b in range(B)])
     pad = np.zeros((B, n), dtype=np.uint32)
-    out_lanes, perm, merged_valid = distributed_merge_step(mesh, kl, sl, pad)
-    out_lanes, perm, merged_valid = map(np.asarray, (out_lanes, perm, merged_valid))
+    out_lanes, out_seqs, perm, merged_valid = distributed_merge_step(mesh, kl, sl, pad)
+    out_lanes, out_seqs, merged_valid = map(np.asarray, (out_lanes, out_seqs, merged_valid))
     p_key = 4
     assert out_lanes.shape == (B, p_key * n, 1)
     for b in range(B):
         # selected lane values across all key-shards == sorted unique keys
         sel = out_lanes[b][:, 0][merged_valid[b]]
-        got = np.sort(sel)
+        sel_seq = out_seqs[b][:, 0][merged_valid[b]]
+        order = np.argsort(sel, kind="stable")
+        got, got_seq = sel[order], sel_seq[order]
         expect = np.unique(kl[b][:, 0])
         assert got.tolist() == expect.tolist(), b
+        # and each key's winner carries the highest seq for that key
+        winners = {}
+        for kv, sq in zip(kl[b][:, 0].tolist(), seq[b].tolist()):
+            winners[kv] = max(winners.get(kv, -1), sq)
+        assert got_seq.tolist() == [winners[kv] for kv in expect.tolist()], b
 
 
 def test_range_partition_lanes_balance_and_order(rng):

@@ -316,3 +316,146 @@ def test_streaming_commit_messages_replay_safe(tmp_path):
     # crash-replay with a REBUILT committable: must be a no-op
     assert tc.commit_messages(1, msgs) == []
     assert t.store.snapshot_manager.latest_snapshot().total_record_count == 1
+
+
+# ---------------------------------------------------------------------------
+# round-2 advisor findings
+# ---------------------------------------------------------------------------
+
+
+def _aux_write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _aux_read(t):
+    rb = t.new_read_builder()
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+def test_record_expire_keeps_null_time_rows(tmp_warehouse):
+    """Rows whose record-level-expire time field is NULL must be kept, not
+    silently dropped (reference RecordLevelExpire non-null contract)."""
+    import time
+
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="rexp")
+    t = cat.create_table(
+        "db.rexpnull",
+        RowType.of(("id", BIGINT()), ("created", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "record-level.expire-time.ms": "3600000",
+            "record-level.time-field": "created",
+        },
+    )
+    now_s = int(time.time())
+    _aux_write(t, {"id": [1, 2, 3], "created": [now_s, None, now_s - 7200], "v": [1.0, 2.0, 3.0]})
+    out = sorted(r[0] for r in _aux_read(t).to_pylist())
+    assert out == [1, 2]  # fresh + NULL kept; only the 2h-old row expires
+
+
+def test_rename_cas_without_hardlinks(tmp_path, monkeypatch):
+    """When os.link is unavailable the fallback must stay compare-and-swap:
+    a dst created between the exists-check and the rename must NOT be
+    clobbered (advisor: check-then-rename loses a concurrent commit)."""
+    import os as _os
+
+    from paimon_tpu.fs import LocalFileIO
+
+    def no_link(src, dst, **kw):
+        raise OSError("hard links not supported")
+
+    monkeypatch.setattr(_os, "link", no_link)
+    io = LocalFileIO()
+    a, b, dst = str(tmp_path / "a"), str(tmp_path / "b"), str(tmp_path / "dst")
+    io.write_bytes(a, b"first")
+    io.write_bytes(b, b"second")
+    assert io.rename(a, dst) is True
+    assert io.read_bytes(dst) == b"first"
+    assert not io.exists(a)
+    # the loser must see False and leave the winner's bytes intact
+    assert io.rename(b, dst) is False
+    assert io.read_bytes(dst) == b"first"
+
+
+def test_expire_cleans_changelog_files(tmp_warehouse):
+    """Snapshot expiry must delete changelog manifests AND the changelog data
+    files of expired snapshots (advisor: they leaked forever)."""
+    import glob
+    import os as _os
+
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="clx")
+    t = cat.create_table(
+        "db.clx",
+        RowType.of(("id", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "changelog-producer": "input",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "1",
+            "snapshot.time-retained.ms": "0",
+        },
+    )
+    from paimon_tpu.table.write import TableCommit
+
+    for i in range(4):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write({"id": [1], "v": [float(i)]})
+        # suppress the automatic post-commit expiry so all 4 changelogs exist
+        TableCommit(t, expire_after_commit=False).commit_messages(
+            wb.COMMIT_IDENTIFIER, w.prepare_commit()
+        )
+    files_before = glob.glob(_os.path.join(t.path, "**", "changelog-*"), recursive=True)
+    assert len(files_before) == 4
+    expired = t.expire_snapshots()
+    assert expired == 3
+    files_after = glob.glob(_os.path.join(t.path, "**", "changelog-*"), recursive=True)
+    assert len(files_after) == 1  # only the retained snapshot's changelog remains
+    # data is intact
+    assert _aux_read(t).to_pylist() == [(1, 3.0)]
+
+
+def test_expire_hint_stops_at_protected_snapshot(tmp_warehouse):
+    """A tagged snapshot inside the expired range survives, and the EARLIEST
+    hint must point at it — not past it (advisor: stale snapshots became
+    unreachable once unprotected)."""
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="hint")
+    t = cat.create_table(
+        "db.hint",
+        RowType.of(("id", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "1",
+            "snapshot.time-retained.ms": "0",
+        },
+    )
+    from paimon_tpu.table.write import TableCommit
+
+    for i in range(5):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write({"id": [1], "v": [float(i)]})
+        TableCommit(t, expire_after_commit=False).commit_messages(
+            wb.COMMIT_IDENTIFIER, w.prepare_commit()
+        )
+    t.create_tag("keep", snapshot_id=2)
+    t.expire_snapshots()
+    sm = t.store.snapshot_manager
+    assert sm.snapshot_exists(2)  # protected by the tag
+    assert sm.earliest_snapshot_id() == 2  # hint NOT advanced past it
