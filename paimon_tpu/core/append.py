@@ -170,6 +170,22 @@ class AppendOnlyWriter:
         if self.compact_manager is not None:
             self._maybe_compact(full=full)
 
+    # mesh-batch protocol shims: append writes have no device merge to batch
+    def flush_dispatch(self):
+        self.flush()
+        return None
+
+    def flush_complete(self, state) -> None:  # pragma: no cover - no-op
+        pass
+
+    def compact_dispatch(self, full: bool = False):
+        if self.compact_manager is not None:
+            self._maybe_compact(full=full)
+        return None
+
+    def compact_complete(self, state) -> None:
+        pass
+
     def prepare_commit(self) -> CommitMessage:
         self.flush()
         # files created AND consumed by compaction within this commit cancel

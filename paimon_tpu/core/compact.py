@@ -170,10 +170,16 @@ class MergeTreeCompactRewriter:
         self, sections: list[list[SortedRun]], output_level: int, drop_delete: bool
     ) -> tuple[list[DataFileMeta], list[DataFileMeta]]:
         """Returns (new files, changelog files)."""
+        return self.rewrite_complete(self.rewrite_dispatch(sections, output_level), output_level, drop_delete)
+
+    def rewrite_dispatch(self, sections: list[list[SortedRun]], output_level: int):
+        """Phase 1: read every section's runs and dispatch their merges.
+        Under a MeshBatchContext the merges of ALL sections (and all buckets
+        whose compactions dispatched in the same batch window) execute in one
+        shard_map over the mesh."""
+        jobs = []
         from .read import order_runs_for_merge
 
-        out: list[DataFileMeta] = []
-        changelog: list[DataFileMeta] = []
         for section in sections:
             runs, seq_ascending = order_runs_for_merge(section)
             batches = []
@@ -185,7 +191,17 @@ class MergeTreeCompactRewriter:
                     if f.level == output_level:
                         old_top.append(b)
             kv = KVBatch.concat(batches)
-            merged = self.merge.merge(kv, seq_ascending=seq_ascending)
+            jobs.append((self.merge.merge_async(kv, seq_ascending=seq_ascending), old_top))
+        return jobs
+
+    def rewrite_complete(
+        self, jobs, output_level: int, drop_delete: bool
+    ) -> tuple[list[DataFileMeta], list[DataFileMeta]]:
+        """Phase 2: resolve merges, emit changelog, write output files."""
+        out: list[DataFileMeta] = []
+        changelog: list[DataFileMeta] = []
+        for handle, old_top in jobs:
+            merged = self.merge.merge_resolve(handle)
             if drop_delete:
                 merged = merged.drop_deletes()
             if self.emit_full_changelog and drop_delete:
@@ -242,13 +258,18 @@ class MergeTreeCompactManager:
 
         g = registry.group("compaction")
         with timed(g.histogram("duration_ms")):
-            result = self._trigger(full)
+            state = self.compact_dispatch(full)
+            result = self.compact_complete(state)
         if result is not None and not result.is_empty():
             g.counter("compactions").inc()
             g.counter("files_rewritten").inc(len(result.before))
         return result
 
-    def _trigger(self, full: bool) -> CompactResult | None:
+    def compact_dispatch(self, full: bool = False):
+        """Phase 1: pick the unit, classify upgrade-vs-rewrite (reference
+        MergeTreeCompactTask.doCompact), read inputs and dispatch the section
+        merges. Returns opaque state for compact_complete, or None when
+        nothing to compact."""
         runs = self.levels.level_sorted_runs()
         if full:
             unit = self.strategy.force_full(self.levels.num_levels, runs)
@@ -259,13 +280,6 @@ class MergeTreeCompactManager:
         # drop deletes iff the output is the highest non-empty level's floor
         # (reference MergeTreeCompactManager.triggerCompaction :148-158)
         drop_delete = unit.output_level != 0 and unit.output_level >= self.levels.non_empty_highest_level()
-        result = self._do_compact(unit, drop_delete)
-        if result is not None and not result.is_empty():
-            self.levels.update(result.before, result.after)
-        return result
-
-    def _do_compact(self, unit: CompactUnit, drop_delete: bool) -> CompactResult:
-        """Upgrade-vs-rewrite (reference MergeTreeCompactTask.doCompact)."""
         result = CompactResult()
         sections = IntervalPartition(unit.files).partition()
         rewrite_sections: list[list[SortedRun]] = []
@@ -291,12 +305,22 @@ class MergeTreeCompactManager:
                         rewrite_sections.append([SortedRun([f])])
             else:
                 rewrite_sections.append(section)
+        jobs = self.rewriter.rewrite_dispatch(rewrite_sections, unit.output_level) if rewrite_sections else []
+        return (unit, drop_delete, result, rewrite_sections, jobs)
+
+    def compact_complete(self, state) -> CompactResult | None:
+        """Phase 2: resolve section merges, write outputs, update Levels."""
+        if state is None:
+            return None
+        unit, drop_delete, result, rewrite_sections, jobs = state
         if rewrite_sections:
             flat_before = [f for sec in rewrite_sections for r in sec for f in r.files]
-            after, changelog = self.rewriter.rewrite(rewrite_sections, unit.output_level, drop_delete)
+            after, changelog = self.rewriter.rewrite_complete(jobs, unit.output_level, drop_delete)
             result.before.extend(flat_before)
             result.after.extend(after)
             result.changelog.extend(changelog)
+        if not result.is_empty():
+            self.levels.update(result.before, result.after)
         return result
 
     @staticmethod

@@ -121,32 +121,57 @@ class MergeExecutor:
         flushes and for runs with disjoint seq ranges concatenated in seq
         order) — the kernel then skips uploading sequence lanes entirely.
         """
+        return self.merge_resolve(self.merge_async(kv, seq_ascending))
+
+    def merge_async(self, kv: KVBatch, seq_ascending: bool = False):
+        """Dispatch half of merge(). When a MeshBatchContext is active, the
+        bucket's merge becomes a job — every job dispatched in the batch
+        window runs in one shard_map over the mesh at the first resolve;
+        without a context the merge computes eagerly inside the handle. One
+        copy of the preamble (ignore-delete, sorted-unique shortcut, lane
+        encoding) serves both paths, so mesh and single-device execution
+        cannot diverge. Resolve with merge_resolve()."""
+        from ..options import SortEngine
+        from ..parallel.executor import current_mesh_context
+
+        ctx = current_mesh_context()
         if kv.num_rows == 0:
-            return kv
+            return ("sync", kv)
         if self.options.ignore_delete:
             keep = kv.kind != int(RowKind.DELETE)
             if not keep.all():
                 kv = kv.filter(keep)
                 if kv.num_rows == 0:
-                    return kv
+                    return ("sync", kv)
         if self.engine == MergeEngine.DEDUPLICATE:
-            from ..options import SortEngine
-
             lanes = self._key_lanes(kv)
             if self._strictly_increasing(lanes):
                 # already key-sorted with unique keys (bulk loads, replayed
                 # sorted runs): dedup is the identity — skip the device trip
                 # (sequence lanes are never built on this path)
-                return kv
+                return ("sync", kv)
             seq_lanes = self._seq_lanes(kv, seq_ascending)
             if self.options.sort_engine == SortEngine.NUMPY:
-                return kv.take(_numpy_dedup_select(lanes, seq_lanes))
+                return ("sync", kv.take(_numpy_dedup_select(lanes, seq_lanes)))
+            if ctx is not None:
+                return ("dedup", ctx, ctx.submit_dedup(lanes, seq_lanes), kv)
             backend = "pallas" if self.options.sort_engine == SortEngine.PALLAS else "xla"
             from ..ops.merge import deduplicate_resolve, deduplicate_select_async
 
-            return kv.take(deduplicate_resolve(deduplicate_select_async(lanes, seq_lanes, backend=backend)))
-        plan = self._plan(kv, seq_ascending)
-        return self._merge_with_plan(kv, plan)
+            return ("sync", kv.take(deduplicate_resolve(deduplicate_select_async(lanes, seq_lanes, backend=backend))))
+        lanes, seq_lanes = self._lanes(kv, seq_ascending)
+        if ctx is not None and self.options.sort_engine != SortEngine.NUMPY:
+            return ("plan", ctx, ctx.submit_plan(lanes, seq_lanes), kv)
+        return ("sync", self._merge_with_plan(kv, merge_plan(lanes, seq_lanes)))
+
+    def merge_resolve(self, handle) -> KVBatch:
+        tag = handle[0]
+        if tag == "sync":
+            return handle[1]
+        _, ctx, job_id, kv = handle
+        if tag == "dedup":
+            return kv.take(ctx.result(job_id))
+        return self._merge_with_plan(kv, ctx.result(job_id))
 
     def supports_keys_only_pipeline(self) -> bool:
         """True when merge needs only (key cols, seq, kind) to pick winners —

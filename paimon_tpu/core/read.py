@@ -62,6 +62,25 @@ class MergeFileSplitRead:
     ) -> ColumnBatch:
         """Merge-read one bucket's files. Returns the value rows (projected),
         key-sorted within each section."""
+        return self.read_split_dispatch(files, predicate, projection, drop_delete, deletion_vectors)()
+
+    def read_split_dispatch(
+        self,
+        files: list[DataFileMeta],
+        predicate: Predicate | None = None,
+        projection: Sequence[str] | None = None,
+        drop_delete: bool = True,
+        deletion_vectors: dict | None = None,
+    ):
+        """Phase 1 of the (possibly mesh-batched) merge-read: read the
+        section inputs and dispatch their merges; returns a zero-arg
+        continuation producing the final ColumnBatch. Under an active
+        MeshBatchContext, the merges of every split dispatched in the same
+        batch window execute as ONE shard_map over the mesh's bucket axis —
+        the TPU equivalent of the reference shipping one split per task
+        (MergeTreeSplitGenerator.java:38)."""
+        from ..parallel.executor import current_mesh_context
+
         key_parts = []
         if predicate is not None:
             parts = PredicateBuilder.split_and(predicate)
@@ -70,39 +89,54 @@ class MergeFileSplitRead:
 
         dvs = deletion_vectors or {}
         sections = IntervalPartition(files).partition()
-        out: list[ColumnBatch] = []
+        section_conts = []
         for section in sections:
             if len(section) == 1:
                 # single sorted run: keys are unique — no merge needed; full
                 # predicate pushdown is safe (reference RawFileSplitRead)
                 kv_parts = [self._read_file(f, predicate, dvs) for f in section[0].files]
                 kv = KVBatch.concat(kv_parts)
+                section_conts.append(lambda kv=kv: kv)
             else:
                 runs, seq_ascending = order_runs_for_merge(section)
                 ordered_files = [f for run in runs for f in run.files]
                 has_dv = any(f.file_name in dvs for f in ordered_files)
-                if self.merge.supports_keys_only_pipeline() and not has_dv:
+                if (
+                    current_mesh_context() is None
+                    and self.merge.supports_keys_only_pipeline()
+                    and not has_dv
+                ):
+                    # single-device: overlap host decode with the device sort
                     kv = self._pipelined_dedup(ordered_files, key_filter, seq_ascending)
+                    section_conts.append(lambda kv=kv: kv)
                 else:
                     batches = [self._read_file(f, key_filter, dvs) for f in ordered_files]
                     kv = KVBatch.concat(batches)
-                    kv = self.merge.merge(kv, seq_ascending=seq_ascending)
-            if drop_delete:
-                kv = kv.drop_deletes()
-            data = kv.data
-            if predicate is not None and data.num_rows:
-                mask = predicate.eval(data)
-                if not mask.all():
-                    data = data.filter(mask)
-            if projection is not None:
-                data = data.select(projection)
-            out.append(data)
-        if not out:
-            schema = self.reader_factory.read_schema
-            if projection is not None:
-                schema = schema.project(projection)
-            return ColumnBatch.empty(schema)
-        return concat_batches(out)
+                    handle = self.merge.merge_async(kv, seq_ascending=seq_ascending)
+                    section_conts.append(lambda h=handle: self.merge.merge_resolve(h))
+
+        def complete() -> ColumnBatch:
+            out: list[ColumnBatch] = []
+            for cont in section_conts:
+                kv = cont()
+                if drop_delete:
+                    kv = kv.drop_deletes()
+                data = kv.data
+                if predicate is not None and data.num_rows:
+                    mask = predicate.eval(data)
+                    if not mask.all():
+                        data = data.filter(mask)
+                if projection is not None:
+                    data = data.select(projection)
+                out.append(data)
+            if not out:
+                schema = self.reader_factory.read_schema
+                if projection is not None:
+                    schema = schema.project(projection)
+                return ColumnBatch.empty(schema)
+            return concat_batches(out)
+
+        return complete
 
     def _read_file(self, f: DataFileMeta, predicate, dvs: dict) -> KVBatch:
         """Read one file, applying its deletion vector if present. DV

@@ -200,13 +200,30 @@ class KeyValueFileStore:
         drop_delete: bool = True,
         deletion_vectors: dict | None = None,
     ):
+        return self.read_bucket_dispatch(
+            partition, bucket, files, predicate, projection, drop_delete, deletion_vectors
+        )()
+
+    def read_bucket_dispatch(
+        self,
+        partition: tuple,
+        bucket: int,
+        files: list[DataFileMeta],
+        predicate=None,
+        projection: Sequence[str] | None = None,
+        drop_delete: bool = True,
+        deletion_vectors: dict | None = None,
+    ):
+        """Two-phase read_bucket for mesh execution: returns a continuation;
+        the merge jobs of all buckets dispatched in one MeshBatchContext run
+        in a single batched shard_map."""
         expire = self.record_expire_predicate()
         if expire is not None:
             from ..data.predicate import and_
 
             predicate = expire if predicate is None else and_(predicate, expire)
         read = MergeFileSplitRead(self.reader_factory(partition, bucket), self.merge_executor(), self.key_names)
-        return read.read_split(files, predicate, projection, drop_delete, deletion_vectors)
+        return read.read_split_dispatch(files, predicate, projection, drop_delete, deletion_vectors)
 
 
 class AppendOnlyFileStore(KeyValueFileStore):
@@ -270,3 +287,9 @@ class AppendOnlyFileStore(KeyValueFileStore):
             schema = self.value_schema if projection is None else self.value_schema.project(projection)
             return ColumnBatch.empty(schema)
         return concat_batches(out)
+
+    def read_bucket_dispatch(self, *args, **kwargs):
+        """Append reads have no merge to batch: the continuation just wraps
+        the eager concat read."""
+        out = self.read_bucket(*args, **kwargs)
+        return lambda: out

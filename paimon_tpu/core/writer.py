@@ -82,8 +82,17 @@ class MergeTreeWriter:
 
     # ---- flush ---------------------------------------------------------
     def flush(self) -> None:
+        state = self.flush_dispatch()
+        if state is not None:
+            self.flush_complete(state)
+
+    def flush_dispatch(self):
+        """Phase 1 of a (possibly mesh-batched) flush: drain the memtable,
+        persist the input changelog, and dispatch the merge. Under an active
+        MeshBatchContext the merge job is only enqueued — every bucket's job
+        runs in one batched mesh call when the first flush_complete resolves."""
         if not self._buffer:
-            return
+            return None
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
         self._buffer.clear()
         self._buffered_rows = 0
@@ -100,8 +109,18 @@ class MergeTreeWriter:
             )
         # memtable rows arrive in seq order: stability replaces seq lanes
         buffer_seq_ordered = self._buffer_seq_ordered
-        merged = self.merge.merge(kv, seq_ascending=buffer_seq_ordered)
+        handle = self.merge.merge_async(kv, seq_ascending=buffer_seq_ordered)
         self._buffer_seq_ordered = True
+        return (handle, buffer_seq_ordered)
+
+    def flush_complete(self, state) -> None:
+        """Phase 2: resolve the merge and write level-0 files + changelog,
+        then trigger compaction."""
+        handle, buffer_seq_ordered = state
+        merged = self.merge.merge_resolve(handle)
+        from ..options import ChangelogProducer
+
+        producer = self.options.changelog_producer
         if producer == ChangelogProducer.LOOKUP:
             # exact changelog at WRITE time: look up the previous visible
             # value of each incoming key (reference LookupChangelogMerge-
@@ -171,6 +190,17 @@ class MergeTreeWriter:
         self.flush()
         if self.compact_manager is not None:
             self._maybe_compact(full=full)
+
+    def compact_dispatch(self, full: bool = False):
+        """Phase 1 of an explicit compaction (caller must have flushed)."""
+        if self.compact_manager is None:
+            return None
+        return self.compact_manager.compact_dispatch(full)
+
+    def compact_complete(self, state) -> None:
+        if state is None or self.compact_manager is None:
+            return
+        self._absorb(self.compact_manager.compact_complete(state))
 
     def _absorb(self, result: CompactResult | None) -> None:
         if result is None or result.is_empty():

@@ -141,9 +141,12 @@ def _rename_noreplace(src: str, dst: str) -> bool:
     import errno as _errno
 
     libc = ctypes.CDLL(None, use_errno=True)
+    renameat2 = getattr(libc, "renameat2", None)
+    if renameat2 is None:  # libc without the symbol (macOS, old glibc/musl)
+        raise OSError(_errno.ENOSYS, "renameat2 not available")
     AT_FDCWD = -100
     RENAME_NOREPLACE = 1
-    r = libc.renameat2(
+    r = renameat2(
         AT_FDCWD, os.fsencode(src), AT_FDCWD, os.fsencode(dst), RENAME_NOREPLACE
     )
     if r == 0:

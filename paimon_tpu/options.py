@@ -189,6 +189,19 @@ class CoreOptions:
     MERGE_ENGINE = ConfigOption.enum("merge-engine", MergeEngine, MergeEngine.DEDUPLICATE, "How same-key records merge.")
     IGNORE_DELETE = ConfigOption.bool_("ignore-delete", False, "Ignore -D records on write/merge.")
     SORT_ENGINE = ConfigOption.enum("sort-engine", SortEngine, SortEngine.XLA_SEGMENTED, "Merge kernel backend.")
+    PARALLEL_MESH_ENABLED = ConfigOption.bool_(
+        "parallel.mesh.enabled",
+        False,
+        "Execute write flush / compaction rewrite / merge-read over the device "
+        "mesh: per-bucket merge jobs batch into one shard_map over the bucket "
+        "axis; oversized buckets range-shuffle over the key axis.",
+    )
+    PARALLEL_KEY_AXIS_ROWS = ConfigOption.int_(
+        "parallel.key-axis.rows",
+        4 * 1024 * 1024,
+        "Row threshold above which one bucket's merge is range-partitioned "
+        "over the mesh's key axis instead of running on a single device.",
+    )
     CHANGELOG_PRODUCER = ConfigOption.enum(
         "changelog-producer", ChangelogProducer, ChangelogProducer.NONE, "How changelog files are produced."
     )

@@ -9,6 +9,7 @@ IntervalPartition), DataTableBatchScan with time travel via scan options
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Sequence
 
@@ -168,6 +169,11 @@ class TableScan:
         return splits
 
 
+@contextmanager
+def _null_ctx():
+    yield None
+
+
 class TableRead:
     def __init__(
         self,
@@ -210,6 +216,13 @@ class TableRead:
     def read(self, split: DataSplit):
         if split.is_changelog:
             return self.read_with_kinds(split)[0]
+        out = self._dispatch(split)()
+        if self.limit is not None and out.num_rows > self.limit:
+            out = out.slice(0, self.limit)
+        return out
+
+    def _dispatch(self, split: DataSplit):
+        """Phase-1 read of one data split: returns a continuation."""
         dvs = None
         if split.dv_index_file:
             from ..core.deletionvectors import DeletionVectorsIndexFile
@@ -217,7 +230,7 @@ class TableRead:
             all_dvs = DeletionVectorsIndexFile(self.table.file_io, self.table.path).read_all(split.dv_index_file)
             names = {f.file_name for f in split.files}
             dvs = {k: v for k, v in all_dvs.items() if k in names}
-        out = self.table.store.read_bucket(
+        return self.table.store.read_bucket_dispatch(
             split.partition,
             split.bucket,
             split.files,
@@ -225,25 +238,36 @@ class TableRead:
             projection=self.projection,
             deletion_vectors=dvs,
         )
-        if self.limit is not None and out.num_rows > self.limit:
-            out = out.slice(0, self.limit)
-        return out
 
     def read_all(self, splits: Sequence[DataSplit]):
         from ..data.batch import concat_batches
+        from ..parallel.executor import maybe_mesh_batch
 
         schema = self.table.row_type if self.projection is None else self.table.row_type.project(self.projection)
         batches = []
         remaining = self.limit
-        for s in splits:
-            b = self.read(s)
-            if remaining is not None:
-                if remaining <= 0:
-                    break
-                if b.num_rows > remaining:
-                    b = b.slice(0, remaining)
-                remaining -= b.num_rows
-            batches.append(b)
+        # a limit wants early-exit split by split — dispatching every split
+        # up front would turn a point query into a full scan, so limited
+        # reads stay on the sequential path
+        use_mesh = remaining is None
+        with maybe_mesh_batch(self.table.store) if use_mesh else _null_ctx() as ctx:
+            if ctx is not None:
+                # mesh mode: dispatch every split first — their merges run as
+                # one batched shard_map over the bucket axis — then complete
+                pending = [(s, self._dispatch(s)) for s in splits if not s.is_changelog]
+                conts = dict((id(s), c) for s, c in pending)
+            for s in splits:
+                if ctx is not None and not s.is_changelog:
+                    b = conts[id(s)]()
+                else:
+                    b = self.read(s)
+                if remaining is not None:
+                    if remaining <= 0:
+                        break
+                    if b.num_rows > remaining:
+                        b = b.slice(0, remaining)
+                    remaining -= b.num_rows
+                batches.append(b)
         if not batches:
             from ..data.batch import ColumnBatch
 

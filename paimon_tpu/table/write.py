@@ -120,19 +120,44 @@ class TableWrite:
 
     def compact(self, full: bool = False) -> None:
         """Compact every bucket this write touched — or, when no rows were
-        written (dedicated compact job), every live bucket of the table."""
+        written (dedicated compact job), every live bucket of the table.
+        Under parallel.mesh.enabled the per-bucket flushes and rewrite merges
+        batch into shard_map calls over the mesh (the TPU analog of the
+        reference's one-compaction-task-per-bucket topology)."""
         if not self._writers:
             plan = self.table.store.new_scan().plan()
             for partition, buckets in plan.grouped().items():
                 for bucket in buckets:
                     self._writer(partition, bucket)
-        for w in self._writers.values():
-            w.compact(full=full)
+        from ..parallel.executor import maybe_mesh_batch
+
+        with maybe_mesh_batch(self.table.store) as ctx:
+            if ctx is None:
+                for w in self._writers.values():
+                    w.compact(full=full)
+                return
+            self._batched_flush()
+            states = [(w, w.compact_dispatch(full)) for w in self._writers.values()]
+            for w, st in states:
+                w.compact_complete(st)
+
+    def _batched_flush(self) -> None:
+        """Dispatch every writer's memtable flush, then complete: the merges
+        run in one batched mesh call (reference: one writer task per bucket)."""
+        states = [(w, w.flush_dispatch()) for w in self._writers.values()]
+        for w, st in states:
+            if st is not None:
+                w.flush_complete(st)
 
     def prepare_commit(self) -> list[CommitMessage]:
         if self._cross is not None:
             return self._cross.prepare_commit()
-        msgs = [m for m in (w.prepare_commit() for w in self._writers.values()) if not m.is_empty()]
+        from ..parallel.executor import maybe_mesh_batch
+
+        with maybe_mesh_batch(self.table.store) as ctx:
+            if ctx is not None:
+                self._batched_flush()
+            msgs = [m for m in (w.prepare_commit() for w in self._writers.values()) if not m.is_empty()]
         if self._assigner is not None:
             by_pb = {(m.partition, m.bucket): m for m in msgs}
             for partition, entries in self._assigner.prepare_commit().items():
