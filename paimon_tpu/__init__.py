@@ -19,6 +19,28 @@ Layering (mirrors the reference's L0..L7):
   catalog/  L4  catalog + warehouse layout
 """
 
+def _enable_x64() -> None:
+    """64-bit jax mode, package-wide. Without it jnp.asarray silently
+    truncates int64 columns to int32 (corrupting BIGINT sums past 2^31) and
+    float64 to float32 (~1e-7 relative error on DOUBLE sums). The sort/merge
+    kernels are explicit-uint32 and unaffected; aggregation gains exact i64
+    everywhere and exact f64 on CPU. TPUs have no native f64 — those
+    reductions fall back to an exact host path (ops/aggregates.py).
+
+    jax is NOT imported eagerly: metadata-only users (catalog browsing,
+    options parsing) shouldn't pay backend init. The env var configures a
+    later import; the config call covers an already-imported jax."""
+    import os
+    import sys
+
+    if "jax" in sys.modules:
+        sys.modules["jax"].config.update("jax_enable_x64", True)
+    else:
+        os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+
+_enable_x64()
+
 from .types import (
     BIGINT,
     BOOLEAN,

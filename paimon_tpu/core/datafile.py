@@ -113,6 +113,7 @@ class KeyValueFileWriterFactory:
         bloom_columns: Sequence[str] = (),
         bloom_fpp: float = 0.05,
         keyed: bool = True,
+        format_options: dict | None = None,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
@@ -128,6 +129,7 @@ class KeyValueFileWriterFactory:
         # _SEQUENCE_NUMBER/_VALUE_KIND columns, no key range
         # (reference AppendOnlyFileStore / AppendOnlyWriter)
         self.keyed = keyed
+        self.format_options = format_options or {}
 
     def _estimate_row_bytes(self, batch: ColumnBatch) -> int:
         total = 0
@@ -180,7 +182,7 @@ class KeyValueFileWriterFactory:
         name = new_file_name(prefix, self.format_id)
         path = f"{self.bucket_dir}/{name}"
         disk = kv.to_disk_batch() if self.keyed else kv.data
-        fmt.write(self.file_io, path, disk, self.compression)
+        fmt.write(self.file_io, path, disk, self.compression, format_options=self.format_options)
         extra: list[str] = []
         if self.bloom_columns:
             from ..format.fileindex import write_file_index

@@ -162,6 +162,19 @@ class MergeExecutor:
         lanes, seq_lanes = self._lanes(kv, seq_ascending)
         if ctx is not None and self.options.sort_engine != SortEngine.NUMPY:
             return ("plan", ctx, ctx.submit_plan(lanes, seq_lanes), kv)
+        if self.options.sort_engine != SortEngine.NUMPY:
+            # single-device fast paths: sort + segment + engine selection in
+            # ONE kernel call (no plan download, no per-field round trips)
+            if self.engine == MergeEngine.PARTIAL_UPDATE and not self._sequence_groups():
+                return ("sync", self._partial_update_fused(kv, lanes, seq_lanes))
+            if self.engine == MergeEngine.AGGREGATE:
+                from ..ops.aggregates import fused_routable
+
+                fields = [f for f in self.value_schema.fields if f.name not in self.key_names]
+                specs = [self._agg_spec(f.name) for f in fields]
+                cols = [kv.data.column(f.name) for f in fields]
+                if fused_routable(specs, cols):
+                    return ("sync", self._aggregate_fused(kv, lanes, seq_lanes, fields, specs, cols))
         return ("sync", self._merge_with_plan(kv, merge_plan(lanes, seq_lanes)))
 
     def merge_resolve(self, handle) -> KVBatch:
@@ -233,14 +246,59 @@ class MergeExecutor:
                 groups[seq_col] = [s.strip() for s in str(value).split(",")]
         return groups
 
-    def _partial_update(self, kv: KVBatch, plan, last_take, out_seq) -> KVBatch:
-        remove_on_delete = self.options.options.get(CoreOptions.PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE)
+    def _check_partial_update_deletes(self, kv: KVBatch, remove_on_delete: bool) -> None:
         has_delete = np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE))).any()
         if has_delete and not remove_on_delete:
             raise ValueError(
                 "partial-update cannot handle -U/-D records; set "
                 "'partial-update.remove-record-on-delete' or 'ignore-delete'"
             )
+
+    def _partial_update_fused(self, kv: KVBatch, lanes, seq_lanes) -> KVBatch:
+        """Single-call partial-update (no sequence groups): the fused kernel
+        returns per-field sources + existence + winners in one device trip."""
+        from ..ops.merge import fused_partial_update
+
+        remove_on_delete = self.options.options.get(CoreOptions.PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE)
+        self._check_partial_update_deletes(kv, remove_on_delete)
+        fields = [f for f in self.value_schema.fields if f.name not in self.key_names]
+        field_valid = (
+            np.stack([kv.data.column(f.name).valid_mask() for f in fields])
+            if fields
+            else np.zeros((0, kv.num_rows), np.bool_)
+        )
+        src, exists, last_take = fused_partial_update(
+            lanes, seq_lanes, field_valid, kv.kind, remove_record_on_delete=remove_on_delete
+        )
+        cols: dict[str, Column] = {}
+        for k in self.key_names:
+            cols[k] = kv.data.column(k).take(last_take)
+        for fi, f in enumerate(fields):
+            cols[f.name] = _gather_column(kv.data.column(f.name), src[fi])
+        data = ColumnBatch(self.value_schema, cols)
+        # without remove-on-delete every row is +I/+U (checked above), so
+        # every segment exists; with it, vanished keys stay as -D rows
+        kind = np.where(exists, int(RowKind.INSERT), int(RowKind.DELETE)).astype(np.uint8)
+        return KVBatch(data, kv.seq.take(last_take), kind)
+
+    def _aggregate_fused(self, kv: KVBatch, lanes, seq_lanes, fields, specs, cols_in) -> KVBatch:
+        """Single-call aggregation: every column's segment reduction runs in
+        the same kernel as the sort."""
+        from ..ops.aggregates import fused_aggregate
+
+        agg_cols, last_take = fused_aggregate(lanes, seq_lanes, cols_in, specs, kv.kind)
+        cols: dict[str, Column] = {}
+        for k in self.key_names:
+            cols[k] = kv.data.column(k).take(last_take)
+        for f, c in zip(fields, agg_cols):
+            cols[f.name] = c
+        data = ColumnBatch(self.value_schema, cols)
+        kind = np.full(len(last_take), int(RowKind.INSERT), dtype=np.uint8)
+        return KVBatch(data, kv.seq.take(last_take), kind)
+
+    def _partial_update(self, kv: KVBatch, plan, last_take, out_seq) -> KVBatch:
+        remove_on_delete = self.options.options.get(CoreOptions.PARTIAL_UPDATE_REMOVE_RECORD_ON_DELETE)
+        self._check_partial_update_deletes(kv, remove_on_delete)
         groups = self._sequence_groups()
         grouped_fields = {f for fields in groups.values() for f in fields} | set(groups)
         non_key = [f for f in self.value_schema.fields if f.name not in self.key_names]

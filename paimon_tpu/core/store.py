@@ -82,6 +82,11 @@ class KeyValueFileStore:
             bloom_columns=[c.strip() for c in bloom_cols.split(",")] if bloom_cols else (),
             bloom_fpp=co.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             keyed=self.keyed,
+            format_options={
+                k: v
+                for k, v in co.options._data.items()
+                if k.startswith(("orc.", "parquet.", "avro."))
+            },
         )
 
     def reader_factory(self, partition: tuple, bucket: int, read_schema: RowType | None = None) -> KeyValueFileReaderFactory:

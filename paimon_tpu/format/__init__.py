@@ -48,6 +48,7 @@ class FileFormat:
         path: str,
         batch: ColumnBatch,
         compression: str = "zstd",
+        format_options: dict | None = None,
     ) -> None:
         raise NotImplementedError
 

@@ -93,7 +93,7 @@ def _avro_schema(schema: RowType) -> dict:
 class AvroFormat(FileFormat):
     identifier = "avro"
 
-    def write(self, file_io: FileIO, path: str, batch: ColumnBatch, compression: str = "deflate") -> None:
+    def write(self, file_io: FileIO, path: str, batch: ColumnBatch, compression: str = "deflate", format_options: dict | None = None) -> None:
         schema = batch.schema
         meta = {
             "avro.schema": json.dumps(_avro_schema(schema)).encode(),

@@ -1,10 +1,11 @@
-"""ORC read/write over pyarrow.
+"""ORC read/write over pyarrow, with stripe-statistics pruning.
 
 Parity: /root/reference/paimon-format/.../orc/OrcReaderFactory.java (batch
-decode into column vectors, SearchArgument pushdown). pyarrow exposes stripes
-but not stripe statistics, so pruning happens at file level (DataFileMeta
-stats) and via dense mask eval after decode; stripe iteration keeps memory
-bounded for large files.
+decode into column vectors, SearchArgument pushdown into the ORC reader).
+pyarrow decodes stripes but exposes no stripe statistics, so orc_meta.py
+reads them straight from the file tail; Predicate.test_stats then skips
+whole stripes before any decode — the same evaluator used for file- and
+parquet-row-group-level pruning.
 """
 
 from __future__ import annotations
@@ -21,14 +22,22 @@ from . import FileFormat, register_format
 class OrcFormat(FileFormat):
     identifier = "orc"
 
-    def write(self, file_io: FileIO, path: str, batch: ColumnBatch, compression: str = "zstd") -> None:
+    def write(
+        self,
+        file_io: FileIO,
+        path: str,
+        batch: ColumnBatch,
+        compression: str = "zstd",
+        format_options: dict | None = None,
+    ) -> None:
         import io as _io
 
         import pyarrow.orc as po
 
         table = batch.to_arrow()
         buf = _io.BytesIO()
-        po.write_table(table, buf, compression=compression)
+        stripe_size = int((format_options or {}).get("orc.stripe.size", 64 << 20))
+        po.write_table(table, buf, compression=compression, stripe_size=stripe_size)
         file_io.write_bytes(path, buf.getvalue())
 
     def read(
@@ -39,21 +48,54 @@ class OrcFormat(FileFormat):
         projection: Sequence[str] | None = None,
         predicate: Predicate | None = None,
     ) -> Iterator[ColumnBatch]:
+        import pyarrow as pa
         import pyarrow.orc as po
 
         cols = list(projection) if projection is not None else schema.field_names
         read_schema = schema.project(cols)
         f = file_io.open_input(path)
         try:
+            tail = None
+            if predicate is not None:
+                from ..metrics import registry
+
+                try:
+                    from .orc_meta import read_tail
+
+                    tail = read_tail(_tail_bytes(f))
+                except Exception:  # malformed/foreign tail: read everything
+                    tail = None
+                f.seek(0)
             of = po.ORCFile(f)
             for stripe in range(of.nstripes):
+                if tail is not None and stripe < tail.nstripes:
+                    if not predicate.test_stats(tail.stripe_stats(stripe)):
+                        registry.group("scan").counter("orc_stripes_skipped").inc()
+                        continue
                 table = of.read_stripe(stripe, columns=cols)
-                if isinstance(table, __import__("pyarrow").RecordBatch):
-                    table = __import__("pyarrow").Table.from_batches([table])
+                if isinstance(table, pa.RecordBatch):
+                    table = pa.Table.from_batches([table])
                 if table.num_rows:
                     yield ColumnBatch.from_arrow(table, read_schema)
         finally:
             f.close()
+
+
+def _tail_bytes(f, first_guess: int = 256 * 1024) -> bytes:
+    """Just the trailing region holding postscript+footer+metadata — decode
+    stays stripe-by-stripe on the file handle, memory stays bounded."""
+    size = f.seek(0, 2)
+    take = min(size, first_guess)
+    f.seek(size - take)
+    data = f.read(take)
+    try:
+        from .orc_meta import read_tail
+
+        read_tail(data)
+        return data
+    except ValueError:  # tail larger than the guess: take the whole file
+        f.seek(0)
+        return f.read()
 
 
 register_format("orc", OrcFormat)

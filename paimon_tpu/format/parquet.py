@@ -21,14 +21,25 @@ from . import FileFormat, register_format
 class ParquetFormat(FileFormat):
     identifier = "parquet"
 
-    def write(self, file_io: FileIO, path: str, batch: ColumnBatch, compression: str = "zstd") -> None:
+    def write(
+        self,
+        file_io: FileIO,
+        path: str,
+        batch: ColumnBatch,
+        compression: str = "zstd",
+        format_options: dict | None = None,
+    ) -> None:
         import io as _io
 
         import pyarrow.parquet as pq
 
         table = batch.to_arrow()
         buf = _io.BytesIO()
-        pq.write_table(table, buf, compression=compression)
+        opts = format_options or {}
+        kw = {}
+        if "parquet.row-group.rows" in opts:
+            kw["row_group_size"] = int(opts["parquet.row-group.rows"])
+        pq.write_table(table, buf, compression=compression, **kw)
         file_io.write_bytes(path, buf.getvalue())
 
     def read(

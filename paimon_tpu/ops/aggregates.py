@@ -188,6 +188,13 @@ def aggregate_merge(
         av = np.asarray(any_valid)[:k]
         return Column(out, av if not av.all() else None)
 
+    if (
+        fn in ("max", "min", "sum")
+        and values.dtype == np.float64
+        and _f64_on_device_unsupported()
+    ):
+        out, av = _host_reduce(plan, values, eff_valid, fn, sign if fn == "sum" else None)
+        return Column(out.astype(values.dtype, copy=False), av if not av.all() else None)
     if fn in ("max", "min"):
         agg, any_valid = _minmax_fn(fn == "max")(
             perm, seg_id, jnp.asarray(pad_to(values, m, 0)), jnp.asarray(pad_to(eff_valid, m, False))
@@ -220,6 +227,177 @@ def aggregate_merge(
     out = np.asarray(agg)[:k].astype(values.dtype, copy=False)
     av = np.asarray(any_valid)[:k]
     return Column(out, av if not av.all() else None)
+
+
+_DEVICE_FNS = ("sum", "count", "max", "min", "bool_and", "bool_or")
+_PICK_FNS = ("first_value", "first_non_null_value", "last_value", "last_non_null_value")
+
+
+def _f64_on_device_unsupported() -> bool:
+    """TPUs have no native f64 ALUs: float64 reductions must stay host-exact
+    there (CPU runs them on device under jax x64)."""
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def fused_routable(specs: list[AggregateSpec], columns: list[Column]) -> bool:
+    """True when every column can run inside the single fused kernel:
+    numeric reductions and first/last picks. product stays host-exact,
+    listagg/collect build variable-length host outputs, and f64 reductions
+    leave the device path on TPU backends (no native f64)."""
+    f64_off_device = _f64_on_device_unsupported()
+    for spec, col in zip(specs, columns):
+        if spec.function in _PICK_FNS:
+            continue
+        if spec.function not in _DEVICE_FNS:
+            return False
+        if col.values.dtype == np.dtype(object):
+            return False
+        if f64_off_device and col.values.dtype == np.float64 and spec.function != "count":
+            return False
+    return True
+
+
+def _host_reduce(plan: MergePlan, values: np.ndarray, eff_valid: np.ndarray, fn: str, sign=None):
+    """Exact segmented sum/max/min on host via np reduceat over the sorted
+    order (the f64-on-TPU fallback; same pattern as _product_host)."""
+    order = plan.perm[plan.valid_sorted]
+    v = values.take(order)
+    ok = eff_valid.take(order)
+    bounds = np.flatnonzero(plan.seg_start[plan.valid_sorted])
+    if fn == "sum":
+        s = sign.take(order) if sign is not None else np.ones_like(v)
+        contrib = np.where(ok, v * s, np.zeros((), v.dtype))
+        total = np.add.reduceat(contrib, bounds)
+    elif fn == "max":
+        contrib = np.where(ok, v, np.full((), -np.inf, v.dtype))
+        total = np.maximum.reduceat(contrib, bounds)
+    else:  # min
+        contrib = np.where(ok, v, np.full((), np.inf, v.dtype))
+        total = np.minimum.reduceat(contrib, bounds)
+    any_valid = np.maximum.reduceat(ok.astype(np.int8), bounds) > 0
+    return total, any_valid
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_aggregate_fn(num_key: int, num_seq: int, col_fns: tuple[str, ...]):
+    """Sort + every column's segment reduction in ONE kernel (the aggregation
+    analog of the fused dedup kernel): uploads lanes + value columns once,
+    downloads only the (C, k) results — no plan arrays, no per-column
+    round trips. col_fns entries: sum|count|max|min|bool_and|bool_or|
+    pick_first|pick_last."""
+
+    from .merge import pack_selected, sorted_segments
+
+    @jax.jit
+    def f(key_lanes, seq_lanes, pad_flag, values, valids, signs):
+        m = pad_flag.shape[0]
+        pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
+            num_key, num_seq, key_lanes, seq_lanes, pad_flag
+        )
+        pos = jnp.arange(m, dtype=jnp.int32)
+        outs = []
+        anyv = []
+        for i, fn in enumerate(col_fns):
+            ok = valids[i][perm]
+            if fn.startswith("pick_"):
+                last = fn == "pick_last"
+                if last:
+                    cand = jnp.where(ok, pos, -1)
+                    best = jax.ops.segment_max(cand, seg_id, num_segments=m)
+                else:
+                    cand = jnp.where(ok, pos, m)
+                    best = jax.ops.segment_min(cand, seg_id, num_segments=m)
+                    best = jnp.where(best == m, -1, best)
+                outs.append(jnp.where(best >= 0, perm[jnp.clip(best, 0, m - 1)], -1))
+                anyv.append(best >= 0)
+                continue
+            v = values[i][perm]
+            if fn in ("sum", "count"):
+                s = signs[i][perm].astype(v.dtype)
+                contrib = jnp.where(ok, v * s, jnp.zeros((), v.dtype))
+                agg = jax.ops.segment_sum(contrib, seg_id, num_segments=m)
+            else:
+                is_max = fn in ("max", "bool_or")
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    fill = jnp.finfo(v.dtype).min if is_max else jnp.finfo(v.dtype).max
+                else:
+                    fill = jnp.iinfo(v.dtype).min if is_max else jnp.iinfo(v.dtype).max
+                masked = jnp.where(ok, v, fill)
+                agg = (
+                    jax.ops.segment_max(masked, seg_id, num_segments=m)
+                    if is_max
+                    else jax.ops.segment_min(masked, seg_id, num_segments=m)
+                )
+            outs.append(agg)
+            anyv.append(jax.ops.segment_max(ok.astype(jnp.int32), seg_id, num_segments=m) > 0)
+        packed, count = pack_selected(keep_last & (pad_sorted == 0), perm)
+        return tuple(outs), tuple(anyv), packed, count
+
+    return f
+
+
+def fused_aggregate(
+    key_lanes: np.ndarray,  # (n, K) uint32
+    seq_lanes: np.ndarray | None,
+    columns: list[Column],
+    specs: list[AggregateSpec],
+    row_kind: np.ndarray,
+) -> tuple[list[Column], np.ndarray]:
+    """Single-call aggregation merge over every value column. Returns
+    (aggregated columns in key order, last_take winning-row indices)."""
+    from .merge import prepare_lanes
+
+    klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, seq_lanes)
+    col_fns = []
+    values = []
+    valids = []
+    signs = []
+    for spec, col in zip(specs, columns):
+        fn = spec.function
+        sign, include = _signs(
+            row_kind, spec, col.values.dtype if col.values.dtype != np.dtype(object) else np.int64
+        )
+        valid = col.valid_mask()
+        if fn in _PICK_FNS:
+            candidate = (valid & include) if "non_null" in fn else include
+            col_fns.append("pick_last" if fn.startswith("last") else "pick_first")
+            values.append(np.zeros(m, np.int8))  # unused by picks
+            valids.append(pad_to(candidate, m, False))
+            signs.append(np.ones(m, np.int8))
+        elif fn == "count":
+            col_fns.append("count")
+            values.append(pad_to(np.ones(n, np.int64), m, 0))
+            valids.append(pad_to(valid & include, m, False))
+            signs.append(pad_to(sign.astype(np.int8), m, 1))
+        elif fn in ("bool_and", "bool_or"):
+            col_fns.append(fn)
+            values.append(pad_to(col.values.astype(np.int8), m, 0))
+            valids.append(pad_to(valid & include, m, False))
+            signs.append(np.ones(m, np.int8))
+        else:
+            col_fns.append(fn)
+            values.append(pad_to(col.values, m, 0))
+            valids.append(pad_to(valid & include, m, False))
+            signs.append(pad_to(sign.astype(np.int8), m, 1))
+    outs, anyv, packed, count = _fused_aggregate_fn(k, s, tuple(col_fns))(
+        klp, slp, pad, tuple(values), tuple(valids), tuple(signs)
+    )
+    kk = int(count)
+    result: list[Column] = []
+    for spec, col, fn, o, av in zip(specs, columns, col_fns, outs, anyv):
+        if fn.startswith("pick_"):
+            result.append(_gather_column(col, np.asarray(o[:kk])))
+        elif fn == "count":
+            result.append(Column(np.asarray(o[:kk])))  # count of nothing is 0
+        else:
+            vals = np.asarray(o[:kk]).astype(col.values.dtype, copy=False)
+            valid = np.asarray(av[:kk])
+            if fn in ("bool_and", "bool_or"):
+                vals = vals.astype(np.bool_)
+            result.append(Column(vals, valid if not valid.all() else None))
+    return result, np.asarray(packed[:kk])
 
 
 def _gather_column(column: Column, src: np.ndarray) -> Column:

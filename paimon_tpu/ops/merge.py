@@ -55,6 +55,60 @@ def pad_to(arr: np.ndarray, m: int, fill=0) -> np.ndarray:
     return out
 
 
+def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes, pad_flag):
+    """The shared in-kernel preamble (traced inside each jitted kernel): one
+    stable lexicographic sort on (pad, key lanes, seq lanes, iota), then
+    segment detection over (pad, key lanes) only — sequence lanes do NOT
+    split segments (same key, different seq = one merge group). Returns
+    (sorted_pad, perm, seg_start, keep_last, seg_id)."""
+    m = pad_flag.shape[0]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    operands = (
+        [pad_flag]
+        + [key_lanes[i] for i in range(num_key_lanes)]
+        + [seq_lanes[i] for i in range(num_seq_lanes)]
+        + [iota]
+    )
+    out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
+    perm = out[-1]
+    seg_keys = jnp.stack(out[: 1 + num_key_lanes], axis=0)
+    neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
+    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
+    keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    return out[0], perm, seg_start, keep_last, seg_id
+
+
+def pack_selected(sel, perm):
+    """In-kernel: pack the selected perms to the front (key order) and count
+    them — the minimal device->host transfer for selection kernels."""
+    not_sel = (~sel).astype(jnp.uint32)
+    _, packed = jax.lax.sort([not_sel, perm], num_keys=1, is_stable=True)
+    return packed, sel.sum()
+
+
+def prepare_lanes(key_lanes: np.ndarray, seq_lanes: np.ndarray | None):
+    """The shared host-side prep: drop constant lanes, pad rows to the
+    power-of-two bucket with 0xFFFFFFFF key sentinels + pad flags. Returns
+    (klp (K, m), slp (S, m), pad (m,), n, num_key, num_seq, m)."""
+    key_lanes = np.ascontiguousarray(key_lanes)
+    kl = drop_constant_lanes(key_lanes)
+    if kl.shape[1] == 0 and key_lanes.shape[1]:
+        kl = key_lanes[:, :1]
+    sl = drop_constant_lanes(np.ascontiguousarray(seq_lanes)) if seq_lanes is not None else None
+    n, k = kl.shape
+    s = 0 if sl is None else sl.shape[1]
+    m = pad_size(n)
+    klp = np.full((k, m), 0xFFFFFFFF, dtype=np.uint32)
+    klp[:, :n] = kl.T
+    slp = np.zeros((s, m), dtype=np.uint32)
+    if s:
+        slp[:, :n] = sl.T
+    pad = np.zeros(m, dtype=np.uint32)
+    pad[n:] = 1
+    return klp, slp, pad, n, k, s, m
+
+
 @functools.lru_cache(maxsize=None)
 def _plan_fn(num_key_lanes: int, num_seq_lanes: int):
     """Builds the jitted sort+segment kernel for a lane arity."""
@@ -62,23 +116,9 @@ def _plan_fn(num_key_lanes: int, num_seq_lanes: int):
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
         # key_lanes: (K, m) uint32; seq_lanes: (S, m) uint32; pad_flag: (m,) uint32
-        m = pad_flag.shape[0]
-        iota = jnp.arange(m, dtype=jnp.int32)
-        operands = (
-            [pad_flag]
-            + [key_lanes[i] for i in range(num_key_lanes)]
-            + [seq_lanes[i] for i in range(num_seq_lanes)]
-            + [iota]
+        _, perm, seg_start, keep_last, seg_id = sorted_segments(
+            num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
         )
-        out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
-        perm = out[-1]
-        # segment detection over (pad, key lanes) only — sequence lanes do NOT
-        # split segments (same key, different seq = one merge group)
-        seg_keys = jnp.stack(out[: 1 + num_key_lanes], axis=0)
-        neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
-        seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
-        keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
-        seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
         return perm, seg_start, keep_last, seg_id
 
     return f
@@ -178,30 +218,27 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
-        m = pad_flag.shape[0]
-        iota = jnp.arange(m, dtype=jnp.int32)
-        operands = (
-            [pad_flag]
-            + [key_lanes[i] for i in range(num_key_lanes)]
-            + [seq_lanes[i] for i in range(num_seq_lanes)]
-            + [iota]
-        )
-        out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
-        perm = out[-1]
         if backend == "pallas":
+            m = pad_flag.shape[0]
+            iota = jnp.arange(m, dtype=jnp.int32)
+            operands = (
+                [pad_flag]
+                + [key_lanes[i] for i in range(num_key_lanes)]
+                + [seq_lanes[i] for i in range(num_seq_lanes)]
+                + [iota]
+            )
+            out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
+            perm = out[-1]
             from .pallas_kernels import keep_last_mask
 
             stacked = jnp.stack(out[: 1 + num_key_lanes], axis=0)
             sel = keep_last_mask(stacked, interpret=jax.default_backend() == "cpu").astype(jnp.bool_)
         else:
-            seg_keys = jnp.stack(out[: 1 + num_key_lanes], axis=0)
-            neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
-            keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
-            sel = keep_last & (out[0] == 0)  # exclude pad rows
-        # pack selected perms to the front, preserving key order
-        not_sel = (~sel).astype(jnp.uint32)
-        _, packed = jax.lax.sort([not_sel, perm], num_keys=1, is_stable=True)
-        return packed, sel.sum()
+            pad_sorted, perm, _, keep_last, _ = sorted_segments(
+                num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
+            )
+            sel = keep_last & (pad_sorted == 0)  # exclude pad rows
+        return pack_selected(sel, perm)
 
     return f
 
@@ -210,21 +247,7 @@ def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None
     """Dispatch the dedup kernel without blocking: returns (packed_device,
     count_device). jax's async dispatch lets the host keep decoding value
     columns while the device sorts — resolve with deduplicate_resolve()."""
-    key_lanes = np.ascontiguousarray(key_lanes)
-    kl = drop_constant_lanes(key_lanes)
-    if kl.shape[1] == 0 and key_lanes.shape[1]:
-        kl = key_lanes[:, :1]
-    sl = drop_constant_lanes(np.ascontiguousarray(seq_lanes)) if seq_lanes is not None else None
-    n, k = kl.shape
-    s = 0 if sl is None else sl.shape[1]
-    m = pad_size(n)
-    klp = np.full((k, m), 0xFFFFFFFF, dtype=np.uint32)
-    klp[:, :n] = kl.T
-    slp = np.zeros((s, m), dtype=np.uint32)
-    if s:
-        slp[:, :n] = sl.T
-    pad = np.zeros(m, dtype=np.uint32)
-    pad[n:] = 1
+    klp, slp, pad, _, k, s, _ = prepare_lanes(key_lanes, seq_lanes)
     return _dedup_select_fn(k, s, backend)(klp, slp, pad)
 
 
@@ -339,6 +362,72 @@ def _partial_update_fn():
         return src, exists
 
     return f
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int):
+    """Sort + segment + partial-update selection in ONE kernel: the plan never
+    leaves the device, and the only downloads are the per-field source indices
+    (F, k), the per-key existence bits and the winning-row indices — instead
+    of 4 full plan arrays + per-field round trips. This is the fusion the
+    dedup engine got in round 1 (_dedup_select_fn), applied to partial-update."""
+
+    @jax.jit
+    def f(key_lanes, seq_lanes, pad_flag, field_valid, is_add, is_delete):
+        m = pad_flag.shape[0]
+        pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
+            num_key, num_seq, key_lanes, seq_lanes, pad_flag
+        )
+        pos = jnp.arange(m, dtype=jnp.int32)
+        add_sorted = is_add[perm]
+        del_sorted = is_delete[perm]
+        del_cand = jnp.where(del_sorted, pos, -1)
+        last_del = jax.ops.segment_max(del_cand, seg_id, num_segments=m)
+        gate = pos[None, :] > last_del[seg_id][None, :]
+        fv_sorted = field_valid[:, perm]
+        cand = jnp.where(fv_sorted & add_sorted[None, :] & gate, pos[None, :], -1)
+        last_per_field = jax.vmap(lambda c: jax.ops.segment_max(c, seg_id, num_segments=m))(cand)
+        src = jnp.where(last_per_field >= 0, perm[jnp.clip(last_per_field, 0, m - 1)], -1)
+        add_cand = jnp.where(add_sorted, pos, -1)
+        last_add = jax.ops.segment_max(add_cand, seg_id, num_segments=m)
+        exists = last_add > last_del
+        packed, count = pack_selected(keep_last & (pad_sorted == 0), perm)
+        return src, exists, packed, count
+
+    return f
+
+
+def fused_partial_update(
+    key_lanes: np.ndarray,  # (n, K) uint32
+    seq_lanes: np.ndarray | None,  # (n, S) uint32
+    field_valid: np.ndarray,  # (F, n) bool
+    row_kind: np.ndarray,  # (n,) uint8
+    remove_record_on_delete: bool = False,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Single-call partial-update merge: returns (src (F, k), exists (k,),
+    last_take (k,)) in key order — the same contract as
+    merge_plan + partial_update_takes + keep-last takes, one device trip."""
+    from ..types import RowKind
+
+    klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, seq_lanes)
+    is_add = np.isin(row_kind, (int(RowKind.INSERT), int(RowKind.UPDATE_AFTER)))
+    if remove_record_on_delete:
+        is_delete = row_kind == int(RowKind.DELETE)
+    else:
+        is_delete = np.zeros_like(is_add)
+    fv = np.zeros((max(field_valid.shape[0], 1), m), dtype=np.bool_)
+    if field_valid.shape[0]:
+        fv[: field_valid.shape[0], :n] = field_valid
+    src, exists, packed, count = _fused_partial_update_fn(k, s, fv.shape[0])(
+        klp, slp, pad, fv, pad_to(is_add, m, False), pad_to(is_delete, m, False)
+    )
+    kk = int(count)
+    # device-side slicing: only (F, k) + 2k elements cross the link
+    return (
+        np.asarray(src[: field_valid.shape[0], :kk]),
+        np.asarray(exists[:kk]),
+        np.asarray(packed[:kk]),
+    )
 
 
 def partial_update_takes(

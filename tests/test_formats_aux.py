@@ -148,3 +148,79 @@ def test_avro_skewed_string_field_retry(tmp_path):
     fmt.write(io, p, b, compression="null")
     out = next(iter(fmt.read(io, p, schema)))
     assert out.to_pydict() == b.to_pydict()
+
+
+# ---------------------------------------------------------------------------
+# round 2: ORC stripe-statistics pruning (orc_meta tail reader)
+# ---------------------------------------------------------------------------
+
+
+def test_orc_tail_stats_roundtrip(tmp_path):
+    import io
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    from paimon_tpu.format.orc_meta import read_tail
+
+    n = 200_000
+    rng = np.random.default_rng(5)
+    ids = rng.permutation(n).astype(np.int64)
+    t = pa.table(
+        {
+            "id": ids,
+            "d": ids.astype(np.float64) * 0.5,
+            "s": pa.array([f"k{int(x) % 1000:03d}" for x in ids]),
+        }
+    )
+    buf = io.BytesIO()
+    po.write_table(t, buf, compression="zstd", stripe_size=64 * 1024)
+    data = buf.getvalue()
+    tail = read_tail(data)
+    of = po.ORCFile(io.BytesIO(data))
+    assert tail.nstripes == of.nstripes > 1
+    assert sum(tail.stripe_rows) == n
+    assert tail.field_columns == {"id": 1, "d": 2, "s": 3}
+    # stats agree with the actual stripe contents
+    for i in range(tail.nstripes):
+        st = tail.stripe_stats(i)
+        chunk = of.read_stripe(i)
+        got_ids = np.asarray(chunk["id"])
+        assert st["id"].min == got_ids.min() and st["id"].max == got_ids.max()
+        assert st["id"].null_count == 0
+        assert st["d"].min == float(np.asarray(chunk["d"]).min())
+        vals = [x.as_py() for x in chunk["s"]]
+        assert st["s"].min == min(vals) and st["s"].max == max(vals)
+
+
+def test_orc_stripe_pruning_skips_stripes(tmp_warehouse):
+    import numpy as np
+
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.data.predicate import PredicateBuilder
+    from paimon_tpu.metrics import registry
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="orcp")
+    t = cat.create_table(
+        "db.orcp",
+        RowType.of(("id", BIGINT()), ("v", DOUBLE())),
+        primary_keys=["id"],
+        options={"bucket": "1", "file.format": "orc", "orc.stripe.size": "65536"},
+    )
+    n = 300_000
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    # sorted ids -> stripes have disjoint id ranges -> range predicates prune
+    w.write({"id": np.arange(n, dtype=np.int64), "v": np.arange(n, dtype=np.float64)})
+    wb.new_commit().commit(w.prepare_commit())
+
+    registry.reset()
+    from paimon_tpu.data.predicate import equal
+
+    rb = t.new_read_builder().with_filter(equal("id", 5))
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert out.to_pylist() == [(5, 5.0)]
+    snap = registry.snapshot()
+    assert snap.get("scan", {}).get("orc_stripes_skipped", 0) >= 1

@@ -242,3 +242,123 @@ def test_tiled_dedup_matches_single(rng):
     tiled = deduplicate_select_tiled(lanes, offsets, tile_rows=512)
     single = deduplicate_select(lanes)
     assert tiled.tolist() == single.tolist()
+
+
+# ---------------------------------------------------------------------------
+# round 2: fused partial-update / aggregation kernels vs the plan-based path
+# ---------------------------------------------------------------------------
+
+
+def _mk_exec(schema, keys, engine, opts=None):
+    from paimon_tpu.core.mergefn import MergeExecutor
+    from paimon_tpu.options import CoreOptions, MergeEngine, Options
+
+    co = CoreOptions(Options({**(opts or {}), "merge-engine": engine}))
+    return MergeExecutor(schema, keys, MergeEngine(co.merge_engine), co)
+
+
+def _kv_random(rng, n=700, keys=60, with_nulls=True, kinds=None):
+    from paimon_tpu.core.kv import KVBatch
+    from paimon_tpu.data.batch import ColumnBatch
+    from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+    schema = RowType.of(("id", BIGINT()), ("a", DOUBLE()), ("b", BIGINT()), ("s", STRING()))
+    ids = rng.integers(0, keys, n)
+    a = rng.normal(size=n)
+    b = rng.integers(-50, 50, n)
+    s = np.array([f"v{int(x) % 7}" for x in b], dtype=object)
+    data = {"id": ids.tolist(), "a": a.tolist(), "b": b.tolist(), "s": s.tolist()}
+    if with_nulls:
+        data["a"] = [None if i % 5 == 0 else v for i, v in enumerate(data["a"])]
+        data["s"] = [None if i % 4 == 0 else v for i, v in enumerate(data["s"])]
+    batch = ColumnBatch.from_pydict(schema, data)
+    return schema, KVBatch.from_rows(batch, 0, kinds)
+
+
+def _rows(kv):
+    return [tuple(r) + (int(k),) for r, k in zip(kv.data.to_pylist(), kv.kind)]
+
+
+def test_fused_partial_update_matches_plan_path(rng):
+    schema, kv = _kv_random(rng)
+    ex = _mk_exec(schema, ["id"], "partial-update")
+    fused = ex.merge(kv, seq_ascending=True)  # routes through the fused kernel
+    oracle = _mk_exec(schema, ["id"], "partial-update", {"sort-engine": "numpy"})
+    # numpy engine takes the plan path
+    want = oracle.merge(kv, seq_ascending=True)
+    assert _rows(fused) == _rows(want)
+    assert (fused.seq == want.seq).all()
+
+
+def test_fused_partial_update_remove_record_on_delete(rng):
+    schema, kv0 = _kv_random(rng, n=400, keys=40)
+    kinds = np.where(rng.random(400) < 0.25, 3, 0).astype(np.uint8)  # -D mix
+    schema, kv = _kv_random(rng, n=400, keys=40, kinds=kinds)
+    opts = {"partial-update.remove-record-on-delete": "true"}
+    fused = _mk_exec(schema, ["id"], "partial-update", opts).merge(kv, seq_ascending=True)
+    want = _mk_exec(schema, ["id"], "partial-update", {**opts, "sort-engine": "numpy"}).merge(
+        kv, seq_ascending=True
+    )
+    assert _rows(fused) == _rows(want)
+
+
+def test_fused_aggregation_matches_plan_path(rng):
+    opts = {
+        "fields.a.aggregate-function": "sum",
+        "fields.b.aggregate-function": "max",
+        "fields.s.aggregate-function": "last_non_null_value",
+    }
+    schema, kv = _kv_random(rng)
+    fused = _mk_exec(schema, ["id"], "aggregation", opts).merge(kv, seq_ascending=True)
+    want = _mk_exec(schema, ["id"], "aggregation", {**opts, "sort-engine": "numpy"}).merge(
+        kv, seq_ascending=True
+    )
+    f_rows, w_rows = _rows(fused), _rows(want)
+    assert len(f_rows) == len(w_rows)
+    for fr, wr in zip(f_rows, w_rows):
+        assert fr[0] == wr[0] and fr[2] == wr[2] and fr[3] == wr[3]
+        if fr[1] is None or wr[1] is None:
+            assert fr[1] == wr[1]
+        else:
+            assert abs(fr[1] - wr[1]) < 1e-9  # float sum association tolerance
+
+
+def test_fused_aggregation_retracts_and_count(rng):
+    from paimon_tpu.core.kv import KVBatch
+    from paimon_tpu.data.batch import ColumnBatch
+    from paimon_tpu.types import BIGINT, RowType
+
+    schema = RowType.of(("id", BIGINT()), ("c", BIGINT()), ("n", BIGINT()))
+    n = 300
+    ids = rng.integers(0, 20, n)
+    kinds = np.where(rng.random(n) < 0.3, 3, 0).astype(np.uint8)  # -D retracts
+    data = ColumnBatch.from_pydict(
+        schema,
+        {"id": ids.tolist(), "c": [1] * n, "n": [None if i % 3 == 0 else 2 for i in range(n)]},
+    )
+    kv = KVBatch.from_rows(data, 0, kinds)
+    opts = {"fields.c.aggregate-function": "sum", "fields.n.aggregate-function": "count"}
+    fused = _mk_exec(schema, ["id"], "aggregation", opts).merge(kv, seq_ascending=True)
+    want = _mk_exec(schema, ["id"], "aggregation", {**opts, "sort-engine": "numpy"}).merge(
+        kv, seq_ascending=True
+    )
+    assert _rows(fused) == _rows(want)
+
+
+def test_aggregation_64bit_exactness(rng):
+    """x64 regression: BIGINT sums past 2^31 and DOUBLE sums must be exact
+    (x32 jax silently truncated both)."""
+    from paimon_tpu.core.kv import KVBatch
+    from paimon_tpu.data.batch import ColumnBatch
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    schema = RowType.of(("id", BIGINT()), ("big", BIGINT()), ("d", DOUBLE()))
+    big_vals = [3_000_000_000, 4_000_000_001, 5]
+    d_vals = [1.0000000123, 2.0000000456, -3.0000000789]
+    data = ColumnBatch.from_pydict(schema, {"id": [1, 1, 1], "big": big_vals, "d": d_vals})
+    kv = KVBatch.from_rows(data, 0)
+    opts = {"fields.big.aggregate-function": "sum", "fields.d.aggregate-function": "sum"}
+    out = _mk_exec(schema, ["id"], "aggregation", opts).merge(kv, seq_ascending=True)
+    row = out.data.to_pylist()[0]
+    assert row[1] == sum(big_vals)  # exact int64, not int32 wraparound
+    assert row[2] == d_vals[0] + d_vals[1] + d_vals[2]  # exact f64 association order
