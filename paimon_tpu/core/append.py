@@ -61,22 +61,35 @@ class AppendOnlyCompactManager:
         pick = self.pick(files, full)
         if not pick:
             return [], []
-        batches = []
-        for f in pick:
-            kv = self.reader_factory.read(f)
-            dv = self.deletion_vectors.get(f.file_name)
-            if dv is not None:
-                mask = ~dv.deleted_mask(kv.num_rows)
-                if not mask.all():
-                    kv = kv.filter(mask)
-            batches.append(kv)
-        kv = KVBatch.concat(batches)
-        # keyed=False readers surface no per-row seqs; re-derive an in-range
-        # sequence span so ordering and writer restore stay correct
-        base = min(f.min_sequence_number for f in pick)
-        kv = KVBatch(kv.data, np.arange(base, base + kv.num_rows, dtype=np.int64), kv.kind)
-        out = self.writer_factory.write(kv, level=0, file_source="compact")
+        out = concat_rewrite(self.reader_factory, self.writer_factory, pick, self.deletion_vectors)
         return pick, out
+
+
+def concat_rewrite(
+    reader_factory: KeyValueFileReaderFactory,
+    writer_factory: KeyValueFileWriterFactory,
+    files: list[DataFileMeta],
+    deletion_vectors: dict | None = None,
+) -> list[DataFileMeta]:
+    """Order-preserving concat of small append files into rolled output (the
+    shared worker body of AppendOnlyCompactManager and the dedicated
+    coordinator/worker split)."""
+    dvs = deletion_vectors or {}
+    batches = []
+    for f in files:
+        kv = reader_factory.read(f)
+        dv = dvs.get(f.file_name)
+        if dv is not None:
+            mask = ~dv.deleted_mask(kv.num_rows)
+            if not mask.all():
+                kv = kv.filter(mask)
+        batches.append(kv)
+    kv = KVBatch.concat(batches)
+    # keyed=False readers surface no per-row seqs; re-derive an in-range
+    # sequence span so ordering and writer restore stay correct
+    base = min(f.min_sequence_number for f in files)
+    kv = KVBatch(kv.data, np.arange(base, base + kv.num_rows, dtype=np.int64), kv.kind)
+    return writer_factory.write(kv, level=0, file_source="compact")
 
 
 class AppendOnlyWriter:
@@ -104,6 +117,7 @@ class AppendOnlyWriter:
         self._existing = list(existing_files or [])
         self._buffer: list[ColumnBatch] = []
         self._buffered_rows = 0
+        self._buffered_bytes = 0
         self._spill = None
         self._io_manager = None
         if options.options.get(CoreOptions.WRITE_BUFFER_SPILLABLE):
@@ -111,7 +125,9 @@ class AppendOnlyWriter:
 
             self._io_manager = IOManager()
             self._spill = SpillableBuffer(
-                self._io_manager, in_memory_rows=options.options.get(CoreOptions.WRITE_BUFFER_SPILL_ROWS)
+                self._io_manager,
+                in_memory_rows=options.options.get(CoreOptions.WRITE_BUFFER_SPILL_ROWS),
+                in_memory_bytes=int(options.options.get(CoreOptions.WRITE_BUFFER_SPILL_SIZE)),
             )
         self._new_files: list[DataFileMeta] = []
         self._compact_before: list[DataFileMeta] = []
@@ -128,7 +144,11 @@ class AppendOnlyWriter:
         else:
             self._buffer.append(data)
             self._buffered_rows += data.num_rows
-        if self._buffered_rows >= self.options.write_buffer_rows:
+            self._buffered_bytes += data.byte_size()
+        if (
+            self._buffered_rows >= self.options.write_buffer_rows
+            or self._buffered_bytes >= self.options.write_buffer_size
+        ):
             self.flush()
 
     def flush(self) -> None:
@@ -152,6 +172,7 @@ class AppendOnlyWriter:
             wrote = True
         self._buffer.clear()
         self._buffered_rows = 0
+        self._buffered_bytes = 0
         if wrote and self.compact_manager is not None and not self.options.write_only:
             self._maybe_compact()
 

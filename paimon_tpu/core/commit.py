@@ -298,8 +298,6 @@ class FileStoreCommit:
                 # lost the race: clean tmp metadata and retry against new latest
                 self._cleanup(tmp_files)
                 retries += 1
-            except CommitConflictError:
-                raise
             except Exception:
                 self._cleanup(tmp_files)
                 raise

@@ -39,11 +39,15 @@ class SpillableBuffer:
     arrow IPC files. Iteration replays spilled segments then memory, in
     insertion order (reference ExternalBuffer semantics)."""
 
-    def __init__(self, io_manager: IOManager, in_memory_rows: int = 1 << 20):
+    def __init__(
+        self, io_manager: IOManager, in_memory_rows: int = 1 << 20, in_memory_bytes: int = 64 << 20
+    ):
         self.io_manager = io_manager
         self.in_memory_rows = in_memory_rows
+        self.in_memory_bytes = in_memory_bytes
         self._memory: list[ColumnBatch] = []
         self._memory_rows = 0
+        self._memory_bytes = 0
         self._spilled: list[str] = []
         self._spilled_rows = 0
 
@@ -60,7 +64,8 @@ class SpillableBuffer:
             return
         self._memory.append(batch)
         self._memory_rows += batch.num_rows
-        if self._memory_rows > self.in_memory_rows:
+        self._memory_bytes += batch.byte_size()
+        if self._memory_rows > self.in_memory_rows or self._memory_bytes > self.in_memory_bytes:
             self._spill()
 
     def _spill(self) -> None:
@@ -78,6 +83,7 @@ class SpillableBuffer:
         self._schema = self._memory[0].schema
         self._spilled_rows += self._memory_rows
         self._memory.clear()
+        self._memory_bytes = 0
         self._memory_rows = 0
 
     def batches(self) -> Iterator[ColumnBatch]:
@@ -100,3 +106,4 @@ class SpillableBuffer:
         self._spilled_rows = 0
         self._memory.clear()
         self._memory_rows = 0
+        self._memory_bytes = 0

@@ -52,6 +52,9 @@ class KVBatch:
     def num_rows(self) -> int:
         return self.data.num_rows
 
+    def byte_size(self) -> int:
+        return self.data.byte_size() + self.seq.nbytes + self.kind.nbytes
+
     def take(self, indices: np.ndarray) -> "KVBatch":
         return KVBatch(self.data.take(indices), self.seq.take(indices), self.kind.take(indices))
 

@@ -47,6 +47,7 @@ class MergeTreeWriter:
         self.seq = restored_max_seq + 1
         self._buffer: list[KVBatch] = []
         self._buffered_rows = 0
+        self._buffered_bytes = 0
         self._buffer_seq_ordered = True
         self._new_files: list[DataFileMeta] = []
         self._compact_before: list[DataFileMeta] = []
@@ -65,7 +66,8 @@ class MergeTreeWriter:
         self.seq += n
         self._buffer.append(kv)
         self._buffered_rows += n
-        if self._buffered_rows >= self.options.write_buffer_rows:
+        self._buffered_bytes += kv.byte_size()
+        if self._should_flush():
             self.flush()
 
     def write_kv(self, kv: KVBatch) -> None:
@@ -77,8 +79,18 @@ class MergeTreeWriter:
         self._buffer.append(kv)
         self.seq = max(self.seq, int(kv.seq.max()) + 1)
         self._buffered_rows += kv.num_rows
-        if self._buffered_rows >= self.options.write_buffer_rows:
+        self._buffered_bytes += kv.byte_size()
+        if self._should_flush():
             self.flush()
+
+    def _should_flush(self) -> bool:
+        """Byte budget first (reference MemorySegmentPool accounts bytes —
+        wide rows must not blow host memory before a row cap), row cap as
+        the secondary bound."""
+        return (
+            self._buffered_bytes >= self.options.write_buffer_size
+            or self._buffered_rows >= self.options.write_buffer_rows
+        )
 
     # ---- flush ---------------------------------------------------------
     def flush(self) -> None:
@@ -96,6 +108,7 @@ class MergeTreeWriter:
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
         self._buffer.clear()
         self._buffered_rows = 0
+        self._buffered_bytes = 0
         from ..options import ChangelogProducer
 
         producer = self.options.changelog_producer

@@ -61,6 +61,23 @@ class Column:
             self._values = v
         return self._values
 
+    def byte_size(self) -> int:
+        """Approximate heap footprint — the currency of write-buffer budgets
+        (reference MemorySegmentPool accounts bytes, not rows)."""
+        if self.arrow is not None:
+            total = self.arrow.nbytes
+        elif self._values.dtype == np.dtype(object):
+            # object ndarray of str/bytes: pointer + measured payloads
+            sample = self._values[:1024]
+            payload = sum(len(x) if isinstance(x, (str, bytes)) else 16 for x in sample if x is not None)
+            avg = payload / max(len(sample), 1)
+            total = int(self._len * (8 + avg + 49))  # ptr + payload + PyObject overhead
+        else:
+            total = self._values.nbytes
+        if self.validity is not None:
+            total += self.validity.nbytes
+        return total
+
     def __len__(self) -> int:
         return self._len
 
@@ -180,6 +197,10 @@ class ColumnBatch:
     @property
     def num_rows(self) -> int:
         return self._num_rows
+
+    def byte_size(self) -> int:
+        """Approximate heap bytes across all columns (budgeting currency)."""
+        return sum(c.byte_size() for c in self.columns.values())
 
     def __len__(self) -> int:
         return self._num_rows

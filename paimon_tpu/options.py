@@ -186,6 +186,9 @@ class CoreOptions:
     WRITE_BUFFER_SPILL_ROWS = ConfigOption.int_(
         "write-buffer-spill.rows", 256 * 1024, "In-memory rows before a spill segment is written."
     )
+    WRITE_BUFFER_SPILL_SIZE = ConfigOption.memory(
+        "write-buffer-spill.size", "64 mb", "In-memory bytes before a spill segment is written."
+    )
     MERGE_ENGINE = ConfigOption.enum("merge-engine", MergeEngine, MergeEngine.DEDUPLICATE, "How same-key records merge.")
     IGNORE_DELETE = ConfigOption.bool_("ignore-delete", False, "Ignore -D records on write/merge.")
     SORT_ENGINE = ConfigOption.enum("sort-engine", SortEngine, SortEngine.XLA_SEGMENTED, "Merge kernel backend.")
@@ -309,6 +312,10 @@ class CoreOptions:
     @property
     def write_buffer_rows(self) -> int:
         return self.options.get(CoreOptions.WRITE_BUFFER_ROWS)
+
+    @property
+    def write_buffer_size(self) -> int:
+        return int(self.options.get(CoreOptions.WRITE_BUFFER_SIZE))
 
     @property
     def write_only(self) -> bool:

@@ -1,0 +1,156 @@
+"""Dedicated compaction: a separate job owns ALL compaction for a table
+whose ingest writers run write-only.
+
+Parity: /root/reference/paimon-flink/paimon-flink-common/.../sink/
+CompactorSink.java + compact/ (the dedicated compaction job: ingest jobs set
+write-only and a separate job scans buckets, compacts, commits COMPACT
+snapshots), and /root/reference/paimon-core/.../append/
+AppendOnlyTableCompactionCoordinator.java (unaware-bucket tables: a
+coordinator plans small-file tasks, workers execute them, the coordinator
+commits). Conflict safety comes from the commit protocol itself: a COMPACT
+commit whose deleted files were concurrently removed fails the conflict
+check and the compactor abandons that round (reference noConflictsOrFail).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+from ..core.commit import CommitConflictError
+from ..core.datafile import DataFileMeta
+from ..core.manifest import CommitMessage
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["DedicatedCompactor", "AppendCompactionCoordinator", "CompactionTask", "execute_compaction_task"]
+
+
+class DedicatedCompactor:
+    """Runs compaction rounds against the latest snapshot and commits them.
+
+    The ingest side sets write-only=true (writers skip compaction entirely);
+    this job opens the same table with compaction enabled and periodically
+    compacts every live bucket. Races with concurrent ingest commits are
+    resolved by the snapshot CAS + conflict check: lost compactions are
+    abandoned, never retried blindly (fresh state is picked up next round).
+    """
+
+    def __init__(self, table: "FileStoreTable"):
+        # compaction must be ON in this job regardless of the table's
+        # write-only ingest setting
+        self.table = table.copy({"write-only": "false"}) if table.options.write_only else table
+
+    def run_once(self, full: bool = False) -> bool:
+        """One compaction round over every live bucket. Returns True when a
+        COMPACT snapshot was committed; False when there was nothing to do
+        or a concurrent commit won the race (abandoned, reference
+        MergeTreeCompactManager loser semantics)."""
+        from .write import BatchWriteBuilder, TableCommit
+
+        wb = self.table.new_batch_write_builder()
+        w = wb.new_write()
+        try:
+            w.compact(full=full)
+            msgs = w.prepare_commit()
+            if not msgs:
+                return False
+            TableCommit(self.table).commit_messages(BatchWriteBuilder.COMMIT_IDENTIFIER, msgs)
+            return True
+        except CommitConflictError:
+            return False
+        finally:
+            w.close()
+
+
+# ---------------------------------------------------------------------------
+# unaware-bucket append tables: coordinator plans, workers execute
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CompactionTask:
+    """One unit of work for a compaction worker (reference
+    AppendOnlyCompactionTask): consecutive small files of one
+    (partition, bucket)."""
+
+    partition: tuple
+    files: list[DataFileMeta] = field(default_factory=list)
+    bucket: int = 0
+
+
+class AppendCompactionCoordinator:
+    """Plans small-file concat tasks across an append table (reference
+    AppendOnlyTableCompactionCoordinator: the coordinator scans, emits tasks
+    to distributed workers, and folds their results into one commit).
+    Unaware-bucket tables get one namespace (bucket 0); fixed-bucket append
+    tables plan per (partition, bucket)."""
+
+    def __init__(self, table: "FileStoreTable"):
+        if table.is_primary_key_table:
+            raise ValueError(
+                "AppendCompactionCoordinator serves append-only tables; "
+                "primary-key tables compact through DedicatedCompactor"
+            )
+        self.table = table
+
+    def plan(self, full: bool = False) -> list[CompactionTask]:
+        store = self.table.store
+        opts = store.options
+        target = opts.target_file_size
+        min_count = opts.compaction_min_file_num
+        plan = store.new_scan().plan()
+        by_pb: dict[tuple, list[DataFileMeta]] = {}
+        for e in plan.entries:
+            by_pb.setdefault((e.partition, e.bucket), []).append(e.file)
+        tasks: list[CompactionTask] = []
+        for (partition, bucket), files in by_pb.items():
+            files = sorted(files, key=lambda f: (f.min_sequence_number, f.file_name))
+            if full:
+                if len(files) > 1:
+                    tasks.append(CompactionTask(partition, files, bucket))
+                continue
+            small: list[DataFileMeta] = []
+            for f in files:
+                if f.file_size < target:
+                    small.append(f)
+                    if len(small) >= min_count or sum(x.file_size for x in small) >= target:
+                        tasks.append(CompactionTask(partition, small, bucket))
+                        small = []
+                else:
+                    if len(small) > 1:
+                        tasks.append(CompactionTask(partition, small, bucket))
+                    small = []
+            if len(small) > 1:
+                tasks.append(CompactionTask(partition, small, bucket))
+        return tasks
+
+    def commit(self, messages: list[CommitMessage]) -> None:
+        """Fold the workers' results into ONE commit (the coordinator is the
+        single-parallelism committer, reference CommitterOperator)."""
+        from .write import BatchWriteBuilder, TableCommit
+
+        messages = [m for m in messages if not m.is_empty()]
+        if messages:
+            TableCommit(self.table).commit_messages(BatchWriteBuilder.COMMIT_IDENTIFIER, messages)
+
+
+def execute_compaction_task(table: "FileStoreTable", task: CompactionTask) -> CommitMessage:
+    """Worker half: concat-rewrite one task's files (order-preserving, no
+    merge function — reference AppendOnlyCompactionWorker; same body as the
+    in-writer path via core.append.concat_rewrite). Returns the
+    CommitMessage to ship back to the coordinator."""
+    from ..core.append import concat_rewrite
+
+    store = table.store
+    rf = store.reader_factory(task.partition, task.bucket)
+    wf = store.writer_factory(task.partition, task.bucket)
+    out = concat_rewrite(rf, wf, task.files)
+    return CommitMessage(
+        partition=task.partition,
+        bucket=task.bucket,
+        total_buckets=max(store.options.bucket, -1),
+        compact_before=list(task.files),
+        compact_after=out,
+    )

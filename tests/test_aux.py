@@ -187,3 +187,45 @@ def test_consumer_expiration(catalog):
     removed = cm.expire_stale(expiration_millis=-1000)  # everything is "stale"
     assert sorted(removed) == ["fresh", "stale"]
     assert cm.list_consumers() == {}
+
+
+def test_byte_budget_flush_and_spill(catalog, tmp_path):
+    """Round-2: budgets are BYTES first (reference MemorySegmentPool) — wide
+    string rows flush/spill long before any row cap."""
+    import numpy as np
+
+    from paimon_tpu.core.disk import IOManager, SpillableBuffer
+    from paimon_tpu.data.batch import ColumnBatch
+
+    # unit: SpillableBuffer spills on byte pressure with tiny row counts
+    io_mgr = IOManager(str(tmp_path / "bspill"))
+    buf = SpillableBuffer(io_mgr, in_memory_rows=10**9, in_memory_bytes=64 * 1024)
+    s = RowType.of(("a", BIGINT()), ("t", STRING()))
+    wide = "x" * 4096
+    for i in range(40):
+        buf.add(ColumnBatch.from_pydict(s, {"a": [i], "t": [wide]}))
+    assert buf.num_rows == 40
+    assert buf.spilled_bytes > 0  # spilled on bytes, nowhere near the row cap
+    got = [r[0] for b in buf.batches() for r in b.to_pylist()]
+    assert got == list(range(40))
+    io_mgr.close()
+
+    # integration: PK table with a small byte budget flushes mid-write, so a
+    # single big write lands as MULTIPLE level-0 files before commit
+    t = catalog.create_table(
+        "db.bytebudget",
+        RowType.of(("id", BIGINT()), ("payload", STRING())),
+        primary_keys=["id"],
+        options={"bucket": "1", "write-buffer-size": "256 kb", "write-only": "true"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    n = 2000
+    for lo in range(0, n, 100):
+        w.write({"id": list(range(lo, lo + 100)), "payload": [wide] * 100})
+    msgs = w.prepare_commit()
+    assert sum(len(m.new_files) for m in msgs) > 1  # byte budget forced early flushes
+    wb.new_commit().commit(msgs)
+    rb = t.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert out.num_rows == n

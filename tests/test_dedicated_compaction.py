@@ -1,0 +1,174 @@
+"""Dedicated compaction + multi-writer coordination (reference
+CompactorSink.java, AppendOnlyTableCompactionCoordinator.java): write-only
+ingest + a separate compactor, racing safely on one table."""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.table.compactor import (
+    AppendCompactionCoordinator,
+    DedicatedCompactor,
+    execute_compaction_task,
+)
+from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
+
+
+def _write(t, data):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _read(t):
+    rb = t.new_read_builder()
+    return sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+
+
+def test_write_only_ingest_plus_compactor(tmp_warehouse):
+    """Ingest never compacts; the dedicated job does, and reads stay equal."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="ingest")
+    t = cat.create_table(
+        "db.dc", SCHEMA, primary_keys=["k"], options={"bucket": "1", "write-only": "true"}
+    )
+    for r in range(6):
+        _write(t, {"k": list(range(20)), "v": [float(r * 100 + i) for i in range(20)]})
+    plan = t.store.new_scan().plan()
+    assert len(plan.entries) == 6  # six L0 runs, untouched by ingest
+    before = _read(t)
+
+    compactor = DedicatedCompactor(t)
+    assert compactor.run_once(full=True) is True
+    t2 = cat.get_table("db.dc")
+    plan2 = t2.store.new_scan().plan()
+    assert len(plan2.entries) < 6
+    assert all(e.file.level == t2.store.options.num_levels - 1 for e in plan2.entries)
+    assert _read(t2) == before
+    snap = t2.store.snapshot_manager.latest_snapshot()
+    assert snap.commit_kind == "COMPACT"
+    # nothing left to do
+    assert compactor.run_once(full=True) is False
+
+
+def test_compactor_abandons_on_conflict(tmp_warehouse):
+    """Two compactors race on the same files: exactly one wins, the loser
+    abandons (reference noConflictsOrFail loser semantics), data intact."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="race")
+    t = cat.create_table(
+        "db.race", SCHEMA, primary_keys=["k"], options={"bucket": "1", "write-only": "true"}
+    )
+    for r in range(4):
+        _write(t, {"k": list(range(10)), "v": [float(r * 10 + i) for i in range(10)]})
+    before = _read(t)
+
+    # both compactors read the same snapshot and prepare overlapping rewrites
+    c1 = DedicatedCompactor(cat.get_table("db.race"))
+    c2 = DedicatedCompactor(cat.get_table("db.race"))
+    from paimon_tpu.table.write import BatchWriteBuilder, TableCommit
+
+    w1 = c1.table.new_batch_write_builder().new_write()
+    w2 = c2.table.new_batch_write_builder().new_write()
+    w1.compact(full=True)
+    w2.compact(full=True)
+    m1, m2 = w1.prepare_commit(), w2.prepare_commit()
+    TableCommit(c1.table).commit_messages(BatchWriteBuilder.COMMIT_IDENTIFIER, m1)
+    from paimon_tpu.core.commit import CommitConflictError
+
+    with pytest.raises(CommitConflictError):
+        TableCommit(c2.table).commit_messages(BatchWriteBuilder.COMMIT_IDENTIFIER, m2)
+    t3 = cat.get_table("db.race")
+    assert _read(t3) == before
+
+
+def test_append_coordinator_worker_split(tmp_warehouse):
+    """Unaware-bucket append table: coordinator plans small-file tasks,
+    workers execute them independently, coordinator commits once."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="coord")
+    t = cat.create_table(
+        "db.ap",
+        RowType.of(("p", BIGINT()), ("x", BIGINT())),
+        partition_keys=["p"],
+        options={"write-only": "true", "compaction.min.file-num": "3"},
+    )
+    for r in range(4):
+        _write(t, {"p": [1] * 5 + [2] * 5, "x": list(range(r * 10, r * 10 + 10))})
+    rows_before = _read(t)
+    plan = t.store.new_scan().plan()
+    files_before = len(plan.entries)
+    assert files_before == 8  # 4 commits x 2 partitions
+
+    coord = AppendCompactionCoordinator(t)
+    tasks = coord.plan()
+    assert len(tasks) == 2  # one per partition
+    assert {(tuple(task.partition), task.bucket) for task in tasks} == {((1,), 0), ((2,), 0)}
+    # workers run independently (order irrelevant); coordinator commits once
+    msgs = [execute_compaction_task(t, task) for task in reversed(tasks)]
+    coord.commit(msgs)
+
+    t2 = cat.get_table("db.ap")
+    assert sorted(_read(t2)) == sorted(rows_before)
+    plan2 = t2.store.new_scan().plan()
+    assert len(plan2.entries) < files_before
+    assert t2.store.snapshot_manager.latest_snapshot().commit_kind == "COMPACT"
+
+
+def test_ingest_and_compactor_processes_race(tmp_warehouse):
+    """Tier-5: a writer process streams write-only commits while a compactor
+    process loops full compactions. Both survive, and the final table equals
+    last-writer-wins over every committed batch."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="parent")
+    cat.create_table(
+        "db.r5", SCHEMA, primary_keys=["k"], options={"bucket": "1", "write-only": "true"}
+    )
+    path = f"{tmp_warehouse}/db.db/r5"
+    writer_code = textwrap.dedent(f"""
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paimon_tpu.table import load_table
+        t = load_table("{path}", commit_user="w")
+        for r in range(12):
+            wb = t.new_batch_write_builder(); w = wb.new_write()
+            w.write({{"k": list(range(30)), "v": [float(r * 1000 + i) for i in range(30)]}})
+            wb.new_commit().commit(w.prepare_commit())
+        print("writer done")
+    """)
+    compactor_code = textwrap.dedent(f"""
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paimon_tpu.table import load_table
+        from paimon_tpu.table.compactor import DedicatedCompactor
+        t = load_table("{path}", commit_user="c")
+        c = DedicatedCompactor(t)
+        done = 0
+        for _ in range(8):
+            if c.run_once(full=True):
+                done += 1
+        print("compactor done", done)
+    """)
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"}
+    pw = subprocess.Popen([sys.executable, "-c", writer_code], cwd="/root/repo", env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    pc = subprocess.Popen([sys.executable, "-c", compactor_code], cwd="/root/repo", env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    ow, ew = pw.communicate(timeout=240)
+    oc, ec = pc.communicate(timeout=240)
+    assert pw.returncode == 0, ew
+    assert pc.returncode == 0, ec
+    assert "writer done" in ow and "compactor done" in oc
+
+    t = cat.get_table("db.r5")
+    rows = _read(t)
+    # every key present exactly once, value from the LAST writer commit
+    assert [r[0] for r in rows] == list(range(30))
+    assert all(v == 11_000.0 + k for k, v in rows), rows[:3]
+    kinds = set()
+    sm = t.store.snapshot_manager
+    for sid in range(sm.earliest_snapshot_id(), sm.latest_snapshot_id() + 1):
+        if sm.snapshot_exists(sid):
+            kinds.add(sm.snapshot(sid).commit_kind)
+    assert "APPEND" in kinds  # both kinds of commits interleaved
