@@ -60,9 +60,7 @@ class OrcFormat(FileFormat):
                 from ..metrics import registry
 
                 try:
-                    from .orc_meta import read_tail
-
-                    tail = read_tail(_tail_bytes(f))
+                    tail = _read_tail_from(f)
                 except Exception:  # malformed/foreign tail: read everything
                     tail = None
                 f.seek(0)
@@ -81,21 +79,21 @@ class OrcFormat(FileFormat):
             f.close()
 
 
-def _tail_bytes(f, first_guess: int = 256 * 1024) -> bytes:
-    """Just the trailing region holding postscript+footer+metadata — decode
-    stays stripe-by-stripe on the file handle, memory stays bounded."""
+def _read_tail_from(f, first_guess: int = 256 * 1024):
+    """Parse the OrcTail from just the trailing region holding
+    postscript+footer+metadata — decode stays stripe-by-stripe on the file
+    handle, memory stays bounded, and the tail parses exactly once."""
+    from .orc_meta import read_tail
+
     size = f.seek(0, 2)
     take = min(size, first_guess)
     f.seek(size - take)
     data = f.read(take)
     try:
-        from .orc_meta import read_tail
-
-        read_tail(data)
-        return data
+        return read_tail(data)
     except ValueError:  # tail larger than the guess: take the whole file
         f.seek(0)
-        return f.read()
+        return read_tail(f.read())
 
 
 register_format("orc", OrcFormat)

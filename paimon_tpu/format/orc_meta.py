@@ -161,8 +161,11 @@ def _parse_col_stats(buf: bytes) -> _ColStats:
                     cs.min = v2.decode("utf-8", "replace")
                 elif f2 == 2:
                     cs.max = v2.decode("utf-8", "replace")
-        elif field == 5:  # BucketStatistics: repeated uint64 count (packed)
-            counts = _packed_varints(v)
+        elif field == 5:  # BucketStatistics { repeated uint64 count [packed] }
+            counts: list[int] = []
+            for f2, _, v2 in fields_of(v):
+                if f2 == 1:
+                    counts.extend(_packed_varints(v2))
             if counts:
                 cs.true_count = counts[0]
         elif field == 7:  # DateStatistics (sint32 days)
@@ -201,7 +204,7 @@ class OrcTail:
             null_count = rows - cs.values if cs.values <= rows else (0 if not cs.has_null else None)
             mn, mx = cs.min, cs.max
             if cs.true_count is not None:  # boolean column
-                mn = cs.true_count < cs.values  # any False present -> min False
+                mn = cs.true_count >= cs.values  # min True iff NO False rows
                 mx = cs.true_count > 0
             out[name] = FieldStats(mn, mx, null_count, rows)
         return out

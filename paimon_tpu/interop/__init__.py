@@ -1,0 +1,19 @@
+"""Reference-layout interop: byte-compatible metadata read/write.
+
+The reference persists table metadata as JSON snapshots/schemas, Avro
+manifests, and BinaryRow-serialized keys/partitions/stats
+(/root/reference/paimon-core/.../manifest/ManifestFile.java:48,
+Snapshot.java:68-183, utils/SerializationUtils.java:75-89). This package
+implements those byte formats natively so a table laid out by the reference
+can be scanned here, and golden fixtures written here follow the reference's
+layout exactly:
+
+  binary_row  — BinaryRow encode/decode (null bitset + 8B slots + var part)
+  avro_io     — generic Avro object-container file read/write for the
+                manifest record schemas
+  golden      — reference-layout table writer (fixtures) + reader/scanner
+"""
+
+from .golden import read_reference_table, write_reference_table
+
+__all__ = ["read_reference_table", "write_reference_table"]

@@ -1,0 +1,351 @@
+"""Reference-layout tables: write golden fixtures, read them back.
+
+Byte-format parity targets (studied, not copied):
+  snapshot JSON     Snapshot.java:68-183 (field names, commitKind enum)
+  schema JSON       schema/SchemaSerializer.java (version 2, compact types)
+  manifest avro     manifest/ManifestEntry.schema() + DataFileMeta.SCHEMA +
+                    stats/SimpleStatsConverter.schema(), wrapped with the
+                    _VERSION field (utils/VersionedObjectSerializer.java:40),
+                    avro naming per format/avro/AvroSchemaConverter.java:56
+  manifest list     manifest/ManifestFileMeta.schema(), version 2
+  binary rows       data/BinaryRow.java layout via interop.binary_row
+  data files        KeyValue.schema(): _KEY_<pk> fields + _SEQUENCE_NUMBER +
+                    _VALUE_KIND + value fields (KeyValue.java:115-120),
+                    parquet via the shared format layer
+
+write_reference_table builds a complete single-bucket PK table in this
+layout; read_reference_table scans ANY such table (fixture or produced by
+the reference) through the normal merge path.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+
+import numpy as np
+
+from ..data.batch import ColumnBatch
+from ..fs import FileIO, LocalFileIO
+from ..types import BIGINT, INT, TINYINT, DataField, RowType
+from .avro_io import read_ocf, write_ocf
+from .binary_row import deserialize_binary_row, serialize_binary_row
+
+__all__ = ["write_reference_table", "read_reference_table"]
+
+_RECORD = "org.apache.paimon.avro.generated.record"
+
+
+def _nullable(t):
+    return ["null", t]
+
+
+def _stats_schema(name: str) -> dict:
+    return {
+        "type": "record",
+        "name": name,
+        "fields": [
+            {"name": "_MIN_VALUES", "type": "bytes"},
+            {"name": "_MAX_VALUES", "type": "bytes"},
+            {"name": "_NULL_COUNTS", "type": _nullable({"type": "array", "items": _nullable("long")})},
+        ],
+    }
+
+
+def manifest_entry_schema() -> dict:
+    file_rec = {
+        "type": "record",
+        "name": f"{_RECORD}__FILE",
+        "fields": [
+            {"name": "_FILE_NAME", "type": "string"},
+            {"name": "_FILE_SIZE", "type": "long"},
+            {"name": "_ROW_COUNT", "type": "long"},
+            {"name": "_MIN_KEY", "type": "bytes"},
+            {"name": "_MAX_KEY", "type": "bytes"},
+            {"name": "_KEY_STATS", "type": _stats_schema(f"{_RECORD}__FILE__KEY_STATS")},
+            {"name": "_VALUE_STATS", "type": _stats_schema(f"{_RECORD}__FILE__VALUE_STATS")},
+            {"name": "_MIN_SEQUENCE_NUMBER", "type": "long"},
+            {"name": "_MAX_SEQUENCE_NUMBER", "type": "long"},
+            {"name": "_SCHEMA_ID", "type": "long"},
+            {"name": "_LEVEL", "type": "int"},
+            {"name": "_EXTRA_FILES", "type": {"type": "array", "items": "string"}},
+            {
+                "name": "_CREATION_TIME",
+                "type": _nullable({"type": "long", "logicalType": "timestamp-millis"}),
+                "default": None,
+            },
+            {"name": "_DELETE_ROW_COUNT", "type": _nullable("long"), "default": None},
+            {"name": "_EMBEDDED_FILE_INDEX", "type": _nullable("bytes"), "default": None},
+            {"name": "_FILE_SOURCE", "type": _nullable("int"), "default": None},
+        ],
+    }
+    return {
+        "type": "record",
+        "name": _RECORD,
+        "fields": [
+            {"name": "_VERSION", "type": "int"},
+            {"name": "_KIND", "type": "int"},
+            {"name": "_PARTITION", "type": "bytes"},
+            {"name": "_BUCKET", "type": "int"},
+            {"name": "_TOTAL_BUCKETS", "type": "int"},
+            {"name": "_FILE", "type": file_rec},
+        ],
+    }
+
+
+def manifest_meta_schema() -> dict:
+    return {
+        "type": "record",
+        "name": _RECORD,
+        "fields": [
+            {"name": "_VERSION", "type": "int"},
+            {"name": "_FILE_NAME", "type": "string"},
+            {"name": "_FILE_SIZE", "type": "long"},
+            {"name": "_NUM_ADDED_FILES", "type": "long"},
+            {"name": "_NUM_DELETED_FILES", "type": "long"},
+            {"name": "_PARTITION_STATS", "type": _stats_schema(f"{_RECORD}__PARTITION_STATS")},
+            {"name": "_SCHEMA_ID", "type": "long"},
+        ],
+    }
+
+
+def _kv_disk_schema(schema: RowType, primary_keys: list[str]) -> RowType:
+    """KeyValue on-disk schema (KeyValue.java:115-120)."""
+    fields: list[DataField] = []
+    for pk in primary_keys:
+        f = schema.field(pk)
+        fields.append(DataField(f.id, f"_KEY_{f.name}", f.type))
+    fields.append(DataField(2147483646, "_SEQUENCE_NUMBER", BIGINT(False)))
+    fields.append(DataField(2147483645, "_VALUE_KIND", TINYINT(False)))
+    fields.extend(schema.fields)
+    return RowType(tuple(fields))
+
+
+def _empty_stats(arity: int, types) -> dict:
+    return {
+        "_MIN_VALUES": serialize_binary_row([None] * arity, types),
+        "_MAX_VALUES": serialize_binary_row([None] * arity, types),
+        "_NULL_COUNTS": [0] * arity,
+    }
+
+
+def write_reference_table(
+    path: str,
+    schema: RowType,
+    primary_keys: list[str],
+    batches: list[dict],
+    file_io: FileIO | None = None,
+    options: dict | None = None,
+) -> None:
+    """Write `batches` (one data file + snapshot per batch, ascending seq) as
+    a complete reference-layout table: schema-0, bucket-0 parquet KV files,
+    avro manifests + manifest lists, snapshot JSONs + LATEST hint."""
+    io = file_io or LocalFileIO()
+    from ..format import get_format
+
+    opts = {"bucket": "1", **(options or {})}
+    key_types = [schema.field(pk).type for pk in primary_keys]
+    disk_schema = _kv_disk_schema(schema, primary_keys)
+    schema_json = {
+        "version": 2,
+        "id": 0,
+        "fields": [f.to_dict() for f in schema.fields],
+        "highestFieldId": max(f.id for f in schema.fields),
+        "partitionKeys": [],
+        "primaryKeys": list(primary_keys),
+        "options": opts,
+        "timeMillis": int(time.time() * 1000),
+    }
+    io.mkdirs(f"{path}/schema")
+    io.mkdirs(f"{path}/manifest")
+    io.mkdirs(f"{path}/snapshot")
+    io.mkdirs(f"{path}/bucket-0")
+    io.write_bytes(f"{path}/schema/schema-0", json.dumps(schema_json).encode())
+
+    fmt = get_format("parquet")
+    seq = 0
+    entry_schema = manifest_entry_schema()
+    meta_schema = manifest_meta_schema()
+    base_entries: list[dict] = []
+    total_rows = 0
+    for snap_id, data in enumerate(batches, start=1):
+        batch = ColumnBatch.from_pydict(schema, data)
+        n = batch.num_rows
+        order = np.lexsort([batch.column(pk).values for pk in reversed(primary_keys)])
+        batch = batch.take(order)
+        cols = {}
+        for pk in primary_keys:
+            cols[f"_KEY_{pk}"] = batch.column(pk)
+        from ..data.batch import Column
+
+        cols["_SEQUENCE_NUMBER"] = Column(np.arange(seq, seq + n, dtype=np.int64))
+        cols["_VALUE_KIND"] = Column(np.zeros(n, dtype=np.int8))
+        for f in schema.fields:
+            cols[f.name] = batch.column(f.name)
+        disk = ColumnBatch(disk_schema, cols)
+        file_name = f"data-{uuid.uuid4().hex}-0.parquet"
+        fmt.write(io, f"{path}/bucket-0/{file_name}", disk)
+        size = io.get_status(f"{path}/bucket-0/{file_name}").size
+        min_key = [batch.column(pk).values[0] for pk in primary_keys]
+        max_key = [batch.column(pk).values[-1] for pk in primary_keys]
+        entry = {
+            "_VERSION": 2,
+            "_KIND": 0,  # ADD
+            "_PARTITION": serialize_binary_row([], []),
+            "_BUCKET": 0,
+            "_TOTAL_BUCKETS": 1,
+            "_FILE": {
+                "_FILE_NAME": file_name,
+                "_FILE_SIZE": size,
+                "_ROW_COUNT": n,
+                "_MIN_KEY": serialize_binary_row([_py(v) for v in min_key], key_types),
+                "_MAX_KEY": serialize_binary_row([_py(v) for v in max_key], key_types),
+                "_KEY_STATS": {
+                    "_MIN_VALUES": serialize_binary_row([_py(v) for v in min_key], key_types),
+                    "_MAX_VALUES": serialize_binary_row([_py(v) for v in max_key], key_types),
+                    "_NULL_COUNTS": [0] * len(primary_keys),
+                },
+                "_VALUE_STATS": _empty_stats(len(schema.fields), [f.type for f in schema.fields]),
+                "_MIN_SEQUENCE_NUMBER": seq,
+                "_MAX_SEQUENCE_NUMBER": seq + n - 1,
+                "_SCHEMA_ID": 0,
+                "_LEVEL": 0,
+                "_EXTRA_FILES": [],
+                "_CREATION_TIME": int(time.time() * 1000),
+                "_DELETE_ROW_COUNT": 0,
+                "_EMBEDDED_FILE_INDEX": None,
+                "_FILE_SOURCE": 0,
+            },
+        }
+        seq += n
+        total_rows += n
+
+        delta_manifest = f"manifest-{uuid.uuid4().hex}-0"
+        io.write_bytes(f"{path}/manifest/{delta_manifest}", write_ocf(entry_schema, [entry]))
+        delta_meta = {
+            "_VERSION": 2,
+            "_FILE_NAME": delta_manifest,
+            "_FILE_SIZE": io.get_status(f"{path}/manifest/{delta_manifest}").size,
+            "_NUM_ADDED_FILES": 1,
+            "_NUM_DELETED_FILES": 0,
+            "_PARTITION_STATS": _empty_stats(0, []),
+            "_SCHEMA_ID": 0,
+        }
+        base_manifest = f"manifest-{uuid.uuid4().hex}-0"
+        io.write_bytes(f"{path}/manifest/{base_manifest}", write_ocf(entry_schema, list(base_entries)))
+        base_meta = {
+            "_VERSION": 2,
+            "_FILE_NAME": base_manifest,
+            "_FILE_SIZE": io.get_status(f"{path}/manifest/{base_manifest}").size,
+            "_NUM_ADDED_FILES": len(base_entries),
+            "_NUM_DELETED_FILES": 0,
+            "_PARTITION_STATS": _empty_stats(0, []),
+            "_SCHEMA_ID": 0,
+        }
+        base_list = f"manifest-list-{uuid.uuid4().hex}-0"
+        delta_list = f"manifest-list-{uuid.uuid4().hex}-1"
+        io.write_bytes(f"{path}/manifest/{base_list}", write_ocf(meta_schema, [base_meta] if base_entries else []))
+        io.write_bytes(f"{path}/manifest/{delta_list}", write_ocf(meta_schema, [delta_meta]))
+        base_entries.append(entry)
+
+        snapshot = {
+            "version": 3,
+            "id": snap_id,
+            "schemaId": 0,
+            "baseManifestList": base_list,
+            "deltaManifestList": delta_list,
+            "changelogManifestList": None,
+            "commitUser": "golden-fixture",
+            "commitIdentifier": 9223372036854775807,
+            "commitKind": "APPEND",
+            "timeMillis": int(time.time() * 1000),
+            "logOffsets": {},
+            "totalRecordCount": total_rows,
+            "deltaRecordCount": n,
+            "changelogRecordCount": 0,
+            "watermark": -9223372036854775808,
+        }
+        io.write_bytes(f"{path}/snapshot/snapshot-{snap_id}", json.dumps(snapshot).encode())
+    io.write_bytes(f"{path}/snapshot/LATEST", str(len(batches)).encode())
+    io.write_bytes(f"{path}/snapshot/EARLIEST", b"1")
+
+
+def _py(v):
+    return v.item() if hasattr(v, "item") else v
+
+
+def read_reference_table(path: str, file_io: FileIO | None = None) -> tuple[RowType, ColumnBatch]:
+    """Scan a reference-layout table (latest snapshot, merge-on-read with
+    deduplicate semantics) into (value schema, rows). Works on golden
+    fixtures and on unpartitioned single-bucket reference tables."""
+    from ..core.datafile import DataFileMeta
+    from ..core.kv import KVBatch
+    from ..core.mergefn import MergeExecutor
+    from ..core.schema import TableSchema
+    from ..core.snapshot import SnapshotManager
+    from ..format import get_format
+
+    io = file_io or LocalFileIO()
+    sm = SnapshotManager(io, path)
+    snap = sm.latest_snapshot()
+    assert snap is not None, f"no snapshots under {path}"
+    ts = TableSchema.from_json(io.read_bytes(f"{path}/schema/schema-{snap.schema_id}"))
+    schema = RowType(ts.fields)
+    primary_keys = list(ts.primary_keys)
+    key_types = [schema.field(pk).type for pk in primary_keys]
+    disk_schema = _kv_disk_schema(schema, primary_keys)
+
+    # manifest lists -> entries (live files of the latest snapshot)
+    def read_list(name: str) -> list[dict]:
+        _, metas = read_ocf(io.read_bytes(f"{path}/manifest/{name}"))
+        entries: list[dict] = []
+        for m in metas:
+            _, es = read_ocf(io.read_bytes(f"{path}/manifest/{m['_FILE_NAME']}"))
+            entries.extend(es)
+        return entries
+
+    entries = read_list(snap.base_manifest_list) + read_list(snap.delta_manifest_list)
+    live: dict[str, dict] = {}
+    for e in entries:
+        f = e["_FILE"]
+        if e["_KIND"] == 0:
+            live[f["_FILE_NAME"]] = e
+        else:
+            live.pop(f["_FILE_NAME"], None)
+
+    files = []
+    for e in live.values():
+        f = e["_FILE"]
+        files.append(
+            DataFileMeta(
+                file_name=f["_FILE_NAME"],
+                file_size=f["_FILE_SIZE"],
+                row_count=f["_ROW_COUNT"],
+                min_key=tuple(deserialize_binary_row(f["_MIN_KEY"], key_types)),
+                max_key=tuple(deserialize_binary_row(f["_MAX_KEY"], key_types)),
+                key_stats={},
+                value_stats={},
+                min_sequence_number=f["_MIN_SEQUENCE_NUMBER"],
+                max_sequence_number=f["_MAX_SEQUENCE_NUMBER"],
+                schema_id=f["_SCHEMA_ID"],
+                level=f["_LEVEL"],
+            )
+        )
+
+    fmt = get_format("parquet")
+    parts = []
+    for meta in sorted(files, key=lambda x: x.min_sequence_number):
+        for b in fmt.read(io, f"{path}/bucket-0/{meta.file_name}", disk_schema):
+            parts.append(b)
+    if not parts:
+        return schema, ColumnBatch.empty(schema)
+    from ..data.batch import concat_batches
+
+    disk = concat_batches(parts)
+    seqs = disk.column("_SEQUENCE_NUMBER").values.astype(np.int64)
+    kinds = disk.column("_VALUE_KIND").values.astype(np.uint8)
+    value = ColumnBatch(schema, {f.name: disk.column(f.name) for f in schema.fields})
+    kv = KVBatch(value, seqs, kinds)
+    merged = MergeExecutor(schema, primary_keys).merge(kv).drop_deletes()
+    return schema, merged.data

@@ -121,6 +121,14 @@ class _KernelCache:
             self._fns[key] = fn
         return fn
 
+    def key_axis_dedup(self, mesh, k: int, s: int):
+        key = ("keyaxis", id(mesh), k, s)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = _make_key_axis_dedup(mesh, k, s)
+            self._fns[key] = fn
+        return fn
+
 
 _KERNELS = _KernelCache()
 
@@ -141,24 +149,16 @@ def _shard_map():
 def _make_batched_dedup(mesh, k: int, s: int):
     """(B, m, K) uint32 key lanes, (B, m, S) seq lanes, (B, m) pad ->
     per-bucket packed selected input indices + counts, buckets sharded over
-    the mesh's bucket axis."""
+    the mesh's bucket axis. The kernel body IS ops.merge.sorted_segments /
+    pack_selected — one copy of the semantics for mesh and single-device."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from ..ops.merge import pack_selected, sorted_segments
+
     def per_bucket(kl, sl, pf):  # (m, K), (m, S), (m,)
-        m = pf.shape[0]
-        iota = jnp.arange(m, dtype=jnp.int32)
-        operands = [pf] + [kl[:, i] for i in range(k)] + [sl[:, i] for i in range(s)] + [iota]
-        out = jax.lax.sort(operands, num_keys=1 + k + s, is_stable=True)
-        perm = out[-1]
-        seg_keys = jnp.stack(out[: 1 + k], axis=0)
-        neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
-        keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
-        sel = keep_last & (out[0] == 0)
-        not_sel = (~sel).astype(jnp.uint32)
-        _, packed = jax.lax.sort([not_sel, perm], num_keys=1, is_stable=True)
-        return packed, sel.sum()
+        pad_sorted, perm, _, keep_last, _ = sorted_segments(k, s, kl.T, sl.T, pf)
+        return pack_selected(keep_last & (pad_sorted == 0), perm)
 
     def shard_fn(kl, sl, pf):
         return jax.vmap(per_bucket)(kl, sl, pf)
@@ -177,20 +177,12 @@ def _make_batched_plan(mesh, k: int, s: int):
     (perm, seg_start, keep_last, seg_id) per bucket — the non-dedup engines
     continue host-side with segment reductions."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from ..ops.merge import sorted_segments
+
     def per_bucket(kl, sl, pf):
-        m = pf.shape[0]
-        iota = jnp.arange(m, dtype=jnp.int32)
-        operands = [pf] + [kl[:, i] for i in range(k)] + [sl[:, i] for i in range(s)] + [iota]
-        out = jax.lax.sort(operands, num_keys=1 + k + s, is_stable=True)
-        perm = out[-1]
-        seg_keys = jnp.stack(out[: 1 + k], axis=0)
-        neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
-        seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
-        keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
-        seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+        _, perm, seg_start, keep_last, seg_id = sorted_segments(k, s, kl.T, sl.T, pf)
         return perm, seg_start, keep_last, seg_id
 
     def shard_fn(kl, sl, pf):
@@ -210,32 +202,16 @@ def _make_batched_plan(mesh, k: int, s: int):
 # ---------------------------------------------------------------------------
 
 
-def distributed_dedup_select(mesh, key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None) -> np.ndarray:
-    """Dedup selection for ONE bucket whose rows are sharded over the mesh's
-    "key" axis: sample splitters (all_gather), range-shuffle rows to their
-    owner (all_to_all over ICI), locally sort + keep-last, return the winning
-    INPUT row indices in global key order. The row id rides the shuffle as the
-    final sort lane, which reproduces input-order tie-break across devices."""
+def _make_key_axis_dedup(mesh, k: int, s: int):
+    """jitted range-shuffle dedup over the mesh's key axis (cached per
+    (mesh, lane arity) like the bucket-axis kernels)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from .merge import _local_plan, _range_exchange
 
-    n, k = key_lanes.shape
     p = mesh.shape["key"]
-    if seq_lanes is None:
-        seq_lanes = np.zeros((n, 0), dtype=np.uint32)
-    s = seq_lanes.shape[1]
-    m_loc = -(-n // p)  # ceil
-    total = m_loc * p
-    kl = np.full((total, k), 0xFFFFFFFF, dtype=np.uint32)
-    kl[:n] = key_lanes
-    sl = np.zeros((total, s + 1), dtype=np.uint32)
-    sl[:n, :s] = seq_lanes
-    sl[:, s] = np.arange(total, dtype=np.uint32)  # row id = last tie-break lane
-    pad = np.zeros(total, dtype=np.uint32)
-    pad[n:] = 1
     sentinel = np.uint32(0xFFFFFFFF)
 
     def shard_fn(klx, slx, pfx):
@@ -251,9 +227,32 @@ def distributed_dedup_select(mesh, key_lanes: np.ndarray, seq_lanes: np.ndarray 
         in_specs=(P("key", None), P("key", None), P("key")),
         out_specs=P("key"),
     )
-    out = np.asarray(jax.jit(fn)(kl, sl, pad))
+    return jax.jit(fn)
+
+
+def distributed_dedup_select(mesh, key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None) -> np.ndarray:
+    """Dedup selection for ONE bucket whose rows are sharded over the mesh's
+    "key" axis: sample splitters (all_gather), range-shuffle rows to their
+    owner (all_to_all over ICI), locally sort + keep-last, return the winning
+    INPUT row indices in global key order. The row id rides the shuffle as the
+    final sort lane, which reproduces input-order tie-break across devices."""
+    n, k = key_lanes.shape
+    p = mesh.shape["key"]
+    if seq_lanes is None:
+        seq_lanes = np.zeros((n, 0), dtype=np.uint32)
+    s = seq_lanes.shape[1]
+    m_loc = -(-n // p)  # ceil
+    total = m_loc * p
+    kl = np.full((total, k), 0xFFFFFFFF, dtype=np.uint32)
+    kl[:n] = key_lanes
+    sl = np.zeros((total, s + 1), dtype=np.uint32)
+    sl[:n, :s] = seq_lanes
+    sl[:, s] = np.arange(total, dtype=np.uint32)  # row id = last tie-break lane
+    pad = np.zeros(total, dtype=np.uint32)
+    pad[n:] = 1
+    out = np.asarray(_KERNELS.key_axis_dedup(mesh, k, s)(kl, sl, pad))
     # shards own ascending key ranges and emit sorted order -> already key order
-    return out[out != sentinel].astype(np.int32)
+    return out[out != np.uint32(0xFFFFFFFF)].astype(np.int32)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Regenerate tests/fixtures/golden_table — a committed reference-layout
+Paimon table (schema JSON + avro manifests + snapshot JSON + parquet KV
+files) used by test_interop.test_golden_fixture_committed_in_repo."""
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from paimon_tpu.interop import write_reference_table
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+here = os.path.dirname(os.path.abspath(__file__))
+target = os.path.join(here, "golden_table")
+shutil.rmtree(target, ignore_errors=True)
+schema = RowType.of(("id", BIGINT(False)), ("name", STRING()), ("score", DOUBLE()))
+write_reference_table(
+    target,
+    schema,
+    ["id"],
+    [
+        {"id": [1, 2], "name": ["one", "two"], "score": [1.0, 2.0]},
+        {"id": [1, 3], "name": ["one-v2", "three"], "score": [100.0, 3.0]},
+    ],
+)
+print("regenerated", target)

@@ -224,3 +224,31 @@ def test_orc_stripe_pruning_skips_stripes(tmp_warehouse):
     assert out.to_pylist() == [(5, 5.0)]
     snap = registry.snapshot()
     assert snap.get("scan", {}).get("orc_stripes_skipped", 0) >= 1
+
+
+def test_orc_boolean_stripe_stats_not_inverted(tmp_path):
+    """Regression: min for a mixed True/False stripe must be False, else
+    equal(flag, False) pruned stripes that contain matching rows."""
+    import io
+
+    import pyarrow as pa
+    import pyarrow.orc as po
+
+    from paimon_tpu.data.predicate import equal
+    from paimon_tpu.format.orc_meta import read_tail
+
+    t = pa.table({"flag": [True] * 100 + [False] * 100})
+    buf = io.BytesIO()
+    po.write_table(t, buf, compression="zstd")
+    tail = read_tail(buf.getvalue())
+    st = tail.stripe_stats(0)["flag"]
+    assert st.min is False and st.max is True
+    assert equal("flag", False).test_stats({"flag": st})
+    assert equal("flag", True).test_stats({"flag": st})
+    # all-True stripe prunes equal(flag, False)
+    t2 = pa.table({"flag": [True] * 50})
+    buf2 = io.BytesIO()
+    po.write_table(t2, buf2, compression="zstd")
+    st2 = read_tail(buf2.getvalue()).stripe_stats(0)["flag"]
+    assert st2.min is True and st2.max is True
+    assert not equal("flag", False).test_stats({"flag": st2})
