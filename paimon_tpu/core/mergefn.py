@@ -403,7 +403,10 @@ class MergeExecutor:
         ignore_retract = (self.options.field_option(field_name, "ignore-retract") or "false").lower() == "true"
         delim = self.options.field_option(field_name, "list-agg-delimiter") or ","
         distinct = (self.options.field_option(field_name, "distinct") or "false").lower() == "true"
-        return AggregateSpec(fn, ignore_retract, delim, distinct)
+        nested_key = tuple(
+            s.strip() for s in (self.options.field_option(field_name, "nested-key") or "").split(",") if s.strip()
+        )
+        return AggregateSpec(fn, ignore_retract, delim, distinct, nested_key)
 
     def _aggregate(self, kv: KVBatch, plan, last_take, out_seq) -> KVBatch:
         cols: dict[str, Column] = {}

@@ -251,6 +251,8 @@ class ColumnBatch:
     def to_arrow(self):
         import pyarrow as pa
 
+        from ..types import TypeRoot
+
         arrays = []
         for f in self.schema.fields:
             c = self.columns[f.name]
@@ -258,7 +260,13 @@ class ColumnBatch:
                 arrays.append(c.arrow)  # zero-conversion passthrough
                 continue
             mask = None if c.validity is None else ~c.validity
-            arrays.append(pa.array(c.values, from_pandas=True, mask=mask))
+            if f.type.root in (TypeRoot.ARRAY, TypeRoot.MAP, TypeRoot.ROW):
+                # nested columns need the declared type: inference cannot see
+                # struct shapes through object ndarrays
+                vals = [None if (mask is not None and mask[i]) else c.values[i] for i in range(len(c.values))]
+                arrays.append(pa.array(vals, type=_pa_nested_type(f.type)))
+            else:
+                arrays.append(pa.array(c.values, from_pandas=True, mask=mask))
         return pa.table(dict(zip(self.schema.field_names, arrays)))
 
     @staticmethod
@@ -271,6 +279,40 @@ class ColumnBatch:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"ColumnBatch(rows={self.num_rows}, fields={self.schema.field_names})"
+
+
+def _restore_nested(x, dtype: DataType):
+    """Recursively restore dict shape for maps at ANY nesting depth (arrow
+    reads maps back as [(k, v), ...] pair lists)."""
+    if x is None:
+        return None
+    root = dtype.root
+    if root == TypeRoot.MAP:
+        return {k: _restore_nested(v, dtype.value) for k, v in x}
+    if root == TypeRoot.ARRAY:
+        return [_restore_nested(e, dtype.element) for e in x]
+    if root == TypeRoot.ROW:
+        return {f.name: _restore_nested(x.get(f.name), f.type) for f in dtype.fields}
+    return x
+
+
+def _pa_nested_type(dtype: DataType):
+    """DataType -> pyarrow type for nested (array/map/row) columns."""
+    import pyarrow as pa
+
+    from ..types import TypeRoot
+
+    root = dtype.root
+    if root == TypeRoot.ARRAY:
+        return pa.list_(_pa_nested_type(dtype.element))
+    if root == TypeRoot.MAP:
+        return pa.map_(_pa_nested_type(dtype.key), _pa_nested_type(dtype.value))
+    if root == TypeRoot.ROW:
+        return pa.struct([(f.name, _pa_nested_type(f.type)) for f in dtype.fields])
+    np_dtype = dtype.numpy_dtype()
+    if np_dtype == np.dtype(object):
+        return pa.binary() if root in (TypeRoot.BINARY, TypeRoot.VARBINARY) else pa.string()
+    return pa.from_numpy_dtype(np_dtype)
 
 
 def _arrow_to_column(arr, dtype: DataType) -> Column:
@@ -287,7 +329,7 @@ def _arrow_to_column(arr, dtype: DataType) -> Column:
             # to_numpy would hand back ndarrays whose equality semantics break
             values = np.empty(len(arr), dtype=object)
             for i, x in enumerate(arr.to_pylist()):
-                values[i] = x
+                values[i] = _restore_nested(x, dtype)
         else:
             # keep the arrow backing: structural ops stay in C++ and the
             # object ndarray materializes only if python-level access happens

@@ -98,6 +98,10 @@ def collect_stats(batch: ColumnBatch, truncate: int = _TRUNCATE_LEN) -> dict[str
         if nulls >= n or n == 0:
             out[f.name] = FieldStats(None, None, nulls, n)
             continue
+        if f.type.root in (TypeRoot.ARRAY, TypeRoot.MAP, TypeRoot.ROW):
+            # nested values have no total order: null-count-only stats
+            out[f.name] = FieldStats(None, None, nulls, n)
+            continue
         valid = col.valid_mask()
         v = col.values[valid] if nulls else col.values
         if f.type.numpy_dtype() == np.dtype(object):

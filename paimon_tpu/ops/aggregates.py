@@ -45,6 +45,9 @@ AGGREGATORS = (
     "last_non_null_value",
     "listagg",
     "collect",
+    "merge_map",
+    "nested_update",
+    "primary-key",
 )
 
 _RETRACTABLE = {"sum", "count"}
@@ -56,6 +59,7 @@ class AggregateSpec:
     ignore_retract: bool = False
     listagg_delimiter: str = ","
     collect_distinct: bool = False
+    nested_key: tuple[str, ...] = ()  # nested_update: ARRAY<ROW> upsert key
 
 
 @functools.lru_cache(maxsize=None)
@@ -159,8 +163,18 @@ def aggregate_merge(
     valid = column.valid_mask()
     fn = spec.function
 
-    if fn in ("listagg", "collect"):
+    if fn in ("listagg", "collect", "merge_map", "nested_update"):
         return _host_aggregate(plan, values, valid, spec, row_kind)
+
+    if fn == "primary-key":
+        # always the latest arrival, null or not, retract rows included
+        # (reference FieldPrimaryKeyAgg: agg/retract both return inputField)
+        src_idx = _pick_fn(True)(
+            jnp.asarray(plan.perm),
+            jnp.asarray(plan.seg_id),
+            jnp.asarray(pad_to(np.ones(len(values), np.bool_), m, False)),
+        )
+        return _gather_column(column, np.asarray(src_idx)[:k])
 
     sign, include = _signs(row_kind, spec, values.dtype if values.dtype != np.dtype(object) else np.int64)
     eff_valid = valid & include
@@ -411,16 +425,17 @@ def _gather_column(column: Column, src: np.ndarray) -> Column:
 
 
 def _host_aggregate(plan: MergePlan, values, valid, spec: AggregateSpec, row_kind) -> Column:
-    """listagg / collect: variable-length outputs, built per segment on host
-    from the sorted order (still no comparator loops — slicing only)."""
+    """listagg / collect / merge_map / nested_update: variable-length or
+    structured outputs, built per segment on host from the sorted order
+    (still no comparator loops — slicing only)."""
     k = plan.num_segments
     order = plan.perm[plan.valid_sorted]
-    seg = plan.seg_id[plan.valid_sorted]
     v_sorted = values.take(order)
     ok_sorted = valid.take(order)
     retract = np.isin(row_kind, (int(RowKind.UPDATE_BEFORE), int(RowKind.DELETE))).take(order)
     if spec.ignore_retract:
         ok_sorted = ok_sorted & ~retract
+        retract = np.zeros_like(retract)
     elif retract.any() and spec.function == "listagg":
         raise ValueError("listagg cannot retract; configure ignore-retract")
     bounds = np.flatnonzero(plan.seg_start[plan.valid_sorted])
@@ -429,12 +444,37 @@ def _host_aggregate(plan: MergePlan, values, valid, spec: AggregateSpec, row_kin
     for s in range(k):
         lo = bounds[s]
         hi = bounds[s + 1] if s + 1 < k else len(order)
+        if spec.function == "merge_map":
+            out[s], validity[s] = _merge_map_segment(v_sorted, ok_sorted, retract, lo, hi)
+            continue
+        if spec.function == "nested_update":
+            out[s], validity[s] = _nested_update_segment(
+                v_sorted, ok_sorted, retract, lo, hi, spec.nested_key
+            )
+            continue
         vals = [v_sorted[i] for i in range(lo, hi) if ok_sorted[i]]
         if spec.function == "listagg":
             if vals:
                 out[s] = spec.listagg_delimiter.join(str(x) for x in vals)
                 validity[s] = True
         else:  # collect
+            vals = []
+            for i in range(lo, hi):
+                if not ok_sorted[i]:
+                    continue
+                x = v_sorted[i]
+                # an input may be a raw scalar OR an already-collected list
+                # (a stored row re-merged with new arrivals): flatten lists so
+                # re-aggregation is associative (reference FieldCollectAgg
+                # concatenates array inputs)
+                items = list(x) if isinstance(x, (list, tuple)) else [x]
+                if retract[i]:
+                    # reference FieldCollectAgg removes matching elements
+                    for item in items:
+                        if item in vals:
+                            vals.remove(item)
+                else:
+                    vals.extend(items)
             if spec.collect_distinct:
                 seen = []
                 for x in vals:
@@ -444,3 +484,60 @@ def _host_aggregate(plan: MergePlan, values, valid, spec: AggregateSpec, row_kin
             out[s] = vals
             validity[s] = True
     return Column(out, validity if not validity.all() else None)
+
+
+def _merge_map_segment(v_sorted, ok_sorted, retract, lo, hi):
+    """Dict union in (key, seq) order; null inputs keep the accumulator;
+    retract rows remove their keys (reference FieldMergeMapAgg)."""
+    acc = None
+    for i in range(lo, hi):
+        if not ok_sorted[i]:
+            continue
+        m = v_sorted[i]
+        if retract[i]:
+            if acc:
+                for key in dict(m):
+                    acc.pop(key, None)
+            continue
+        if acc is None:
+            acc = dict(m)
+        else:
+            acc.update(m)
+    return acc, acc is not None
+
+
+def _row_key(row, nested_key):
+    if isinstance(row, dict):
+        return tuple(row.get(f) for f in nested_key)
+    return tuple(row)  # full-row identity when no key configured
+
+
+def _nested_update_segment(v_sorted, ok_sorted, retract, lo, hi, nested_key):
+    """ARRAY<ROW> upsert: concat in order; with a nested key, later rows
+    replace earlier rows sharing the key; retract rows remove matching
+    elements (reference FieldNestedUpdateAgg)."""
+    acc = None
+    for i in range(lo, hi):
+        if not ok_sorted[i]:
+            continue
+        rows = v_sorted[i] or []
+        if retract[i]:
+            if acc:
+                if nested_key:
+                    dead = {_row_key(r, nested_key) for r in rows}
+                    acc = [r for r in acc if _row_key(r, nested_key) not in dead]
+                else:
+                    for r in rows:
+                        if r in acc:
+                            acc.remove(r)
+            continue
+        if acc is None:
+            acc = list(rows)
+        else:
+            acc.extend(rows)
+    if acc is not None and nested_key:
+        by_key = {}
+        for r in acc:
+            by_key[_row_key(r, nested_key)] = r  # last wins
+        acc = list(by_key.values())
+    return acc, acc is not None

@@ -38,6 +38,8 @@ __all__ = [
     "DOUBLE",
     "BOOLEAN",
     "STRING",
+    "CHAR",
+    "VARCHAR",
     "BYTES",
     "DATE",
     "TIMESTAMP",
@@ -223,6 +225,14 @@ def DOUBLE(nullable: bool = True) -> DataType:
 
 def BOOLEAN(nullable: bool = True) -> DataType:
     return DataType(TypeRoot.BOOLEAN, nullable)
+
+
+def CHAR(length: int, nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.CHAR, nullable, length=length)
+
+
+def VARCHAR(length: int, nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.VARCHAR, nullable, length=length)
 
 
 def STRING(nullable: bool = True) -> DataType:

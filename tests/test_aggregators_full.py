@@ -1,0 +1,198 @@
+"""Aggregators 14-18 (merge_map, nested_update, primary-key) + the full
+explicit cast matrix (reference mergetree/compact/aggregate/, casting/)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.data.batch import Column
+from paimon_tpu.data.casting import can_cast_explicit, cast_explicit
+from paimon_tpu.ops.aggregates import AGGREGATORS
+from paimon_tpu.types import (
+    BIGINT,
+    BOOLEAN,
+    DATE,
+    DECIMAL,
+    DOUBLE,
+    INT,
+    SMALLINT,
+    STRING,
+    TIMESTAMP,
+    TINYINT,
+    ArrayType,
+    DataField,
+    MapType,
+    RowType,
+)
+
+
+def _write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _read(t):
+    rb = t.new_read_builder()
+    return sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+
+
+def test_aggregator_registry_complete():
+    # the reference ships 18 FieldAggregator subclasses; ignore-retract is a
+    # wrapper (AggregateSpec.ignore_retract) and product is host-exact
+    assert set(AGGREGATORS) >= {
+        "sum", "product", "count", "max", "min", "bool_and", "bool_or",
+        "first_value", "first_non_null_value", "last_value", "last_non_null_value",
+        "listagg", "collect", "merge_map", "nested_update", "primary-key",
+    }
+    assert len(set(AGGREGATORS)) + 2 >= 18  # + ignore-retract wrapper + distinct collect
+
+
+def test_merge_map_aggregator(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="mm")
+    schema = RowType.of(("id", BIGINT()), ("m", MapType(STRING(), BIGINT())))
+    t = cat.create_table(
+        "db.mm", schema, primary_keys=["id"],
+        options={"bucket": "1", "merge-engine": "aggregation", "fields.m.aggregate-function": "merge_map"},
+    )
+    _write(t, {"id": [1, 2], "m": [{"a": 1, "b": 2}, None]})
+    _write(t, {"id": [1, 2], "m": [{"b": 20, "c": 3}, {"x": 9}]})
+    out = dict((r[0], r[1]) for r in _read(t))
+    assert out[1] == {"a": 1, "b": 20, "c": 3}  # later map wins per key
+    assert out[2] == {"x": 9}  # null input kept the accumulator
+
+
+def test_nested_update_aggregator(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="nu")
+    elem = RowType((DataField(100, "k", INT()), DataField(101, "note", STRING())))
+    schema = RowType.of(("id", BIGINT()), ("rows", ArrayType(elem)))
+    t = cat.create_table(
+        "db.nu", schema, primary_keys=["id"],
+        options={
+            "bucket": "1", "merge-engine": "aggregation",
+            "fields.rows.aggregate-function": "nested_update",
+            "fields.rows.nested-key": "k",
+        },
+    )
+    _write(t, {"id": [7], "rows": [[{"k": 1, "note": "one"}, {"k": 2, "note": "two"}]]})
+    _write(t, {"id": [7], "rows": [[{"k": 2, "note": "two-v2"}, {"k": 3, "note": "three"}]]})
+    out = _read(t)
+    got = sorted(out[0][1], key=lambda r: r["k"])
+    assert got == [
+        {"k": 1, "note": "one"},
+        {"k": 2, "note": "two-v2"},  # upsert by nested key
+        {"k": 3, "note": "three"},
+    ]
+
+
+def test_nested_update_without_key_appends(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="nu2")
+    elem = RowType((DataField(100, "x", INT()),))
+    schema = RowType.of(("id", BIGINT()), ("rows", ArrayType(elem)))
+    t = cat.create_table(
+        "db.nu2", schema, primary_keys=["id"],
+        options={"bucket": "1", "merge-engine": "aggregation",
+                 "fields.rows.aggregate-function": "nested_update"},
+    )
+    _write(t, {"id": [1], "rows": [[{"x": 1}]]})
+    _write(t, {"id": [1], "rows": [[{"x": 2}, {"x": 1}]]})
+    assert _read(t)[0][1] == [{"x": 1}, {"x": 2}, {"x": 1}]
+
+
+def test_primary_key_aggregator(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="pk")
+    schema = RowType.of(("id", BIGINT()), ("v", STRING()))
+    t = cat.create_table(
+        "db.pk", schema, primary_keys=["id"],
+        options={"bucket": "1", "merge-engine": "aggregation", "fields.v.aggregate-function": "primary-key"},
+    )
+    _write(t, {"id": [1, 2], "v": ["a", "b"]})
+    _write(t, {"id": [1, 2], "v": [None, "b2"]})  # null OVERWRITES (unlike last_non_null)
+    out = dict(_read(t))
+    assert out[1] is None and out[2] == "b2"
+
+
+# ---------------------------------------------------------------------------
+# full cast matrix
+# ---------------------------------------------------------------------------
+
+
+def _cast1(value, src, dst):
+    col = Column.from_pylist([value], src)
+    out = cast_explicit(col, src, dst)
+    return out.to_pylist()[0]
+
+
+def test_cast_matrix_numeric_and_boolean():
+    assert _cast1(300, INT(), TINYINT()) == 44  # Java truncation: (byte) 300
+    assert _cast1(3.9, DOUBLE(), BIGINT()) == 3
+    assert _cast1(True, BOOLEAN(), INT()) == 1
+    assert _cast1(0, INT(), BOOLEAN()) is False
+    assert _cast1(2, SMALLINT(), BOOLEAN()) is True
+    assert _cast1("true", STRING(), BOOLEAN()) is True
+    assert _cast1("nope", STRING(), BOOLEAN()) is None  # unparseable -> null
+    assert _cast1(False, BOOLEAN(), STRING()) == "false"
+
+
+def test_cast_matrix_temporal_and_decimal():
+    day = _cast1("2020-03-01", STRING(), DATE())
+    assert day == (np.datetime64("2020-03-01") - np.datetime64("1970-01-01")).astype(int)
+    assert _cast1(day, DATE(), STRING()) == "2020-03-01"
+    micros = _cast1("2020-03-01 12:30:00", STRING(), TIMESTAMP())
+    assert micros == day * 86_400_000_000 + (12 * 3600 + 30 * 60) * 1_000_000
+    assert _cast1(micros, TIMESTAMP(), DATE()) == day
+    assert _cast1(day, DATE(), TIMESTAMP()) == day * 86_400_000_000
+    assert "2020-03-01 12:30:00" in _cast1(micros, TIMESTAMP(), STRING())
+    # decimals: unscaled-int representation
+    assert _cast1("12.345", STRING(), DECIMAL(10, 2)) == 1235  # HALF_UP-ish via Decimal
+    assert _cast1(1235, DECIMAL(10, 2), STRING()) == "12.35"
+    assert _cast1(1235, DECIMAL(10, 2), DECIMAL(10, 1)) == 124  # rescale rounds
+    assert _cast1(1235, DECIMAL(10, 2), BIGINT()) == 12
+    assert _cast1(7, INT(), DECIMAL(10, 2)) == 700
+
+
+def test_cast_matrix_strings_and_bytes():
+    from paimon_tpu.types import BYTES, CHAR
+
+    assert _cast1("abc", STRING(), BYTES()) == b"abc"
+    assert _cast1(b"xyz", BYTES(), STRING()) == "xyz"
+    assert _cast1("toolong", STRING(), CHAR(3)) == "too"
+    assert _cast1("12.5", STRING(), DOUBLE()) == 12.5
+    assert _cast1(42, BIGINT(), STRING()) == "42"
+    assert not can_cast_explicit(BYTES(), BIGINT())
+
+
+def test_cast_review_regressions():
+    """Round-2 review: truncation-toward-zero, HALF_UP floats, overflow->null,
+    VARCHAR(n) truncation, exact big-int parse."""
+    from paimon_tpu.types import VARCHAR
+
+    assert _cast1(-15, DECIMAL(10, 1), INT()) == -1  # toward zero, not floor
+    assert _cast1(0.25, DOUBLE(), DECIMAL(10, 1)) == 3  # HALF_UP away from zero
+    assert _cast1(-0.25, DOUBLE(), DECIMAL(10, 1)) == -3
+    assert _cast1("1e30", STRING(), DECIMAL(10, 0)) is None  # overflow -> null
+    assert _cast1("99999999999999999999", STRING(), BIGINT()) is None
+    assert _cast1("9223372036854775807", STRING(), BIGINT()) == 9223372036854775807  # exact
+    assert _cast1("abcdef", STRING(), VARCHAR(2)) == "ab"
+
+
+def test_collect_retract_removes_elements(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="cr")
+    schema = RowType.of(("id", BIGINT()), ("v", STRING()))
+    t = cat.create_table(
+        "db.cr", schema, primary_keys=["id"],
+        options={"bucket": "1", "merge-engine": "aggregation", "fields.v.aggregate-function": "collect"},
+    )
+    _write(t, {"id": [1, 1, 1], "v": ["a", "b", "a"]})
+    _write(t, {"id": [1], "v": ["a"]}, kinds=["-D"])  # retract one 'a'
+    out = _read(t)
+    assert out[0][1] == ["b", "a"]
+
+
+def test_nested_map_roundtrip_through_table(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="nm")
+    schema = RowType.of(("id", BIGINT()), ("tags", ArrayType(MapType(STRING(), BIGINT()))))
+    t = cat.create_table("db.nm", schema, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1], "tags": [[{"a": 1}, {"b": 2}]]})
+    assert _read(t) == [(1, [{"a": 1}, {"b": 2}])]  # dicts at depth, not pair lists

@@ -157,3 +157,29 @@ def test_lookup_changelog_first_commit_all_inserts(catalog):
     write(t, {"id": [5], "v": [5.0]})
     plan = t.store.new_scan().with_kind("changelog").plan()
     assert sum(e.file.row_count for e in plan.entries) == 1
+
+
+def test_lookup_changelog_with_first_row_engine(catalog):
+    """The reference pairs first-row tables with the LookupMergeFunction so
+    only genuinely-new keys emit +I; here the vectorized before/after diff
+    plays that role (same engine re-merged over the overlapping files)."""
+    t = catalog.create_table(
+        "db.clfr",
+        SCHEMA,
+        primary_keys=["id"],
+        options={"bucket": "1", "merge-engine": "first-row", "changelog-producer": "lookup"},
+    )
+    scan = t.new_read_builder().new_stream_scan()
+    read = t.new_read_builder().new_read()
+    write(t, {"id": [1, 2], "v": [1.0, 2.0]})
+    events = changelog_of(t, scan, read)
+    assert sorted(events) == [("+I", 1, 1.0), ("+I", 2, 2.0)]
+    # re-writing key 1 must emit NOTHING (first row wins, no visible change);
+    # key 3 is new -> one +I
+    write(t, {"id": [1, 3], "v": [111.0, 3.0]})
+    events = changelog_of(t, scan, read) or []
+    assert sorted(events) == [("+I", 3, 3.0)]
+    # table state kept the FIRST values
+    rb = t.new_read_builder()
+    rows = sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+    assert rows == [(1, 1.0), (2, 2.0), (3, 3.0)]
