@@ -39,6 +39,14 @@ class ScanPlan:
                 return e.file_name
         return None
 
+    def dv_indexes(self) -> dict[tuple, str]:
+        """{(partition, bucket): dv index file name} for every bucket."""
+        return {
+            (e.partition, e.bucket): e.file_name
+            for e in self.index_entries
+            if e.kind == "DELETION_VECTORS"
+        }
+
 
 class FileStoreScan:
     def __init__(self, file_io: FileIO, table_path: str, key_names: Sequence[str]):

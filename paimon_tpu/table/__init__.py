@@ -129,6 +129,22 @@ class FileStoreTable(Table):
 
         return delete_where(self, predicate)
 
+    def update_where(self, predicate, assignments: dict) -> int:
+        """UPDATE ... SET assignments WHERE predicate (reference
+        UpdatePaimonTableCommand): upsert for PK tables, copy-on-write
+        rewrite for append tables. Returns #rows updated."""
+        from .rowops import update_where
+
+        return update_where(self, predicate, assignments)
+
+    def merge_into(self, source) -> "MergeInto":
+        """MERGE INTO builder (reference MergeIntoPaimonTable):
+        table.merge_into(source).when_matched_update(...).
+        when_not_matched_insert().execute()."""
+        from .rowops import MergeInto
+
+        return MergeInto(self, source)
+
     def expire_snapshots(self) -> int:
         from .tags import TagManager
 

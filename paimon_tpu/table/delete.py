@@ -119,26 +119,54 @@ def _delete_with_retract(table: "FileStoreTable", predicate: Predicate) -> int:
 def _delete_with_rewrite(table: "FileStoreTable", predicate: Predicate, commit_identifier: int | None) -> int:
     """Append table copy-on-write: rewrite each affected file without the
     matching rows."""
+    return copy_on_write_rewrite(table, predicate, transform=None, commit_identifier=commit_identifier)
+
+
+def copy_on_write_rewrite(
+    table: "FileStoreTable",
+    predicate: Predicate,
+    transform,
+    commit_identifier: int | None = None,
+) -> int:
+    """Shared copy-on-write scaffolding for row-level DELETE and UPDATE on
+    append tables: rewrite every file containing predicate matches, with the
+    matching rows dropped (transform=None) or replaced by transform(kv_match)
+    (reference DeleteFromPaimonTableCommand / UpdatePaimonTableCommand
+    copy-on-write strategy). Pre-existing deletion vectors are applied before
+    the rewrite so dead rows never resurrect; the commit purges the DVs of
+    rewritten files."""
     store = table.store
     plan = store.new_scan().plan()
+    dv_by_pb: dict[tuple, dict] = {}
+    if store.options.options.get(CoreOptions.DELETION_VECTORS_ENABLED):
+        idx = DeletionVectorsIndexFile(table.file_io, table.path)
+        for (partition, bucket), name in plan.dv_indexes().items():
+            dv_by_pb[(partition, bucket)] = idx.read_all(name)
     messages: list[CommitMessage] = []
-    deleted = 0
+    affected = 0
     for partition, buckets in plan.grouped().items():
         for bucket, files in buckets.items():
             rf = store.reader_factory(partition, bucket)
             wf = store.writer_factory(partition, bucket)
+            dvs = dv_by_pb.get((partition, bucket), {})
             before, after = [], []
             for f in files:
                 kv = rf.read(f)
+                dv = dvs.get(f.file_name)
+                if dv is not None:
+                    alive = ~dv.deleted_mask(kv.num_rows)
+                    if not alive.all():
+                        kv = kv.filter(alive)
                 mask = predicate.eval(kv.data)
                 hits = int(mask.sum())
                 if hits == 0:
                     continue
-                deleted += hits
+                affected += hits
                 before.append(f)
-                remaining = kv.filter(~mask)
-                if remaining.num_rows:
-                    after.extend(wf.write(remaining, level=f.level, file_source="compact"))
+                kept = kv.filter(~mask)
+                out = kept if transform is None else _concat_kv(kept, transform(kv.filter(mask)))
+                if out.num_rows:
+                    after.extend(wf.write(out, level=f.level, file_source="compact"))
             if before:
                 messages.append(
                     CommitMessage(
@@ -152,4 +180,14 @@ def _delete_with_rewrite(table: "FileStoreTable", predicate: Predicate, commit_i
     if messages:
         ident = commit_identifier if commit_identifier is not None else (1 << 63) - 2
         store.new_commit().commit(ManifestCommittable(ident, messages=messages))
-    return deleted
+    return affected
+
+
+def _concat_kv(kept, changed):
+    from ..core.kv import KVBatch
+
+    if kept.num_rows == 0:
+        return changed
+    if changed.num_rows == 0:
+        return kept
+    return KVBatch.concat([kept, changed])

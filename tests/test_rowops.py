@@ -1,0 +1,157 @@
+"""UPDATE / MERGE INTO command semantics (reference
+UpdatePaimonTableCommand.scala, MergeIntoPaimonTable.scala +
+MergeIntoTableTest)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.data.predicate import equal, greater_than
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("name", STRING()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="rowops")
+
+
+def _write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _read(t):
+    rb = t.new_read_builder()
+    return sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+
+
+def test_update_where_pk(catalog):
+    t = catalog.create_table("db.u", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1, 2, 3], "name": ["a", "b", "c"], "v": [1.0, 2.0, 3.0]})
+    n = t.update_where(greater_than("v", 1.5), {"name": "bumped", "v": lambda b: b.column("v").values + 100})
+    assert n == 2
+    assert _read(t) == [(1, "a", 1.0), (2, "bumped", 102.0), (3, "bumped", 103.0)]
+    with pytest.raises(ValueError):
+        t.update_where(equal("id", 1), {"id": 9})  # PK update forbidden
+
+
+def test_update_where_append_rewrite(catalog):
+    t = catalog.create_table("db.ua", SCHEMA, options={"bucket": "1"})
+    _write(t, {"id": [1, 1, 2], "name": ["x", "x", "y"], "v": [1.0, 1.0, 2.0]})
+    n = t.update_where(equal("id", 1), {"v": 0.0})
+    assert n == 2  # BOTH duplicate rows updated (no PK)
+    assert _read(t) == [(1, "x", 0.0), (1, "x", 0.0), (2, "y", 2.0)]
+
+
+def test_merge_into_full_clause_set(catalog):
+    t = catalog.create_table("db.m", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1, 2, 3, 4], "name": ["a", "b", "c", "d"], "v": [1.0, 2.0, 3.0, 4.0]})
+    source = {
+        "id": [2, 3, 4, 5, 6],
+        "name": ["B", "C", "D", "E", "F"],
+        "v": [20.0, -1.0, 40.0, 5.0, -6.0],
+    }
+    res = (
+        t.merge_into(source)
+        .when_matched_delete(condition=lambda s, tg: np.asarray(s.column("v").values) < 0)
+        .when_matched_update({"name": "src.name", "v": lambda s, tg: s.column("v").values + tg.column("v").values})
+        .when_not_matched_insert(condition=lambda s: np.asarray(s.column("v").values) > 0)
+        .execute()
+    )
+    assert (res.rows_updated, res.rows_deleted, res.rows_inserted) == (2, 1, 1)
+    assert _read(t) == [
+        (1, "a", 1.0),      # untouched
+        (2, "B", 22.0),     # matched update: src.name, v = src+tgt
+        (4, "D", 44.0),     # matched update
+        (5, "E", 5.0),      # not matched insert (condition passed)
+    ]  # id=3 deleted (v<0), id=6 not inserted (condition failed)
+
+
+def test_merge_into_rejects_duplicate_source_keys(catalog):
+    t = catalog.create_table("db.md", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1], "name": ["a"], "v": [1.0]})
+    with pytest.raises(ValueError, match="duplicate"):
+        t.merge_into({"id": [1, 1], "name": ["x", "y"], "v": [0.0, 0.0]}).when_matched_update(
+            {"v": 9.0}
+        ).execute()
+
+
+def test_merge_into_insert_only_and_projection_source(catalog):
+    t = catalog.create_table("db.mi", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1], "name": ["a"], "v": [1.0]})
+    # source without the 'name' column: inserts fill missing fields with null
+    res = t.merge_into({"id": [1, 7], "v": [99.0, 7.0]}).when_not_matched_insert().execute()
+    assert (res.rows_updated, res.rows_deleted, res.rows_inserted) == (0, 0, 1)
+    assert _read(t) == [(1, "a", 1.0), (7, None, 7.0)]  # matched row untouched
+
+
+def test_merge_into_requires_pk_coverage(catalog):
+    t = catalog.create_table("db.mr", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    with pytest.raises(ValueError, match="primary key"):
+        t.merge_into({"name": ["x"], "v": [1.0]})
+    ta = catalog.create_table("db.ma", SCHEMA, options={"bucket": "1"})
+    with pytest.raises(ValueError, match="primary-key"):
+        ta.merge_into({"id": [1]})
+
+
+def test_update_respects_deletion_vectors(catalog):
+    """Round-2 review regression: UPDATE on a DV-enabled append table must
+    not resurrect DV-deleted rows."""
+    t = catalog.create_table(
+        "db.udv", SCHEMA, options={"bucket": "1", "deletion-vectors.enabled": "true"}
+    )
+    _write(t, {"id": [1, 2, 3], "name": ["a", "b", "c"], "v": [1.0, 2.0, 3.0]})
+    assert t.delete_where(equal("id", 2)) == 1
+    n = t.update_where(equal("id", 3), {"v": 30.0})
+    assert n == 1
+    assert _read(t) == [(1, "a", 1.0), (3, "c", 30.0)]  # id=2 stays dead
+
+
+def test_rowops_reject_non_dedup_engines(catalog):
+    t = catalog.create_table(
+        "db.agg", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "merge-engine": "aggregation", "fields.v.aggregate-function": "sum"},
+    )
+    _write(t, {"id": [1], "name": ["a"], "v": [2.0]})
+    with pytest.raises(ValueError, match="deduplicate"):
+        t.update_where(equal("id", 1), {"v": 100.0})
+    with pytest.raises(ValueError, match="deduplicate"):
+        t.merge_into({"id": [1], "name": ["x"], "v": [0.0]})
+
+
+def test_merge_into_clause_declaration_order(catalog):
+    """SQL MERGE applies the FIRST matching clause per row."""
+    t = catalog.create_table("db.ord", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1, 2], "name": ["a", "b"], "v": [1.0, -2.0]})
+    src = {"id": [1, 2], "name": ["A", "B"], "v": [10.0, -20.0]}
+    # unconditional UPDATE declared first: the delete clause is unreachable
+    res = (
+        t.merge_into(src)
+        .when_matched_update({"v": "src.v"})
+        .when_matched_delete(condition=lambda s, g: np.asarray(s.column("v").values) < 0)
+        .execute()
+    )
+    assert (res.rows_updated, res.rows_deleted) == (2, 0)
+    # declared the other way, the conditional delete fires first
+    res2 = (
+        t.merge_into(src)
+        .when_matched_delete(condition=lambda s, g: np.asarray(s.column("v").values) < 0)
+        .when_matched_update({"name": "src.name"})
+        .execute()
+    )
+    assert (res2.rows_updated, res2.rows_deleted) == (1, 1)
+    assert _read(t) == [(1, "A", 10.0)]
+
+
+def test_merge_into_validates_at_declaration(catalog):
+    t = catalog.create_table("db.vd", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    with pytest.raises(ValueError, match="primary key"):
+        t.merge_into({"id": [9], "name": ["x"], "v": [0.0]}).when_matched_update({"id": 1})
+    with pytest.raises(ValueError, match="tgt"):
+        t.merge_into({"id": [9], "name": ["x"], "v": [0.0]}).when_not_matched_insert(
+            values={"name": "tgt.name"}
+        ).execute()
