@@ -74,8 +74,9 @@ class CdcTableWrite:
         wb = table.new_stream_write_builder()
         w = wb.new_write()
         w.write(ColumnBatch.from_pydict(schema, data), np.array(kinds, dtype=np.uint8))
-        wb.new_commit().commit_messages(commit_identifier, w.prepare_commit())
-        return n
+        committed = wb.new_commit().commit_messages(commit_identifier, w.prepare_commit())
+        # an already-seen identifier is filtered as a replay: report 0 applied
+        return n if committed else 0
 
     @staticmethod
     def _coerce(value: Any, dtype: DataType):

@@ -1,0 +1,151 @@
+"""CDC source formats: parse change-capture JSON streams into CdcRecords.
+
+Parity: /root/reference/paimon-flink/paimon-flink-cdc/src/main/java/org/
+apache/paimon/flink/action/cdc/format/ — RecordParser subclasses for
+debezium (DebeziumRecordParser: payload/before/after/op c|u|d|r), canal
+(CanalRecordParser: data[]/old[]/type INSERT|UPDATE|DELETE), maxwell
+(MaxwellRecordParser: data/old/type insert|update|delete), and plain json.
+Each parser turns one raw message into 0..2 CdcRecords (-U/+U pairs for
+updates) plus optional primary-key hints; records feed the schema-evolving
+CdcTableWrite sink, completing the source half the round-1 build lacked.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Iterable, Iterator, Mapping
+
+from .cdc import CdcRecord, CdcTableWrite
+
+__all__ = ["parse_debezium", "parse_canal", "parse_maxwell", "parse_json", "get_cdc_parser", "CdcStream"]
+
+
+def _loads(message: str | bytes | Mapping | None):
+    if message is None or isinstance(message, Mapping):
+        return message
+    return json.loads(message)
+
+
+def parse_debezium(message: str | bytes | Mapping) -> list[CdcRecord]:
+    """Debezium JSON (optionally schema-wrapped): op c/r -> +I, u -> -U/+U,
+    d -> -D; tombstones (null payload / null message) are skipped
+    (reference DebeziumRecordParser ignores null payloads)."""
+    node = _loads(message)
+    if node is None:
+        return []
+    if "payload" in node:
+        node = node["payload"]
+        if node is None:  # kafka compaction tombstone after a delete
+            return []
+    op = node.get("op")
+    before = node.get("before")
+    after = node.get("after")
+    if op in ("c", "r"):
+        return [CdcRecord(after, "+I")] if after else []
+    if op == "u":
+        out = []
+        if before:
+            out.append(CdcRecord(before, "-U"))
+        if after:
+            out.append(CdcRecord(after, "+U"))
+        return out
+    if op == "d":
+        return [CdcRecord(before, "-D")] if before else []
+    raise ValueError(f"unknown debezium op {op!r}")
+
+
+def parse_canal(message: str | bytes | Mapping) -> list[CdcRecord]:
+    """Canal JSON: type INSERT/UPDATE/DELETE with data[] rows and old[]
+    pre-images (reference CanalRecordParser)."""
+    node = _loads(message)
+    typ = (node.get("type") or "").upper()
+    rows = node.get("data") or []
+    olds = node.get("old") or []
+    out: list[CdcRecord] = []
+    if typ == "INSERT":
+        out.extend(CdcRecord(r, "+I") for r in rows)
+    elif typ == "UPDATE":
+        for i, r in enumerate(rows):
+            old = olds[i] if i < len(olds) and olds[i] else {}
+            # canal's old[] carries only changed fields: pre-image = row + old
+            before = {**r, **old}
+            out.append(CdcRecord(before, "-U"))
+            out.append(CdcRecord(r, "+U"))
+    elif typ == "DELETE":
+        out.extend(CdcRecord(r, "-D") for r in rows)
+    elif typ in ("CREATE", "ALTER", "QUERY", "TRUNCATE"):
+        return []  # DDL events carry no rows; schema evolves from data
+    else:
+        raise ValueError(f"unknown canal type {typ!r}")
+    return out
+
+
+def parse_maxwell(message: str | bytes | Mapping) -> list[CdcRecord]:
+    """Maxwell JSON: type insert/update/delete with data and old
+    (reference MaxwellRecordParser)."""
+    node = _loads(message)
+    typ = node.get("type")
+    data = node.get("data") or {}
+    old = node.get("old") or {}
+    if typ == "insert" or typ == "bootstrap-insert":
+        return [CdcRecord(data, "+I")]
+    if typ == "update":
+        return [CdcRecord({**data, **old}, "-U"), CdcRecord(data, "+U")]
+    if typ == "delete":
+        return [CdcRecord(data, "-D")]
+    if typ in ("bootstrap-start", "bootstrap-complete", "table-create", "table-alter"):
+        return []
+    raise ValueError(f"unknown maxwell type {typ!r}")
+
+
+def parse_json(message: str | bytes | Mapping) -> list[CdcRecord]:
+    """Plain JSON records: each message is one +I row."""
+    return [CdcRecord(_loads(message), "+I")]
+
+
+_PARSERS: dict[str, Callable[[Any], list[CdcRecord]]] = {
+    "debezium-json": parse_debezium,
+    "debezium": parse_debezium,
+    "canal-json": parse_canal,
+    "canal": parse_canal,
+    "maxwell-json": parse_maxwell,
+    "maxwell": parse_maxwell,
+    "json": parse_json,
+}
+
+
+def get_cdc_parser(fmt: str) -> Callable[[Any], list[CdcRecord]]:
+    if fmt not in _PARSERS:
+        raise ValueError(f"unknown cdc format {fmt!r}; known: {sorted(_PARSERS)}")
+    return _PARSERS[fmt]
+
+
+class CdcStream:
+    """The source->sink pipeline: parse raw messages with a format parser and
+    feed the schema-evolving sink, committing per batch (the engine-neutral
+    SyncTableAction analog — reference SynchronizationActionBase)."""
+
+    def __init__(self, table, fmt: str = "debezium-json"):
+        self.parser = get_cdc_parser(fmt)
+        self.write = CdcTableWrite(table)
+        # resume after the table's last commit by THIS user: restarting the
+        # stream must not reuse identifiers the replay filter already saw
+        # (it would silently drop the new batches)
+        latest = table.store.snapshot_manager.latest_snapshot_of_user(table.store.commit_user)
+        self._commit_id = latest.commit_identifier if latest else 0
+
+    def ingest(self, messages: Iterable[str | bytes | Mapping]) -> int:
+        """Parse + buffer one batch of raw messages, then flush as one
+        commit. Returns the number of records applied (0 when the batch was
+        a replay the commit filter dropped). Parsing completes for the WHOLE
+        batch before anything is buffered, so a malformed message cannot
+        leave half a batch behind to ride along with a later commit."""
+        records = [record for m in messages for record in self.parser(m)]
+        for record in records:
+            self.write.write(record)
+        self._commit_id += 1
+        return self.write.flush(self._commit_id)
+
+    @property
+    def table(self):
+        return self.write.table
