@@ -82,6 +82,8 @@ class FileSystemCatalog(Catalog):
         return sorted(out)
 
     def create_database(self, name: str, ignore_if_exists: bool = True) -> None:
+        if name == "sys":
+            raise ValueError("'sys' is reserved for catalog system tables")
         path = self._db_path(name)
         if self.file_io.exists(path):
             if not ignore_if_exists:
@@ -117,6 +119,8 @@ class FileSystemCatalog(Catalog):
     ) -> FileStoreTable:
         ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
         self.create_database(ident.database)
+        if ident.database == "sys":
+            raise ValueError("'sys' is reserved for catalog system tables")
         path = self.table_path(ident)
         sm = SchemaManager(self.file_io, path)
         if sm.latest() is not None and not ignore_if_exists:
@@ -124,8 +128,24 @@ class FileSystemCatalog(Catalog):
         schema = sm.create_table(row_type, partition_keys, primary_keys, options)
         return FileStoreTable(self.file_io, path, schema, self.commit_user)
 
+    def system_table(self, name: str):
+        """Catalog-scope system tables: sys.all_table_options,
+        sys.catalog_options, lineage x4 (reference SystemTableLoader
+        loadGlobal)."""
+        from .globals import global_system_table
+
+        return global_system_table(self, name)
+
+    def lineage_meta(self):
+        """The catalog's lineage store (reference LineageMeta SPI)."""
+        from .globals import FsLineageMeta
+
+        return FsLineageMeta(self)
+
     def get_table(self, identifier: "Identifier | str") -> Table:
         ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
+        if ident.database == "sys":
+            return self.system_table(ident.table)
         if self.SYSTEM_SEP in ident.table:
             base, _, sys_name = ident.table.partition(self.SYSTEM_SEP)
             data_table = self.get_table(Identifier(ident.database, base))

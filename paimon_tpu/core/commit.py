@@ -54,6 +54,15 @@ class FileStoreCommit:
         self.commit_user = commit_user
         self.schema_id = schema_id
         self.options = options or CoreOptions()
+        # external mutual exclusion where the FS rename is not atomic
+        # (reference: commits run under CatalogLock on such stores)
+        self._lock = None
+        if self.options.options.get(CoreOptions.COMMIT_CATALOG_LOCK) or not getattr(
+            file_io, "atomic_write_supported", True
+        ):
+            from ..catalog.lock import FileBasedCatalogLock
+
+            self._lock = FileBasedCatalogLock(file_io, table_path)
         self.snapshot_manager = SnapshotManager(file_io, table_path)
         self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
         self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
@@ -230,77 +239,80 @@ class FileStoreCommit:
         g = registry.group("commit")
         retries = 0
         t_start = time.perf_counter()
+        from contextlib import nullcontext
+
         while True:
-            latest = self.snapshot_manager.latest_snapshot()
-            if check_conflicts and latest is not None:
-                self._no_conflicts_or_fail(latest, entries)
-            tmp_files: list[str] = []
-            try:
-                snapshot_id = (latest.id + 1) if latest else 1
-                base_metas = (
-                    self.manifest_list.read(latest.base_manifest_list)
-                    + self.manifest_list.read(latest.delta_manifest_list)
-                    if latest
-                    else []
-                )
-                base_metas = self._maybe_merge_manifests(base_metas, tmp_files)
-                delta_meta = self.manifest_file.write(entries, self.schema_id)
-                tmp_files.append(delta_meta.file_name)
-                base_name = self.manifest_list.write(base_metas)
-                tmp_files.append(base_name)
-                delta_name = self.manifest_list.write([delta_meta])
-                tmp_files.append(delta_name)
-                changelog_list = None
-                changelog_rows = None
-                if changelog_entries:
-                    cl_meta = self.manifest_file.write(changelog_entries, self.schema_id)
-                    tmp_files.append(cl_meta.file_name)
-                    changelog_list = self.manifest_list.write([cl_meta])
-                    tmp_files.append(changelog_list)
-                    changelog_rows = sum(e.file.row_count for e in changelog_entries)
-                added = sum(e.file.row_count for e in entries if e.kind == FileKind.ADD)
-                deleted = sum(e.file.row_count for e in entries if e.kind == FileKind.DELETE)
-                prev_total = (latest.total_record_count or 0) if latest else 0
-                index_manifest = self._index_manifest(latest, index_entries or [], removed_files)
-                snapshot = Snapshot(
-                    id=snapshot_id,
-                    schema_id=self.schema_id,
-                    base_manifest_list=base_name,
-                    delta_manifest_list=delta_name,
-                    changelog_manifest_list=changelog_list,
-                    commit_user=self.commit_user,
-                    commit_identifier=committable.commit_identifier,
-                    commit_kind=kind,
-                    time_millis=now_millis(),
-                    index_manifest=index_manifest,
-                    total_record_count=prev_total + added - deleted,
-                    delta_record_count=added - deleted,
-                    changelog_record_count=changelog_rows,
-                    statistics=statistics,
-                    watermark=committable.watermark,
-                    log_offsets=dict(committable.log_offsets),
-                )
-                path = self.snapshot_manager.snapshot_path(snapshot_id)
-                if self.file_io.try_atomic_write(path, snapshot.to_json().encode()):
-                    g.counter("commits").inc()
-                    g.counter("retries").inc(retries)
-                    g.histogram("duration_ms").update((time.perf_counter() - t_start) * 1000)
-                    # committed: the snapshot now references these manifests —
-                    # they must never be cleaned up, even if hints fail
-                    tmp_files.clear()
-                    try:
-                        self.snapshot_manager.commit_latest_hint(snapshot_id)
-                        if snapshot_id == 1:
-                            self.snapshot_manager.commit_earliest_hint(1)
-                    except Exception:
-                        pass  # hints are best-effort; listing is authoritative
-                    return snapshot_id
-                # lost the race: clean tmp metadata and retry against new latest
-                self._cleanup(tmp_files)
-                retries += 1
-            except Exception:
-                self._cleanup(tmp_files)
-                raise
+            with self._lock.lock() if self._lock is not None else nullcontext():
+                latest = self.snapshot_manager.latest_snapshot()
+                if check_conflicts and latest is not None:
+                    self._no_conflicts_or_fail(latest, entries)
+                tmp_files: list[str] = []
+                try:
+                    snapshot_id = (latest.id + 1) if latest else 1
+                    base_metas = (
+                        self.manifest_list.read(latest.base_manifest_list)
+                        + self.manifest_list.read(latest.delta_manifest_list)
+                        if latest
+                        else []
+                    )
+                    base_metas = self._maybe_merge_manifests(base_metas, tmp_files)
+                    delta_meta = self.manifest_file.write(entries, self.schema_id)
+                    tmp_files.append(delta_meta.file_name)
+                    base_name = self.manifest_list.write(base_metas)
+                    tmp_files.append(base_name)
+                    delta_name = self.manifest_list.write([delta_meta])
+                    tmp_files.append(delta_name)
+                    changelog_list = None
+                    changelog_rows = None
+                    if changelog_entries:
+                        cl_meta = self.manifest_file.write(changelog_entries, self.schema_id)
+                        tmp_files.append(cl_meta.file_name)
+                        changelog_list = self.manifest_list.write([cl_meta])
+                        tmp_files.append(changelog_list)
+                        changelog_rows = sum(e.file.row_count for e in changelog_entries)
+                    added = sum(e.file.row_count for e in entries if e.kind == FileKind.ADD)
+                    deleted = sum(e.file.row_count for e in entries if e.kind == FileKind.DELETE)
+                    prev_total = (latest.total_record_count or 0) if latest else 0
+                    index_manifest = self._index_manifest(latest, index_entries or [], removed_files)
+                    snapshot = Snapshot(
+                        id=snapshot_id,
+                        schema_id=self.schema_id,
+                        base_manifest_list=base_name,
+                        delta_manifest_list=delta_name,
+                        changelog_manifest_list=changelog_list,
+                        commit_user=self.commit_user,
+                        commit_identifier=committable.commit_identifier,
+                        commit_kind=kind,
+                        time_millis=now_millis(),
+                        index_manifest=index_manifest,
+                        total_record_count=prev_total + added - deleted,
+                        delta_record_count=added - deleted,
+                        changelog_record_count=changelog_rows,
+                        statistics=statistics,
+                        watermark=committable.watermark,
+                        log_offsets=dict(committable.log_offsets),
+                    )
+                    path = self.snapshot_manager.snapshot_path(snapshot_id)
+                    if self.file_io.try_atomic_write(path, snapshot.to_json().encode()):
+                        g.counter("commits").inc()
+                        g.counter("retries").inc(retries)
+                        g.histogram("duration_ms").update((time.perf_counter() - t_start) * 1000)
+                        # committed: the snapshot now references these manifests —
+                        # they must never be cleaned up, even if hints fail
+                        tmp_files.clear()
+                        try:
+                            self.snapshot_manager.commit_latest_hint(snapshot_id)
+                            if snapshot_id == 1:
+                                self.snapshot_manager.commit_earliest_hint(1)
+                        except Exception:
+                            pass  # hints are best-effort; listing is authoritative
+                        return snapshot_id
+                    # lost the race: clean tmp metadata and retry against new latest
+                    self._cleanup(tmp_files)
+                    retries += 1
+                except Exception:
+                    self._cleanup(tmp_files)
+                    raise
 
     def _no_conflicts_or_fail(self, latest: Snapshot, entries: list[ManifestEntry]) -> None:
         """Every file we logically delete must still be live (reference

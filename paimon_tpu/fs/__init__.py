@@ -54,6 +54,10 @@ class FileIO:
     """Abstract filesystem. All paths are absolute strings (optionally with a
     scheme prefix, which implementations strip via split_scheme)."""
 
+    # object-store adapters without a no-clobber rename set this False;
+    # commits then automatically run under the catalog lock
+    atomic_write_supported: bool = True
+
     # ---- required primitives ------------------------------------------
     def read_bytes(self, path: str) -> bytes:
         raise NotImplementedError
@@ -171,9 +175,14 @@ class LocalFileIO(FileIO):
     def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
         p = self._p(path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
-        if not overwrite and os.path.exists(p):
-            raise FileExistsError(p)
-        with open(p, "wb") as f:
+        if overwrite:
+            with open(p, "wb") as f:
+                f.write(data)
+            return
+        # O_EXCL: creation is a true CAS (check-then-write would let two
+        # writers both succeed), which the catalog lock relies on
+        fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        with os.fdopen(fd, "wb") as f:
             f.write(data)
 
     def exists(self, path: str) -> bool:

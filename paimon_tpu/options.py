@@ -199,6 +199,12 @@ class CoreOptions:
         "mesh: per-bucket merge jobs batch into one shard_map over the bucket "
         "axis; oversized buckets range-shuffle over the key axis.",
     )
+    COMMIT_CATALOG_LOCK = ConfigOption.bool_(
+        "commit.catalog-lock.enabled",
+        False,
+        "Run snapshot commits under an external catalog lock (required on "
+        "stores whose rename is not atomic; reference CatalogLock SPI).",
+    )
     PARALLEL_KEY_AXIS_ROWS = ConfigOption.int_(
         "parallel.key-axis.rows",
         4 * 1024 * 1024,

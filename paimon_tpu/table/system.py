@@ -313,6 +313,46 @@ def _aggregation_fields(table: "FileStoreTable") -> _StaticTable:
     return _StaticTable("aggregation_fields", ColumnBatch.from_pylist(schema, rows))
 
 
+def _file_monitor(table: "FileStoreTable") -> _StaticTable:
+    """Per-snapshot file changes (reference FileMonitorTable: _SNAPSHOT_ID,
+    _PARTITION, _BUCKET, _BEFORE_FILES, _DATA_FILES) — the input of the
+    dedicated-compaction and lookup-refresh topologies."""
+    from json import dumps
+
+    schema = RowType.of(
+        ("_SNAPSHOT_ID", BIGINT(False)),
+        ("_PARTITION", STRING(False)),
+        ("_BUCKET", INT(False)),
+        ("_BEFORE_FILES", STRING(False)),
+        ("_DATA_FILES", STRING(False)),
+    )
+    store = table.store
+    sm = store.snapshot_manager
+    rows = []
+    latest = sm.latest_snapshot_id()
+    earliest = sm.earliest_snapshot_id()
+    if latest is not None and earliest is not None:
+        for sid in range(earliest, latest + 1):
+            if not sm.snapshot_exists(sid):
+                continue
+            plan = store.new_scan().with_snapshot(sid).with_kind("delta").plan()
+            by_pb: dict[tuple, dict[str, list]] = {}
+            for e in plan.entries:
+                slot = by_pb.setdefault((e.partition, e.bucket), {"before": [], "after": []})
+                slot["after" if e.kind.name == "ADD" else "before"].append(e.file.file_name)
+            for (partition, bucket), slot in sorted(by_pb.items()):
+                rows.append(
+                    (
+                        sid,
+                        dumps(list(partition)),
+                        bucket,
+                        dumps(sorted(slot["before"])),
+                        dumps(sorted(slot["after"])),
+                    )
+                )
+    return _StaticTable("file_monitor", ColumnBatch.from_pylist(schema, rows))
+
+
 SYSTEM_TABLES = {
     "snapshots": _snapshots,
     "statistics": _statistics,
@@ -328,4 +368,5 @@ SYSTEM_TABLES = {
     "buckets": _buckets,
     "audit_log": _AuditLogTable,
     "read_optimized": _ReadOptimizedTable,
+    "file_monitor": _file_monitor,
 }

@@ -1,0 +1,154 @@
+"""Global system tables, lineage store, catalog lock, full-cache lookup
+tables (reference SystemTableLoader.loadGlobal, CatalogLock,
+FullCacheLookupTable)."""
+
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.lookup.tables import FullCacheLookupTable
+from paimon_tpu.types import BIGINT, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT()), ("city", STRING()), ("name", STRING()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="glob")
+
+
+def _write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def test_global_system_tables(catalog):
+    catalog.create_table("db.a", SCHEMA, primary_keys=["id"], options={"bucket": "2"})
+    catalog.create_table("db2.b", SCHEMA, options={"write-only": "true"})
+    rows = catalog.get_table("sys.all_table_options").to_pylist()
+    assert ("db", "a", "bucket", "2") in rows
+    assert ("db2", "b", "write-only", "true") in rows
+    co = catalog.get_table("sys.catalog_options").to_pylist()
+    assert co and co[0][0] == "warehouse"
+
+
+def test_lineage_tables(catalog):
+    lm = catalog.lineage_meta()
+    lm.save_source_table_lineage("job1", "db.a")
+    lm.save_sink_table_lineage("job1", "db.b")
+    lm.save_source_data_lineage("job1", "db.a", barrier_id=7, snapshot_id=3)
+    lm.save_sink_data_lineage("job1", "db.b", barrier_id=7, snapshot_id=9)
+    src = catalog.get_table("sys.source_table_lineage").to_pylist()
+    assert src[0][:3] == ("db", "a", "job1")
+    snk = catalog.get_table("sys.sink_data_lineage").to_pylist()
+    assert snk[0][:5] == ("db", "b", "job1", 7, 9)
+    assert catalog.get_table("sys.source_data_lineage").to_pylist()[0][3] == 7
+
+
+def test_file_monitor_system_table(catalog):
+    t = catalog.create_table("db.fm", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1], "city": ["x"], "name": ["n"]})
+    _write(t, {"id": [2], "city": ["y"], "name": ["m"]})
+    rows = catalog.get_table("db.fm$file_monitor").to_pylist()
+    assert len(rows) >= 2
+    assert rows[0][0] == 1 and rows[0][2] == 0  # snapshot 1, bucket 0
+    import json
+
+    assert len(json.loads(rows[0][4])) == 1  # one added data file
+
+
+def test_catalog_lock_serializes_commits(catalog, tmp_path):
+    """commit.catalog-lock.enabled: concurrent committers on a LINK-LESS
+    filesystem (no CAS rename) still cannot lose a commit."""
+    import threading
+
+    t = catalog.create_table(
+        "db.lk", SCHEMA, primary_keys=["id"], options={"bucket": "1", "commit.catalog-lock.enabled": "true"}
+    )
+    errs = []
+
+    def worker(i):
+        try:
+            _write(t, {"id": [i], "city": [f"c{i}"], "name": [f"n{i}"]})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    rb = t.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert out.num_rows == 6  # every commit landed
+    assert t.store.snapshot_manager.latest_snapshot_id() == 6
+
+
+def test_full_cache_lookup_primary_and_refresh(catalog):
+    t = catalog.create_table("db.lkp", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1, 2], "city": ["ber", "muc"], "name": ["a", "b"]})
+    lt = FullCacheLookupTable(t)
+    assert lt.mode == "primary"
+    assert lt.get((1,)) == [(1, "ber", "a")]
+    assert lt.get((9,)) == []
+    # changes become visible after refresh()
+    _write(t, {"id": [1, 3], "city": ["ber", "ham"], "name": ["a2", "c"]})
+    _write(t, {"id": [2], "city": ["muc"], "name": ["b"]}, kinds=["-D"])
+    assert lt.get((1,)) == [(1, "ber", "a")]  # stale until refresh
+    applied = lt.refresh()
+    assert applied >= 3
+    assert lt.get((1,)) == [(1, "ber", "a2")]
+    assert lt.get((2,)) == []
+    assert lt.get((3,)) == [(3, "ham", "c")]
+
+
+def test_full_cache_lookup_secondary_index(catalog):
+    t = catalog.create_table("db.sec", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, {"id": [1, 2, 3], "city": ["ber", "ber", "muc"], "name": ["a", "b", "c"]})
+    lt = FullCacheLookupTable(t, join_keys=["city"])
+    assert lt.mode == "secondary"
+    assert lt.get(("ber",)) == [(1, "ber", "a"), (2, "ber", "b")]
+    # moving id=2 to muc updates the index on refresh
+    _write(t, {"id": [2], "city": ["muc"], "name": ["b"]})
+    lt.refresh()
+    assert lt.get(("ber",)) == [(1, "ber", "a")]
+    assert lt.get(("muc",)) == [(2, "muc", "b"), (3, "muc", "c")]
+
+
+def test_full_cache_lookup_no_pk_multimap(catalog):
+    t = catalog.create_table("db.nopk", SCHEMA, options={"bucket": "1"})
+    _write(t, {"id": [1, 1], "city": ["x", "x"], "name": ["dup", "dup"]})
+    lt = FullCacheLookupTable(t, join_keys=["id"])
+    assert lt.mode == "no-pk"
+    assert len(lt.get((1,))) == 2  # duplicates preserved
+
+
+def test_sys_database_reserved(catalog):
+    with pytest.raises(ValueError, match="reserved"):
+        catalog.create_database("sys", ignore_if_exists=False)
+    with pytest.raises(ValueError, match="reserved"):
+        catalog.create_table("sys.t", SCHEMA)
+
+
+def test_non_atomic_fileio_auto_locks(tmp_warehouse):
+    """A FileIO that declares atomic_write_supported=False gets the catalog
+    lock automatically (reference: CatalogLock engages on object stores)."""
+    from paimon_tpu.fs import LocalFileIO
+
+    class ObjectStoreishIO(LocalFileIO):
+        atomic_write_supported = False
+
+    from paimon_tpu.core.schema import SchemaManager
+    from paimon_tpu.table import FileStoreTable
+
+    io = ObjectStoreishIO()
+    path = f"{tmp_warehouse}/db.db/oss"
+    schema = SchemaManager(io, path).create_table(SCHEMA, (), ["id"], {"bucket": "1"})
+    t = FileStoreTable(io, path, schema, "oss-user")
+    commit = t.store.new_commit()
+    assert commit._lock is not None  # auto-engaged
+    _write(t, {"id": [1], "city": ["c"], "name": ["n"]})
+    rb = t.new_read_builder()
+    assert rb.new_read().read_all(rb.new_scan().plan()).num_rows == 1
