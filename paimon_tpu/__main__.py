@@ -1,0 +1,206 @@
+"""The actions CLI: `python -m paimon_tpu <action> ...`.
+
+Parity: /root/reference/paimon-flink/paimon-flink-common/.../action/ (47
+`flink run` actions, mirrored as SQL CALL procedures) — the maintenance and
+ingestion surface operators drive without writing code: compact,
+sort-compact, delete, tag/branch management, rollback, expiry, migration,
+orphan cleanup, CDC sync, scans. Each action binds to the same engine-neutral
+Table API the connectors use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _table(args):
+    from .catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(args.warehouse, commit_user=getattr(args, "user", "cli"))
+    return cat, cat.get_table(args.table)
+
+
+def _add_common(p):
+    p.add_argument("--warehouse", required=True, help="warehouse directory")
+    p.add_argument("--table", required=True, help="db.table identifier")
+    p.add_argument("--user", default="cli", help="commit user")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="paimon_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="action", required=True)
+
+    for name in (
+        "compact",
+        "sort_compact",
+        "delete",
+        "create_tag",
+        "delete_tag",
+        "list_tags",
+        "rollback_to",
+        "expire_snapshots",
+        "remove_orphan_files",
+        "migrate_table",
+        "query",
+        "sync_table",
+        "create_branch",
+        "fast_forward",
+    ):
+        p = sub.add_parser(name.replace("_", "-"))
+        if name != "migrate_table":
+            _add_common(p)
+        if name == "compact":
+            p.add_argument("--full", action="store_true")
+        elif name == "sort_compact":
+            p.add_argument("--order-by", required=True, help="comma-separated cluster columns")
+            p.add_argument("--strategy", default="zorder", choices=["zorder", "hilbert", "order"])
+        elif name == "delete":
+            p.add_argument("--where", required=True, help='predicate json: {"field":..,"op":..,"value":..}')
+        elif name in ("create_tag", "delete_tag"):
+            p.add_argument("--tag", required=True)
+            if name == "create_tag":
+                p.add_argument("--snapshot", type=int, default=None)
+        elif name == "rollback_to":
+            p.add_argument("--to", required=True, help="snapshot id or tag name")
+        elif name == "remove_orphan_files":
+            p.add_argument("--older-than-hours", type=float, default=24.0)
+            p.add_argument("--dry-run", action="store_true")
+        elif name == "migrate_table":
+            p.add_argument("--warehouse", required=True)
+            p.add_argument("--table", required=True, help="target db.table")
+            p.add_argument("--source-dir", required=True, help="directory of parquet/orc files")
+            p.add_argument("--format", default="parquet")
+            p.add_argument("--user", default="cli")
+        elif name == "query":
+            p.add_argument("--limit", type=int, default=20)
+            p.add_argument("--filter", default=None, help="predicate json")
+        elif name == "sync_table":
+            p.add_argument("--format", default="debezium-json", help="cdc format")
+            p.add_argument("--input", default="-", help="file of json messages (- = stdin)")
+        elif name in ("create_branch", "fast_forward"):
+            p.add_argument("--branch", required=True)
+
+    args = ap.parse_args(argv)
+    action = args.action.replace("-", "_")
+
+    if action == "migrate_table":
+        import glob
+
+        from .catalog import FileSystemCatalog
+        from .data.batch import ColumnBatch
+        from .table.migrate import migrate_files
+
+        cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
+        # infer the row type from the first data file (reference Migrator
+        # reads the hive schema; here the files carry it themselves)
+        candidates = sorted(glob.glob(f"{args.source_dir}/*.{args.format}"))
+        if not candidates:
+            ap.error(f"no *.{args.format} files found in {args.source_dir}")
+        first = candidates[0]
+        if args.format == "parquet":
+            import pyarrow.parquet as pq
+
+            arrow_schema = pq.read_schema(first)
+        else:
+            import pyarrow.orc as po
+
+            arrow_schema = po.ORCFile(first).schema
+        row_type = ColumnBatch.row_type_from_arrow(arrow_schema)
+        t = migrate_files(cat, args.table, args.source_dir, row_type, file_format=args.format)
+        print(json.dumps({"migrated": args.table, "snapshot": t.store.snapshot_manager.latest_snapshot_id()}))
+        return 0
+
+    cat, t = _table(args)
+
+    if action == "compact":
+        from .table.compactor import DedicatedCompactor
+
+        # DedicatedCompactor re-enables compaction even on write-only tables
+        # (the CLI IS the dedicated compaction job, reference CompactAction)
+        done = DedicatedCompactor(t).run_once(full=args.full)
+        print(json.dumps({"compacted": done, "full": args.full}))
+    elif action == "sort_compact":
+        from .table.sort_compact import sort_compact
+
+        n = sort_compact(t, [c.strip() for c in args.order_by.split(",")], order=args.strategy)
+        print(json.dumps({"rows_clustered": n, "strategy": args.strategy}))
+    elif action == "delete":
+        n = t.delete_where(_predicate(args.where))
+        print(json.dumps({"rows_deleted": n}))
+    elif action == "create_tag":
+        t.create_tag(args.tag, snapshot_id=args.snapshot)
+        print(json.dumps({"tag": args.tag}))
+    elif action == "delete_tag":
+        t.delete_tag(args.tag)
+        print(json.dumps({"deleted_tag": args.tag}))
+    elif action == "list_tags":
+        print(json.dumps(t.tags()))
+    elif action == "rollback_to":
+        target = int(args.to) if args.to.isdigit() else args.to
+        t.rollback_to(target)
+        print(json.dumps({"rolled_back_to": target}))
+    elif action == "expire_snapshots":
+        n = t.expire_snapshots()
+        print(json.dumps({"expired": n}))
+    elif action == "remove_orphan_files":
+        from .table.maintenance import remove_orphan_files
+
+        removed = remove_orphan_files(
+            t, older_than_millis=int(args.older_than_hours * 3600_000), dry_run=args.dry_run
+        )
+        print(json.dumps({"orphans": removed, "dry_run": args.dry_run}))
+    elif action == "query":
+        rb = t.new_read_builder()
+        if args.filter:
+            rb = rb.with_filter(_predicate(args.filter))
+        rb = rb.with_limit(args.limit)
+        out = rb.new_read().read_all(rb.new_scan().plan())
+        for row in out.to_pylist():
+            print(json.dumps(list(row), default=str))
+    elif action == "sync_table":
+        from contextlib import nullcontext
+
+        from .table.cdc_format import CdcStream
+
+        stream = CdcStream(t, args.format)
+        ctx = nullcontext(sys.stdin) if args.input == "-" else open(args.input)
+        with ctx as source:
+            n = stream.ingest(line for line in source if line.strip())
+        print(json.dumps({"records_applied": n}))
+    elif action == "create_branch":
+        from .table.branch import BranchManager
+
+        BranchManager(t.file_io, t.path).create(args.branch)
+        print(json.dumps({"branch": args.branch}))
+    elif action == "fast_forward":
+        from .table.branch import BranchManager
+
+        BranchManager(t.file_io, t.path).fast_forward(args.branch)
+        print(json.dumps({"fast_forwarded": args.branch}))
+    return 0
+
+
+def _predicate(spec: str):
+    from .data import predicate as P
+
+    d = json.loads(spec)
+    op = d.get("op", "=")
+    fns = {
+        "=": P.equal,
+        "!=": P.not_equal,
+        ">": P.greater_than,
+        ">=": P.greater_or_equal,
+        "<": P.less_than,
+        "<=": P.less_or_equal,
+    }
+    if op == "in":
+        return P.in_(d["field"], d["value"])
+    if op == "is_null":
+        return P.is_null(d["field"])
+    return fns[op](d["field"], d["value"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -118,9 +118,7 @@ class FileSystemCatalog(Catalog):
         ignore_if_exists: bool = False,
     ) -> FileStoreTable:
         ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
-        self.create_database(ident.database)
-        if ident.database == "sys":
-            raise ValueError("'sys' is reserved for catalog system tables")
+        self.create_database(ident.database)  # raises for the reserved 'sys'
         path = self.table_path(ident)
         sm = SchemaManager(self.file_io, path)
         if sm.latest() is not None and not ignore_if_exists:

@@ -11,6 +11,7 @@ exactly the primitive the reference's dialects emulate over JDBC/Hive.
 from __future__ import annotations
 
 import os
+import threading
 import time
 import uuid
 from contextlib import contextmanager
@@ -72,9 +73,27 @@ class FileBasedCatalogLock(CatalogLock):
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"could not acquire catalog lock {path}")
                 time.sleep(0.05)
+        # heartbeat: refresh our timestamp so a long commit is never mistaken
+        # for a crashed holder and stolen mid-flight
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(self.stale_ttl / 3):
+                try:
+                    raw = self.file_io.read_bytes(path).decode()
+                    if raw.split()[0] != self.holder:
+                        return  # lost the lock (TTL takeover): stop touching it
+                    self.file_io.write_bytes(path, f"{self.holder} {time.time()}".encode(), overwrite=True)
+                except Exception:
+                    return
+
+        hb = threading.Thread(target=beat, daemon=True)
+        hb.start()
         try:
             yield
         finally:
+            stop.set()
+            hb.join(timeout=1.0)
             # release only OUR lock: after a stale-TTL takeover the file may
             # belong to another holder now
             try:

@@ -270,6 +270,57 @@ class ColumnBatch:
         return pa.table(dict(zip(self.schema.field_names, arrays)))
 
     @staticmethod
+    def row_type_from_arrow(arrow_schema) -> RowType:
+        """Infer a RowType from a pyarrow schema (migration entry point)."""
+        import pyarrow as pa
+
+        from ..types import (
+            BIGINT,
+            BOOLEAN,
+            BYTES,
+            DATE,
+            DOUBLE,
+            FLOAT,
+            INT,
+            SMALLINT,
+            STRING,
+            TIMESTAMP,
+            TINYINT,
+            DataField,
+        )
+
+        def conv(t):
+            if pa.types.is_boolean(t):
+                return BOOLEAN()
+            if pa.types.is_int8(t):
+                return TINYINT()
+            if pa.types.is_int16(t):
+                return SMALLINT()
+            if pa.types.is_int32(t):
+                return INT()
+            if pa.types.is_integer(t):
+                return BIGINT()
+            if pa.types.is_float32(t):
+                return FLOAT()
+            if pa.types.is_floating(t):
+                return DOUBLE()
+            if pa.types.is_date(t):
+                return DATE()
+            if pa.types.is_timestamp(t):
+                return TIMESTAMP()
+            if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+                return BYTES()
+            if pa.types.is_decimal(t) and t.precision <= 18:
+                from ..types import DECIMAL
+
+                return DECIMAL(t.precision, t.scale)
+            return STRING()
+
+        return RowType(
+            tuple(DataField(i, f.name, conv(f.type)) for i, f in enumerate(arrow_schema))
+        )
+
+    @staticmethod
     def from_arrow(table, schema: RowType) -> "ColumnBatch":
         cols: dict[str, Column] = {}
         for f in schema.fields:

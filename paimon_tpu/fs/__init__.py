@@ -63,6 +63,10 @@ class FileIO:
         raise NotImplementedError
 
     def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        """overwrite=False MUST be an atomic exclusive create (raise
+        FileExistsError on a loser) — the catalog lock's mutual exclusion
+        rests on it; a check-then-write implementation breaks commits on
+        stores without atomic rename."""
         raise NotImplementedError
 
     def exists(self, path: str) -> bool:
@@ -181,7 +185,7 @@ class LocalFileIO(FileIO):
             return
         # O_EXCL: creation is a true CAS (check-then-write would let two
         # writers both succeed), which the catalog lock relies on
-        fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
         with os.fdopen(fd, "wb") as f:
             f.write(data)
 
