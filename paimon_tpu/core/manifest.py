@@ -87,10 +87,52 @@ class ManifestFileMeta:
         return ManifestFileMeta(d["fileName"], d["fileSize"], d["numAddedFiles"], d["numDeletedFiles"], d["schemaId"])
 
 
+_AVRO_MAGIC = b"Obj\x01"
+
+
 class _JsonlZst:
+    """Manifest container io. The store's native format is zstd-compressed
+    JSON-lines; `manifest.format=avro` switches WRITES to the reference's
+    Avro layout (interop.manifest_codec) and READS always sniff the magic
+    bytes, so mixed-format histories (option flipped mid-life, or a table
+    laid out by the reference) read transparently."""
+
     def __init__(self, file_io: FileIO, directory: str):
         self.file_io = file_io
         self.directory = directory
+        self._table_cfg = None  # lazy (format, schema_id -> StatsContext)
+
+    def _config(self):
+        """(manifest_format, resolver) from the owning table's schemas —
+        self-provisioned so every construction site keeps working. Failures
+        are NOT cached (a transient IO error must not downgrade an avro table
+        to jsonl writes for the object's lifetime)."""
+        if self._table_cfg is None:
+            from ..interop.manifest_codec import StatsContext
+            from .schema import SchemaManager
+
+            table_path = self.directory.rsplit("/", 1)[0]
+            sm = SchemaManager(self.file_io, table_path)
+            ts = sm.latest()  # IO errors propagate; None = no table schema
+            if ts is None:
+                return ("jsonl", None)
+            fmt = str(ts.options.get("manifest.format", "jsonl")).lower()
+            latest_ctx = StatsContext.from_table_schema(ts)
+            cache: dict[int, "StatsContext"] = {ts.id: latest_ctx}
+
+            def resolver(schema_id: int):
+                # positional BinaryRow stats decode under the schema that
+                # WROTE them, not the latest (schema evolution)
+                if schema_id not in cache:
+                    try:
+                        old = sm.schema(schema_id)
+                        cache[schema_id] = StatsContext.from_table_schema(old)
+                    except Exception:
+                        cache[schema_id] = latest_ctx
+                return cache[schema_id]
+
+            self._table_cfg = (fmt, resolver)
+        return self._table_cfg
 
     def _write_lines(self, name: str, dicts: Iterable[dict]) -> int:
         raw = "\n".join(dumps(d) for d in dicts).encode()
@@ -99,8 +141,10 @@ class _JsonlZst:
         self.file_io.write_bytes(path, data)
         return len(data)
 
-    def _read_lines(self, name: str) -> list[dict]:
-        data = self.file_io.read_bytes(f"{self.directory}/{name}")
+    def _read_raw(self, name: str) -> bytes:
+        return self.file_io.read_bytes(f"{self.directory}/{name}")
+
+    def _read_lines_from(self, data: bytes) -> list[dict]:
         raw = zstandard.ZstdDecompressor().decompress(data)
         return [loads(line) for line in raw.decode().splitlines() if line]
 
@@ -113,12 +157,28 @@ class ManifestFile(_JsonlZst):
 
     def write(self, entries: Sequence[ManifestEntry], schema_id: int) -> ManifestFileMeta:
         name = new_file_name("manifest")
-        size = self._write_lines(name, (e.to_dict() for e in entries))
+        fmt, resolver = self._config()
+        if fmt == "avro" and resolver is not None:
+            from ..interop.manifest_codec import write_entries_avro
+
+            data = write_entries_avro(entries, resolver)
+            self.file_io.write_bytes(f"{self.directory}/{name}", data)
+            size = len(data)
+        else:
+            size = self._write_lines(name, (e.to_dict() for e in entries))
         added = sum(1 for e in entries if e.kind == FileKind.ADD)
         return ManifestFileMeta(name, size, added, len(entries) - added, schema_id)
 
     def read(self, name: str) -> list[ManifestEntry]:
-        return [ManifestEntry.from_dict(d) for d in self._read_lines(name)]
+        data = self._read_raw(name)
+        if data[:4] == _AVRO_MAGIC:
+            from ..interop.manifest_codec import read_entries_avro
+
+            _, resolver = self._config()
+            if resolver is None:
+                raise ValueError(f"avro manifest {name} needs the table schema for decoding")
+            return read_entries_avro(data, resolver)
+        return [ManifestEntry.from_dict(d) for d in self._read_lines_from(data)]
 
 
 class ManifestList(_JsonlZst):
@@ -126,11 +186,22 @@ class ManifestList(_JsonlZst):
 
     def write(self, metas: Sequence[ManifestFileMeta]) -> str:
         name = new_file_name("manifest-list")
-        self._write_lines(name, (m.to_dict() for m in metas))
+        fmt, resolver = self._config()
+        if fmt == "avro" and resolver is not None:
+            from ..interop.manifest_codec import write_metas_avro
+
+            self.file_io.write_bytes(f"{self.directory}/{name}", write_metas_avro(metas, resolver))
+        else:
+            self._write_lines(name, (m.to_dict() for m in metas))
         return name
 
     def read(self, name: str) -> list[ManifestFileMeta]:
-        return [ManifestFileMeta.from_dict(d) for d in self._read_lines(name)]
+        data = self._read_raw(name)
+        if data[:4] == _AVRO_MAGIC:
+            from ..interop.manifest_codec import read_metas_avro
+
+            return read_metas_avro(data)
+        return [ManifestFileMeta.from_dict(d) for d in self._read_lines_from(data)]
 
 
 def merge_entries(*entry_lists: Iterable[ManifestEntry]) -> list[ManifestEntry]:

@@ -176,3 +176,94 @@ def test_golden_fixture_committed_in_repo():
         (2, "two", 2.0),
         (3, "three", 3.0),
     ]
+
+
+def test_store_writes_reference_avro_manifests(tmp_path):
+    """manifest.format=avro: the store's OWN manifests use the reference Avro
+    layout; reads sniff the magic so scans/compactions/expiry keep working."""
+    import glob
+
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.interop.avro_io import read_ocf
+    from paimon_tpu.types import BIGINT, DOUBLE, STRING as S, RowType as RT
+
+    cat = FileSystemCatalog(str(tmp_path / "wh"), commit_user="avro")
+    t = cat.create_table(
+        "db.av",
+        RT.of(("pt", S()), ("id", BIGINT(False)), ("v", DOUBLE())),
+        primary_keys=["pt", "id"],
+        partition_keys=["pt"],
+        options={"bucket": "2", "manifest.format": "avro"},
+    )
+
+    def write(data):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(data)
+        wb.new_commit().commit(w.prepare_commit())
+
+    write({"pt": ["a", "a", "b"], "id": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    write({"pt": ["a", "b"], "id": [1, 9], "v": [10.0, 9.0]})
+    # every manifest + manifest list on disk is a reference Avro OCF
+    paths = glob.glob(f"{t.path}/manifest/manifest*")
+    assert paths
+    for p in paths:
+        blob = open(p, "rb").read()
+        assert blob[:4] == b"Obj\x01", p
+        schema, _ = read_ocf(blob)
+        assert schema["name"] == "org.apache.paimon.avro.generated.record"
+    # scans (partition + key-range pruning over avro-decoded stats) work
+    rb = t.new_read_builder()
+    rows = sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+    assert rows == [("a", 1, 10.0), ("a", 2, 2.0), ("b", 3, 3.0), ("b", 9, 9.0)]
+    # compaction + expiry traverse avro manifests too
+    from paimon_tpu.table.compactor import DedicatedCompactor
+
+    assert DedicatedCompactor(t).run_once(full=True)
+    t2 = cat.get_table("db.av")
+    rows2 = sorted(
+        t2.new_read_builder().new_read().read_all(t2.new_read_builder().new_scan().plan()).to_pylist()
+    )
+    assert rows2 == rows
+    # predicate pruning through avro stats: only partition 'b' files read
+    from paimon_tpu.data.predicate import equal
+
+    rb = t2.new_read_builder().with_filter(equal("pt", "b"))
+    assert sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist()) == [
+        ("b", 3, 3.0), ("b", 9, 9.0),
+    ]
+
+
+def test_avro_manifests_survive_schema_evolution(tmp_path):
+    """Positional BinaryRow stats decode under the schema that WROTE them;
+    pre-evolution files keep their pruning stats after add_column."""
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.core.schema import SchemaChange
+    from paimon_tpu.data.predicate import equal
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType as RT
+
+    cat = FileSystemCatalog(str(tmp_path / "wh"), commit_user="evo")
+    t = cat.create_table(
+        "db.evo", RT.of(("id", BIGINT(False)), ("v", DOUBLE())),
+        primary_keys=["id"], options={"bucket": "1", "manifest.format": "avro"},
+    )
+
+    def write(tbl, data):
+        wb = tbl.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(data)
+        wb.new_commit().commit(w.prepare_commit())
+
+    write(t, {"id": [1, 2], "v": [1.0, 2.0]})  # schema 0 (2 fields)
+    cat.alter_table("db.evo", SchemaChange.add_column("extra", DOUBLE()))
+    t2 = cat.get_table("db.evo")
+    write(t2, {"id": [3], "v": [3.0], "extra": [30.0]})  # schema 1 (3 fields)
+    rows = sorted(
+        t2.new_read_builder().new_read().read_all(t2.new_read_builder().new_scan().plan()).to_pylist()
+    )
+    assert rows == [(1, 1.0, None), (2, 2.0, None), (3, 3.0, 30.0)]
+    # the schema-0 file kept decodable stats: its entry round-trips min/max
+    plan = t2.store.new_scan().plan()
+    old = [e for e in plan.entries if e.file.schema_id == 0]
+    assert old and old[0].file.value_stats.get("v") is not None
+    assert old[0].file.value_stats["v"].min == 1.0 and old[0].file.value_stats["v"].max == 2.0
