@@ -199,6 +199,12 @@ class CoreOptions:
         "mesh: per-bucket merge jobs batch into one shard_map over the bucket "
         "axis; oversized buckets range-shuffle over the key axis.",
     )
+    SOURCE_SPLIT_TARGET_SIZE = ConfigOption.memory(
+        "source.split.target-size", "128 mb", "Target size of one batch-read split."
+    )
+    SOURCE_SPLIT_OPEN_FILE_COST = ConfigOption.memory(
+        "source.split.open-file-cost", "4 mb", "Weight floor per file when packing splits."
+    )
     COMMIT_CATALOG_LOCK = ConfigOption.bool_(
         "commit.catalog-lock.enabled",
         False,

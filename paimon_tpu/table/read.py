@@ -151,22 +151,52 @@ class TableScan:
 
                 scan = scan.with_partition_filter(accept)
         plan = scan.plan()
+        co = store.options
+        target = int(co.options.get(CoreOptions.SOURCE_SPLIT_TARGET_SIZE))
+        open_cost = int(co.options.get(CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST))
         splits = []
         for partition, buckets in sorted(plan.grouped().items(), key=lambda kv: kv[0]):
             for bucket, files in sorted(buckets.items()):
-                sections = IntervalPartition(files).partition()
-                raw = all(len(s) == 1 for s in sections)
-                splits.append(
-                    DataSplit(
-                        partition,
-                        bucket,
-                        files,
-                        snapshot_id=plan.snapshot.id if plan.snapshot else None,
-                        raw_convertible=raw,
-                        dv_index_file=plan.dv_index_for(partition, bucket),
+                snapshot = plan.snapshot.id if plan.snapshot else None
+                dv_index = plan.dv_index_for(partition, bucket)
+                for pack in _pack_bucket_splits(files, target, open_cost):
+                    raw = all(len(s) == 1 for s in IntervalPartition(pack).partition())
+                    splits.append(
+                        DataSplit(
+                            partition,
+                            bucket,
+                            pack,
+                            snapshot_id=snapshot,
+                            raw_convertible=raw,
+                            dv_index_file=dv_index,
+                        )
                     )
-                )
         return splits
+
+
+def _pack_bucket_splits(files, target: int, open_cost: int) -> list[list]:
+    """Weighted bin-packing of one bucket's files into read splits
+    (reference MergeTreeSplitGenerator.splitForBatch + BinPacking
+    packForOrdered). Sections are the atomic unit — files that must merge
+    together stay in one split; key-disjoint sections spread across splits
+    so a big bucket reads in parallel."""
+    if not files:
+        return []
+    sections = IntervalPartition(files).partition()
+    units = [[f for run in section for f in run.files] for section in sections]
+    packs: list[list] = []
+    cur: list = []
+    cur_weight = 0
+    for unit in units:
+        w = sum(max(f.file_size, open_cost) for f in unit)
+        if cur and cur_weight + w > target:
+            packs.append(cur)
+            cur, cur_weight = [], 0
+        cur.extend(unit)
+        cur_weight += w
+    if cur:
+        packs.append(cur)
+    return packs
 
 
 @contextmanager

@@ -276,3 +276,35 @@ def test_stream_plan_aligned(catalog):
     assert splits is not None
     read = t.new_read_builder().new_read()
     assert [r[0] for r in read.read_all(splits).to_pylist()] == [2]
+
+
+def test_batch_split_packing(catalog):
+    """Section-aware weighted bin-packing (reference MergeTreeSplitGenerator
+    splitForBatch): key-disjoint sections spread over multiple splits under a
+    small target size; overlapping runs stay together; results byte-match."""
+    t = catalog.create_table(
+        "db.packing",
+        SCHEMA,
+        primary_keys=["id"],
+        options={"bucket": "1", "write-only": "true"},
+    )
+    # 6 commits of DISJOINT key ranges -> 6 non-overlapping sections
+    for r in range(6):
+        write_batch(t, {"id": list(range(r * 100, r * 100 + 100)),
+                        "region": ["x"] * 100, "amount": [float(r)] * 100})
+    before = sorted(read_batch(t).to_pylist())
+    small = t.copy({"source.split.target-size": "1 kb", "source.split.open-file-cost": "1 b"})
+    rb = small.new_read_builder()
+    splits = rb.new_scan().plan()
+    assert len(splits) == 6  # one split per section under the tiny target
+    assert all(s.bucket == 0 for s in splits)
+    assert sorted(rb.new_read().read_all(splits).to_pylist()) == before
+    # overlapping runs (same key space) must stay in ONE split
+    t2 = catalog.create_table(
+        "db.packing2", SCHEMA, primary_keys=["id"], options={"bucket": "1", "write-only": "true"}
+    )
+    for r in range(4):
+        write_batch(t2, {"id": list(range(100)), "region": ["x"] * 100, "amount": [float(r)] * 100})
+    small2 = t2.copy({"source.split.target-size": "1 kb", "source.split.open-file-cost": "1 b"})
+    splits2 = small2.new_read_builder().new_scan().plan()
+    assert len(splits2) == 1 and len(splits2[0].files) == 4
