@@ -159,8 +159,8 @@ class TableScan:
             for bucket, files in sorted(buckets.items()):
                 snapshot = plan.snapshot.id if plan.snapshot else None
                 dv_index = plan.dv_index_for(partition, bucket)
-                for pack in _pack_bucket_splits(files, target, open_cost):
-                    raw = all(len(s) == 1 for s in IntervalPartition(pack).partition())
+                keyed = bool(self.table.schema.primary_keys)
+                for pack, raw in _pack_bucket_splits(files, target, open_cost, keyed):
                     splits.append(
                         DataSplit(
                             partition,
@@ -174,28 +174,41 @@ class TableScan:
         return splits
 
 
-def _pack_bucket_splits(files, target: int, open_cost: int) -> list[list]:
-    """Weighted bin-packing of one bucket's files into read splits
-    (reference MergeTreeSplitGenerator.splitForBatch + BinPacking
-    packForOrdered). Sections are the atomic unit — files that must merge
-    together stay in one split; key-disjoint sections spread across splits
-    so a big bucket reads in parallel."""
+def _pack_bucket_splits(files, target: int, open_cost: int, keyed: bool) -> list[tuple[list, bool]]:
+    """Weighted bin-packing of one bucket's files into read splits, returning
+    (files, raw_convertible) per pack (reference
+    MergeTreeSplitGenerator.splitForBatch + AppendOnlySplitGenerator +
+    BinPacking.packForOrdered). Keyed tables pack SECTIONS — files that must
+    merge together stay atomic, key-disjoint sections spread across splits —
+    weighing each section max(total size, open-file-cost); append tables have
+    no key ranges (one degenerate section), so their unit is the single file.
+    Not ported: the reference's DV/first-row fast path that packs per-file
+    raw groups even for overlapping keyed sections."""
     if not files:
         return []
-    sections = IntervalPartition(files).partition()
-    units = [[f for run in section for f in run.files] for section in sections]
-    packs: list[list] = []
+    if keyed:
+        sections = IntervalPartition(files).partition()
+        units = [
+            ([f for run in section for f in run.files], len(section) == 1, None)
+            for section in sections
+        ]
+    else:
+        ordered = sorted(files, key=lambda f: (f.min_sequence_number, f.file_name))
+        units = [([f], True, None) for f in ordered]
+    packs: list[tuple[list, bool]] = []
     cur: list = []
+    cur_raw = True
     cur_weight = 0
-    for unit in units:
-        w = sum(max(f.file_size, open_cost) for f in unit)
+    for unit_files, unit_raw, _ in units:
+        w = max(sum(f.file_size for f in unit_files), open_cost)
         if cur and cur_weight + w > target:
-            packs.append(cur)
-            cur, cur_weight = [], 0
-        cur.extend(unit)
+            packs.append((cur, cur_raw))
+            cur, cur_raw, cur_weight = [], True, 0
+        cur.extend(unit_files)
+        cur_raw = cur_raw and unit_raw
         cur_weight += w
     if cur:
-        packs.append(cur)
+        packs.append((cur, cur_raw))
     return packs
 
 

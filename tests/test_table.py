@@ -308,3 +308,16 @@ def test_batch_split_packing(catalog):
     small2 = t2.copy({"source.split.target-size": "1 kb", "source.split.open-file-cost": "1 b"})
     splits2 = small2.new_read_builder().new_scan().plan()
     assert len(splits2) == 1 and len(splits2[0].files) == 4
+
+
+def test_append_table_split_packing(catalog):
+    """Append tables have no key ranges: files pack individually (reference
+    AppendOnlySplitGenerator), so split-level parallelism works there too."""
+    t = catalog.create_table("db.packapp", SCHEMA, options={"bucket": "1", "write-only": "true"})
+    for r in range(5):
+        write_batch(t, {"id": list(range(100)), "region": ["x"] * 100, "amount": [float(r)] * 100})
+    small = t.copy({"source.split.target-size": "1 kb", "source.split.open-file-cost": "1 b"})
+    splits = small.new_read_builder().new_scan().plan()
+    assert len(splits) == 5  # one split per file under the tiny target
+    rb = small.new_read_builder()
+    assert rb.new_read().read_all(splits).num_rows == 500
