@@ -155,11 +155,11 @@ class TableScan:
         target = int(co.options.get(CoreOptions.SOURCE_SPLIT_TARGET_SIZE))
         open_cost = int(co.options.get(CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST))
         splits = []
+        keyed = bool(self.table.schema.primary_keys)
         for partition, buckets in sorted(plan.grouped().items(), key=lambda kv: kv[0]):
             for bucket, files in sorted(buckets.items()):
                 snapshot = plan.snapshot.id if plan.snapshot else None
                 dv_index = plan.dv_index_for(partition, bucket)
-                keyed = bool(self.table.schema.primary_keys)
                 for pack, raw in _pack_bucket_splits(files, target, open_cost, keyed):
                     splits.append(
                         DataSplit(
@@ -189,17 +189,17 @@ def _pack_bucket_splits(files, target: int, open_cost: int, keyed: bool) -> list
     if keyed:
         sections = IntervalPartition(files).partition()
         units = [
-            ([f for run in section for f in run.files], len(section) == 1, None)
+            ([f for run in section for f in run.files], len(section) == 1)
             for section in sections
         ]
     else:
         ordered = sorted(files, key=lambda f: (f.min_sequence_number, f.file_name))
-        units = [([f], True, None) for f in ordered]
+        units = [([f], True) for f in ordered]
     packs: list[tuple[list, bool]] = []
     cur: list = []
     cur_raw = True
     cur_weight = 0
-    for unit_files, unit_raw, _ in units:
+    for unit_files, unit_raw in units:
         w = max(sum(f.file_size for f in unit_files), open_cost)
         if cur and cur_weight + w > target:
             packs.append((cur, cur_raw))

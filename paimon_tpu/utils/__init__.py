@@ -59,3 +59,29 @@ def _default(o):
     if isinstance(o, (np.bool_,)):
         return bool(o)
     raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+def probe_devices(timeout_s: int = 120) -> tuple[int, str]:
+    """(device_count, backend) probed in a SUBPROCESS with a timeout: a
+    wedged accelerator tunnel can hang jax backend init indefinitely (an
+    observed killed client left the device grant unreclaimed for hours).
+    (0, "unreachable") when the probe fails — callers fall back to CPU."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()), jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=repo_root,
+        )
+        if proc.returncode == 0:
+            count, backend = proc.stdout.strip().splitlines()[-1].split()
+            return int(count), backend
+    except Exception:
+        pass
+    return 0, "unreachable"
