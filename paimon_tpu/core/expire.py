@@ -57,6 +57,10 @@ class SnapshotExpire:
                 end = sid + 1
             else:
                 break
+        # bound work per run (reference ExpireConfig snapshot max deletes)
+        limit = self.options.options.get(CoreOptions.SNAPSHOT_EXPIRE_LIMIT)
+        if limit is not None and end - earliest > limit:
+            end = earliest + limit
         protected = set(self.protected_ids())
         expire_ids = [i for i in range(earliest, end) if i not in protected and sm.snapshot_exists(i)]
         if not expire_ids:

@@ -224,6 +224,9 @@ class CoreOptions:
     SCAN_SNAPSHOT_ID = ConfigOption.int_("scan.snapshot-id", None, "Snapshot id for time travel.")
     SCAN_TIMESTAMP_MILLIS = ConfigOption.int_("scan.timestamp-millis", None, "Timestamp for time travel.")
     SCAN_TAG_NAME = ConfigOption.string("scan.tag-name", None, "Tag name for time travel.")
+    SNAPSHOT_EXPIRE_LIMIT = ConfigOption.int_(
+        "snapshot.expire.limit", 50, "Max snapshots processed per expire run."
+    )
     SNAPSHOT_NUM_RETAINED_MIN = ConfigOption.int_("snapshot.num-retained.min", 10, "Min snapshots retained.")
     SNAPSHOT_NUM_RETAINED_MAX = ConfigOption.int_("snapshot.num-retained.max", 2147483647, "Max snapshots retained.")
     SNAPSHOT_TIME_RETAINED_MS = ConfigOption.int_("snapshot.time-retained.ms", 3600_000, "Snapshot retention time.")
