@@ -172,3 +172,84 @@ def test_ingest_and_compactor_processes_race(tmp_warehouse):
         if sm.snapshot_exists(sid):
             kinds.add(sm.snapshot(sid).commit_kind)
     assert "APPEND" in kinds  # both kinds of commits interleaved
+
+
+def test_writer_and_compactor_processes_under_fault_injection(tmp_warehouse):
+    """VERDICT tier-5: writer and compactor processes race on one table with
+    RANDOM IO FAILURES injected in both. Whatever fails, the surviving
+    table must be consistent: every key exactly once, each key's value from
+    some fully-committed writer batch, monotone per key."""
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="parent")
+    cat.create_table(
+        "db.f5", SCHEMA, primary_keys=["k"], options={"bucket": "1", "write-only": "true"}
+    )
+    local_path = f"{tmp_warehouse}/db.db/f5"
+    writer_code = textwrap.dedent(f"""
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paimon_tpu.fs.testing import FailingFileIO
+        from paimon_tpu.table import FileStoreTable
+        from paimon_tpu.core.schema import SchemaManager
+        FailingFileIO.reset("w5", max_fails=40, possibility=12, seed=11)
+        io = FailingFileIO()
+        path = "fail://w5{local_path}"
+        committed = []
+        for r in range(10):
+            try:
+                schema = SchemaManager(io, path).latest()
+                t = FileStoreTable(io, path, schema, "w")
+                wb = t.new_batch_write_builder(); w = wb.new_write()
+                w.write({{"k": list(range(25)), "v": [float(r * 100 + i) for i in range(25)]}})
+                wb.new_commit().commit(w.prepare_commit())
+                committed.append(r)
+            except Exception:
+                pass
+        print("WRITER", committed)
+    """)
+    compactor_code = textwrap.dedent(f"""
+        import jax; jax.config.update("jax_platforms", "cpu")
+        from paimon_tpu.fs.testing import FailingFileIO
+        from paimon_tpu.table import FileStoreTable
+        from paimon_tpu.table.compactor import DedicatedCompactor
+        from paimon_tpu.core.schema import SchemaManager
+        FailingFileIO.reset("c5", max_fails=40, possibility=12, seed=23)
+        io = FailingFileIO()
+        path = "fail://c5{local_path}"
+        done = 0
+        for _ in range(8):
+            try:
+                schema = SchemaManager(io, path).latest()
+                t = FileStoreTable(io, path, schema, "c")
+                if DedicatedCompactor(t).run_once(full=True):
+                    done += 1
+            except Exception:
+                pass
+        print("COMPACTOR", done)
+    """)
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"}
+    pw = subprocess.Popen([sys.executable, "-c", writer_code], cwd="/root/repo", env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    pc = subprocess.Popen([sys.executable, "-c", compactor_code], cwd="/root/repo", env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    ow, ew = pw.communicate(timeout=300)
+    oc, ec = pc.communicate(timeout=300)
+    assert pw.returncode == 0, ew
+    assert pc.returncode == 0, ec
+    committed = eval(ow.strip().split("WRITER", 1)[1])
+    assert committed, "fault rate too high: no writer batch landed"
+
+    # heal: verify through a clean FileIO
+    t = cat.get_table("db.f5")
+    rb = t.new_read_builder()
+    rows = sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+    keys = [r[0] for r in rows]
+    assert keys == sorted(set(keys)), "duplicate keys after faulted race"
+    assert keys == list(range(25))
+    # every value comes from ONE fully-committed batch (no torn writes) and
+    # per-key value reflects the LAST committed batch containing that key
+    last = max(committed)
+    assert all(v == last * 100 + k for k, v in rows), rows[:3]
+    # snapshot chain is intact and walkable end to end
+    sm = t.store.snapshot_manager
+    for sid in range(sm.earliest_snapshot_id(), sm.latest_snapshot_id() + 1):
+        if sm.snapshot_exists(sid):
+            sm.snapshot(sid)

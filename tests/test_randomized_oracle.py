@@ -131,3 +131,54 @@ def test_random_ops_partitioned_dynamic_bucket(tmp_warehouse):
         rb = t.new_read_builder()
         got = {(r[0], r[1]): r for r in rb.new_read().read_all(rb.new_scan().plan()).to_pylist()}
         assert got == oracle, f"divergence at step {step}"
+
+
+@pytest.mark.skipif(
+    __import__("jax").device_count() < 8 if True else False,
+    reason="needs the 8-device virtual mesh",
+)
+@pytest.mark.parametrize("seed", [13])
+def test_random_ops_mesh_mode_matches_oracle(tmp_warehouse, seed):
+    """The same randomized churn with parallel.mesh.enabled + avro manifests:
+    the mesh execution path and the interop metadata plane must be invisible
+    to semantics."""
+    rng = np.random.default_rng(seed)
+    cat = FileSystemCatalog(f"{tmp_warehouse}/mesh{seed}", commit_user="oracle")
+    t = cat.create_table(
+        "db.rm",
+        SCHEMA,
+        primary_keys=["k"],
+        options={
+            "bucket": "4",
+            "num-sorted-run.compaction-trigger": "3",
+            "target-file-size": "4 kb",
+            "parallel.mesh.enabled": "true",
+            "manifest.format": "avro",
+        },
+    )
+    oracle: dict[int, tuple] = {}
+    for step in range(25):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        n = int(rng.integers(5, 60))
+        ks = rng.integers(0, 150, n).tolist()
+        rows = [(k, f"s{k % 13}", float(step * 1000 + k)) for k in ks]
+        w.write({"k": [r[0] for r in rows], "s": [r[1] for r in rows], "v": [r[2] for r in rows]})
+        for r in rows:
+            oracle[r[0]] = r
+        if rng.random() < 0.3 and oracle:
+            idx = rng.integers(0, len(oracle), size=min(5, len(oracle)))
+            dels = [sorted(oracle)[i] for i in np.unique(idx)]
+            w.write({"k": dels, "s": [None] * len(dels), "v": [None] * len(dels)}, kinds=["-D"] * len(dels))
+            for k in dels:
+                oracle.pop(k, None)
+        if rng.random() < 0.25:
+            w.compact(full=rng.random() < 0.4)
+        wb.new_commit().commit(w.prepare_commit())
+        if step % 6 == 5:
+            rb = t.new_read_builder()
+            got = {r[0]: r for r in rb.new_read().read_all(rb.new_scan().plan()).to_pylist()}
+            assert got == oracle, f"divergence at step {step}"
+    rb = t.new_read_builder()
+    got = {r[0]: r for r in rb.new_read().read_all(rb.new_scan().plan()).to_pylist()}
+    assert got == oracle
