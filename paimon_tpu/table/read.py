@@ -49,6 +49,7 @@ class DataSplit:
             "snapshotId": self.snapshot_id,
             "rawConvertible": self.raw_convertible,
             "dvIndexFile": self.dv_index_file,
+            "isChangelog": self.is_changelog,
         }
 
     @staticmethod
@@ -60,6 +61,7 @@ class DataSplit:
             d.get("snapshotId"),
             d.get("rawConvertible", False),
             d.get("dvIndexFile"),
+            d.get("isChangelog", False),
         )
 
 

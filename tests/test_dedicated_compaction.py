@@ -194,15 +194,19 @@ def test_writer_and_compactor_processes_under_fault_injection(tmp_warehouse):
         path = "fail://w5{local_path}"
         committed = []
         for r in range(10):
-            try:
-                schema = SchemaManager(io, path).latest()
-                t = FileStoreTable(io, path, schema, "w")
-                wb = t.new_batch_write_builder(); w = wb.new_write()
-                w.write({{"k": list(range(25)), "v": [float(r * 100 + i) for i in range(25)]}})
-                wb.new_commit().commit(w.prepare_commit())
-                committed.append(r)
-            except Exception:
-                pass
+            # retry until this round's batch lands (the 40-failure budget
+            # guarantees eventual success, so `committed` is never empty)
+            for attempt in range(25):
+                try:
+                    schema = SchemaManager(io, path).latest()
+                    t = FileStoreTable(io, path, schema, "w")
+                    wb = t.new_batch_write_builder(); w = wb.new_write()
+                    w.write({{"k": list(range(25)), "v": [float(r * 100 + i) for i in range(25)]}})
+                    wb.new_commit().commit(w.prepare_commit())
+                    committed.append(r)
+                    break
+                except Exception:
+                    pass
         print("WRITER", committed)
     """)
     compactor_code = textwrap.dedent(f"""

@@ -134,8 +134,7 @@ def test_random_ops_partitioned_dynamic_bucket(tmp_warehouse):
 
 
 @pytest.mark.skipif(
-    __import__("jax").device_count() < 8 if True else False,
-    reason="needs the 8-device virtual mesh",
+    __import__("jax").device_count() < 8, reason="needs the 8-device virtual mesh"
 )
 @pytest.mark.parametrize("seed", [13])
 def test_random_ops_mesh_mode_matches_oracle(tmp_warehouse, seed):
