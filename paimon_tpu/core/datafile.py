@@ -114,6 +114,7 @@ class KeyValueFileWriterFactory:
         bloom_fpp: float = 0.05,
         keyed: bool = True,
         format_options: dict | None = None,
+        include_key_columns: bool = False,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
@@ -130,6 +131,8 @@ class KeyValueFileWriterFactory:
         # (reference AppendOnlyFileStore / AppendOnlyWriter)
         self.keyed = keyed
         self.format_options = format_options or {}
+        # reference-layout data files: duplicate trimmed PK as _KEY_ columns
+        self.include_key_columns = include_key_columns
 
     def _estimate_row_bytes(self, batch: ColumnBatch) -> int:
         total = 0
@@ -181,7 +184,8 @@ class KeyValueFileWriterFactory:
         fmt = get_format(self.format_id)
         name = new_file_name(prefix, self.format_id)
         path = f"{self.bucket_dir}/{name}"
-        disk = kv.to_disk_batch() if self.keyed else kv.data
+        key_cols = self.key_names if (self.keyed and self.include_key_columns) else None
+        disk = kv.to_disk_batch(key_cols) if self.keyed else kv.data
         fmt.write(self.file_io, path, disk, self.compression, format_options=self.format_options)
         extra: list[str] = []
         if self.bloom_columns:

@@ -5,7 +5,9 @@ Parity: /root/reference/paimon-core/.../KeyValue.java:44 — a KeyValue is
 ColumnBatch of the value row type plus two system vectors. The on-disk schema
 is `_SEQUENCE_NUMBER BIGINT, _VALUE_KIND TINYINT, <value fields...>`
 (KeyValue.java:115-120 puts key fields first; here the primary key is always a
-subset of the value fields, so key columns are projected, not duplicated —
+subset of the value fields, so key columns are normally projected, not
+duplicated (data-file.include-key-columns opts into the reference's
+duplicated _KEY_ layout for byte-level interop) —
 one less copy on the wire and on device).
 """
 
@@ -80,14 +82,29 @@ class KVBatch:
             kinds = np.full(n, int(RowKind.INSERT), dtype=np.uint8)
         return KVBatch(data, seq, kinds)
 
-    def to_disk_batch(self) -> ColumnBatch:
-        """Attach system columns for the on-disk layout."""
-        schema = kv_disk_schema(self.data.schema)
-        cols = {
-            SEQUENCE_FIELD_NAME: Column(self.seq),
-            VALUE_KIND_FIELD_NAME: Column(self.kind.astype(np.int8)),
-        }
+    _KEY_FIELD_ID_OFFSET = 1_000_000_000  # keeps _KEY_ ids disjoint from value ids
+
+    def to_disk_batch(self, key_names: "Sequence[str] | None" = None) -> ColumnBatch:
+        """Attach system columns for the on-disk layout. With key_names,
+        the trimmed primary key is ALSO duplicated as _KEY_<name> columns at
+        the front — the reference KeyValue.schema() layout
+        (KeyValue.java:115-120). Key field ids are offset so they never
+        collide with the value fields' ids (the reference offsets by the max
+        key id for the same reason, KeyValue.createKeyValueFields)."""
+        value_schema = self.data.schema
+        cols = {}
+        fields = []
+        if key_names:
+            for name in key_names:
+                f = value_schema.field(name)
+                fields.append(DataField(self._KEY_FIELD_ID_OFFSET + f.id, f"_KEY_{name}", f.type))
+                cols[f"_KEY_{name}"] = self.data.column(name)
+        disk_schema = kv_disk_schema(value_schema)
+        fields.extend(disk_schema.fields)
+        cols[SEQUENCE_FIELD_NAME] = Column(self.seq)
+        cols[VALUE_KIND_FIELD_NAME] = Column(self.kind.astype(np.int8))
         cols.update(self.data.columns)
+        schema = RowType(tuple(fields)) if key_names else disk_schema
         return ColumnBatch(schema, cols)
 
     @staticmethod

@@ -87,6 +87,7 @@ class KeyValueFileStore:
                 for k, v in co.options._data.items()
                 if k.startswith(("orc.", "parquet.", "avro."))
             },
+            include_key_columns=co.options.get(CoreOptions.DATA_FILE_INCLUDE_KEY_COLUMNS),
         )
 
     def reader_factory(self, partition: tuple, bucket: int, read_schema: RowType | None = None) -> KeyValueFileReaderFactory:

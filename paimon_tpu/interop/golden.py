@@ -111,14 +111,16 @@ def manifest_meta_schema() -> dict:
 
 
 def _kv_disk_schema(schema: RowType, primary_keys: list[str]) -> RowType:
-    """KeyValue on-disk schema (KeyValue.java:115-120)."""
+    """KeyValue on-disk schema (KeyValue.java:115-120) — ONE builder shared
+    with the store's write path (KVBatch.to_disk_batch carries the same
+    layout and key-id offset)."""
+    from ..core.kv import KVBatch, kv_disk_schema
+
     fields: list[DataField] = []
     for pk in primary_keys:
         f = schema.field(pk)
-        fields.append(DataField(f.id, f"_KEY_{f.name}", f.type))
-    fields.append(DataField(2147483646, "_SEQUENCE_NUMBER", BIGINT(False)))
-    fields.append(DataField(2147483645, "_VALUE_KIND", TINYINT(False)))
-    fields.extend(schema.fields)
+        fields.append(DataField(KVBatch._KEY_FIELD_ID_OFFSET + f.id, f"_KEY_{f.name}", f.type))
+    fields.extend(kv_disk_schema(schema).fields)
     return RowType(tuple(fields))
 
 

@@ -199,6 +199,14 @@ class CoreOptions:
         "mesh: per-bucket merge jobs batch into one shard_map over the bucket "
         "axis; oversized buckets range-shuffle over the key axis.",
     )
+    DATA_FILE_INCLUDE_KEY_COLUMNS = ConfigOption.bool_(
+        "data-file.include-key-columns",
+        False,
+        "Duplicate the trimmed primary key as _KEY_<name> columns at the "
+        "front of every data file (the reference KeyValue.schema layout) — "
+        "with manifest.format=avro this makes the whole table "
+        "reference-layout on disk.",
+    )
     SOURCE_SPLIT_TARGET_SIZE = ConfigOption.memory(
         "source.split.target-size", "128 mb", "Target size of one batch-read split."
     )

@@ -267,3 +267,48 @@ def test_avro_manifests_survive_schema_evolution(tmp_path):
     old = [e for e in plan.entries if e.file.schema_id == 0]
     assert old and old[0].file.value_stats.get("v") is not None
     assert old[0].file.value_stats["v"].min == 1.0 and old[0].file.value_stats["v"].max == 2.0
+
+
+def test_reference_layout_data_files_option(tmp_path):
+    """data-file.include-key-columns + manifest.format=avro: the whole table
+    on disk (data files included) follows the reference KV layout, and the
+    interop reader — which expects exactly that layout — can scan it."""
+    import glob
+
+    import pyarrow.parquet as pq
+
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, STRING as S, RowType as RT
+
+    cat = FileSystemCatalog(str(tmp_path / "wh"), commit_user="ref")
+    t = cat.create_table(
+        "db.ref",
+        RT.of(("id", BIGINT(False)), ("name", S()), ("score", DOUBLE())),
+        primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "manifest.format": "avro",
+            "data-file.include-key-columns": "true",
+        },
+    )
+
+    def write(data):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(data)
+        wb.new_commit().commit(w.prepare_commit())
+
+    write({"id": [1, 2], "name": ["a", "b"], "score": [1.0, 2.0]})
+    write({"id": [1, 3], "name": ["a2", "c"], "score": [10.0, 3.0]})
+    # data files carry the reference column layout
+    files = glob.glob(f"{t.path}/bucket-0/data-*.parquet")
+    assert files
+    names = pq.ParquetFile(files[0]).schema_arrow.names
+    assert names == ["_KEY_id", "_SEQUENCE_NUMBER", "_VALUE_KIND", "id", "name", "score"]
+    # our own reads are unaffected (projection skips the extra columns)
+    rb = t.new_read_builder()
+    rows = sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+    assert rows == [(1, "a2", 10.0), (2, "b", 2.0), (3, "c", 3.0)]
+    # the strict reference-layout scanner reads the table end to end
+    schema, got = read_reference_table(t.path)
+    assert sorted(got.to_pylist()) == rows
