@@ -232,6 +232,17 @@ class CoreOptions:
     SCAN_SNAPSHOT_ID = ConfigOption.int_("scan.snapshot-id", None, "Snapshot id for time travel.")
     SCAN_TIMESTAMP_MILLIS = ConfigOption.int_("scan.timestamp-millis", None, "Timestamp for time travel.")
     SCAN_TAG_NAME = ConfigOption.string("scan.tag-name", None, "Tag name for time travel.")
+    INCREMENTAL_BETWEEN = ConfigOption.string(
+        "incremental-between",
+        None,
+        "Read incremental changes between two snapshots or tags "
+        "('3,7' or 'tagA,tagB'): start exclusive, end inclusive.",
+    )
+    SCAN_BOUNDED_WATERMARK = ConfigOption.int_(
+        "scan.bounded.watermark",
+        None,
+        "Streaming reads end once a snapshot's watermark passes this bound.",
+    )
     SNAPSHOT_EXPIRE_LIMIT = ConfigOption.int_(
         "snapshot.expire.limit", 50, "Max snapshots processed per expire run."
     )

@@ -101,6 +101,86 @@ class TableScan:
         self.table = table
         self.predicate = predicate
 
+    def _incremental_splits(self, spec: str) -> list[DataSplit]:
+        """incremental-between='a,b' (snapshot ids or tag names): the union
+        of APPEND deltas of snapshots (a, b], rows carrying their original
+        kinds (reference IncrementalStartingScanner, delta scan mode)."""
+        store = self.table.store
+        sm = store.snapshot_manager
+
+        def resolve(token: str) -> int:
+            token = token.strip()
+            if token.lstrip("-").isdigit():
+                return int(token)
+            from .tags import TagManager
+
+            try:
+                return TagManager(self.table.file_io, self.table.path).snapshot_id(token)
+            except FileNotFoundError:
+                raise ValueError(f"unknown tag {token!r} in incremental-between") from None
+
+        parts = spec.split(",")
+        if len(parts) != 2:
+            raise ValueError(f"incremental-between expects 'start,end', got {spec!r}")
+        start, end = resolve(parts[0]), resolve(parts[1])
+        if start >= end:
+            raise ValueError(
+                f"incremental-between start must precede end, got {start} >= {end}"
+            )
+        from ..core.snapshot import CommitKind
+
+        partition_accept = self._partition_predicate()
+        splits: list[DataSplit] = []
+        for sid in range(start + 1, end + 1):
+            if not sm.snapshot_exists(sid):
+                continue
+            snap = sm.snapshot(sid)
+            if snap.commit_kind != CommitKind.APPEND:
+                continue  # COMPACT/OVERWRITE rewrite existing rows, no new changes
+            scan = store.new_scan().with_snapshot(sid).with_kind("delta")
+            if partition_accept is not None:
+                scan = scan.with_partition_filter(partition_accept)
+            plan = scan.plan()
+            for partition, buckets in sorted(plan.grouped().items()):
+                for bucket, files in sorted(buckets.items()):
+                    splits.append(
+                        DataSplit(
+                            partition,
+                            bucket,
+                            files,
+                            snapshot_id=sid,
+                            # raw per-file reads preserving row kinds: the
+                            # delta IS the change stream for this snapshot
+                            is_changelog=True,
+                        )
+                    )
+        return splits
+
+    def _partition_predicate(self):
+        """partition tuple -> bool from the scan predicate's partition
+        conjuncts; None when nothing prunes."""
+        if self.predicate is None:
+            return None
+        from ..data.predicate import PredicateBuilder, and_
+
+        store = self.table.store
+        parts = PredicateBuilder.split_and(self.predicate)
+        part_parts = PredicateBuilder.pick_by_fields(parts, set(store.partition_keys))
+        if not part_parts:
+            return None
+        pred = and_(*part_parts)
+        keys = store.partition_keys
+
+        def accept(partition: tuple) -> bool:
+            from ..data.batch import ColumnBatch
+
+            row = ColumnBatch.from_pydict(
+                self.table.row_type.project(keys), {k: [v] for k, v in zip(keys, partition)}
+            )
+            return bool(pred.eval(row)[0])
+
+        return accept
+
     def _resolve_snapshot(self) -> int | None:
         """Time travel via scan options (reference StartupMode/time-travel)."""
         store = self.table.store
@@ -121,6 +201,9 @@ class TableScan:
 
     def plan(self) -> list[DataSplit]:
         store = self.table.store
+        inc = store.options.options.get(CoreOptions.INCREMENTAL_BETWEEN)
+        if inc:
+            return self._incremental_splits(inc)
         scan = store.new_scan()
         snapshot_id = self._resolve_snapshot()
         if snapshot_id is not None:
@@ -137,20 +220,8 @@ class TableScan:
                 # safely skip whole files (reference AppendOnlyFileStoreScan)
                 scan = scan.with_value_filter(self.predicate)
             # partition predicate -> partition pruning
-            part_fields = set(store.partition_keys)
-            part_parts = PredicateBuilder.pick_by_fields(parts, part_fields)
-            if part_parts:
-                pred = and_(*part_parts)
-                keys = store.partition_keys
-
-                def accept(partition: tuple) -> bool:
-                    from ..data.batch import ColumnBatch
-
-                    row = ColumnBatch.from_pydict(
-                        self.table.row_type.project(keys), {k: [v] for k, v in zip(keys, partition)}
-                    )
-                    return bool(pred.eval(row)[0])
-
+            accept = self._partition_predicate()
+            if accept is not None:
                 scan = scan.with_partition_filter(accept)
         plan = scan.plan()
         co = store.options

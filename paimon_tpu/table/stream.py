@@ -54,6 +54,7 @@ class StreamTableScan:
     def restore(self, next_snapshot: int | None) -> None:
         self._next = next_snapshot
         self._started = next_snapshot is not None
+        self._ended = False  # a rollback may land before the bound again
 
     def notify_checkpoint_complete(self) -> None:
         cp = getattr(self, "_last_checkpoint", None)
@@ -76,11 +77,31 @@ class StreamTableScan:
                 return None
             time.sleep(min(poll_seconds, remaining))
 
+    def _past_bound(self, snap) -> bool:
+        """scan.bounded.watermark: the stream ENDS once a snapshot's
+        watermark passes the bound (reference BoundedChecker)."""
+        bound = self.store.options.options.get(CoreOptions.SCAN_BOUNDED_WATERMARK)
+        if bound is None or snap is None or snap.watermark is None:
+            return False
+        return snap.watermark > bound
+
+    @property
+    def ended(self) -> bool:
+        return getattr(self, "_ended", False)
+
     def plan(self) -> list[DataSplit] | None:
         """None = nothing new yet. First call obeys the startup mode; later
         calls return the delta of one new snapshot each."""
         sm = self.store.snapshot_manager
+        if self.ended:
+            return None
         if not self._started:
+            # the bound applies to the FIRST plan too (reference
+            # DataTableStreamScan.tryFirstPlan + BoundedChecker): a starting
+            # snapshot already past the bound ends the stream with no data
+            if self._past_bound(sm.latest_snapshot()):
+                self._ended = True
+                return None
             self._started = True
             splits = self._starting_plan()
             if splits is not None:
@@ -89,6 +110,9 @@ class StreamTableScan:
         if latest is None or self._next is None or self._next > latest:
             return None
         snap = sm.snapshot(self._next)
+        if self._past_bound(snap):
+            self._ended = True
+            return None
         splits = self._delta_splits(self._next, snap)
         self._next += 1
         return splits

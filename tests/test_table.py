@@ -368,3 +368,96 @@ def test_split_enumerator_distributed_assignment(catalog):
     assert any(999 in [row[0] for row in read.read(s).to_pylist()] for s in got)
     # restored scan continues AFTER the checkpointed snapshot (no re-delivery)
     assert enum2.discover() == 0
+
+
+def test_incremental_between(catalog):
+    """incremental-between reads the change stream of (a, b] — kinds
+    preserved, compaction snapshots skipped (reference
+    IncrementalStartingScanner, delta mode)."""
+    t = catalog.create_table("db.inc", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write_batch(t, {"id": [1, 2], "region": ["x", "x"], "amount": [1.0, 2.0]})     # snap 1
+    write_batch(t, {"id": [2, 3], "region": ["x", "x"], "amount": [20.0, 3.0]})    # snap 2
+    t.create_tag("a", snapshot_id=1)
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [1], "region": ["x"], "amount": [None]}, kinds=["-D"])
+    w.compact(full=True)  # adds a COMPACT snapshot in the range
+    wb.new_commit().commit(w.prepare_commit())                                      # snaps 3+4
+    t.create_tag("b")
+
+    inc = t.copy({"incremental-between": "1,9"})
+    rb = inc.new_read_builder()
+    read = rb.new_read()
+    events = []
+    for s in rb.new_scan().plan():
+        data, kinds = read.read_with_kinds(s)
+        for row, k in zip(data.to_pylist(), kinds.tolist()):
+            events.append((k, row[0]))
+    # snap2: +I for 2 (upsert), +I for 3; snap3: -D for 1; compaction skipped
+    assert sorted(events) == [(0, 2), (0, 3), (3, 1)]
+    # tag names work too
+    inc2 = t.copy({"incremental-between": "a,b"})
+    rb2 = inc2.new_read_builder()
+    assert len(rb2.new_scan().plan()) == len(rb.new_scan().plan())
+
+
+def test_scan_bounded_watermark(catalog):
+    """Streaming ends once a snapshot's watermark passes the bound."""
+    from paimon_tpu.core.manifest import ManifestCommittable
+    from paimon_tpu.table.write import BatchWriteBuilder, TableCommit
+
+    t = catalog.create_table("db.bw", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+
+    def write_wm(ident, rows, watermark):
+        wb = t.new_stream_write_builder()
+        w = wb.new_write()
+        w.write(rows)
+        wb.new_commit().commit_messages(ident, w.prepare_commit(), watermark=watermark)
+
+    bounded = t.copy({"scan.bounded.watermark": "1000"})
+    scan = bounded.new_read_builder().new_stream_scan()
+    write_wm(1, {"id": [1], "region": ["x"], "amount": [1.0]}, watermark=500)
+    assert scan.plan()  # starting plan
+    write_wm(2, {"id": [2], "region": ["x"], "amount": [2.0]}, watermark=900)
+    assert scan.plan()  # within bound
+    write_wm(3, {"id": [3], "region": ["x"], "amount": [3.0]}, watermark=1500)
+    assert scan.plan() is None and scan.ended  # bound crossed: stream ENDS
+    write_wm(4, {"id": [4], "region": ["x"], "amount": [4.0]}, watermark=1600)
+    assert scan.plan() is None  # stays ended
+
+
+def test_incremental_between_validation_and_pruning(catalog):
+    import pytest as _pytest
+
+    t = catalog.create_table(
+        "db.incv", SCHEMA, primary_keys=["region", "id"], partition_keys=["region"],
+        options={"bucket": "1"},
+    )
+    write_batch(t, {"id": [1, 2], "region": ["a", "b"], "amount": [1.0, 2.0]})
+    write_batch(t, {"id": [3, 4], "region": ["a", "b"], "amount": [3.0, 4.0]})
+    with _pytest.raises(ValueError, match="unknown tag"):
+        t.copy({"incremental-between": "nope,alsono"}).new_read_builder().new_scan().plan()
+    with _pytest.raises(ValueError, match="precede"):
+        t.copy({"incremental-between": "2,1"}).new_read_builder().new_scan().plan()
+    # partition predicate prunes incremental splits
+    from paimon_tpu.data.predicate import equal
+
+    inc = t.copy({"incremental-between": "1,2"})
+    rb = inc.new_read_builder().with_filter(equal("region", "a"))
+    splits = rb.new_scan().plan()
+    assert splits and all(s.partition == ("a",) for s in splits)
+
+
+def test_bounded_watermark_applies_to_first_plan(catalog):
+    from paimon_tpu.table.write import BatchWriteBuilder
+
+    t = catalog.create_table("db.bw2", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    wb = t.new_stream_write_builder()
+    w = wb.new_write()
+    w.write({"id": [1], "region": ["x"], "amount": [1.0]})
+    wb.new_commit().commit_messages(1, w.prepare_commit(), watermark=5000)
+    bounded = t.copy({"scan.bounded.watermark": "1000"})
+    scan = bounded.new_read_builder().new_stream_scan()
+    assert scan.plan() is None and scan.ended  # past bound before any data
+    scan.restore(1)
+    assert not scan.ended  # rollback clears the ended latch
