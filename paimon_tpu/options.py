@@ -238,6 +238,12 @@ class CoreOptions:
         "Read incremental changes between two snapshots or tags "
         "('3,7' or 'tagA,tagB'): start exclusive, end inclusive.",
     )
+    INCREMENTAL_BETWEEN_SCAN_MODE = ConfigOption.string(
+        "incremental-between-scan-mode",
+        "delta",
+        "Incremental read source: delta (APPEND snapshot deltas) or "
+        "changelog (changelog files of the range).",
+    )
     SCAN_BOUNDED_WATERMARK = ConfigOption.int_(
         "scan.bounded.watermark",
         None,

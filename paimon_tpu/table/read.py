@@ -129,15 +129,29 @@ class TableScan:
             )
         from ..core.snapshot import CommitKind
 
+        mode = str(
+            store.options.options.get(CoreOptions.INCREMENTAL_BETWEEN_SCAN_MODE) or "delta"
+        ).lower()
+        if mode not in ("delta", "changelog"):
+            raise ValueError(f"unknown incremental-between-scan-mode {mode!r}")
         partition_accept = self._partition_predicate()
         splits: list[DataSplit] = []
         for sid in range(start + 1, end + 1):
             if not sm.snapshot_exists(sid):
                 continue
             snap = sm.snapshot(sid)
-            if snap.commit_kind != CommitKind.APPEND:
-                continue  # COMPACT/OVERWRITE rewrite existing rows, no new changes
-            scan = store.new_scan().with_snapshot(sid).with_kind("delta")
+            if mode == "changelog":
+                # exact change events the producers recorded (reference
+                # scan-mode=changelog); COMPACT snapshots carry the
+                # full-compaction producer's files, so none are skipped
+                if not snap.changelog_manifest_list:
+                    continue
+                kind = "changelog"
+            else:
+                if snap.commit_kind != CommitKind.APPEND:
+                    continue  # COMPACT/OVERWRITE rewrite existing rows, no new changes
+                kind = "delta"
+            scan = store.new_scan().with_snapshot(sid).with_kind(kind)
             if partition_accept is not None:
                 scan = scan.with_partition_filter(partition_accept)
             plan = scan.plan()

@@ -461,3 +461,24 @@ def test_bounded_watermark_applies_to_first_plan(catalog):
     assert scan.plan() is None and scan.ended  # past bound before any data
     scan.restore(1)
     assert not scan.ended  # rollback clears the ended latch
+
+
+def test_incremental_between_changelog_mode(catalog):
+    """incremental-between-scan-mode=changelog replays the recorded change
+    events (input producer) of the range."""
+    t = catalog.create_table(
+        "db.incc", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "changelog-producer": "input"},
+    )
+    write_batch(t, {"id": [1], "region": ["x"], "amount": [1.0]})
+    write_batch(t, {"id": [1, 2], "region": ["x", "x"], "amount": [10.0, 2.0]})
+    write_batch(t, {"id": [2], "region": ["x"], "amount": [None]}, kinds=["-D"])
+    inc = t.copy({"incremental-between": "1,3", "incremental-between-scan-mode": "changelog"})
+    rb = inc.new_read_builder()
+    read = rb.new_read()
+    events = []
+    for s in rb.new_scan().plan():
+        assert s.is_changelog
+        data, kinds = read.read_with_kinds(s)
+        events += [(int(k), r[0], r[2]) for r, k in zip(data.to_pylist(), kinds.tolist())]
+    assert sorted(events) == [(0, 1, 10.0), (0, 2, 2.0), (3, 2, None)]
