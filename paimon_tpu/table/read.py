@@ -129,9 +129,7 @@ class TableScan:
             )
         from ..core.snapshot import CommitKind
 
-        mode = str(
-            store.options.options.get(CoreOptions.INCREMENTAL_BETWEEN_SCAN_MODE) or "delta"
-        ).lower()
+        mode = store.options.options.get(CoreOptions.INCREMENTAL_BETWEEN_SCAN_MODE).lower()
         if mode not in ("delta", "changelog"):
             raise ValueError(f"unknown incremental-between-scan-mode {mode!r}")
         partition_accept = self._partition_predicate()
