@@ -320,6 +320,7 @@ def read_reference_table(path: str, file_io: FileIO | None = None) -> tuple[RowT
     for e in live.values():
         f = e["_FILE"]
         files.append(
+            (e["_BUCKET"],
             DataFileMeta(
                 file_name=f["_FILE_NAME"],
                 file_size=f["_FILE_SIZE"],
@@ -332,22 +333,42 @@ def read_reference_table(path: str, file_io: FileIO | None = None) -> tuple[RowT
                 max_sequence_number=f["_MAX_SEQUENCE_NUMBER"],
                 schema_id=f["_SCHEMA_ID"],
                 level=f["_LEVEL"],
-            )
+            ))
         )
 
+    # schema evolution: each file reads under the schema that WROTE it, then
+    # aligns to the latest schema by field id (missing columns -> null)
+    schemas_cache: dict[int, RowType] = {snap.schema_id: schema}
+
+    def value_schema_of(schema_id: int) -> RowType:
+        if schema_id not in schemas_cache:
+            old = TableSchema.from_json(io.read_bytes(f"{path}/schema/schema-{schema_id}"))
+            schemas_cache[schema_id] = RowType(old.fields)
+        return schemas_cache[schema_id]
+
     fmt = get_format("parquet")
+    from ..data.batch import Column, concat_batches
+
     parts = []
-    for meta in sorted(files, key=lambda x: x.min_sequence_number):
-        for b in fmt.read(io, f"{path}/bucket-0/{meta.file_name}", disk_schema):
-            parts.append(b)
+    for bucket, meta in sorted(files, key=lambda x: x[1].min_sequence_number):
+        file_value_schema = value_schema_of(meta.schema_id)
+        file_disk = _kv_disk_schema(file_value_schema, primary_keys)
+        for b in fmt.read(io, f"{path}/bucket-{bucket}/{meta.file_name}", file_disk):
+            by_id = {f.id: f for f in file_value_schema.fields}
+            cols = {}
+            for f in schema.fields:
+                src = by_id.get(f.id)
+                cols[f.name] = (
+                    b.column(src.name)
+                    if src is not None
+                    else Column.from_pylist([None] * b.num_rows, f.type)
+                )
+            value = ColumnBatch(schema, cols)
+            seqs = b.column("_SEQUENCE_NUMBER").values.astype(np.int64)
+            kinds = b.column("_VALUE_KIND").values.astype(np.uint8)
+            parts.append(KVBatch(value, seqs, kinds))
     if not parts:
         return schema, ColumnBatch.empty(schema)
-    from ..data.batch import concat_batches
-
-    disk = concat_batches(parts)
-    seqs = disk.column("_SEQUENCE_NUMBER").values.astype(np.int64)
-    kinds = disk.column("_VALUE_KIND").values.astype(np.uint8)
-    value = ColumnBatch(schema, {f.name: disk.column(f.name) for f in schema.fields})
-    kv = KVBatch(value, seqs, kinds)
+    kv = KVBatch.concat(parts)
     merged = MergeExecutor(schema, primary_keys).merge(kv).drop_deletes()
     return schema, merged.data
