@@ -312,3 +312,38 @@ def test_reference_layout_data_files_option(tmp_path):
     # the strict reference-layout scanner reads the table end to end
     schema, got = read_reference_table(t.path)
     assert sorted(got.to_pylist()) == rows
+
+
+def test_avro_manifests_with_branches(tmp_path):
+    """Branch tables carry their own schema lineage; the lazy avro-manifest
+    config must resolve under the BRANCH path too (manifest dir parent)."""
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.table.branch import BranchManager, branch_table
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType as RT
+
+    cat = FileSystemCatalog(str(tmp_path / "wh"), commit_user="br")
+    t = cat.create_table(
+        "db.b", RT.of(("id", BIGINT(False)), ("v", DOUBLE())),
+        primary_keys=["id"], options={"bucket": "1", "manifest.format": "avro"},
+    )
+
+    def write(tbl, data):
+        wb = tbl.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(data)
+        wb.new_commit().commit(w.prepare_commit())
+
+    def read(tbl):
+        rb = tbl.new_read_builder()
+        return sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+
+    write(t, {"id": [1, 2], "v": [1.0, 2.0]})
+    bm = BranchManager(t.file_io, t.path)
+    bm.create("dev")
+    bt = branch_table(t, "dev")
+    assert read(bt) == [(1, 1.0), (2, 2.0)]  # branch reads avro manifests
+    write(bt, {"id": [3], "v": [3.0]})  # branch WRITES avro manifests too
+    assert read(bt) == [(1, 1.0), (2, 2.0), (3, 3.0)]
+    assert read(t) == [(1, 1.0), (2, 2.0)]  # main unaffected
+    bm.fast_forward("dev")
+    assert read(cat.get_table("db.b")) == [(1, 1.0), (2, 2.0), (3, 3.0)]
