@@ -15,16 +15,22 @@ from paimon_tpu.types import BIGINT, DOUBLE, RowType
 SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
 
 
-def test_commit_crash_safety_under_random_failures(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("manifest_format", ["jsonl", "avro"])
+def test_commit_crash_safety_under_random_failures(tmp_path, manifest_format):
     """Writers crash randomly mid write/commit; retries must never corrupt the
     table: every successful commit is fully visible, every failed one fully
-    invisible."""
-    domain = "commitfault"
+    invisible. Runs for BOTH metadata planes (jsonl and reference avro)."""
+    domain = f"commitfault_{manifest_format}"
     FailingFileIO.reset(domain, max_fails=0, possibility=0)
     io = get_file_io(f"fail://{domain}/x")
     path = f"fail://{domain}{tmp_path}/table"
     sm = SchemaManager(io, path)
-    ts = sm.create_table(SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    ts = sm.create_table(
+        SCHEMA, primary_keys=["k"], options={"bucket": "1", "manifest.format": manifest_format}
+    )
     store = KeyValueFileStore(io, path, ts, commit_user="crashy")
 
     oracle = {}
