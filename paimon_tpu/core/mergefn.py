@@ -68,8 +68,9 @@ class MergeExecutor:
         self._user_seq = self.options.sequence_field
 
     def _key_lanes(self, kv: KVBatch) -> np.ndarray:
-        pools = {k: build_string_pool([kv.data.column(k).values]) for k in self._string_keys}
-        return encode_key_lanes(kv.data, self.key_names, pools)
+        from ..data.keys import encode_key_lanes_with_pools
+
+        return encode_key_lanes_with_pools(kv.data, self.key_names)
 
     def _lanes(self, kv: KVBatch, seq_ascending: bool) -> tuple[np.ndarray, np.ndarray | None]:
         return self._key_lanes(kv), self._seq_lanes(kv, seq_ascending)

@@ -168,3 +168,17 @@ def lexsort_rows(lanes: np.ndarray, *tiebreakers: np.ndarray) -> np.ndarray:
     if not keys:
         return np.arange(lanes.shape[0])
     return np.lexsort(keys)
+
+
+def encode_key_lanes_with_pools(batch, key_names):
+    """encode_key_lanes with string pools auto-built for string/bytes keys —
+    the idiom every key-encoding call site needs."""
+    from ..types import TypeRoot
+
+    pools = {
+        name: build_string_pool([batch.column(name).values])
+        for name in key_names
+        if batch.schema.field(name).type.root
+        in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+    }
+    return encode_key_lanes(batch, key_names, pools)

@@ -186,6 +186,12 @@ class CoreOptions:
     WRITE_BUFFER_SPILL_ROWS = ConfigOption.int_(
         "write-buffer-spill.rows", 256 * 1024, "In-memory rows before a spill segment is written."
     )
+    LOCAL_MERGE_BUFFER_SIZE = ConfigOption.memory(
+        "local-merge-buffer-size",
+        "0 b",
+        "When >0, pre-merge high-churn keys in a local buffer BEFORE bucket "
+        "routing (reference LocalMergeOperator; deduplicate engine only).",
+    )
     WRITE_BUFFER_SPILL_SIZE = ConfigOption.memory(
         "write-buffer-spill.size", "64 mb", "In-memory bytes before a spill segment is written."
     )

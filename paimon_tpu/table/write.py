@@ -47,6 +47,11 @@ class TableWrite:
             # global-index writer (reference GlobalDynamicBucketSink)
             from .crosspartition import CrossPartitionUpsertWrite
 
+            self._init_local_merge()  # validate the option combo even here
+            if self._local_merge_cap:
+                raise ValueError(
+                    "local-merge-buffer-size is not supported with cross-partition upsert"
+                )
             self._cross = CrossPartitionUpsertWrite(table)
             return
         if self.dynamic:
@@ -56,6 +61,60 @@ class TableWrite:
             target = store.options.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
             self._assigner = SimpleHashBucketAssigner(HashIndexFile(table.file_io, table.path), target)
             self._bootstrapped: set[tuple] = set()
+        self._init_local_merge()
+
+    def _init_local_merge(self) -> None:
+        """Local pre-merge (reference LocalMergeOperator / FlinkSinkBuilder's
+        optional pre-shuffle merge): high-churn keys collapse in a small
+        buffer BEFORE bucket routing, shrinking shuffle + memtable traffic.
+        Deduplicate engine only — other engines need every record."""
+        from ..options import CoreOptions, MergeEngine
+
+        store = self.table.store
+        size = int(store.options.options.get(CoreOptions.LOCAL_MERGE_BUFFER_SIZE))
+        self._local_merge_bytes = 0
+        self._local_buffer: list[tuple[ColumnBatch, np.ndarray | None]] = []
+        self._local_merge_cap = 0
+        if size > 0:
+            if store.options.merge_engine != MergeEngine.DEDUPLICATE:
+                raise ValueError("local-merge-buffer-size requires merge-engine=deduplicate")
+            if not self.table.is_primary_key_table:
+                raise ValueError("local-merge-buffer-size requires a primary-key table")
+            if store.options.sequence_field:
+                # the buffer dedups by ARRIVAL order; a user sequence field
+                # could make a lower-seq late arrival evict a higher-seq row
+                raise ValueError("local-merge-buffer-size cannot combine with sequence.field")
+            if store.options.ignore_delete:
+                # a trailing -D would evict its insert here, then be dropped
+                # downstream — losing the row ignore-delete meant to keep
+                raise ValueError("local-merge-buffer-size cannot combine with ignore-delete")
+            self._local_merge_cap = size
+
+    def _local_merge_flush(self) -> None:
+        if not self._local_buffer:
+            return
+        from ..data.batch import concat_batches
+        from ..data.keys import encode_key_lanes_with_pools
+        from ..ops.merge import deduplicate_select
+
+        batches = [b for b, _ in self._local_buffer]
+        kinds = [
+            k if k is not None else np.full(b.num_rows, int(RowKind.INSERT), dtype=np.uint8)
+            for b, k in self._local_buffer
+        ]
+        self._local_buffer = []
+        self._local_merge_bytes = 0
+        data = concat_batches(batches) if len(batches) > 1 else batches[0]
+        kind = np.concatenate(kinds)
+        # the FULL primary key (partition columns included): the buffer spans
+        # partitions, and trimmed keys would collapse same-id rows of
+        # DIFFERENT partitions into one — routing separates them downstream
+        keys = list(self.table.primary_keys)
+        lanes = encode_key_lanes_with_pools(data, keys)
+        # stability = arrival order: the LAST record per key (with its kind)
+        # survives, exactly what dedup would do downstream
+        take = deduplicate_select(lanes)
+        self._route(data.take(take), kind.take(take))
 
     def write(self, data: ColumnBatch | dict, kinds: np.ndarray | Sequence[str] | None = None) -> None:
         if isinstance(data, dict):
@@ -65,6 +124,15 @@ class TableWrite:
         if self._cross is not None:
             self._cross.write(data, kinds)
             return
+        if self._local_merge_cap:
+            self._local_buffer.append((data, kinds))
+            self._local_merge_bytes += data.byte_size()
+            if self._local_merge_bytes >= self._local_merge_cap:
+                self._local_merge_flush()
+            return
+        self._route(data, kinds)
+
+    def _route(self, data: ColumnBatch, kinds: np.ndarray | None) -> None:
         from .bucket import group_by_partition_bucket
 
         if self.dynamic:
@@ -152,6 +220,8 @@ class TableWrite:
     def prepare_commit(self) -> list[CommitMessage]:
         if self._cross is not None:
             return self._cross.prepare_commit()
+        if self._local_merge_cap:
+            self._local_merge_flush()
         from ..parallel.executor import maybe_mesh_batch
 
         with maybe_mesh_batch(self.table.store) as ctx:
