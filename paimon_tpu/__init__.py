@@ -62,4 +62,32 @@ from .types import (
 from .options import CoreOptions, MergeEngine, Options
 from .data import ColumnBatch, PredicateBuilder
 
-__version__ = "0.1.0"
+# CHAR/VARCHAR joined the type constructors in round 2
+from .types import CHAR, VARCHAR  # noqa: E402
+
+
+def __getattr__(name):
+    """Lazy top-level access to the heavier surfaces, so `import paimon_tpu`
+    stays metadata-cheap: FileSystemCatalog/JdbcCatalog, load_table,
+    CdcStream, DedicatedCompactor, FullCacheLookupTable, SplitEnumerator,
+    read/write_reference_table."""
+    lazy = {
+        "FileSystemCatalog": ("paimon_tpu.catalog", "FileSystemCatalog"),
+        "JdbcCatalog": ("paimon_tpu.catalog.jdbc", "JdbcCatalog"),
+        "load_table": ("paimon_tpu.table", "load_table"),
+        "CdcStream": ("paimon_tpu.table.cdc_format", "CdcStream"),
+        "DedicatedCompactor": ("paimon_tpu.table.compactor", "DedicatedCompactor"),
+        "FullCacheLookupTable": ("paimon_tpu.lookup.tables", "FullCacheLookupTable"),
+        "SplitEnumerator": ("paimon_tpu.table.enumerator", "SplitEnumerator"),
+        "read_reference_table": ("paimon_tpu.interop", "read_reference_table"),
+        "write_reference_table": ("paimon_tpu.interop", "write_reference_table"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'paimon_tpu' has no attribute {name!r}")
+
+
+__version__ = "0.2.0"
