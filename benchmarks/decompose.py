@@ -25,13 +25,13 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from paimon_tpu.utils import enable_compile_cache
+from paimon_tpu.utils import enable_compile_cache, probe_devices
 
 enable_compile_cache()
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # the environment may pin jax to the real TPU via sitecustomize; the
-    # config update wins over both
+if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_devices(timeout_s=180)[0] == 0:
+    # explicit CPU request, or the accelerator does not answer (a wedged
+    # tunnel would hang backend init forever): pin this run to CPU
     import jax
 
     jax.config.update("jax_platforms", "cpu")
