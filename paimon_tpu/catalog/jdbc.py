@@ -56,10 +56,17 @@ class JdbcCatalog(Catalog):
         with self._conn() as c:
             c.executescript(_SCHEMA)
 
-    def _conn(self) -> sqlite3.Connection:
+    @contextmanager
+    def _conn(self):
+        # one short-lived connection per operation; closed (not just
+        # committed) so per-op/per-heartbeat connections cannot leak fds
         c = sqlite3.connect(self.db_path, timeout=30.0)
         c.execute("PRAGMA busy_timeout = 30000")
-        return c
+        try:
+            with c:
+                yield c
+        finally:
+            c.close()
 
     # ---- databases -----------------------------------------------------
     def list_databases(self) -> list[str]:
@@ -197,10 +204,17 @@ class JdbcCatalogLock(CatalogLock):
         self.stale_ttl = stale_ttl
         self.holder = uuid.uuid4().hex
 
-    def _conn(self) -> sqlite3.Connection:
+    @contextmanager
+    def _conn(self):
+        # one short-lived connection per operation; closed (not just
+        # committed) so per-op/per-heartbeat connections cannot leak fds
         c = sqlite3.connect(self.db_path, timeout=30.0)
         c.execute("PRAGMA busy_timeout = 30000")
-        return c
+        try:
+            with c:
+                yield c
+        finally:
+            c.close()
 
     @contextmanager
     def lock(self, database: str = "", table: str = ""):
@@ -229,16 +243,23 @@ class JdbcCatalogLock(CatalogLock):
         stop = threading.Event()
 
         def beat():
-            while not stop.wait(self.stale_ttl / 3):
+            interval = self.stale_ttl / 3
+            while not stop.wait(interval):
                 try:
                     with self._conn() as c:
-                        c.execute(
+                        cur = c.execute(
                             "UPDATE paimon_distributed_locks SET acquired_at = ? "
                             "WHERE lock_id = ? AND holder = ?",
                             (time.time(), self.lock_id, self.holder),
                         )
+                        if cur.rowcount == 0:
+                            return  # row swept/stolen: lock confirmed lost
+                    interval = self.stale_ttl / 3
                 except Exception:
-                    return
+                    # transient sqlite busy/IO hiccup: keep beating (retry
+                    # sooner) instead of abandoning the heartbeat while the
+                    # holder is still in the critical section.
+                    interval = min(1.0, self.stale_ttl / 10)
 
         hb = threading.Thread(target=beat, daemon=True)
         hb.start()

@@ -78,14 +78,20 @@ class FileBasedCatalogLock(CatalogLock):
         stop = threading.Event()
 
         def beat():
-            while not stop.wait(self.stale_ttl / 3):
+            interval = self.stale_ttl / 3
+            while not stop.wait(interval):
                 try:
                     raw = self.file_io.read_bytes(path).decode()
                     if raw.split()[0] != self.holder:
                         return  # lost the lock (TTL takeover): stop touching it
                     self.file_io.write_bytes(path, f"{self.holder} {time.time()}".encode(), overwrite=True)
+                    interval = self.stale_ttl / 3
                 except Exception:
-                    return
+                    # transient IO hiccup: keep beating (retry sooner), else a
+                    # waiter would sweep the "stale" lock while we still hold
+                    # the critical section.  A real takeover is detected above
+                    # by the holder mismatch once reads succeed again.
+                    interval = min(1.0, self.stale_ttl / 10)
 
         hb = threading.Thread(target=beat, daemon=True)
         hb.start()

@@ -33,6 +33,11 @@ from .manifest import (
 )
 from .snapshot import CommitKind, Snapshot, SnapshotManager
 
+# Batch jobs commit once with this sentinel identifier (reference
+# BatchWriteBuilder.COMMIT_IDENTIFIER = Long.MAX_VALUE); it never enters the
+# monotonic per-user streaming sequence.
+BATCH_COMMIT_IDENTIFIER = (1 << 63) - 1
+
 __all__ = ["FileStoreCommit", "CommitConflictError"]
 
 
@@ -70,8 +75,19 @@ class FileStoreCommit:
     # ---- idempotence ----------------------------------------------------
     def filter_committed(self, committables: Sequence[ManifestCommittable]) -> list[ManifestCommittable]:
         """Drop committables whose identifier this user already committed
-        (crash-replay safety; reference FileStoreCommit.filterCommitted)."""
-        latest_of_user = self.snapshot_manager.latest_snapshot_of_user(self.commit_user)
+        (crash-replay safety; reference FileStoreCommit.filterCommitted).
+
+        Only streaming committables route through here (batch commits carry
+        the sentinel identifier and skip the filter), so the watermark is the
+        user's latest NON-sentinel snapshot: a batch maintenance commit by
+        the same user must not make every pending streaming identifier look
+        already-committed (the reference avoids this only by convention —
+        fresh UUID commit users per job)."""
+        latest_of_user = None
+        for snap in self.snapshot_manager.snapshots_of_user(self.commit_user):
+            if snap.commit_identifier != BATCH_COMMIT_IDENTIFIER:
+                latest_of_user = snap
+                break
         if latest_of_user is None:
             return list(committables)
         done = latest_of_user.commit_identifier

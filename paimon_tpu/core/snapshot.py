@@ -177,17 +177,23 @@ class SnapshotManager:
     def latest_snapshot_of_user(self, user: str) -> Snapshot | None:
         """Walk backward from latest, stop at the first match — O(gap), not
         O(history) (reference SnapshotManager does the same backward walk)."""
+        for snap in self.snapshots_of_user(user):
+            return snap
+        return None
+
+    def snapshots_of_user(self, user: str):
+        """Yield this user's snapshots newest-first (lazy backward walk, so
+        callers that stop at the first acceptable one stay O(gap))."""
         latest = self.latest_snapshot_id()
         earliest = self.earliest_snapshot_id()
         if latest is None or earliest is None:
-            return None
+            return
         for sid in range(latest, earliest - 1, -1):
             if not self.snapshot_exists(sid):
                 continue
             snap = self.snapshot(sid)
             if snap.commit_user == user:
-                return snap
-        return None
+                yield snap
 
     def snapshots_of_user_with_identifier(self, user: str, identifier: int) -> list[Snapshot]:
         """All of this user's snapshots carrying `identifier`, walking

@@ -452,8 +452,8 @@ def _host_aggregate(plan: MergePlan, values, valid, spec: AggregateSpec, row_kin
                 v_sorted, ok_sorted, retract, lo, hi, spec.nested_key
             )
             continue
-        vals = [v_sorted[i] for i in range(lo, hi) if ok_sorted[i]]
         if spec.function == "listagg":
+            vals = [v_sorted[i] for i in range(lo, hi) if ok_sorted[i]]
             if vals:
                 out[s] = spec.listagg_delimiter.join(str(x) for x in vals)
                 validity[s] = True

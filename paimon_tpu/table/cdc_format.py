@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Iterable, Iterator, Mapping
 
+from ..core.commit import BATCH_COMMIT_IDENTIFIER
 from .cdc import CdcRecord, CdcTableWrite
 
 __all__ = ["parse_debezium", "parse_canal", "parse_maxwell", "parse_json", "get_cdc_parser", "CdcStream"]
@@ -130,9 +131,17 @@ class CdcStream:
         self.write = CdcTableWrite(table)
         # resume after the table's last commit by THIS user: restarting the
         # stream must not reuse identifiers the replay filter already saw
-        # (it would silently drop the new batches)
-        latest = table.store.snapshot_manager.latest_snapshot_of_user(table.store.commit_user)
-        self._commit_id = latest.commit_identifier if latest else 0
+        # (it would silently drop the new batches).  Batch commits carry the
+        # sentinel identifier 2^63-1 (reference BatchWriteBuilder MAX_VALUE)
+        # and the same user may interleave batch maintenance with the stream;
+        # resuming from the sentinel would push identifiers past int64 and
+        # break format parity, so only streaming identifiers count.
+        self._commit_id = 0
+        sm = table.store.snapshot_manager
+        for snap in sm.snapshots_of_user(table.store.commit_user):
+            if snap.commit_identifier != BATCH_COMMIT_IDENTIFIER:
+                self._commit_id = snap.commit_identifier
+                break
 
     def ingest(self, messages: Iterable[str | bytes | Mapping]) -> int:
         """Parse + buffer one batch of raw messages, then flush as one

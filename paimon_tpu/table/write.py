@@ -16,6 +16,7 @@ from typing import TYPE_CHECKING, Sequence
 
 import numpy as np
 
+from ..core.commit import BATCH_COMMIT_IDENTIFIER
 from ..core.manifest import CommitMessage, ManifestCommittable
 from ..data.batch import ColumnBatch
 from ..types import RowKind
@@ -296,7 +297,7 @@ class BatchWriteBuilder:
     """One-shot batch job: write() everything, then commit() once
     (identifier is fixed — batch jobs have a single commit)."""
 
-    COMMIT_IDENTIFIER = (1 << 63) - 1  # reference BatchWriteBuilder uses MAX_VALUE
+    COMMIT_IDENTIFIER = BATCH_COMMIT_IDENTIFIER  # reference uses Long.MAX_VALUE
 
     def __init__(self, table: "FileStoreTable"):
         self.table = table

@@ -132,3 +132,25 @@ def test_cdc_ingest_parse_error_leaves_no_orphans(catalog):
     # nothing buffered: the next clean batch commits exactly its own rows
     stream.ingest([{"payload": {"op": "c", "before": None, "after": {"id": 9, "name": "ok"}}}])
     assert _read(stream.table) == [(9, "ok")]
+
+def test_cdc_stream_resume_ignores_batch_commits(catalog):
+    """Round-2 advisor: a batch commit by the same user carries the sentinel
+    identifier 2^63-1 (reference BatchWriteBuilder MAX_VALUE); resuming the
+    stream from it would overflow int64 identifiers. Resume must skip batch
+    snapshots and continue from the latest STREAMING identifier."""
+    from paimon_tpu.table.write import BatchWriteBuilder
+
+    t = catalog.create_table("db.batchmix", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    s1 = CdcStream(t, "json")
+    s1.ingest([{"id": 1, "name": "a"}])  # streaming identifier 1
+    # a batch maintenance commit by the SAME user (e.g. CLI backfill)
+    wb = s1.table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": [7], "name": ["batch"]})
+    wb.new_commit().commit(w.prepare_commit())
+    # restart: must resume at 1, not at the batch sentinel
+    s2 = CdcStream(s1.table, "json")
+    assert s2._commit_id == 1
+    assert s2._commit_id < BatchWriteBuilder.COMMIT_IDENTIFIER
+    assert s2.ingest([{"id": 2, "name": "b"}]) == 1  # not replay-filtered
+    assert _read(s2.table) == [(1, "a"), (2, "b"), (7, "batch")]
