@@ -30,22 +30,12 @@ from paimon_tpu.utils import enable_compile_cache
 enable_compile_cache()
 
 
-def _ensure_live_backend() -> str:
-    """When the accelerator doesn't answer (wedged tunnel), pin this run to
-    the CPU backend so the benchmark always reports a number; the emitted
-    JSON carries the platform used."""
-    from paimon_tpu.utils import probe_devices
+# Wedge-proof device access: detached probe (never killed), single-flight
+# lock around the grant, clean-exit signal handlers, loud CPU fallback.
+# PAIMON_TPU_REQUIRE=1 refuses the fallback (exit 3).
+from paimon_tpu.utils.tpuguard import ensure_live_backend
 
-    count, backend = probe_devices(timeout_s=180)
-    if count > 0:
-        return backend
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    return "cpu (accelerator unreachable)"
-
-
-_PLATFORM = _ensure_live_backend()
+_PLATFORM = ensure_live_backend()
 
 BASELINE_ROWS_PER_SEC = 975_400.0
 N_ROWS = 1_000_000

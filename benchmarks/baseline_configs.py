@@ -31,16 +31,15 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from paimon_tpu.utils import enable_compile_cache, probe_devices
+from paimon_tpu.utils import enable_compile_cache
+from paimon_tpu.utils.tpuguard import ensure_live_backend
 
 enable_compile_cache()
 
-if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_devices(timeout_s=180)[0] == 0:
-    # explicit CPU request, or the accelerator does not answer (a wedged
-    # tunnel would hang backend init forever): pin this run to CPU
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+# wedge-proof device access (tpuguard): explicit-CPU honored, detached probe
+# (never killed), single-flight lock, clean-exit signals, LOUD CPU fallback
+# (PAIMON_TPU_REQUIRE=1 turns the fallback into exit 3)
+PLATFORM = ensure_live_backend()
 
 BASE = 975_400.0
 
@@ -49,7 +48,8 @@ def emit(metric, value, unit="rows/s", vs=None, **extra):
     print(
         json.dumps(
             {"metric": metric, "value": round(value, 1), "unit": unit,
-             "vs_baseline": round(value / BASE, 3) if vs is None else vs, **extra}
+             "vs_baseline": round(value / BASE, 3) if vs is None else vs,
+             "platform": PLATFORM, **extra}
         ),
         flush=True,
     )

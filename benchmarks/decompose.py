@@ -25,16 +25,15 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from paimon_tpu.utils import enable_compile_cache, probe_devices
+from paimon_tpu.utils import enable_compile_cache
+from paimon_tpu.utils.tpuguard import ensure_live_backend
 
 enable_compile_cache()
 
-if os.environ.get("JAX_PLATFORMS") == "cpu" or probe_devices(timeout_s=180)[0] == 0:
-    # explicit CPU request, or the accelerator does not answer (a wedged
-    # tunnel would hang backend init forever): pin this run to CPU
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+# wedge-proof device access (tpuguard): explicit-CPU honored, detached probe
+# (never killed), single-flight lock, clean-exit signals, LOUD CPU fallback
+# (PAIMON_TPU_REQUIRE=1 turns the fallback into exit 3)
+PLATFORM = ensure_live_backend()
 
 
 def best_of(fn, iters=3):
@@ -135,10 +134,8 @@ def main():
             + results["kernel_plus_transfer_ms"]
             + results["gather_ms"]
         )
-        import jax
-
         meta = {
-            "platform": jax.default_backend(),
+            "platform": PLATFORM,
             "rows": args.rows,
             "runs": args.runs,
             "merged_rows": merged.num_rows,

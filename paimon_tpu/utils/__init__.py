@@ -61,27 +61,13 @@ def _default(o):
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 
-def probe_devices(timeout_s: int = 120) -> tuple[int, str]:
-    """(device_count, backend) probed in a SUBPROCESS with a timeout: a
-    wedged accelerator tunnel can hang jax backend init indefinitely (an
-    observed killed client left the device grant unreclaimed for hours).
-    (0, "unreachable") when the probe fails — callers fall back to CPU."""
-    import os
-    import subprocess
-    import sys
+def probe_devices(timeout_s: float = 120.0) -> tuple[int, str]:
+    """(device_count, backend) probed by a DETACHED subprocess with a
+    timeout: a wedged accelerator tunnel can hang jax backend init
+    indefinitely, and killing the prober mid-init is itself what wedges the
+    tunnel — so the child is never killed, its verdict is cached, and on
+    timeout callers get (0, "unreachable...") and fall back to CPU.  Full
+    discipline layer (single-flight lock, signals, runbook): tpuguard.py."""
+    from .tpuguard import probe_devices as _probe
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()), jax.default_backend())"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            cwd=repo_root,
-        )
-        if proc.returncode == 0:
-            count, backend = proc.stdout.strip().splitlines()[-1].split()
-            return int(count), backend
-    except Exception:
-        pass
-    return 0, "unreachable"
+    return _probe(timeout_s=timeout_s)

@@ -1,0 +1,87 @@
+"""tpuguard: wedge-proof device access discipline (probe cache, single-flight
+lock, loud fallback). The real-probe path needs the tunnel; here we pin the
+cache/lock logic so a benchmark run can never wedge or silently lie."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paimon_tpu.utils import tpuguard
+
+
+@pytest.fixture
+def guard_paths(tmp_path, monkeypatch):
+    monkeypatch.setattr(tpuguard, "PROBE_CACHE", str(tmp_path / "probe.json"))
+    monkeypatch.setattr(tpuguard, "PROBE_PIDFILE", str(tmp_path / "probe.pid"))
+    monkeypatch.setattr(tpuguard, "TPU_LOCK", str(tmp_path / "device.lock"))
+    # cache verdicts are env-scoped: pin a known env for these tests
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    return tmp_path
+
+
+def test_probe_uses_fresh_cache_without_spawning(guard_paths):
+    with open(tpuguard.PROBE_CACHE, "w") as f:
+        json.dump({"done": True, "started": time.time(), "completed": time.time(), "platforms_env": "", "n": 1, "backend": "axon"}, f)
+    assert tpuguard.probe_devices(timeout_s=0.1) == (1, "axon")
+
+
+def test_probe_ignores_stale_cache(guard_paths, monkeypatch):
+    # stale verdict + a "live prober" pidfile pointing at this test process:
+    # probe must wait (not trust stale data, not kill pid, not spawn a second
+    # prober) and report unreachable. Marker aligned so our own cmdline
+    # passes the pid-recycling guard.
+    monkeypatch.setattr(tpuguard, "_PROBE_MARKER", "pytest")
+    with open(tpuguard.PROBE_CACHE, "w") as f:
+        json.dump({"done": True, "started": time.time() - 10_000, "completed": time.time() - 10_000, "platforms_env": "", "n": 1, "backend": "axon"}, f)
+    with open(tpuguard.PROBE_PIDFILE, "w") as f:
+        f.write(str(os.getpid()))
+    n, backend = tpuguard.probe_devices(timeout_s=0.1)
+    assert n == 0 and "unreachable" in backend
+    # and the "prober" (us) was not killed: reaching here proves it
+
+
+def test_single_flight_excludes_second_process(guard_paths):
+    sf = tpuguard.SingleFlight(tpuguard.TPU_LOCK)
+    assert sf.acquire()
+    # a second PROCESS (flock is per-process) must fail fast
+    code = subprocess.run(
+        [sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+from paimon_tpu.utils.tpuguard import SingleFlight
+sys.exit(0 if not SingleFlight({tpuguard.TPU_LOCK!r}).acquire() else 1)
+"""],
+        timeout=30,
+    ).returncode
+    assert code == 0
+    sf.release()
+    code2 = subprocess.run(
+        [sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+from paimon_tpu.utils.tpuguard import SingleFlight
+sys.exit(0 if SingleFlight({tpuguard.TPU_LOCK!r}).acquire() else 1)
+"""],
+        timeout=30,
+    ).returncode
+    assert code2 == 0
+
+
+def test_ensure_live_backend_refuses_fallback_when_required(guard_paths, capsys):
+    with open(tpuguard.PROBE_CACHE, "w") as f:
+        json.dump({"done": True, "started": time.time(), "completed": time.time(), "platforms_env": "", "n": 0, "backend": "unreachable"}, f)
+    with pytest.raises(SystemExit) as e:
+        tpuguard.ensure_live_backend(require_tpu=True, probe_timeout_s=0.1)
+    assert e.value.code == 3
+
+
+def test_ensure_live_backend_loud_cpu_fallback(guard_paths, capsys):
+    with open(tpuguard.PROBE_CACHE, "w") as f:
+        json.dump({"done": True, "started": time.time(), "completed": time.time(), "platforms_env": "", "n": 0, "backend": "unreachable"}, f)
+    tag = tpuguard.ensure_live_backend(require_tpu=False, probe_timeout_s=0.1)
+    assert tag == "cpu (accelerator unreachable)"
+    assert "ACCELERATOR UNREACHABLE" in capsys.readouterr().err
