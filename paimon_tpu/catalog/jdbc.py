@@ -29,7 +29,17 @@ from .lock import CatalogLock
 
 __all__ = ["JdbcCatalog", "JdbcCatalogLock"]
 
-_SCHEMA = """
+# one definition, shared by the catalog schema and standalone locks
+_LOCK_TABLE_DDL = """
+CREATE TABLE IF NOT EXISTS paimon_distributed_locks (
+    lock_id TEXT PRIMARY KEY,
+    holder TEXT NOT NULL,
+    acquired_at REAL NOT NULL
+);
+"""
+
+_SCHEMA = (
+    """
 CREATE TABLE IF NOT EXISTS paimon_databases (
     name TEXT PRIMARY KEY
 );
@@ -39,12 +49,9 @@ CREATE TABLE IF NOT EXISTS paimon_tables (
     location TEXT NOT NULL,
     PRIMARY KEY (database_name, table_name)
 );
-CREATE TABLE IF NOT EXISTS paimon_distributed_locks (
-    lock_id TEXT PRIMARY KEY,
-    holder TEXT NOT NULL,
-    acquired_at REAL NOT NULL
-);
 """
+    + _LOCK_TABLE_DDL
+)
 
 
 class JdbcCatalog(Catalog):
@@ -203,6 +210,10 @@ class JdbcCatalogLock(CatalogLock):
         self.timeout = timeout
         self.stale_ttl = stale_ttl
         self.holder = uuid.uuid4().hex
+        # standalone use (commit.catalog-lock.type=jdbc without a JdbcCatalog):
+        # the lock table must exist before the first acquire
+        with self._conn() as c:
+            c.executescript(_LOCK_TABLE_DDL)
 
     @contextmanager
     def _conn(self):

@@ -55,18 +55,10 @@ class FileBasedCatalogLock(CatalogLock):
                 break
             except FileExistsError:
                 try:
-                    raw = self.file_io.read_bytes(path).decode()
-                    _, ts = raw.split()
+                    raw = self.file_io.read_bytes(path)
+                    _, ts = raw.decode().split()
                     if time.time() - float(ts) > self.stale_ttl:
-                        # crashed holder: take over by ATOMIC rename — only
-                        # one waiter wins the tombstone, so a racer can never
-                        # delete a FRESH lock another waiter just created
-                        tomb = f"{path}.stale-{uuid.uuid4().hex}"
-                        try:
-                            if self.file_io.rename(path, tomb):
-                                self.file_io.delete(tomb)
-                        except Exception:
-                            pass
+                        self._sweep_stale(path, raw)
                         continue
                 except Exception:
                     pass
@@ -106,5 +98,41 @@ class FileBasedCatalogLock(CatalogLock):
                 raw = self.file_io.read_bytes(path).decode()
                 if raw.split()[0] == self.holder:
                     self.file_io.delete(path)
+            except Exception:
+                pass
+
+    def _sweep_stale(self, path: str, raw: bytes) -> None:
+        """Remove a crashed holder's lock with exactly-one-deleter semantics.
+
+        The sweep right is a CAS on a tombstone keyed by the stale lock's
+        CONTENT (holder uuid + timestamp — unique per incarnation): whoever
+        exclusively creates the tombstone is the only process allowed to
+        delete that incarnation, and it re-checks the content first.  A racer
+        can therefore never delete a FRESH lock another waiter just created.
+        (The previous design renamed the lock away, but rename is
+        copy+delete on object stores — the delete half could land on a fresh
+        lock.)  A sweeper that crashes mid-sweep leaves its tombstone; other
+        waiters clear tombstones older than stale_ttl."""
+        import hashlib
+
+        tomb = f"{path}.sweep-{hashlib.sha1(raw).hexdigest()[:16]}"
+        if self.file_io.try_atomic_write(tomb, f"{time.time()}".encode()):
+            try:
+                if self.file_io.read_bytes(path) == raw:
+                    self.file_io.delete(path)
+            except Exception:
+                pass
+            finally:
+                try:
+                    self.file_io.delete(tomb)
+                except Exception:
+                    pass
+        else:
+            # another waiter owns this sweep; clear its tombstone if it
+            # crashed mid-sweep so the takeover can eventually proceed
+            try:
+                t = float(self.file_io.read_bytes(tomb).decode())
+                if time.time() - t > self.stale_ttl:
+                    self.file_io.delete(tomb)
             except Exception:
                 pass

@@ -65,9 +65,31 @@ class FileStoreCommit:
         if self.options.options.get(CoreOptions.COMMIT_CATALOG_LOCK) or not getattr(
             file_io, "atomic_write_supported", True
         ):
-            from ..catalog.lock import FileBasedCatalogLock
+            lock_type = self.options.options.get(CoreOptions.COMMIT_CATALOG_LOCK_TYPE)
+            timeout = self.options.options.get(CoreOptions.COMMIT_CATALOG_LOCK_TIMEOUT)
+            stale_ttl = self.options.options.get(CoreOptions.COMMIT_CATALOG_LOCK_STALE_TTL)
+            if lock_type == "jdbc":
+                from ..catalog.jdbc import JdbcCatalogLock
 
-            self._lock = FileBasedCatalogLock(file_io, table_path)
+                db = self.options.options.get(CoreOptions.COMMIT_CATALOG_LOCK_JDBC_PATH)
+                if not db:
+                    raise ValueError("commit.catalog-lock.type=jdbc needs commit.catalog-lock.jdbc-path")
+                self._lock = JdbcCatalogLock(db, lock_id=table_path, timeout=timeout, stale_ttl=stale_ttl)
+            elif lock_type == "file":
+                if not getattr(file_io, "exclusive_create_supported", True):
+                    # a file lock on a store without exclusive create is
+                    # check-then-put theater: two holders would both "win"
+                    raise ValueError(
+                        "this store has no exclusive create (no conditional PUT); "
+                        "the file-based catalog lock cannot provide mutual exclusion — "
+                        "configure commit.catalog-lock.type=jdbc with "
+                        "commit.catalog-lock.jdbc-path"
+                    )
+                from ..catalog.lock import FileBasedCatalogLock
+
+                self._lock = FileBasedCatalogLock(file_io, table_path, timeout=timeout, stale_ttl=stale_ttl)
+            else:
+                raise ValueError(f"unknown commit.catalog-lock.type: {lock_type!r} (expected 'file' or 'jdbc')")
         self.snapshot_manager = SnapshotManager(file_io, table_path)
         self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
         self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")

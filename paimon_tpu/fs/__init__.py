@@ -57,6 +57,10 @@ class FileIO:
     # object-store adapters without a no-clobber rename set this False;
     # commits then automatically run under the catalog lock
     atomic_write_supported: bool = True
+    # False on stores without exclusive create (no conditional PUT): the
+    # file-based catalog lock cannot work there — commits must configure an
+    # external lock (commit.catalog-lock.type=jdbc)
+    exclusive_create_supported: bool = True
 
     # ---- required primitives ------------------------------------------
     def read_bytes(self, path: str) -> bytes:
@@ -301,6 +305,14 @@ def get_file_io(path: str) -> FileIO:
     scheme, _ = split_scheme(path)
     with _LOCK:
         factory = _REGISTRY.get(scheme)
+    if factory is None:
+        # lazy SPI load (reference FileIO.discoverLoaders loads plugin
+        # modules on first use of an unknown scheme); the plugin module owns
+        # the scheme->factory knowledge, nothing is hardcoded here
+        from . import object_store  # noqa: F401  (registers on import)
+
+        with _LOCK:
+            factory = _REGISTRY.get(scheme)
     if factory is not None:
         return factory()
     if scheme == "file":

@@ -42,12 +42,19 @@ class FailingFileIO(FileIO):
     Usage:
         FailingFileIO.reset("mytest", max_fails=100, possibility=10)
         path = f"fail://mytest{local_dir}"
-    """
+
+    Any FileIO can be wrapped (scheme "fail-s3" injects over the
+    object-store semantics, proving the commit protocol for that store the
+    same way "fail" proves it for POSIX)."""
 
     _states: dict[str, _FailState] = {}
 
-    def __init__(self):
-        self._inner = LocalFileIO()
+    def __init__(self, inner: FileIO | None = None):
+        self._inner = inner or LocalFileIO()
+        # capability flags must shine through the wrapper: a commit over
+        # fail-s3 engages the catalog lock exactly like over s3
+        self.atomic_write_supported = getattr(self._inner, "atomic_write_supported", True)
+        self.exclusive_create_supported = getattr(self._inner, "exclusive_create_supported", True)
 
     @classmethod
     def reset(cls, name: str, max_fails: int, possibility: int, seed: int = 0) -> None:
@@ -110,6 +117,25 @@ class FailingFileIO(FileIO):
     def open_input(self, path: str):
         return self._inner.open_input(self._wrap(path))
 
+    def try_atomic_write(self, path: str, data: bytes) -> bool:
+        if isinstance(self._inner, LocalFileIO):
+            # base temp+rename path: faults injected per sub-op (write, rename)
+            return super().try_atomic_write(path, data)
+        # inner overrides the commit primitive (object store: conditional
+        # PUT, no rename) — delegate so the oracle exercises THAT protocol
+        st, local = self._strip(path)
+        if st is not None:
+            st.maybe_fail()
+        return self._inner.try_atomic_write(local, data)
+
+    def try_overwrite(self, path: str, data: bytes) -> bool:
+        if isinstance(self._inner, LocalFileIO):
+            return super().try_overwrite(path, data)
+        st, local = self._strip(path)
+        if st is not None:
+            st.maybe_fail()
+        return self._inner.try_overwrite(local, data)
+
 
 class TraceableFileIO(FileIO):
     """Tracks open streams so tests can assert no reader/writer leaks."""
@@ -169,5 +195,19 @@ class TraceableFileIO(FileIO):
         return self._inner.get_status(self._p(path))
 
 
+def _fail_s3() -> FailingFileIO:
+    from .object_store import ObjectStoreFileIO
+
+    return FailingFileIO(inner=ObjectStoreFileIO(conditional_put=True))
+
+
+def _fail_s3_legacy() -> FailingFileIO:
+    from .object_store import ObjectStoreFileIO
+
+    return FailingFileIO(inner=ObjectStoreFileIO(conditional_put=False))
+
+
 register_file_io("fail", FailingFileIO)
+register_file_io("fail-s3", _fail_s3)
+register_file_io("fail-s3-legacy", _fail_s3_legacy)
 register_file_io("traceable", TraceableFileIO)

@@ -225,6 +225,31 @@ class CoreOptions:
         "Run snapshot commits under an external catalog lock (required on "
         "stores whose rename is not atomic; reference CatalogLock SPI).",
     )
+    COMMIT_CATALOG_LOCK_TYPE = ConfigOption.string(
+        "commit.catalog-lock.type",
+        "file",
+        "Catalog lock implementation: 'file' (lock object in the table dir; "
+        "needs exclusive-create, i.e. conditional PUT on object stores) or "
+        "'jdbc' (external lock database — the only safe choice on legacy "
+        "object stores without conditional PUT).",
+    )
+    COMMIT_CATALOG_LOCK_JDBC_PATH = ConfigOption.string(
+        "commit.catalog-lock.jdbc-path",
+        None,
+        "Database path for commit.catalog-lock.type=jdbc.",
+    )
+    COMMIT_CATALOG_LOCK_TIMEOUT = ConfigOption.float_(
+        "commit.catalog-lock.acquire-timeout",
+        60.0,
+        "Seconds to wait for the catalog lock before the commit fails "
+        "(reference catalog option lock-acquire-timeout).",
+    )
+    COMMIT_CATALOG_LOCK_STALE_TTL = ConfigOption.float_(
+        "commit.catalog-lock.check-max-sleep",
+        300.0,
+        "Seconds after which a non-heartbeating lock holder is presumed "
+        "crashed and its lock is swept (reference lock-check-max-sleep).",
+    )
     PARALLEL_KEY_AXIS_ROWS = ConfigOption.int_(
         "parallel.key-axis.rows",
         4 * 1024 * 1024,

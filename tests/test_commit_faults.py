@@ -18,19 +18,30 @@ SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
 import pytest
 
 
-@pytest.mark.parametrize("manifest_format", ["jsonl", "avro"])
-def test_commit_crash_safety_under_random_failures(tmp_path, manifest_format):
+@pytest.mark.parametrize(
+    "manifest_format,scheme",
+    [("jsonl", "fail"), ("avro", "fail"), ("jsonl", "fail-s3"), ("jsonl", "fail-s3-legacy")],
+)
+def test_commit_crash_safety_under_random_failures(tmp_path, manifest_format, scheme):
     """Writers crash randomly mid write/commit; retries must never corrupt the
     table: every successful commit is fully visible, every failed one fully
-    invisible. Runs for BOTH metadata planes (jsonl and reference avro)."""
-    domain = f"commitfault_{manifest_format}"
+    invisible. Runs for BOTH metadata planes (jsonl and reference avro) and
+    for BOTH storage models: POSIX rename CAS ("fail") and object-store
+    conditional-PUT-under-catalog-lock ("fail-s3"; "fail-s3-legacy" commits
+    check-then-put under a jdbc lock — no store-level CAS at all)."""
+    domain = f"commitfault_{manifest_format}_{scheme.replace('-', '')}"
     FailingFileIO.reset(domain, max_fails=0, possibility=0)
-    io = get_file_io(f"fail://{domain}/x")
-    path = f"fail://{domain}{tmp_path}/table"
+    io = get_file_io(f"{scheme}://{domain}/x")
+    path = f"{scheme}://{domain}{tmp_path}/table"
+    opts = {"bucket": "1", "manifest.format": manifest_format,
+            "commit.catalog-lock.acquire-timeout": "10"}
+    if scheme == "fail-s3-legacy":
+        # no conditional PUT: the file lock itself would be check-then-put;
+        # mutual exclusion must come from the external jdbc lock
+        opts.update({"commit.catalog-lock.type": "jdbc",
+                     "commit.catalog-lock.jdbc-path": str(tmp_path / "locks.db")})
     sm = SchemaManager(io, path)
-    ts = sm.create_table(
-        SCHEMA, primary_keys=["k"], options={"bucket": "1", "manifest.format": manifest_format}
-    )
+    ts = sm.create_table(SCHEMA, primary_keys=["k"], options=opts)
     store = KeyValueFileStore(io, path, ts, commit_user="crashy")
 
     oracle = {}
