@@ -81,6 +81,9 @@ def __getattr__(name):
         "SplitEnumerator": ("paimon_tpu.table.enumerator", "SplitEnumerator"),
         "read_reference_table": ("paimon_tpu.interop", "read_reference_table"),
         "write_reference_table": ("paimon_tpu.interop", "write_reference_table"),
+        "PaimonFlightServer": ("paimon_tpu.service.flight", "PaimonFlightServer"),
+        "flight_scan": ("paimon_tpu.service.flight", "flight_scan"),
+        "record_batch_reader": ("paimon_tpu.interop.arrow_surface", "record_batch_reader"),
     }
     if name in lazy:
         import importlib

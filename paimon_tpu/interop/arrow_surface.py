@@ -1,0 +1,171 @@
+"""Arrow-native engine surface: any Arrow-speaking engine can scan a table.
+
+The reference's entire L5 exists so other engines can consume tables
+(paimon-hive PaimonInputFormat hands table splits to the engine as its
+splits; flink/source/FlinkSourceBuilder builds the scan topology).  The
+Arrow-ecosystem analog needs no per-engine glue: a table exposes
+
+- ``arrow_schema(row_type)`` — logical Arrow schema (timestamps/dates as
+  real Arrow temporal types, not the int64/int32 device encoding),
+- ``record_batch_reader(table, ...)`` — a lazy streaming
+  ``pyarrow.RecordBatchReader``, one merge-read per split at a time; this is
+  the C-stream-protocol object duckdb/polars/pandas/datafusion all accept,
+- ``arrow_scanner(table, ...)`` / ``arrow_dataset(table, ...)`` —
+  ``pyarrow.dataset`` views (the scanner stays lazy; the dataset
+  materializes, documented),
+
+plus per-split readers so a distributed engine can schedule one split per
+worker exactly like PaimonInputFormat does (splits serialize via
+``DataSplit.to_dict``).  The Flight server (service/flight.py) carries the
+same surface over the network.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator, Sequence
+
+if TYPE_CHECKING:
+    from ..data.predicate import Predicate
+    from ..table import FileStoreTable
+    from ..table.read import DataSplit
+    from ..types import DataType, RowType
+
+__all__ = [
+    "arrow_schema",
+    "arrow_type",
+    "record_batch_reader",
+    "split_record_batches",
+    "arrow_scanner",
+    "arrow_dataset",
+]
+
+
+def arrow_type(dtype: "DataType"):
+    """DataType -> logical pyarrow type (temporal types are real Arrow
+    temporals; the internal columnar encoding keeps them as int64 micros /
+    int32 days for the device path)."""
+    import pyarrow as pa
+
+    from ..types import TypeRoot
+
+    r = dtype.root
+    if r == TypeRoot.TIMESTAMP:
+        return pa.timestamp("us")
+    if r == TypeRoot.TIMESTAMP_LTZ:
+        return pa.timestamp("us", tz="UTC")
+    if r == TypeRoot.DATE:
+        return pa.date32()
+    if r == TypeRoot.TIME:
+        return pa.time32("ms")  # internal encoding IS millis-of-day (int32)
+    if r == TypeRoot.DECIMAL:
+        return pa.decimal128(dtype.precision or 38, dtype.scale or 0)
+    from ..data.batch import _pa_nested_type
+
+    return _pa_nested_type(dtype)
+
+
+def arrow_schema(row_type: "RowType"):
+    import pyarrow as pa
+
+    return pa.schema(
+        [pa.field(f.name, arrow_type(f.type), nullable=f.type.nullable) for f in row_type.fields]
+    )
+
+
+def _cast_to_logical(tbl, schema):
+    """Internal to_arrow() output -> the logical surface schema (int64
+    micros -> timestamp[us], int32 days -> date32, int32 millis ->
+    time32[ms], unscaled int64 -> decimal128)."""
+    import pyarrow as pa
+
+    cols = []
+    for fld in schema:
+        col = tbl.column(fld.name)
+        if col.type != fld.type:
+            if pa.types.is_decimal(fld.type):
+                # internal DECIMAL is the UNSCALED long (value * 10^scale):
+                # a value-cast would multiply by 10^scale again, so rebuild
+                # from the raw ints via python Decimal (decimals are an edge
+                # surface; correctness over speed here)
+                from decimal import Decimal
+
+                scale = fld.type.scale
+                vals = [
+                    None if v is None else Decimal(v).scaleb(-scale)
+                    for chunk in col.chunks
+                    for v in chunk.to_pylist()
+                ]
+                col = pa.chunked_array([pa.array(vals, type=fld.type)])
+            else:
+                col = col.cast(fld.type)
+        cols.append(col)
+    return pa.table(dict(zip(schema.names, cols)), schema=schema)
+
+
+def _surface_schema(table: "FileStoreTable", projection: Sequence[str] | None):
+    rt = table.row_type if projection is None else table.row_type.project(projection)
+    return arrow_schema(rt)
+
+
+def split_record_batches(
+    table: "FileStoreTable",
+    split: "DataSplit",
+    predicate: "Predicate | None" = None,
+    projection: Sequence[str] | None = None,
+    max_chunksize: int = 1 << 20,
+) -> Iterator:
+    """Arrow RecordBatches of one split's merge-read (an engine worker's
+    unit of work, reference PaimonInputFormat.RecordReader)."""
+    rb = table.new_read_builder()
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    if projection is not None:
+        rb = rb.with_projection(list(projection))
+    out = rb.new_read().read(split)
+    tbl = _cast_to_logical(out.to_arrow(), _surface_schema(table, projection))
+    yield from tbl.to_batches(max_chunksize=max_chunksize)
+
+
+def record_batch_reader(
+    table: "FileStoreTable",
+    predicate: "Predicate | None" = None,
+    projection: Sequence[str] | None = None,
+    splits: "Sequence[DataSplit] | None" = None,
+    max_chunksize: int = 1 << 20,
+):
+    """Lazy streaming reader over the whole table (or given splits): splits
+    merge one at a time, so peak memory is one split's worth regardless of
+    table size."""
+    import pyarrow as pa
+
+    schema = _surface_schema(table, projection)
+    if splits is None:
+        rb = table.new_read_builder()
+        if predicate is not None:
+            rb = rb.with_filter(predicate)
+        splits = rb.new_scan().plan()
+
+    def gen():
+        for s in splits:
+            yield from split_record_batches(
+                table, s, predicate=predicate, projection=projection, max_chunksize=max_chunksize
+            )
+
+    return pa.RecordBatchReader.from_batches(schema, gen())
+
+
+def arrow_scanner(table: "FileStoreTable", predicate=None, projection=None, splits=None):
+    """Lazy ``pyarrow.dataset.Scanner`` (duckdb: ``duckdb.from_arrow``)."""
+    import pyarrow.dataset as ds
+
+    reader = record_batch_reader(table, predicate=predicate, projection=projection, splits=splits)
+    return ds.Scanner.from_batches(reader)
+
+
+def arrow_dataset(table: "FileStoreTable", predicate=None, projection=None):
+    """``pyarrow.dataset.Dataset`` view.  NOTE: InMemoryDataset materializes
+    the merge-read once; use record_batch_reader/arrow_scanner for streaming."""
+    import pyarrow.dataset as ds
+
+    reader = record_batch_reader(table, predicate=predicate, projection=projection)
+    return ds.dataset(reader.read_all())

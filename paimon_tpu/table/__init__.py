@@ -145,6 +145,32 @@ class FileStoreTable(Table):
 
         return MergeInto(self, source)
 
+    # ---- Arrow-native engine surface (interop/arrow_surface.py) --------
+    def to_record_batch_reader(self, predicate=None, projection=None, splits=None):
+        """Lazy pyarrow.RecordBatchReader over the merge-read — the
+        C-stream object any Arrow engine (duckdb/polars/pandas/datafusion)
+        consumes directly."""
+        from ..interop.arrow_surface import record_batch_reader
+
+        return record_batch_reader(self, predicate=predicate, projection=projection, splits=splits)
+
+    def to_arrow_scanner(self, predicate=None, projection=None):
+        from ..interop.arrow_surface import arrow_scanner
+
+        return arrow_scanner(self, predicate=predicate, projection=projection)
+
+    def to_arrow_dataset(self, predicate=None, projection=None):
+        from ..interop.arrow_surface import arrow_dataset
+
+        return arrow_dataset(self, predicate=predicate, projection=projection)
+
+    def to_arrow(self, predicate=None, projection=None):
+        """Whole table as one pyarrow.Table (materializing convenience)."""
+        return self.to_record_batch_reader(predicate=predicate, projection=projection).read_all()
+
+    def to_pandas(self, predicate=None, projection=None):
+        return self.to_arrow(predicate=predicate, projection=projection).to_pandas()
+
     def expire_snapshots(self) -> int:
         from .tags import TagManager
 

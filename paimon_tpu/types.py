@@ -42,6 +42,7 @@ __all__ = [
     "VARCHAR",
     "BYTES",
     "DATE",
+    "TIME",
     "TIMESTAMP",
     "DECIMAL",
     "parse_type",
@@ -245,6 +246,10 @@ def BYTES(nullable: bool = True) -> DataType:
 
 def DATE(nullable: bool = True) -> DataType:
     return DataType(TypeRoot.DATE, nullable)
+
+
+def TIME(nullable: bool = True) -> DataType:
+    return DataType(TypeRoot.TIME, nullable)
 
 
 def TIMESTAMP(precision: int = 6, nullable: bool = True) -> DataType:
