@@ -52,11 +52,15 @@ class UniversalCompaction:
         size_ratio_percent: int = 1,
         num_run_compaction_trigger: int = 5,
         optimization_interval_millis: int | None = None,
+        max_file_num: int = 50,
     ):
         self.max_size_amp = max_size_amp_percent
         self.size_ratio = size_ratio_percent
         self.num_run_trigger = num_run_compaction_trigger
         self.opt_interval = optimization_interval_millis
+        # bounds ONE size-ratio pick's input file count so a single
+        # compaction cannot balloon (reference compaction.max.file-num)
+        self.max_file_num = max_file_num
         self._last_opt_millis = now_millis()
 
     def pick(self, num_levels: int, runs: list[tuple[int, SortedRun]]) -> CompactUnit | None:
@@ -92,10 +96,14 @@ class UniversalCompaction:
             return None
         candidate_size = runs[0][1].total_size()
         count = 1
+        files = len(runs[0][1].files)
         for lv, run in runs[1:]:
             if candidate_size * (100.0 + self.size_ratio) / 100.0 < run.total_size():
                 break
+            if files + len(run.files) > self.max_file_num:
+                break
             candidate_size += run.total_size()
+            files += len(run.files)
             count += 1
         if count > 1:
             return self._unit(runs, max_level, count)

@@ -115,6 +115,8 @@ class KeyValueFileWriterFactory:
         keyed: bool = True,
         format_options: dict | None = None,
         include_key_columns: bool = False,
+        per_level_format: dict[int, str] | None = None,
+        per_level_compression: dict[int, str] | None = None,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
@@ -133,6 +135,11 @@ class KeyValueFileWriterFactory:
         self.format_options = format_options or {}
         # reference-layout data files: duplicate trimmed PK as _KEY_ columns
         self.include_key_columns = include_key_columns
+        # per-LSM-level overrides (reference file.format.per.level /
+        # file.compression.per.level); readers pick the format off the file
+        # extension, so levels can mix freely
+        self.per_level_format = per_level_format or {}
+        self.per_level_compression = per_level_compression or {}
 
     def _estimate_row_bytes(self, batch: ColumnBatch) -> int:
         total = 0
@@ -181,12 +188,14 @@ class KeyValueFileWriterFactory:
     def _write_one(
         self, kv: KVBatch, level: int, file_source: str, prefix: str = "data", sorted_input: bool = True
     ) -> DataFileMeta:
-        fmt = get_format(self.format_id)
-        name = new_file_name(prefix, self.format_id)
+        format_id = self.per_level_format.get(level, self.format_id)
+        compression = self.per_level_compression.get(level, self.compression)
+        fmt = get_format(format_id)
+        name = new_file_name(prefix, format_id)
         path = f"{self.bucket_dir}/{name}"
         key_cols = self.key_names if (self.keyed and self.include_key_columns) else None
         disk = kv.to_disk_batch(key_cols) if self.keyed else kv.data
-        fmt.write(self.file_io, path, disk, self.compression, format_options=self.format_options)
+        fmt.write(self.file_io, path, disk, compression, format_options=self.format_options)
         extra: list[str] = []
         if self.bloom_columns:
             from ..format.fileindex import write_file_index
@@ -268,7 +277,10 @@ class KeyValueFileReaderFactory:
             mapping.append((f, src))
             if src is not None:
                 wanted_cols.append(src.name)
-        fmt = get_format(self.format_id)
+        # the extension is authoritative: per-level format overrides mean a
+        # table legitimately mixes formats across files
+        ext = meta.file_name.rsplit(".", 1)[-1]
+        fmt = get_format(ext if "." in meta.file_name else self.format_id)
         path = f"{self.bucket_dir}/{meta.file_name}"
         parts = list(fmt.read(self.file_io, path, disk_schema, projection=wanted_cols, predicate=predicate))
         if parts:

@@ -97,10 +97,12 @@ class SnapshotExpire:
 
         from ..utils import partition_path
 
+        touched_dirs: set[str] = set()
         for (partition, bucket, file_name), extra in dead_files:
             # partition path needs key names; data dirs embed them already —
             # bucket dirs are resolved by the store layer convention
             pp = self._bucket_dir(partition, bucket)
+            touched_dirs.add(pp)
             self.file_io.delete(f"{pp}/{file_name}")
             for x in extra:
                 self.file_io.delete(f"{pp}/{x}")
@@ -113,6 +115,21 @@ class SnapshotExpire:
         # walks that trust the hint (earliest_snapshot_id, user scans) would
         # otherwise never see them again once unprotected
         sm.commit_earliest_hint(min(retained_ids))
+        if self.options.options.get(CoreOptions.SNAPSHOT_EXPIRE_CLEAN_EMPTY_DIRS):
+            # sweep bucket dirs emptied by this run, then their parent
+            # partition dirs — AFTER every metadata deletion (the sweep is
+            # cosmetic; a concurrent writer repopulating a dir between the
+            # emptiness check and the rmdir must never abort expiry)
+            for d in sorted(touched_dirs, key=len, reverse=True):
+                try:
+                    if not self.file_io.list_status(d):
+                        self.file_io.delete(d)
+                        parent = d.rsplit("/", 1)[0]
+                        while parent != self.table_path and not self.file_io.list_status(parent):
+                            self.file_io.delete(parent)
+                            parent = parent.rsplit("/", 1)[0]
+                except OSError:
+                    continue  # dir went live again: leave it
         return len(expire_ids)
 
     def _snapshot_manifests(self, snap: Snapshot):
@@ -128,6 +145,10 @@ class SnapshotExpire:
     def _bucket_dir(self, partition: tuple, bucket: int) -> str:
         from ..utils import partition_path
 
-        pp = partition_path(self._partition_keys, partition)
+        pp = partition_path(
+            self._partition_keys,
+            partition,
+            default_name=self.options.options.get(CoreOptions.PARTITION_DEFAULT_NAME),
+        )
         base = f"{self.table_path}/{pp}" if pp else self.table_path
         return f"{base}/bucket-{bucket}"

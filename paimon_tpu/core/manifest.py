@@ -100,7 +100,7 @@ class _JsonlZst:
     def __init__(self, file_io: FileIO, directory: str):
         self.file_io = file_io
         self.directory = directory
-        self._table_cfg = None  # lazy (format, schema_id -> StatsContext)
+        self._table_cfg = None  # lazy (format, resolver, compression)
 
     def _config(self):
         """(manifest_format, resolver) from the owning table's schemas —
@@ -115,8 +115,9 @@ class _JsonlZst:
             sm = SchemaManager(self.file_io, table_path)
             ts = sm.latest()  # IO errors propagate; None = no table schema
             if ts is None:
-                return ("jsonl", None)
+                return ("jsonl", None, "default")
             fmt = str(ts.options.get("manifest.format", "jsonl")).lower()
+            compression = str(ts.options.get("manifest.compression", "default")).lower()
             latest_ctx = StatsContext.from_table_schema(ts)
             cache: dict[int, "StatsContext"] = {ts.id: latest_ctx}
 
@@ -131,12 +132,13 @@ class _JsonlZst:
                         cache[schema_id] = latest_ctx
                 return cache[schema_id]
 
-            self._table_cfg = (fmt, resolver)
+            self._table_cfg = (fmt, resolver, compression)
         return self._table_cfg
 
     def _write_lines(self, name: str, dicts: Iterable[dict]) -> int:
         raw = "\n".join(dumps(d) for d in dicts).encode()
-        data = zstandard.ZstdCompressor(level=3).compress(raw)
+        _, _, compression = self._config()
+        data = raw if compression == "none" else zstandard.ZstdCompressor(level=3).compress(raw)
         path = f"{self.directory}/{name}"
         self.file_io.write_bytes(path, data)
         return len(data)
@@ -145,7 +147,11 @@ class _JsonlZst:
         return self.file_io.read_bytes(f"{self.directory}/{name}")
 
     def _read_lines_from(self, data: bytes) -> list[dict]:
-        raw = zstandard.ZstdDecompressor().decompress(data)
+        # sniff: zstd magic, else plain jsonl (manifest.compression=none)
+        if data[:4] == b"\x28\xb5\x2f\xfd":
+            raw = zstandard.ZstdDecompressor().decompress(data)
+        else:
+            raw = data
         return [loads(line) for line in raw.decode().splitlines() if line]
 
     def delete(self, name: str) -> None:
@@ -157,11 +163,11 @@ class ManifestFile(_JsonlZst):
 
     def write(self, entries: Sequence[ManifestEntry], schema_id: int) -> ManifestFileMeta:
         name = new_file_name("manifest")
-        fmt, resolver = self._config()
+        fmt, resolver, compression = self._config()
         if fmt == "avro" and resolver is not None:
             from ..interop.manifest_codec import write_entries_avro
 
-            data = write_entries_avro(entries, resolver)
+            data = write_entries_avro(entries, resolver, codec="null" if compression == "none" else "deflate")
             self.file_io.write_bytes(f"{self.directory}/{name}", data)
             size = len(data)
         else:
@@ -174,7 +180,7 @@ class ManifestFile(_JsonlZst):
         if data[:4] == _AVRO_MAGIC:
             from ..interop.manifest_codec import read_entries_avro
 
-            _, resolver = self._config()
+            _, resolver, _ = self._config()
             if resolver is None:
                 raise ValueError(f"avro manifest {name} needs the table schema for decoding")
             return read_entries_avro(data, resolver)
@@ -186,11 +192,14 @@ class ManifestList(_JsonlZst):
 
     def write(self, metas: Sequence[ManifestFileMeta]) -> str:
         name = new_file_name("manifest-list")
-        fmt, resolver = self._config()
+        fmt, resolver, compression = self._config()
         if fmt == "avro" and resolver is not None:
             from ..interop.manifest_codec import write_metas_avro
 
-            self.file_io.write_bytes(f"{self.directory}/{name}", write_metas_avro(metas, resolver))
+            self.file_io.write_bytes(
+                f"{self.directory}/{name}",
+                write_metas_avro(metas, resolver, codec="null" if compression == "none" else "deflate"),
+            )
         else:
             self._write_lines(name, (m.to_dict() for m in metas))
         return name

@@ -33,6 +33,20 @@ from .writer import MergeTreeWriter
 __all__ = ["KeyValueFileStore"]
 
 
+def _parse_per_level(spec: str | None) -> dict[int, str]:
+    """'0:avro,5:parquet' -> {0: 'avro', 5: 'parquet'} (reference
+    CoreOptions.fileFormatPerLevel / fileCompressionPerLevel)."""
+    if not spec:
+        return {}
+    out: dict[int, str] = {}
+    for part in spec.split(","):
+        lvl, _, val = part.strip().partition(":")
+        if not val:
+            raise ValueError(f"per-level spec needs 'level:value' pairs, got {part!r}")
+        out[int(lvl)] = val.strip()
+    return out
+
+
 class KeyValueFileStore:
     def __init__(self, file_io: FileIO, table_path: str, schema: TableSchema, commit_user: str = "anonymous"):
         self.file_io = file_io
@@ -49,7 +63,11 @@ class KeyValueFileStore:
 
     # ---- layout --------------------------------------------------------
     def bucket_dir(self, partition: tuple, bucket: int) -> str:
-        pp = partition_path(self.partition_keys, partition)
+        pp = partition_path(
+            self.partition_keys,
+            partition,
+            default_name=self.options.options.get(CoreOptions.PARTITION_DEFAULT_NAME),
+        )
         base = f"{self.table_path}/{pp}" if pp else self.table_path
         return f"{base}/bucket-{bucket}"
 
@@ -70,6 +88,18 @@ class KeyValueFileStore:
     def writer_factory(self, partition: tuple, bucket: int) -> KeyValueFileWriterFactory:
         co = self.options
         bloom_cols = co.options.get(CoreOptions.FILE_INDEX_BLOOM_COLUMNS)
+        format_options = {
+            k: v
+            for k, v in co.options._data.items()
+            if k.startswith(("orc.", "parquet.", "avro."))
+        }
+        # generic writer knobs the format backends understand
+        block = co.options.get(CoreOptions.FILE_BLOCK_SIZE)
+        if block is not None:
+            format_options.setdefault("file.block-size", int(block))
+        format_options.setdefault(
+            "file.compression.zstd-level", co.options.get(CoreOptions.FILE_COMPRESSION_ZSTD_LEVEL)
+        )
         return KeyValueFileWriterFactory(
             self.file_io,
             self.bucket_dir(partition, bucket),
@@ -82,12 +112,10 @@ class KeyValueFileStore:
             bloom_columns=[c.strip() for c in bloom_cols.split(",")] if bloom_cols else (),
             bloom_fpp=co.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
             keyed=self.keyed,
-            format_options={
-                k: v
-                for k, v in co.options._data.items()
-                if k.startswith(("orc.", "parquet.", "avro."))
-            },
+            format_options=format_options,
             include_key_columns=co.options.get(CoreOptions.DATA_FILE_INCLUDE_KEY_COLUMNS),
+            per_level_format=_parse_per_level(co.options.get(CoreOptions.FILE_FORMAT_PER_LEVEL)),
+            per_level_compression=_parse_per_level(co.options.get(CoreOptions.FILE_COMPRESSION_PER_LEVEL)),
         )
 
     def reader_factory(self, partition: tuple, bucket: int, read_schema: RowType | None = None) -> KeyValueFileReaderFactory:
@@ -101,7 +129,12 @@ class KeyValueFileStore:
         )
 
     def new_scan(self) -> FileStoreScan:
-        return FileStoreScan(self.file_io, self.table_path, self.key_names)
+        return FileStoreScan(
+            self.file_io,
+            self.table_path,
+            self.key_names,
+            manifest_parallelism=self.options.options.get(CoreOptions.SCAN_MANIFEST_PARALLELISM),
+        )
 
     def new_commit(self) -> FileStoreCommit:
         return FileStoreCommit(
@@ -150,6 +183,7 @@ class KeyValueFileStore:
                 self.options.size_ratio,
                 self.options.num_sorted_runs_compaction_trigger,
                 self.options.options.get(CoreOptions.COMPACTION_OPTIMIZATION_INTERVAL),
+                max_file_num=self.options.options.get(CoreOptions.COMPACTION_MAX_FILE_NUM),
             )
             from ..options import ChangelogProducer
 

@@ -36,7 +36,8 @@ class OrcFormat(FileFormat):
 
         table = batch.to_arrow()
         buf = _io.BytesIO()
-        stripe_size = int((format_options or {}).get("orc.stripe.size", 64 << 20))
+        opts = format_options or {}
+        stripe_size = int(opts.get("orc.stripe.size", opts.get("file.block-size", 64 << 20)))
         po.write_table(table, buf, compression=compression, stripe_size=stripe_size)
         file_io.write_bytes(path, buf.getvalue())
 

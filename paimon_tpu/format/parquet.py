@@ -39,6 +39,15 @@ class ParquetFormat(FileFormat):
         kw = {}
         if "parquet.row-group.rows" in opts:
             kw["row_group_size"] = int(opts["parquet.row-group.rows"])
+        elif "file.block-size" in opts and table.num_rows:
+            # block-size is bytes; pyarrow sizes row groups in rows —
+            # translate through the actual in-memory bytes/row of this table
+            per_row = max(1, table.nbytes // table.num_rows)
+            kw["row_group_size"] = max(1024, int(opts["file.block-size"]) // per_row)
+        if "parquet.enable.dictionary" in opts:
+            kw["use_dictionary"] = str(opts["parquet.enable.dictionary"]).lower() == "true"
+        if compression == "zstd" and "file.compression.zstd-level" in opts:
+            kw["compression_level"] = int(opts["file.compression.zstd-level"])
         pq.write_table(table, buf, compression=compression, **kw)
         file_io.write_bytes(path, buf.getvalue())
 

@@ -131,13 +131,18 @@ def record_batch_reader(
     predicate: "Predicate | None" = None,
     projection: Sequence[str] | None = None,
     splits: "Sequence[DataSplit] | None" = None,
-    max_chunksize: int = 1 << 20,
+    max_chunksize: int | None = None,
 ):
     """Lazy streaming reader over the whole table (or given splits): splits
     merge one at a time, so peak memory is one split's worth regardless of
-    table size."""
+    table size.  Batch granularity: explicit max_chunksize, else the table's
+    read.batch-size option if set, else 1M rows."""
     import pyarrow as pa
 
+    if max_chunksize is None:
+        from ..options import CoreOptions
+
+        max_chunksize = table.options.options.get(CoreOptions.READ_BATCH_SIZE) or 1 << 20
     schema = _surface_schema(table, projection)
     if splits is None:
         rb = table.new_read_builder()

@@ -154,8 +154,8 @@ def entry_from_avro(node: dict, resolver):
     )
 
 
-def write_entries_avro(entries, resolver) -> bytes:
-    return write_ocf(manifest_entry_schema(), [entry_to_avro(e, resolver) for e in entries])
+def write_entries_avro(entries, resolver, codec: str = "deflate") -> bytes:
+    return write_ocf(manifest_entry_schema(), [entry_to_avro(e, resolver) for e in entries], codec=codec)
 
 
 def read_entries_avro(data: bytes, resolver):
@@ -163,7 +163,7 @@ def read_entries_avro(data: bytes, resolver):
     return [entry_from_avro(r, resolver) for r in records]
 
 
-def write_metas_avro(metas, resolver) -> bytes:
+def write_metas_avro(metas, resolver, codec: str = "deflate") -> bytes:
     records = []
     for m in metas:
         ctx = resolver(m.schema_id)
@@ -185,7 +185,7 @@ def write_metas_avro(metas, resolver) -> bytes:
                 "_SCHEMA_ID": m.schema_id,
             }
         )
-    return write_ocf(manifest_meta_schema(), records)
+    return write_ocf(manifest_meta_schema(), records, codec=codec)
 
 
 def read_metas_avro(data: bytes):

@@ -29,6 +29,29 @@ __all__ = [
 ]
 
 
+_DURATION_UNITS = {
+    "ms": 1,
+    "s": 1000,
+    "sec": 1000,
+    "min": 60_000,
+    "m": 60_000,
+    "h": 3_600_000,
+    "d": 86_400_000,
+}
+
+
+def parse_duration_millis(v: "str | int | float") -> int:
+    """'1 h' / '30s' / '100 ms' / bare number (millis) -> millis int
+    (reference TimeUtils.parseDuration)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    t = str(v).strip().lower().replace(" ", "")
+    for u in ("ms", "sec", "min", "s", "m", "h", "d"):
+        if t.endswith(u) and t[: -len(u)].replace(".", "", 1).isdigit():
+            return int(float(t[: -len(u)]) * _DURATION_UNITS[u])
+    return int(float(t))
+
+
 class MemorySize(int):
     """Bytes, parseable from '128 mb' style strings."""
 
@@ -75,6 +98,13 @@ class ConfigOption(Generic[T]):
     @staticmethod
     def memory(key: str, default: str, description: str = ""):
         return ConfigOption(key, MemorySize.parse(default), MemorySize.parse, description)
+
+    @staticmethod
+    def duration(key: str, default: "str | None", description: str = "", fallback: tuple[str, ...] = ()):
+        """Duration in MILLIS, parsed from '1 h' / '30 s' / '100 ms' / bare
+        millis (reference TimeUtils.parseDuration). Value type: int | None."""
+        d = None if default is None else parse_duration_millis(default)
+        return ConfigOption(key, d, lambda v: None if v is None else parse_duration_millis(v), description, fallback)
 
     @staticmethod
     def enum(key: str, enum_cls, default, description: str = ""):
@@ -175,7 +205,39 @@ class CoreOptions:
     PATH = ConfigOption.string("path", None, "Table path.")
     FILE_FORMAT = ConfigOption.string("file.format", "parquet", "Data file format: parquet|orc|lance.")
     FILE_COMPRESSION = ConfigOption.string("file.compression", "zstd", "Data file compression codec.")
+    FILE_COMPRESSION_ZSTD_LEVEL = ConfigOption.int_(
+        "file.compression.zstd-level", 1, "zstd level for data files (higher = smaller + slower)."
+    )
+    FILE_COMPRESSION_PER_LEVEL = ConfigOption.string(
+        "file.compression.per.level",
+        None,
+        "Per-LSM-level compression override, e.g. '0:lz4,5:zstd' (level-0 "
+        "files are short-lived: cheap codec; bottom level: dense codec).",
+    )
+    FILE_FORMAT_PER_LEVEL = ConfigOption.string(
+        "file.format.per.level",
+        None,
+        "Per-LSM-level format override, e.g. '0:avro,5:parquet' (row format "
+        "for hot small runs, columnar for the settled bottom level).",
+    )
+    FILE_BLOCK_SIZE = ConfigOption(
+        "file.block-size",
+        None,
+        lambda v: None if v is None else MemorySize.parse(v),
+        "Write block size: orc stripe / parquet row-group bytes.",
+    )
+    PARQUET_ENABLE_DICTIONARY = ConfigOption.bool_(
+        "parquet.enable.dictionary", True, "Dictionary encoding for parquet data files."
+    )
+    READ_BATCH_SIZE = ConfigOption.int_(
+        "read.batch-size", None, "Rows per record batch handed to engine surfaces (unset: 1M-row chunks)."
+    )
     MANIFEST_FORMAT = ConfigOption.string("manifest.format", "jsonl", "Manifest file format.")
+    MANIFEST_COMPRESSION = ConfigOption.string(
+        "manifest.compression",
+        "default",
+        "Manifest codec: default (zstd for jsonl / deflate for avro) or none.",
+    )
     TARGET_FILE_SIZE = ConfigOption.memory("target-file-size", "128 mb", "Rolling target size for data files.")
     WRITE_BUFFER_SIZE = ConfigOption.memory("write-buffer-size", "256 mb", "Memtable size before flush.")
     WRITE_BUFFER_ROWS = ConfigOption.int_("write-buffer-rows", 1_000_000, "Memtable row cap before flush.")
@@ -262,7 +324,36 @@ class CoreOptions:
     SCAN_MODE = ConfigOption.enum("scan.mode", StartupMode, StartupMode.DEFAULT, "Startup mode for scans.")
     SCAN_SNAPSHOT_ID = ConfigOption.int_("scan.snapshot-id", None, "Snapshot id for time travel.")
     SCAN_TIMESTAMP_MILLIS = ConfigOption.int_("scan.timestamp-millis", None, "Timestamp for time travel.")
+    SCAN_TIMESTAMP = ConfigOption.string(
+        "scan.timestamp", None, "Timestamp for time travel as 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' (local time)."
+    )
     SCAN_TAG_NAME = ConfigOption.string("scan.tag-name", None, "Tag name for time travel.")
+    SCAN_VERSION = ConfigOption.string(
+        "scan.version", None, "Unified time travel: a tag name, or a snapshot id (tag wins on ambiguity)."
+    )
+    SCAN_WATERMARK = ConfigOption.int_(
+        "scan.watermark", None, "Travel to the earliest snapshot whose watermark is >= this value."
+    )
+    SCAN_FILE_CREATION_TIME_MILLIS = ConfigOption.int_(
+        "scan.file-creation-time-millis", None, "Only read data files created after this epoch-millis."
+    )
+    SCAN_PLAN_SORT_PARTITION = ConfigOption.bool_(
+        "scan.plan-sort-partition",
+        False,
+        "true: splits strictly partition-major (sorted sequential consumption); "
+        "false: round-robin across partitions (spreads parallel readers).",
+    )
+    SCAN_MAX_SPLITS_PER_TASK = ConfigOption.int_(
+        "scan.max-splits-per-task", 10, "Split-assignment batch cap per reader task in the enumerator."
+    )
+    SCAN_MANIFEST_PARALLELISM = ConfigOption.int_(
+        "scan.manifest.parallelism", None, "Threads for reading manifests during scan planning."
+    )
+    INCREMENTAL_BETWEEN_TIMESTAMP = ConfigOption.string(
+        "incremental-between-timestamp",
+        None,
+        "Incremental read between two epoch-millis timestamps 't1,t2' (resolved to snapshots).",
+    )
     INCREMENTAL_BETWEEN = ConfigOption.string(
         "incremental-between",
         None,
@@ -283,9 +374,16 @@ class CoreOptions:
     SNAPSHOT_EXPIRE_LIMIT = ConfigOption.int_(
         "snapshot.expire.limit", 50, "Max snapshots processed per expire run."
     )
+    SNAPSHOT_EXPIRE_CLEAN_EMPTY_DIRS = ConfigOption.bool_(
+        "snapshot.expire.clean-empty-directories",
+        False,
+        "Also remove bucket/partition directories left empty by expiry.",
+    )
     SNAPSHOT_NUM_RETAINED_MIN = ConfigOption.int_("snapshot.num-retained.min", 10, "Min snapshots retained.")
     SNAPSHOT_NUM_RETAINED_MAX = ConfigOption.int_("snapshot.num-retained.max", 2147483647, "Max snapshots retained.")
-    SNAPSHOT_TIME_RETAINED_MS = ConfigOption.int_("snapshot.time-retained.ms", 3600_000, "Snapshot retention time.")
+    SNAPSHOT_TIME_RETAINED_MS = ConfigOption.duration(
+        "snapshot.time-retained", "1 h", "Snapshot retention time.", fallback=("snapshot.time-retained.ms",)
+    )
     NUM_SORTED_RUNS_COMPACTION_TRIGGER = ConfigOption.int_(
         "num-sorted-run.compaction-trigger", 5, "Sorted runs per bucket that trigger compaction."
     )
@@ -298,6 +396,12 @@ class CoreOptions:
     )
     COMPACTION_SIZE_RATIO = ConfigOption.int_("compaction.size-ratio", 1, "Universal compaction size ratio percent.")
     COMPACTION_MIN_FILE_NUM = ConfigOption.int_("compaction.min.file-num", 5, "Min files for size-ratio pick.")
+    COMPACTION_MAX_FILE_NUM = ConfigOption.int_(
+        "compaction.max.file-num",
+        50,
+        "Cap on files merged by one size-ratio/file-num pick (bounds a "
+        "single compaction's input; reference compaction.max.file-num).",
+    )
     COMPACTION_OPTIMIZATION_INTERVAL = ConfigOption.int_(
         "compaction.optimization-interval", None, "Force full compaction every N millis."
     )
@@ -324,16 +428,65 @@ class CoreOptions:
         "merge.read-batch-rows", 8 << 20, "Row tile per device merge step (key-range tiling)."
     )
     CONSUMER_ID = ConfigOption.string("consumer-id", None, "Consumer id protecting read progress.")
-    CONSUMER_EXPIRATION_TIME_MS = ConfigOption.int_("consumer.expiration-time.ms", None, "Consumer expiry.")
+    CONSUMER_EXPIRATION_TIME_MS = ConfigOption.duration(
+        "consumer.expiration-time", None, "Consumer expiry.", fallback=("consumer.expiration-time.ms",)
+    )
     TAG_AUTOMATIC_CREATION = ConfigOption.string("tag.automatic-creation", "none", "none|process-time|watermark.")
+    TAG_CREATION_DELAY = ConfigOption.duration(
+        "tag.creation-delay", "0 ms", "Extra wait after a period closes before its tag is created."
+    )
+    TAG_PERIOD_FORMATTER = ConfigOption.string(
+        "tag.period-formatter", "with_dashes", "Tag name style: with_dashes (2024-01-02[ 03]) | without_dashes (20240102[03])."
+    )
+    TAG_NUM_RETAINED_MAX = ConfigOption.int_(
+        "tag.num-retained-max", None, "Max auto-created tags kept (oldest pruned first)."
+    )
+    TAG_DEFAULT_TIME_RETAINED = ConfigOption.duration(
+        "tag.default-time-retained", None, "Auto tags older than this (by tagged snapshot time) are removed."
+    )
+    TAG_CALLBACKS = ConfigOption.string(
+        "tag.callbacks", None, "Comma list of 'module:function' callables invoked as fn(table, tag_name, snapshot)."
+    )
+    COMMIT_CALLBACKS = ConfigOption.string(
+        "commit.callbacks", None, "Comma list of 'module:function' callables invoked as fn(table, snapshot) after commit."
+    )
+    COMMIT_USER_PREFIX = ConfigOption.string(
+        "commit.user-prefix", None, "Generated commit users become '<prefix>-<uuid>' (job attribution)."
+    )
+    COMMIT_FORCE_COMPACT = ConfigOption.bool_(
+        "commit.force-compact", False, "Run a full compaction as part of every batch prepare_commit."
+    )
+    COMMIT_FORCE_CREATE_SNAPSHOT = ConfigOption.bool_(
+        "commit.force-create-snapshot", False, "Create a snapshot even for an empty commit."
+    )
+    DYNAMIC_PARTITION_OVERWRITE = ConfigOption.bool_(
+        "dynamic-partition-overwrite",
+        True,
+        "INSERT OVERWRITE without a partition filter clears only the "
+        "partitions present in the new data (false: whole table).",
+    )
+    ROWKIND_FIELD = ConfigOption.string(
+        "rowkind.field", None, "Column holding the row kind ('+I'/'-U'/'+U'/'-D') extracted on write."
+    )
+    PARTITION_DEFAULT_NAME = ConfigOption.string(
+        "partition.default-name", "__DEFAULT_PARTITION__", "Path name used for null/empty partition values."
+    )
     TAG_CREATION_PERIOD = ConfigOption.string("tag.creation-period", "daily", "daily|hourly.")
     METADATA_STATS_MODE = ConfigOption.string("metadata.stats-mode", "truncate(16)", "Stats collection mode.")
     MANIFEST_TARGET_SIZE = ConfigOption.memory("manifest.target-file-size", "8 mb", "Manifest merge target size.")
     MANIFEST_MERGE_MIN_COUNT = ConfigOption.int_("manifest.merge-min-count", 30, "Small manifests before merge.")
-    PARTITION_EXPIRATION_TIME_MS = ConfigOption.int_("partition.expiration-time.ms", None, "Partition TTL.")
+    PARTITION_EXPIRATION_TIME_MS = ConfigOption.duration(
+        "partition.expiration-time", None, "Partition TTL.", fallback=("partition.expiration-time.ms",)
+    )
+    PARTITION_EXPIRATION_CHECK_INTERVAL = ConfigOption.duration(
+        "partition.expiration-check-interval", "1 h",
+        "Min interval between partition-expiry sweeps piggybacked on commits.",
+    )
     PARTITION_TIMESTAMP_FORMATTER = ConfigOption.string("partition.timestamp-formatter", None)
     PARTITION_TIMESTAMP_PATTERN = ConfigOption.string("partition.timestamp-pattern", None)
-    RECORD_LEVEL_EXPIRE_TIME_MS = ConfigOption.int_("record-level.expire-time.ms", None, "Row TTL on read/compact.")
+    RECORD_LEVEL_EXPIRE_TIME_MS = ConfigOption.duration(
+        "record-level.expire-time", None, "Row TTL on read/compact.", fallback=("record-level.expire-time.ms",)
+    )
     RECORD_LEVEL_TIME_FIELD = ConfigOption.string("record-level.time-field", None, "Row TTL time column.")
     RECORD_LEVEL_TIME_FIELD_TYPE = ConfigOption.string(
         "record-level.time-field-type", "seconds", "Row TTL column unit: seconds|millis|micros."

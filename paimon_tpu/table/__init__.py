@@ -45,6 +45,14 @@ class FileStoreTable(Table):
         self.path = path
         self.schema = schema
         self.name = path.rstrip("/").rsplit("/", 1)[-1]
+        if commit_user == "anonymous":
+            # commit.user-prefix: attribute generated users to the job
+            # (reference createCommitUser: prefix + UUID)
+            prefix = schema.options.get("commit.user-prefix")
+            if prefix:
+                import uuid as _uuid
+
+                commit_user = f"{prefix}-{_uuid.uuid4().hex[:12]}"
         if schema.primary_keys:
             self.store = KeyValueFileStore(file_io, path, schema, commit_user=commit_user)
         else:

@@ -52,12 +52,15 @@ class SplitEnumerator:
         return len(splits)
 
     def next_splits(self, reader_id: int, max_splits: int | None = None) -> list[DataSplit]:
-        """Drain up to max_splits pending splits for one reader."""
-        q = self._pending[reader_id]
+        """Drain up to max_splits pending splits for one reader (default:
+        the table's scan.max-splits-per-task — one assignment batch stays
+        bounded so failover never re-queues an unbounded backlog)."""
         if max_splits is None:
-            out, self._pending[reader_id] = q, []
-        else:
-            out, self._pending[reader_id] = q[:max_splits], q[max_splits:]
+            from ..options import CoreOptions
+
+            max_splits = self.table.options.options.get(CoreOptions.SCAN_MAX_SPLITS_PER_TASK)
+        q = self._pending[reader_id]
+        out, self._pending[reader_id] = q[:max_splits], q[max_splits:]
         return out
 
     @property

@@ -206,9 +206,31 @@ class TableScan:
 
             return TagManager(self.table.file_io, self.table.path).snapshot_id(tag)
         ts = opts.get(CoreOptions.SCAN_TIMESTAMP_MILLIS)
+        if ts is None:
+            iso = opts.get(CoreOptions.SCAN_TIMESTAMP)
+            if iso:
+                import datetime as _dt
+
+                ts = int(_dt.datetime.fromisoformat(iso).timestamp() * 1000)
         if ts is not None:
             snap = store.snapshot_manager.earlier_or_equal_time_millis(ts)
             return snap.id if snap else None
+        version = opts.get(CoreOptions.SCAN_VERSION)
+        if version:
+            from .tags import TagManager
+
+            tm = TagManager(self.table.file_io, self.table.path)
+            if version in tm.list_tags():
+                return tm.snapshot_id(version)
+            return int(version)
+        wm = opts.get(CoreOptions.SCAN_WATERMARK)
+        if wm is not None:
+            # earliest snapshot whose watermark passed the bound (reference
+            # TimeTravelUtil watermark travel)
+            for snap in store.snapshot_manager.snapshots():
+                if snap.watermark is not None and snap.watermark >= wm:
+                    return snap.id
+            return None
         return None
 
     def plan(self) -> list[DataSplit]:
@@ -216,6 +238,20 @@ class TableScan:
         inc = store.options.options.get(CoreOptions.INCREMENTAL_BETWEEN)
         if inc:
             return self._incremental_splits(inc)
+        inc_ts = store.options.options.get(CoreOptions.INCREMENTAL_BETWEEN_TIMESTAMP)
+        if inc_ts:
+            # resolve 't1,t2' epoch-millis to the snapshots at those times,
+            # then reuse the id-based incremental machinery
+            t1, t2 = (int(x) for x in inc_ts.split(","))
+            sm = store.snapshot_manager
+            s1 = sm.earlier_or_equal_time_millis(t1)
+            s2 = sm.earlier_or_equal_time_millis(t2)
+            if s2 is None:
+                return []
+            start = s1.id if s1 else 0
+            if start >= s2.id:
+                return []  # empty window: no snapshot landed between t1 and t2
+            return self._incremental_splits(f"{start},{s2.id}")
         scan = store.new_scan()
         snapshot_id = self._resolve_snapshot()
         if snapshot_id is not None:
@@ -239,14 +275,23 @@ class TableScan:
         co = store.options
         target = int(co.options.get(CoreOptions.SOURCE_SPLIT_TARGET_SIZE))
         open_cost = int(co.options.get(CoreOptions.SOURCE_SPLIT_OPEN_FILE_COST))
+        created_after = co.options.get(CoreOptions.SCAN_FILE_CREATION_TIME_MILLIS)
         splits = []
         keyed = bool(self.table.schema.primary_keys)
+        per_partition: dict[tuple, list[DataSplit]] = {}
         for partition, buckets in sorted(plan.grouped().items(), key=lambda kv: kv[0]):
+            plist = per_partition.setdefault(partition, [])
             for bucket, files in sorted(buckets.items()):
+                if created_after is not None:
+                    # reference scan.file-creation-time-millis: only files
+                    # born after the bound (append/log-style consumption)
+                    files = [f for f in files if f.creation_time_millis > created_after]
+                    if not files:
+                        continue
                 snapshot = plan.snapshot.id if plan.snapshot else None
                 dv_index = plan.dv_index_for(partition, bucket)
                 for pack, raw in _pack_bucket_splits(files, target, open_cost, keyed):
-                    splits.append(
+                    plist.append(
                         DataSplit(
                             partition,
                             bucket,
@@ -256,6 +301,23 @@ class TableScan:
                             dv_index_file=dv_index,
                         )
                     )
+        if co.options.get(CoreOptions.SCAN_PLAN_SORT_PARTITION):
+            # strict partition-major order for sorted sequential consumption
+            for p in sorted(per_partition):
+                splits.extend(per_partition[p])
+        else:
+            # round-robin across partitions: parallel readers spread load
+            lanes = [per_partition[p] for p in sorted(per_partition)]
+            i = 0
+            while True:
+                emitted = False
+                for lane in lanes:
+                    if i < len(lane):
+                        splits.append(lane[i])
+                        emitted = True
+                if not emitted:
+                    break
+                i += 1
         return splits
 
 

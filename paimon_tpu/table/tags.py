@@ -54,3 +54,93 @@ class TagManager:
 
     def tagged_snapshot_ids(self) -> set[int]:
         return set(self.list_tags().values())
+
+
+class TagAutoCreation:
+    """Automatic periodic tags (reference tag/TagAutoCreation.java +
+    TagPeriodHandler/TagTimeExtractor): once a daily/hourly period closes
+    (plus tag.creation-delay), the latest snapshot is tagged with the
+    period's name; old auto tags are pruned by tag.num-retained-max and
+    tag.default-time-retained.  Time source: process time, or the
+    snapshot's watermark (tag.automatic-creation=watermark)."""
+
+    def __init__(self, table):
+        self.table = table
+        self.tm = TagManager(table.file_io, table.path)
+
+    def run(self) -> list[str]:
+        import datetime as _dt
+
+        from ..options import CoreOptions
+        from ..utils import now_millis
+
+        opts = self.table.options.options
+        mode = opts.get(CoreOptions.TAG_AUTOMATIC_CREATION)
+        if mode in (None, "none"):
+            return []
+        snap = self.tm.snapshot_manager.latest_snapshot()
+        if snap is None:
+            return []
+        if mode == "watermark":
+            if snap.watermark is None:
+                return []
+            t = snap.watermark
+        else:  # process-time
+            t = now_millis()
+        delay = opts.get(CoreOptions.TAG_CREATION_DELAY) or 0
+        period = opts.get(CoreOptions.TAG_CREATION_PERIOD)
+        style = opts.get(CoreOptions.TAG_PERIOD_FORMATTER)
+        ref = _dt.datetime.fromtimestamp((t - delay) / 1000)
+        if period == "hourly":
+            closed = ref.replace(minute=0, second=0, microsecond=0) - _dt.timedelta(hours=1)
+            fmt = "%Y-%m-%d %H" if style == "with_dashes" else "%Y%m%d%H"
+        else:  # daily
+            closed = ref.replace(hour=0, minute=0, second=0, microsecond=0) - _dt.timedelta(days=1)
+            fmt = "%Y-%m-%d" if style == "with_dashes" else "%Y%m%d"
+        name = closed.strftime(fmt)
+        created = []
+        if name not in self.tm.list_tags():
+            self.tm.create(name, snap.id)
+            created.append(name)
+            self._callbacks(name, snap)
+        self._prune(fmt)
+        return created
+
+    def _callbacks(self, name: str, snap) -> None:
+        from ..options import CoreOptions
+        from .write import load_callbacks
+
+        for fn in load_callbacks(self.table, CoreOptions.TAG_CALLBACKS):
+            try:
+                fn(self.table, name, snap)
+            except Exception:
+                pass  # callbacks must never fail tagging
+
+    def _prune(self, fmt: str) -> None:
+        """Apply retention to AUTO tags only (names matching the period
+        format); user tags are never touched."""
+        import datetime as _dt
+
+        from ..options import CoreOptions
+        from ..utils import now_millis
+
+        opts = self.table.options.options
+        auto = []
+        for name, sid in self.tm.list_tags().items():
+            try:
+                _dt.datetime.strptime(name, fmt)
+            except ValueError:
+                continue
+            auto.append(name)
+        auto.sort()
+        keep_n = opts.get(CoreOptions.TAG_NUM_RETAINED_MAX)
+        if keep_n is not None and len(auto) > keep_n:
+            for name in auto[: len(auto) - keep_n]:
+                self.tm.delete(name)
+            auto = auto[len(auto) - keep_n :]
+        ttl = opts.get(CoreOptions.TAG_DEFAULT_TIME_RETAINED)
+        if ttl is not None:
+            cutoff = now_millis() - ttl
+            for name in list(auto):
+                if self.tm.get(name).time_millis < cutoff:
+                    self.tm.delete(name)

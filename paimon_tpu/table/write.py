@@ -122,6 +122,14 @@ class TableWrite:
             data = ColumnBatch.from_pydict(self.table.row_type, data)
         if kinds is not None and not isinstance(kinds, np.ndarray):
             kinds = np.array([int(RowKind.from_short_string(k)) for k in kinds], dtype=np.uint8)
+        if kinds is None:
+            # rowkind.field: the row kind rides in a data column ('+I'...)
+            from ..options import CoreOptions
+
+            rk_field = self.table.options.options.get(CoreOptions.ROWKIND_FIELD)
+            if rk_field:
+                vals = data.column(rk_field).values
+                kinds = np.array([int(RowKind.from_short_string(str(v))) for v in vals], dtype=np.uint8)
         if self._cross is not None:
             self._cross.write(data, kinds)
             return
@@ -223,6 +231,10 @@ class TableWrite:
             return self._cross.prepare_commit()
         if self._local_merge_cap:
             self._local_merge_flush()
+        from ..options import CoreOptions
+
+        if self.table.options.options.get(CoreOptions.COMMIT_FORCE_COMPACT) and not self.table.options.write_only:
+            self.compact(full=True)
         from ..parallel.executor import maybe_mesh_batch
 
         with maybe_mesh_batch(self.table.store) as ctx:
@@ -247,6 +259,23 @@ class TableWrite:
             if close is not None:
                 close()
         self._writers.clear()
+
+
+def load_callbacks(table, option) -> list:
+    """Resolve a 'module:function,module:function' option into callables
+    (reference commit.callbacks/tag.callbacks load classes by name; here the
+    python-native form). Unresolvable specs raise at load time — a silently
+    dropped callback is worse than a loud config error."""
+    spec = table.options.options.get(option)
+    if not spec:
+        return []
+    import importlib
+
+    out = []
+    for item in spec.split(","):
+        mod, _, fn = item.strip().partition(":")
+        out.append(getattr(importlib.import_module(mod), fn))
+    return out
 
 
 class TableCommit:
@@ -286,11 +315,62 @@ class TableCommit:
         return ids
 
     def _post_commit(self) -> None:
+        from ..options import CoreOptions
+
+        snap = self.table.store.snapshot_manager.latest_snapshot()
+        for fn in load_callbacks(self.table, CoreOptions.COMMIT_CALLBACKS):
+            try:
+                fn(self.table, snap)
+            except Exception:
+                pass  # callbacks must never fail a commit
+        try:
+            from .tags import TagAutoCreation
+
+            TagAutoCreation(self.table).run()
+        except Exception:
+            pass  # tagging is maintenance
         if self.expire_after_commit:
             try:
                 self.table.expire_snapshots()
             except Exception:
                 pass  # expiry is maintenance, never fails a commit
+            self._maybe_expire_partitions()
+
+    def _maybe_expire_partitions(self) -> None:
+        """Piggyback partition TTL sweeps on commits, rate-limited by
+        partition.expiration-check-interval (reference PartitionExpire is
+        wired into the committer the same way)."""
+        from ..options import CoreOptions
+        from ..utils import now_millis
+
+        opts = self.table.options.options
+        ttl = opts.get(CoreOptions.PARTITION_EXPIRATION_TIME_MS)
+        if ttl is None or not self.table.partition_keys:
+            return
+        interval = opts.get(CoreOptions.PARTITION_EXPIRATION_CHECK_INTERVAL)
+        now = now_millis()
+        # rate-limit state lives on the STORE (one per table instance):
+        # TableCommit objects are per-commit, so instance state here would
+        # make the interval inert and put a full scan on every commit
+        store = self.table.store
+        last = getattr(store, "_last_partition_expire_check", 0)
+        if now - last < (interval or 0):
+            return
+        store._last_partition_expire_check = now
+        try:
+            from .maintenance import expire_partitions
+
+            # partition.timestamp-pattern picks the column ('$dt' form);
+            # partition.timestamp-formatter is a strptime pattern here
+            col_spec = opts.get(CoreOptions.PARTITION_TIMESTAMP_PATTERN)
+            expire_partitions(
+                self.table,
+                ttl,
+                time_col=col_spec.lstrip("$") if col_spec else None,
+                pattern=opts.get(CoreOptions.PARTITION_TIMESTAMP_FORMATTER) or "%Y-%m-%d",
+            )
+        except Exception:
+            pass  # maintenance must never fail the commit
 
 
 class BatchWriteBuilder:
@@ -323,9 +403,20 @@ class BatchTableCommit(TableCommit):
         self._partition_filter = partition_filter
 
     def commit(self, messages: list[CommitMessage]) -> list[int]:
+        from ..options import CoreOptions
+
+        opts = self.table.options.options
         ident = BatchWriteBuilder.COMMIT_IDENTIFIER
         if self._overwrite:
-            return self.overwrite(ident, messages, self._partition_filter)
+            pf = self._partition_filter
+            if pf is None and self.table.partition_keys and opts.get(CoreOptions.DYNAMIC_PARTITION_OVERWRITE):
+                # dynamic mode (reference default): only the partitions the
+                # new data touches are replaced, not the whole table
+                touched = {m.partition for m in messages}
+                pf = lambda p: p in touched  # noqa: E731
+            return self.overwrite(ident, messages, pf)
+        if not messages and not opts.get(CoreOptions.COMMIT_FORCE_CREATE_SNAPSHOT):
+            return []  # reference batch commits ignore empty by default
         return self.commit_messages(ident, messages)
 
 

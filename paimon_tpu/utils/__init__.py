@@ -30,11 +30,20 @@ def new_file_name(prefix: str, ext: str | None = None) -> str:
     return f"{n}.{ext}" if ext else n
 
 
-def partition_path(partition_keys: Sequence[str], partition: Sequence[Any]) -> str:
-    """Hive-style partition directory: k1=v1/k2=v2 ('' for unpartitioned)."""
+def partition_path(
+    partition_keys: Sequence[str],
+    partition: Sequence[Any],
+    default_name: str = "__DEFAULT_PARTITION__",
+) -> str:
+    """Hive-style partition directory: k1=v1/k2=v2 ('' for unpartitioned).
+    Null/empty values take partition.default-name (reference
+    PartitionPathUtils.generatePartitionPath)."""
     if not partition_keys:
         return ""
-    return "/".join(f"{k}={v}" for k, v in zip(partition_keys, partition))
+    return "/".join(
+        f"{k}={default_name if v is None or v == '' else v}"
+        for k, v in zip(partition_keys, partition)
+    )
 
 
 def now_millis() -> int:

@@ -1,0 +1,391 @@
+"""Behavior tests for the CoreOptions parity waves (reference
+CoreOptions.java knobs implemented with semantics, not just keys)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("id", BIGINT(False)), ("v", DOUBLE()), ("s", STRING()))
+
+
+@pytest.fixture
+def cat(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="opts")
+
+
+def _write(t, n=100, seed=0):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    ids = np.arange(n, dtype=np.int64) + seed
+    w.write({"id": ids, "v": ids * 0.5, "s": np.array([f"s{int(i) % 9}" for i in ids], dtype=object)})
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _read(t):
+    rb = t.new_read_builder()
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+# ---- wave A: format/writer knobs ---------------------------------------
+
+
+def test_file_format_and_compression_per_level(cat):
+    """Level-0 flushes use the hot-level format; full compaction rewrites at
+    the bottom level with the settled format — a table legitimately mixes
+    formats (reference fileFormatPerLevel/fileCompressionPerLevel)."""
+    t = cat.create_table(
+        "db.perlevel", SCHEMA, primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "file.format": "parquet",
+            "file.format.per.level": "0:avro",
+            "file.compression.per.level": "0:snappy",
+            "write-only": "true",
+        },
+    )
+    _write(t, 50)
+    files0 = t.store.restore_files((), 0)
+    assert all(f.file_name.endswith(".avro") for f in files0), [f.file_name for f in files0]
+    # full compaction rewrites to the bottom level -> default parquet
+    t2 = t.copy({"write-only": "false"})
+    wb = t2.new_batch_write_builder()
+    w = wb.new_write()
+    w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    files = t2.store.restore_files((), 0)
+    assert all(f.file_name.endswith(".parquet") for f in files), [f.file_name for f in files]
+    # mixed-format history reads fine (extension-dispatched readers)
+    assert _read(t2).num_rows == 50
+
+
+def test_file_block_size_controls_parquet_row_groups(cat):
+    import pyarrow.parquet as pq
+
+    t = cat.create_table(
+        "db.blk", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "file.block-size": "4 kb", "write-only": "true"},
+    )
+    _write(t, 5000)
+    f = t.store.restore_files((), 0)[0]
+    path = f"{t.store.bucket_dir((), 0)}/{f.file_name}"
+    md = pq.ParquetFile(path).metadata
+    assert md.num_row_groups > 1  # 4kb blocks over ~5000 rows must split
+
+
+def test_zstd_level_changes_file_size(cat):
+    sizes = {}
+    for lvl in (1, 19):
+        t = cat.create_table(
+            f"db.z{lvl}", SCHEMA, primary_keys=["id"],
+            options={"bucket": "1", "file.compression.zstd-level": str(lvl), "write-only": "true"},
+        )
+        _write(t, 20000)
+        sizes[lvl] = sum(f.file_size for f in t.store.restore_files((), 0))
+    assert sizes[19] < sizes[1]  # higher level compresses harder
+
+
+def test_parquet_dictionary_toggle(cat):
+    import pyarrow.parquet as pq
+
+    sizes = {}
+    for flag in ("true", "false"):
+        t = cat.create_table(
+            f"db.dict{flag}", SCHEMA, primary_keys=["id"],
+            options={"bucket": "1", "parquet.enable.dictionary": flag, "write-only": "true"},
+        )
+        _write(t, 5000)
+        f = t.store.restore_files((), 0)[0]
+        path = f"{t.store.bucket_dir((), 0)}/{f.file_name}"
+        col = pq.ParquetFile(path).metadata.row_group(0).column(0)
+        sizes[flag] = "PLAIN_DICTIONARY" in str(col.encodings) or "RLE_DICTIONARY" in str(col.encodings)
+    assert sizes["true"] and not sizes["false"]
+
+
+def test_manifest_compression_none_is_plain_jsonl(cat):
+    t = cat.create_table(
+        "db.mfnone", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "manifest.compression": "none"},
+    )
+    _write(t, 10)
+    sm = t.store.snapshot_manager
+    snap = sm.latest_snapshot()
+    raw = t.file_io.read_bytes(f"{t.path}/manifest/{snap.delta_manifest_list}")
+    assert raw.lstrip()[:1] == b"{"  # plain JSON lines, no zstd frame
+    assert _read(t).num_rows == 10  # and reads back (sniffed)
+
+
+def test_read_batch_size_controls_surface_chunks(cat):
+    t = cat.create_table(
+        "db.rbs", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "read.batch-size": "100"},
+    )
+    _write(t, 1000)
+    batches = list(t.to_record_batch_reader())
+    assert all(b.num_rows <= 100 for b in batches)
+    assert sum(b.num_rows for b in batches) == 1000
+
+
+# ---- wave B: time travel / scan shaping ---------------------------------
+
+
+def test_scan_timestamp_iso_and_scan_version(cat):
+    import time as _time
+
+    t = cat.create_table("db.tt", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, 10)
+    t.create_tag("v1")
+    _time.sleep(0.05)
+    import datetime as _dt
+
+    mid_iso = _dt.datetime.now().isoformat()
+    _time.sleep(0.05)
+    _write(t, 10, seed=100)
+    # scan.timestamp (ISO local) -> first snapshot
+    t_iso = t.copy({"scan.timestamp": mid_iso})
+    assert _read(t_iso).num_rows == 10
+    # scan.version as tag name, then as snapshot id
+    assert _read(t.copy({"scan.version": "v1"})).num_rows == 10
+    assert _read(t.copy({"scan.version": "2"})).num_rows == 20
+
+
+def test_scan_watermark_travel(cat):
+    t = cat.create_table("db.wm", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    wb = t.new_stream_write_builder()
+    w, c = wb.new_write(), wb.new_commit()
+    for i, wm in enumerate([100, 200, 300], start=1):
+        ids = np.arange(i * 10, dtype=np.int64)
+        w.write({"id": ids, "v": ids * 1.0, "s": np.array(["x"] * len(ids), dtype=object)})
+        c.commit_messages(i, w.prepare_commit(), watermark=wm)
+    # earliest snapshot with watermark >= 200 is snapshot 2 (20 rows)
+    assert _read(t.copy({"scan.watermark": "200"})).num_rows == 20
+
+
+def test_scan_file_creation_time_filter(cat):
+    t = cat.create_table("db.fct", SCHEMA, primary_keys=["id"], options={"bucket": "1", "write-only": "true"})
+    _write(t, 10)
+    import time as _time
+
+    _time.sleep(0.05)
+    from paimon_tpu.utils import now_millis
+
+    bound = now_millis()
+    _time.sleep(0.05)
+    _write(t, 10, seed=100)
+    got = _read(t.copy({"scan.file-creation-time-millis": str(bound)}))
+    assert got.num_rows == 10  # only the file created after the bound
+    assert sorted(got.to_pylist())[0][0] == 100
+
+
+def test_scan_plan_sort_partition_orders(cat):
+    schema = RowType.of(("id", BIGINT(False)), ("v", DOUBLE()), ("p", STRING(False)))
+    t = cat.create_table(
+        "db.psp", schema, primary_keys=["id", "p"], partition_keys=["p"],
+        # 1-byte split target: one split per file, so ordering is observable
+        options={"bucket": "1", "write-only": "true", "source.split.target-size": "1 b"},
+    )
+    for r in range(2):  # two files per partition
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        ids = np.arange(r * 10, r * 10 + 10, dtype=np.int64)
+        w.write({
+            "id": np.concatenate([ids, ids]),
+            "v": np.concatenate([ids, ids]) * 1.0,
+            "p": np.array(["a"] * 10 + ["b"] * 10, dtype=object),
+        })
+        wb.new_commit().commit(w.prepare_commit())
+    rb = t.new_read_builder()
+    rr = [s.partition for s in rb.new_scan().plan()]
+    assert rr == [("a",), ("b",), ("a",), ("b",)]  # round-robin default
+    t2 = t.copy({"scan.plan-sort-partition": "true"})
+    rb2 = t2.new_read_builder()
+    pm = [s.partition for s in rb2.new_scan().plan()]
+    assert pm == [("a",), ("a",), ("b",), ("b",)]  # partition-major
+
+
+def test_incremental_between_timestamp(cat):
+    import time as _time
+
+    from paimon_tpu.utils import now_millis
+
+    t = cat.create_table("db.ibt", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, 10)
+    _time.sleep(0.05)
+    t1 = now_millis()
+    _time.sleep(0.05)
+    _write(t, 10, seed=100)
+    t2 = now_millis()
+    got = _read(t.copy({"incremental-between-timestamp": f"{t1},{t2}"}))
+    assert sorted(r[0] for r in got.to_pylist()) == list(range(100, 110))
+
+
+# ---- wave B: tags + commit hooks ---------------------------------------
+
+
+def test_tag_auto_creation_watermark_mode(cat):
+    import datetime as _dt
+
+    t = cat.create_table(
+        "db.tauto", SCHEMA, primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "tag.automatic-creation": "watermark",
+            "tag.creation-period": "daily",
+            "tag.num-retained-max": "2",
+        },
+    )
+    wb = t.new_stream_write_builder()
+    w, c = wb.new_write(), wb.new_commit()
+    base = _dt.datetime(2024, 3, 10, 12, 0)
+    for i in range(4):  # four days of watermarks -> tags for d-1 each time
+        ids = np.arange(5, dtype=np.int64)
+        w.write({"id": ids, "v": ids * 1.0, "s": np.array(["x"] * 5, dtype=object)})
+        wm = int((base + _dt.timedelta(days=i)).timestamp() * 1000)
+        c.commit_messages(i + 1, w.prepare_commit(), watermark=wm)
+    tags = t.tags()
+    # retention keeps only the last 2 auto tags
+    assert sorted(tags) == ["2024-03-11", "2024-03-12"]
+
+
+def test_tag_auto_creation_without_dashes_formatter(cat):
+    import datetime as _dt
+
+    t = cat.create_table(
+        "db.tfmt", SCHEMA, primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "tag.automatic-creation": "watermark",
+            "tag.period-formatter": "without_dashes",
+        },
+    )
+    wb = t.new_stream_write_builder()
+    w, c = wb.new_write(), wb.new_commit()
+    ids = np.arange(3, dtype=np.int64)
+    w.write({"id": ids, "v": ids * 1.0, "s": np.array(["x"] * 3, dtype=object)})
+    wm = int(_dt.datetime(2024, 3, 10, 12, 0).timestamp() * 1000)
+    c.commit_messages(1, w.prepare_commit(), watermark=wm)
+    assert "20240309" in t.tags()
+
+
+def test_commit_callbacks_invoked(cat, tmp_path, monkeypatch):
+    mod = tmp_path / "cbmod.py"
+    mod.write_text(
+        "CALLS = []\n"
+        "def record(table, snapshot):\n"
+        "    CALLS.append((table.name, snapshot.id))\n"
+    )
+    monkeypatch.syspath_prepend(str(tmp_path))
+    t = cat.create_table(
+        "db.cb", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "commit.callbacks": "cbmod:record"},
+    )
+    _write(t, 5)
+    import cbmod
+
+    assert cbmod.CALLS == [("cb", 1)]
+
+
+def test_commit_user_prefix(cat, tmp_warehouse):
+    from paimon_tpu.table import load_table
+
+    t = cat.create_table(
+        "db.prefix", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "commit.user-prefix": "etl-job"},
+    )
+    t2 = load_table(f"{tmp_warehouse}/db.db/prefix")  # anonymous load
+    _write(t2, 5)
+    user = t2.store.snapshot_manager.latest_snapshot().commit_user
+    assert user.startswith("etl-job-") and len(user) > len("etl-job-")
+
+
+def test_empty_batch_commit_skipped_unless_forced(cat):
+    t = cat.create_table("db.empty1", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    wb = t.new_batch_write_builder()
+    ids = wb.new_commit().commit([])
+    assert ids == [] and t.store.snapshot_manager.latest_snapshot_id() is None
+    t2 = cat.create_table(
+        "db.empty2", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "commit.force-create-snapshot": "true"},
+    )
+    t2.new_batch_write_builder().new_commit().commit([])
+    assert t2.store.snapshot_manager.latest_snapshot_id() == 1
+
+
+def test_commit_force_compact(cat):
+    t = cat.create_table(
+        "db.fcomp", SCHEMA, primary_keys=["id"],
+        options={"bucket": "1", "commit.force-compact": "true",
+                 "num-sorted-run.compaction-trigger": "100"},  # never auto-trigger
+    )
+    for r in range(3):
+        _write(t, 20)
+    files = t.store.restore_files((), 0)
+    # force-compact keeps the bucket fully compacted despite the high trigger
+    assert len(files) == 1 and files[0].level > 0
+
+
+def test_dynamic_partition_overwrite(cat):
+    schema = RowType.of(("id", BIGINT(False)), ("v", DOUBLE()), ("p", STRING(False)))
+    t = cat.create_table(
+        "db.dpo", schema, primary_keys=["id", "p"], partition_keys=["p"], options={"bucket": "1"}
+    )
+
+    def write_p(t, part, ids, overwrite=False):
+        wb = t.new_batch_write_builder()
+        if overwrite:
+            wb = wb.with_overwrite()
+        w = wb.new_write()
+        arr = np.asarray(ids, dtype=np.int64)
+        w.write({"id": arr, "v": arr * 1.0, "p": np.array([part] * len(arr), dtype=object)})
+        wb.new_commit().commit(w.prepare_commit())
+
+    write_p(t, "a", [1, 2])
+    write_p(t, "b", [3, 4])
+    # dynamic (default): overwrite touching only 'a' keeps 'b'
+    write_p(t, "a", [9], overwrite=True)
+    rb = t.new_read_builder()
+    rows = sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+    assert [r[0] for r in rows] == [3, 4, 9]
+    # static: whole table replaced
+    t2 = t.copy({"dynamic-partition-overwrite": "false"})
+    write_p(t2, "a", [7], overwrite=True)
+    rb2 = t2.new_read_builder()
+    rows2 = sorted(rb2.new_read().read_all(rb2.new_scan().plan()).to_pylist())
+    assert [r[0] for r in rows2] == [7]
+
+
+def test_rowkind_field(cat):
+    schema = RowType.of(("id", BIGINT(False)), ("v", DOUBLE()), ("rk", STRING()))
+    t = cat.create_table(
+        "db.rk", schema, primary_keys=["id"],
+        options={"bucket": "1", "rowkind.field": "rk"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({
+        "id": np.array([1, 2, 1], dtype=np.int64),
+        "v": np.array([1.0, 2.0, 0.0]),
+        "rk": np.array(["+I", "+I", "-D"], dtype=object),
+    })
+    wb.new_commit().commit(w.prepare_commit())
+    rb = t.new_read_builder()
+    rows = rb.new_read().read_all(rb.new_scan().plan()).to_pylist()
+    assert [r[0] for r in rows] == [2]  # id=1 deleted via rowkind column
+
+
+def test_partition_default_name(cat):
+    schema = RowType.of(("id", BIGINT(False)), ("v", DOUBLE()), ("p", STRING()))
+    t = cat.create_table(
+        "db.pdef", schema, primary_keys=["id", "p"], partition_keys=["p"],
+        options={"bucket": "1", "partition.default-name": "__NULLP__"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": np.array([1], dtype=np.int64), "v": np.array([1.0]),
+             "p": np.array([""], dtype=object)})
+    wb.new_commit().commit(w.prepare_commit())
+    import os
+
+    assert os.path.isdir(f"{t.path}/p=__NULLP__/bucket-0")
+    assert _read(t).num_rows == 1
