@@ -25,6 +25,7 @@ def full_compaction_changelog(
     after: KVBatch,
     key_lanes_before: np.ndarray,
     key_lanes_after: np.ndarray,
+    row_deduplicate: bool = True,
 ) -> KVBatch:
     """Diff two key-sorted, unique-key sides (previous top level vs newly
     compacted result): emits +I for new keys, -U/+U pairs for changed rows,
@@ -52,11 +53,14 @@ def full_compaction_changelog(
     if gone.any():
         d = before.filter(gone)
         parts.append(KVBatch(d.data, d.seq, np.full(d.num_rows, int(RowKind.DELETE), dtype=np.uint8)))
-    # changed rows: -U (old) then +U (new); unchanged rows are skipped
+    # changed rows: -U (old) then +U (new); with row_deduplicate (default
+    # here — the diff is vectorized and effectively free) unchanged rows are
+    # skipped, else every matched key emits a pair (reference
+    # changelog-producer.row-deduplicate, whose default is false)
     if has_prev.any():
         old_rows = before.take(safe[has_prev])
         new_rows = after.filter(has_prev)
-        changed = _rows_differ(old_rows, new_rows)
+        changed = _rows_differ(old_rows, new_rows) if row_deduplicate else np.ones(old_rows.num_rows, dtype=np.bool_)
         if changed.any():
             ub = old_rows.filter(changed)
             ua = new_rows.filter(changed)

@@ -147,6 +147,7 @@ class MergeTreeCompactRewriter:
         merge_executor: MergeExecutor,
         deletion_vectors: dict | None = None,
         emit_full_changelog: bool = False,
+        row_deduplicate: bool = True,
         expire_predicate=None,
     ):
         self.reader_factory = reader_factory
@@ -160,6 +161,7 @@ class MergeTreeCompactRewriter:
         # full-compaction changelog producer (reference
         # FullChangelogMergeTreeCompactRewriter:43)
         self.emit_full_changelog = emit_full_changelog
+        self.row_deduplicate = row_deduplicate
 
     def _read(self, f: DataFileMeta) -> KVBatch:
         kv = self.reader_factory.read(f)
@@ -235,7 +237,9 @@ class MergeTreeCompactRewriter:
                 pools[k] = build_string_pool([before.data.column(k).values, merged.data.column(k).values])
         lanes_before = encode_key_lanes(before.data, key_names, pools)
         lanes_after = encode_key_lanes(merged.data, key_names, pools)
-        return full_compaction_changelog(before, merged, lanes_before, lanes_after)
+        return full_compaction_changelog(
+            before, merged, lanes_before, lanes_after, row_deduplicate=self.row_deduplicate
+        )
 
     def upgrade(self, file: DataFileMeta, output_level: int) -> DataFileMeta:
         return file.upgrade(output_level)

@@ -38,7 +38,25 @@ class SnapshotExpire:
         self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
         self.protected_ids = protected_ids or (lambda: ())
 
+    def _changelog_decoupled(self) -> bool:
+        return any(
+            self.options.options.get(o) is not None
+            for o in (
+                CoreOptions.CHANGELOG_NUM_RETAINED_MIN,
+                CoreOptions.CHANGELOG_NUM_RETAINED_MAX,
+                CoreOptions.CHANGELOG_TIME_RETAINED,
+            )
+        )
+
     def expire(self) -> int:
+        n = self._expire_snapshots()
+        # changelog retention is independent of snapshot expiry: aged
+        # changelogs must trim even in runs where no snapshot is expirable
+        if self._changelog_decoupled():
+            self.expire_changelogs()
+        return n
+
+    def _expire_snapshots(self) -> int:
         sm = self.snapshot_manager
         latest = sm.latest_snapshot_id()
         earliest = sm.earliest_snapshot_id()
@@ -80,18 +98,31 @@ class SnapshotExpire:
             if snap.changelog_manifest_list:
                 live_manifests.add(snap.changelog_manifest_list)
 
+        # decoupled changelog lifecycle (reference Changelog.java +
+        # ChangelogDeletion): with changelog retention configured, an
+        # expiring snapshot that carries changelog leaves a changelog-<id>
+        # copy behind and its changelog manifests/files survive the snapshot
+        decoupled = self._changelog_decoupled()
         dead_manifests: set[str] = set()
         dead_files: set[tuple] = set()
         for sid in expire_ids:
             snap = sm.snapshot(sid)
-            for name, entries in self._snapshot_manifests(snap):
+            preserve_changelog = decoupled and snap.changelog_manifest_list
+            if preserve_changelog:
+                self.file_io.write_bytes(
+                    sm.changelog_path(sid), snap.to_json().encode(), overwrite=True
+                )
+            for name, entries in self._snapshot_manifests(snap, include_changelog=not preserve_changelog):
                 if name not in live_manifests:
                     dead_manifests.add(name)
                 for e in entries:
                     key = (e.partition, e.bucket, e.file.file_name)
                     if key not in live_files:
                         dead_files.add((key, e.file.extra_files))
-            for lst in (snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list):
+            dead_lists = [snap.base_manifest_list, snap.delta_manifest_list]
+            if not preserve_changelog:
+                dead_lists.append(snap.changelog_manifest_list)
+            for lst in dead_lists:
                 if lst and lst not in live_manifests:
                     dead_manifests.add(lst)
 
@@ -132,11 +163,60 @@ class SnapshotExpire:
                     continue  # dir went live again: leave it
         return len(expire_ids)
 
-    def _snapshot_manifests(self, snap: Snapshot):
-        # changelog manifests included: their manifest files AND the
-        # changelog data files they reference die with the snapshot (the
-        # reference's SnapshotDeletion cleans changelog files the same way)
-        for lst in (snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list):
+    def expire_changelogs(self) -> int:
+        """Expire decoupled changelogs by changelog.num-retained.min/max and
+        changelog.time-retained; consumer/tag-protected ids stay (reference
+        ChangelogDeletion). Changelog data files are per-snapshot, never
+        shared, so they die with their changelog."""
+        from ..utils import now_millis
+
+        sm = self.snapshot_manager
+        ids = sm.changelog_ids()
+        if not ids:
+            return 0
+        opts = self.options.options
+        min_r = opts.get(CoreOptions.CHANGELOG_NUM_RETAINED_MIN) or 0
+        max_r = opts.get(CoreOptions.CHANGELOG_NUM_RETAINED_MAX)
+        ttl = opts.get(CoreOptions.CHANGELOG_TIME_RETAINED)
+        protected = set(self.protected_ids())
+        expire: list[int] = []
+        if max_r is not None and len(ids) > max_r:
+            expire.extend(ids[: len(ids) - max_r])
+        rest = ids[len(expire) :]
+        if ttl is not None:
+            cutoff = now_millis() - ttl
+            for cid in rest[: max(0, len(rest) - min_r)]:
+                if sm.changelog(cid).time_millis < cutoff:
+                    expire.append(cid)
+                else:
+                    break
+        n = 0
+        for cid in expire:
+            if cid in protected:
+                continue
+            snap = sm.changelog(cid)
+            if snap.changelog_manifest_list:
+                for meta in self.manifest_list.read(snap.changelog_manifest_list):
+                    for e in self.manifest_file.read(meta.file_name):
+                        d = self._bucket_dir(e.partition, e.bucket)
+                        self.file_io.delete(f"{d}/{e.file.file_name}")
+                        for x in e.file.extra_files:
+                            self.file_io.delete(f"{d}/{x}")
+                    self.manifest_file.delete(meta.file_name)
+                self.manifest_list.delete(snap.changelog_manifest_list)
+            self.file_io.delete(sm.changelog_path(cid))
+            n += 1
+        return n
+
+    def _snapshot_manifests(self, snap: Snapshot, include_changelog: bool = True):
+        # changelog manifests included by default: their manifest files AND
+        # the changelog data files they reference die with the snapshot
+        # (reference SnapshotDeletion) — unless the decoupled lifecycle is
+        # preserving them past snapshot expiry
+        lists = [snap.base_manifest_list, snap.delta_manifest_list]
+        if include_changelog:
+            lists.append(snap.changelog_manifest_list)
+        for lst in lists:
             if not lst:
                 continue
             for meta in self.manifest_list.read(lst):

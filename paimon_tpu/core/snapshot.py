@@ -104,10 +104,44 @@ class SnapshotManager:
         return f"{self.snapshot_dir}/snapshot-{snapshot_id}"
 
     def snapshot(self, snapshot_id: int) -> Snapshot:
-        return Snapshot.from_json(self.file_io.read_bytes(self.snapshot_path(snapshot_id)))
+        """The snapshot — falling back to its decoupled changelog copy when
+        the snapshot itself already expired (reference
+        SnapshotManager.tryGetChangelog): streaming consumers resuming from
+        an old position keep reading changelog history."""
+        try:
+            return Snapshot.from_json(self.file_io.read_bytes(self.snapshot_path(snapshot_id)))
+        except FileNotFoundError:
+            if self.changelog_exists(snapshot_id):
+                return self.changelog(snapshot_id)
+            raise
 
     def snapshot_exists(self, snapshot_id: int) -> bool:
         return self.file_io.exists(self.snapshot_path(snapshot_id))
+
+    # ---- decoupled changelogs (reference Changelog.java) ----------------
+    @property
+    def changelog_dir(self) -> str:
+        return f"{self.table_path}/changelog"
+
+    def changelog_path(self, snapshot_id: int) -> str:
+        return f"{self.changelog_dir}/changelog-{snapshot_id}"
+
+    def changelog(self, snapshot_id: int) -> Snapshot:
+        return Snapshot.from_json(self.file_io.read_bytes(self.changelog_path(snapshot_id)))
+
+    def changelog_exists(self, snapshot_id: int) -> bool:
+        return self.file_io.exists(self.changelog_path(snapshot_id))
+
+    def changelog_ids(self) -> list[int]:
+        out = []
+        for st in self.file_io.list_files(self.changelog_dir):
+            base = st.path.rsplit("/", 1)[-1]
+            if base.startswith("changelog-"):
+                try:
+                    out.append(int(base[len("changelog-") :]))
+                except ValueError:
+                    continue
+        return sorted(out)
 
     # ---- discovery -----------------------------------------------------
     def _hint(self, name: str) -> int | None:

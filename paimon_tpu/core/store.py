@@ -193,6 +193,7 @@ class KeyValueFileStore:
                 merge,
                 deletion_vectors=dvs,
                 emit_full_changelog=self.options.changelog_producer == ChangelogProducer.FULL_COMPACTION,
+                row_deduplicate=self.options.options.get(CoreOptions.CHANGELOG_PRODUCER_ROW_DEDUPLICATE),
                 expire_predicate=self.record_expire_predicate(),
             )
             compact_manager = MergeTreeCompactManager(levels, strategy, rewriter, self.options)

@@ -191,7 +191,13 @@ class MergeTreeWriter:
                 pools[k] = build_string_pool([before.data.column(k).values, after.data.column(k).values])
         lanes_before = encode_key_lanes(before.data, key_names, pools)
         lanes_after = encode_key_lanes(after.data, key_names, pools)
-        return full_compaction_changelog(before, after, lanes_before, lanes_after)
+        return full_compaction_changelog(
+            before,
+            after,
+            lanes_before,
+            lanes_after,
+            row_deduplicate=self.options.options.get(CoreOptions.CHANGELOG_PRODUCER_ROW_DEDUPLICATE),
+        )
 
     def _maybe_compact(self, full: bool = False) -> None:
         assert self.compact_manager is not None

@@ -318,6 +318,55 @@ class CoreOptions:
         "Row threshold above which one bucket's merge is range-partitioned "
         "over the mesh's key axis instead of running on a single device.",
     )
+    CHANGELOG_NUM_RETAINED_MIN = ConfigOption.int_(
+        "changelog.num-retained.min", None, "Min decoupled changelogs retained (enables the decoupled lifecycle)."
+    )
+    CHANGELOG_NUM_RETAINED_MAX = ConfigOption.int_(
+        "changelog.num-retained.max", None, "Max decoupled changelogs retained."
+    )
+    CHANGELOG_TIME_RETAINED = ConfigOption.duration(
+        "changelog.time-retained", None, "Decoupled changelog retention time (enables the decoupled lifecycle)."
+    )
+    CHANGELOG_PRODUCER_ROW_DEDUPLICATE = ConfigOption.bool_(
+        "changelog-producer.row-deduplicate",
+        True,
+        "Drop -U/+U changelog pairs whose values did not change "
+        "(full-compaction/lookup producers). Default true here: the diff is "
+        "a vectorized compare, effectively free (reference defaults false "
+        "because its row-by-row compare costs).",
+    )
+    DELETE_FORCE_PRODUCE_CHANGELOG = ConfigOption.bool_(
+        "delete.force-produce-changelog",
+        False,
+        "DELETE/UPDATE commands produce input changelog even when "
+        "changelog-producer=none.",
+    )
+    STREAMING_READ_OVERWRITE = ConfigOption.bool_(
+        "streaming-read-overwrite",
+        False,
+        "Streaming reads also emit the new content of OVERWRITE snapshots.",
+    )
+    STREAMING_READ_MODE = ConfigOption.string(
+        "streaming-read-mode", "file", "Streaming source: file (lake files). 'log' needs an external log system."
+    )
+    STREAM_SCAN_MODE = ConfigOption.string(
+        "stream-scan-mode",
+        "none",
+        "none: normal changelog-aware follow-up; file-monitor: raw delta "
+        "files of EVERY snapshot incl. compaction (compactor sources).",
+    )
+    CONTINUOUS_DISCOVERY_INTERVAL = ConfigOption.duration(
+        "continuous.discovery-interval", "10 s", "Poll interval for discovering new snapshots in streaming reads."
+    )
+    CONSUMER_IGNORE_PROGRESS = ConfigOption.bool_(
+        "consumer.ignore-progress", False, "Start from the startup mode, ignoring saved consumer progress."
+    )
+    CONSUMER_MODE = ConfigOption.string(
+        "consumer.mode",
+        "exactly-once",
+        "exactly-once: progress advances on checkpoint ack; at-least-once: on every plan.",
+    )
+    BRANCH = ConfigOption.string("branch", "main", "Branch this table view reads and writes.")
     CHANGELOG_PRODUCER = ConfigOption.enum(
         "changelog-producer", ChangelogProducer, ChangelogProducer.NONE, "How changelog files are produced."
     )

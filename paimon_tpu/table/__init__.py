@@ -204,10 +204,19 @@ class FileStoreTable(Table):
 
 
 def load_table(path: str, commit_user: str = "anonymous", dynamic_options: dict[str, str] | None = None) -> FileStoreTable:
-    """Open an existing table from its path."""
+    """Open an existing table from its path. The 'branch' option (in the
+    table's options or dynamic_options) pins the view to that branch."""
     file_io = get_file_io(path)
     schema = SchemaManager(file_io, path).latest()
     if schema is None:
         raise FileNotFoundError(f"no table at {path}")
     table = FileStoreTable(file_io, path, schema, commit_user)
+    # branch first: branch_table rebuilds from the branch schema, so other
+    # dynamic options must land on the BRANCH view, not the main table
+    dynamic_options = dict(dynamic_options or {})
+    branch = dynamic_options.pop("branch", None) or table.options.options.get(CoreOptions.BRANCH)
+    if branch and branch != "main":
+        from .branch import branch_table
+
+        table = branch_table(table, branch)
     return table.copy(dynamic_options) if dynamic_options else table

@@ -103,11 +103,21 @@ def _delete_with_dvs(table: "FileStoreTable", predicate: Predicate, commit_ident
 
 def _delete_with_retract(table: "FileStoreTable", predicate: Predicate) -> int:
     """PK table: read the matching merged rows, write them back as -D."""
+    from ..options import ChangelogProducer
+
     rb = table.new_read_builder().with_filter(predicate)
     splits = rb.new_scan().plan()
     matching = rb.new_read().read_all(splits)
     if matching.num_rows == 0:
         return 0
+    opts = table.options.options
+    if (
+        opts.get(CoreOptions.DELETE_FORCE_PRODUCE_CHANGELOG)
+        and table.options.changelog_producer == ChangelogProducer.NONE
+    ):
+        # downstream consumers see the retracts even on a changelog-less
+        # table (reference delete.force-produce-changelog)
+        table = table.copy({"changelog-producer": "input"})
     wb = table.new_batch_write_builder()
     w = wb.new_write()
     kinds = np.full(matching.num_rows, int(RowKind.DELETE), dtype=np.uint8)

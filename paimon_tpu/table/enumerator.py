@@ -19,7 +19,7 @@ from .read import DataSplit
 if TYPE_CHECKING:
     from . import FileStoreTable
 
-__all__ = ["SplitEnumerator"]
+__all__ = ["SplitEnumerator", "AlignedSplitEnumerator"]
 
 
 class SplitEnumerator:
@@ -87,3 +87,53 @@ class SplitEnumerator:
 
     def notify_checkpoint_complete(self) -> None:
         self.scan.notify_checkpoint_complete()
+
+
+class AlignedSplitEnumerator(SplitEnumerator):
+    """Checkpoint-aligned coordinator (reference flink/source/align/
+    AlignedContinuousFileSplitEnumerator): discovery pulls EXACTLY ONE
+    snapshot's splits at a time, and a checkpoint may only be taken once
+    every split of the current snapshot has been drained by its reader —
+    so each checkpoint corresponds to a consistent snapshot boundary.
+
+    Protocol:
+        n = enum.discover()            # <= one snapshot's splits enqueued
+        ... readers drain via next_splits() ...
+        state = enum.aligned_checkpoint(timeout)  # blocks for the barrier
+    """
+
+    def __init__(self, table, num_readers: int, predicate=None):
+        super().__init__(table, num_readers, predicate)
+        self._current_snapshot: int | None = None
+
+    def discover(self) -> int:
+        """One snapshot per call: a second discovery before the previous
+        snapshot is drained is refused (alignment invariant)."""
+        if self.pending_count:
+            return 0
+        splits = self.scan.plan()
+        if not splits:
+            self._current_snapshot = None
+            return 0
+        self._current_snapshot = splits[0].snapshot_id
+        for s in splits:
+            self._pending[self._owner(s)].append(s)
+        return len(splits)
+
+    def aligned_checkpoint(self, timeout_seconds: float = 10.0, poll_seconds: float = 0.02) -> dict:
+        """Barrier: wait until readers drained the current snapshot, then
+        checkpoint. TimeoutError when readers cannot drain in time
+        (reference alignment timeout => checkpoint failure)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_seconds
+        while self.pending_count:
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"alignment timeout: {self.pending_count} splits of snapshot "
+                    f"{self._current_snapshot} still undrained"
+                )
+            _time.sleep(poll_seconds)
+        state = self.checkpoint()
+        state["alignedSnapshot"] = self._current_snapshot
+        return state

@@ -32,10 +32,22 @@ class StreamTableScan:
         self.store = table.store
         opts = self.store.options.options
         self.mode: StartupMode = opts.get(CoreOptions.SCAN_MODE)
+        read_mode = opts.get(CoreOptions.STREAMING_READ_MODE)
+        if read_mode != "file":
+            raise ValueError(
+                f"streaming-read-mode={read_mode!r}: only 'file' is supported "
+                "('log' needs an external log system, which is out of scope)"
+            )
+        self.scan_mode = opts.get(CoreOptions.STREAM_SCAN_MODE)
+        if self.scan_mode not in ("none", "file-monitor"):
+            raise ValueError(f"unknown stream-scan-mode {self.scan_mode!r}")
+        self.consumer_mode = opts.get(CoreOptions.CONSUMER_MODE)
+        if self.consumer_mode not in ("exactly-once", "at-least-once"):
+            raise ValueError(f"unknown consumer.mode {self.consumer_mode!r}")
         self.consumer_id = opts.get(CoreOptions.CONSUMER_ID)
         self._next: int | None = None  # next snapshot id to read
         self._started = False
-        if self.consumer_id:
+        if self.consumer_id and not opts.get(CoreOptions.CONSUMER_IGNORE_PROGRESS):
             saved = ConsumerManager(table.file_io, table.path).consumer(self.consumer_id)
             if saved is not None:
                 self._next = saved
@@ -62,11 +74,13 @@ class StreamTableScan:
             ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, cp)
 
     # ---- planning ------------------------------------------------------
-    def plan_aligned(self, timeout_seconds: float = 60.0, poll_seconds: float = 0.5) -> list[DataSplit] | None:
+    def plan_aligned(self, timeout_seconds: float = 60.0, poll_seconds: float | None = None) -> list[DataSplit] | None:
         """Checkpoint-aligned variant (reference flink/source/align/): blocks
         until the next snapshot is available or the timeout passes, so every
         checkpoint lands exactly on a snapshot boundary. Returns None only on
-        timeout."""
+        timeout. Poll cadence defaults to continuous.discovery-interval."""
+        if poll_seconds is None:
+            poll_seconds = (self.store.options.options.get(CoreOptions.CONTINUOUS_DISCOVERY_INTERVAL) or 10_000) / 1000.0
         deadline = time.monotonic() + timeout_seconds
         while True:
             splits = self.plan()
@@ -113,8 +127,15 @@ class StreamTableScan:
         if self._past_bound(snap):
             self._ended = True
             return None
-        splits = self._delta_splits(self._next, snap)
+        planned = self._next
+        splits = self._delta_splits(planned, snap)
         self._next += 1
+        if self.consumer_id and self.consumer_mode == "at-least-once":
+            # progress advances as soon as the plan is handed out — to the
+            # PLANNED snapshot, not past it: a crash between plan and
+            # processing replays this snapshot (at-least-once), and expiry
+            # keeps protecting it while a reader may still be on it
+            ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, planned)
         return splits
 
     def _starting_plan(self) -> list[DataSplit] | None:
@@ -178,6 +199,16 @@ class StreamTableScan:
         from ..core.snapshot import CommitKind
         from ..options import ChangelogProducer
 
+        if self.scan_mode == "file-monitor":
+            # compactor sources: raw delta files of EVERY snapshot, compaction
+            # included — no changelog interpretation (reference
+            # StreamScanMode.FILE_MONITOR)
+            return self._raw_delta_splits(snapshot_id)
+        if snap.commit_kind == CommitKind.OVERWRITE:
+            if self.store.options.options.get(CoreOptions.STREAMING_READ_OVERWRITE):
+                # surface the overwrite's new content as the change stream
+                return self._raw_delta_splits(snapshot_id)
+            return []
         producer = self.store.options.changelog_producer
         if producer in (ChangelogProducer.INPUT, ChangelogProducer.LOOKUP):
             # input: raw +I/-U/+U/-D input rides APPEND snapshots;
@@ -207,6 +238,14 @@ class StreamTableScan:
                     )
                 )
         return out
+
+    def _raw_delta_splits(self, snapshot_id: int) -> list[DataSplit]:
+        plan = self.store.new_scan().with_snapshot(snapshot_id).with_kind("delta").plan()
+        return [
+            DataSplit(partition, bucket, files, snapshot_id, raw_convertible=True)
+            for partition, buckets in sorted(plan.grouped().items())
+            for bucket, files in sorted(buckets.items())
+        ]
 
     def _changelog_splits(self, snapshot_id: int) -> list[DataSplit]:
         plan = self.store.new_scan().with_snapshot(snapshot_id).with_kind("changelog").plan()
