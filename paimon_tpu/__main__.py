@@ -47,9 +47,15 @@ def main(argv=None) -> int:
         "sync_table",
         "create_branch",
         "fast_forward",
+        "clone",
+        "compact_database",
+        "reset_consumer",
+        "expire_partitions",
+        "drop_partition",
+        "mark_partition_done",
     ):
         p = sub.add_parser(name.replace("_", "-"))
-        if name != "migrate_table":
+        if name not in ("migrate_table", "clone", "compact_database"):
             _add_common(p)
         if name == "compact":
             p.add_argument("--full", action="store_true")
@@ -81,9 +87,103 @@ def main(argv=None) -> int:
             p.add_argument("--input", default="-", help="file of json messages (- = stdin)")
         elif name in ("create_branch", "fast_forward"):
             p.add_argument("--branch", required=True)
+        elif name == "clone":
+            p.add_argument("--warehouse", required=True, help="source warehouse")
+            p.add_argument("--database", default=None, help="source database (omit = all)")
+            p.add_argument("--table", default=None, help="source table (omit = whole database)")
+            p.add_argument("--target-warehouse", required=True)
+            p.add_argument("--target-database", default=None)
+            p.add_argument("--target-table", default=None)
+            p.add_argument("--tag", default=None, help="clone this tag's snapshot")
+            p.add_argument("--branch", default=None, help="clone from this branch")
+            p.add_argument("--parallelism", type=int, default=8)
+            p.add_argument("--user", default="cli")
+        elif name == "compact_database":
+            p.add_argument("--warehouse", required=True)
+            p.add_argument("--including-databases", default=None, help="regex (default .*)")
+            p.add_argument("--including-tables", default=None, help="regex (default .*)")
+            p.add_argument("--excluding-tables", default=None, help="regex")
+            p.add_argument("--full", action="store_true")
+            p.add_argument("--user", default="cli")
+        elif name == "reset_consumer":
+            p.add_argument("--consumer-id", required=True)
+            p.add_argument("--next-snapshot", type=int, default=None, help="omit = delete consumer")
+        elif name == "expire_partitions":
+            p.add_argument("--expiration-time-hours", type=float, required=True)
+            p.add_argument("--timestamp-formatter", default="%Y-%m-%d")
+            p.add_argument("--time-col", default=None, help="partition key holding the timestamp")
+        elif name == "drop_partition":
+            p.add_argument("--partition", required=True, action="append",
+                           help="k=v[,k=v...] (repeatable)")
+        elif name == "mark_partition_done":
+            p.add_argument("--partition", required=True, action="append",
+                           help="k=v[,k=v...] (repeatable)")
 
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
+
+    if action == "clone":
+        from .catalog import FileSystemCatalog
+        from .table import clone as C
+
+        if not args.table and (args.tag or args.branch or args.target_table):
+            ap.error("--tag/--branch/--target-table require --table")
+        if not args.database and args.target_database:
+            ap.error("--target-database requires --database")
+        src_cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
+        dst_cat = FileSystemCatalog(args.target_warehouse, commit_user=args.user)
+        if args.table:
+            if not args.database:
+                ap.error("--table requires --database")
+            t = src_cat.get_table(f"{args.database}.{args.table}")
+            sid = None
+            if args.branch:
+                from .table.branch import branch_table
+
+                t = branch_table(t, args.branch)
+            if args.tag:
+                from .table.tags import TagManager
+
+                sid = TagManager(t.file_io, t.path).snapshot_id(args.tag)
+            target = f"{args.target_database or args.database}.{args.target_table or args.table}"
+            C.clone_table(t, dst_cat, target, snapshot_id=sid, parallelism=args.parallelism)
+            cloned = [target]
+        elif args.database:
+            cloned = C.clone_database(
+                src_cat, args.database, dst_cat, args.target_database, parallelism=args.parallelism
+            )
+        else:
+            cloned = C.clone_warehouse(src_cat, dst_cat, parallelism=args.parallelism)
+        print(json.dumps({"cloned": cloned}))
+        return 0
+
+    if action == "compact_database":
+        import re
+
+        from .catalog import FileSystemCatalog
+        from .table.compactor import DedicatedCompactor
+
+        cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
+        db_pat = re.compile(args.including_databases or ".*")
+        inc = re.compile(args.including_tables or ".*")
+        exc = re.compile(args.excluding_tables) if args.excluding_tables else None
+        compacted = []
+        for db in cat.list_databases():
+            if not db_pat.fullmatch(db):
+                continue
+            for name in cat.list_tables(db):
+                full = f"{db}.{name}"
+                if not inc.fullmatch(full) and not inc.fullmatch(name):
+                    continue
+                if exc and (exc.fullmatch(full) or exc.fullmatch(name)):
+                    continue
+                t = cat.get_table(full)
+                if not t.primary_keys:
+                    continue  # reference: only changelog tables in DIVIDED mode
+                if DedicatedCompactor(t).run_once(full=args.full):
+                    compacted.append(full)
+        print(json.dumps({"compacted": compacted, "full": args.full}))
+        return 0
 
     if action == "migrate_table":
         import glob
@@ -169,6 +269,38 @@ def main(argv=None) -> int:
         with ctx as source:
             n = stream.ingest(line for line in source if line.strip())
         print(json.dumps({"records_applied": n}))
+    elif action == "reset_consumer":
+        from .table.consumer import ConsumerManager
+
+        cm = ConsumerManager(t.file_io, t.path)
+        if args.next_snapshot is None:
+            cm.delete(args.consumer_id)
+            print(json.dumps({"deleted_consumer": args.consumer_id}))
+        else:
+            cm.reset(args.consumer_id, args.next_snapshot)
+            print(json.dumps({"consumer": args.consumer_id, "next_snapshot": args.next_snapshot}))
+    elif action == "expire_partitions":
+        from .table.maintenance import expire_partitions
+
+        expired = expire_partitions(
+            t,
+            int(args.expiration_time_hours * 3600_000),
+            time_col=args.time_col,
+            pattern=args.timestamp_formatter,
+        )
+        print(json.dumps({"expired_partitions": [list(p) for p in expired]}))
+    elif action == "drop_partition":
+        from .table.maintenance import drop_partition
+
+        specs = [dict(kv.split("=", 1) for kv in s.split(",")) for s in args.partition]
+        dropped = [list(p) for p in drop_partition(t, *specs)]  # one atomic commit
+        print(json.dumps({"dropped_partitions": dropped}))
+    elif action == "mark_partition_done":
+        from .table.maintenance import mark_partition_done
+
+        specs = [dict(kv.split("=", 1) for kv in s.split(",")) for s in args.partition]
+        paths = mark_partition_done(t, specs)
+        print(json.dumps({"markers": paths}))
     elif action == "create_branch":
         from .table.branch import BranchManager
 

@@ -17,7 +17,7 @@ from ..utils import now_millis
 if TYPE_CHECKING:
     from . import FileStoreTable
 
-__all__ = ["remove_orphan_files", "expire_partitions"]
+__all__ = ["remove_orphan_files", "expire_partitions", "drop_partition", "mark_partition_done"]
 
 
 def remove_orphan_files(table: "FileStoreTable", older_than_millis: int = 24 * 3600_000, dry_run: bool = False) -> list[str]:
@@ -95,6 +95,8 @@ def expire_partitions(table: "FileStoreTable", expiration_millis: int, time_col:
     if not keys:
         return []
     col = time_col or keys[0]
+    if col not in keys:
+        raise ValueError(f"time_col {col!r} is not a partition key (have {keys})")
     idx = keys.index(col)
     cutoff = now_millis() - expiration_millis
     store = table.store
@@ -108,11 +110,77 @@ def expire_partitions(table: "FileStoreTable", expiration_millis: int, time_col:
             continue
         if ts < cutoff:
             expired.append(partition)
-    if expired:
-        dead = set(expired)
-        commit = store.new_commit()
-        commit.overwrite(
-            ManifestCommittable((1 << 63) - 4, messages=[]),
-            partition_filter=lambda p: p in dead,
-        )
+    _commit_partition_drop(store, expired)
     return expired
+
+
+def _commit_partition_drop(store, partitions: list[tuple]) -> None:
+    """One OVERWRITE commit logically deleting the given partitions (shared
+    by expire_partitions and drop_partition; identifier is the maintenance
+    sentinel — see core/commit.py batch-commit sentinels)."""
+    if not partitions:
+        return
+    dead = set(partitions)
+    store.new_commit().overwrite(
+        ManifestCommittable((1 << 63) - 4, messages=[]),
+        partition_filter=lambda p: p in dead,
+    )
+
+
+def drop_partition(table: "FileStoreTable", *specs: dict[str, str]) -> list[tuple]:
+    """Logically delete all partitions matching ANY of `specs` (each a
+    possibly-partial, non-empty {partition_key: value} map) in ONE OVERWRITE
+    commit — a reader never observes a partially-dropped state. Reference:
+    flink/action/DropPartitionAction.java -> FileStoreCommit.dropPartitions.
+    Returns the dropped partition tuples."""
+    keys = table.partition_keys
+    if not keys:
+        raise ValueError("drop_partition requires a partitioned table")
+    if not specs or any(not s for s in specs):
+        raise ValueError("each partition spec must name at least one key=value")
+    compiled = []
+    for spec in specs:
+        unknown = set(spec) - set(keys)
+        if unknown:
+            raise ValueError(f"not partition keys: {sorted(unknown)} (have {keys})")
+        compiled.append([(keys.index(k), str(v)) for k, v in spec.items()])
+    store = table.store
+    plan = store.new_scan().plan()
+    dead = [
+        p
+        for p in plan.grouped()
+        if any(all(str(p[i]) == v for i, v in positions) for positions in compiled)
+    ]
+    _commit_partition_drop(store, dead)
+    return dead
+
+
+def mark_partition_done(table: "FileStoreTable", specs: list[dict[str, str]]) -> list[str]:
+    """Write a _SUCCESS marker in each partition directory (reference
+    flink/action/MarkPartitionDoneAction.java, success-file mode of
+    partition.mark-done-action): downstream schedulers poll the marker to
+    know the partition stopped receiving data. Marker content matches the
+    reference's SuccessFile JSON ({creationTime, modificationTime})."""
+    from ..utils import dumps, partition_path
+
+    keys = table.partition_keys
+    if not keys:
+        raise ValueError("mark_partition_done requires a partitioned table")
+    out = []
+    for spec in specs:
+        missing = [k for k in keys if k not in spec]
+        if missing:
+            raise ValueError(f"partition spec {spec} missing keys {missing}")
+        pp = partition_path(keys, tuple(spec[k] for k in keys))
+        path = f"{table.path}/{pp}/_SUCCESS"
+        now = now_millis()
+        try:
+            prev = table.file_io.read_bytes(path)
+            from ..utils import loads
+
+            created = loads(prev).get("creationTime", now)
+        except (FileNotFoundError, OSError, ValueError):
+            created = now
+        table.file_io.try_overwrite(path, dumps({"creationTime": created, "modificationTime": now}).encode())
+        out.append(path)
+    return out
