@@ -78,16 +78,57 @@ def make_lanes(n: int, k: int, s: int, dup_factor: int = 4, seed: int = 7):
     )
 
 
-def time_kernel(fn, args, n_rows: int, iters: int = 6) -> float:
+def _chained(inner, chain_iters: int):
+    """K data-dependent kernel invocations inside ONE jit: each iteration's
+    keys are perturbed by the previous iteration's (data-dependent) count, so
+    the device MUST run them sequentially and cannot reuse a cached result.
+    One dispatch + one sync amortizes the tunnel RTT over K real executions —
+    naive per-call block_until_ready timing on this remote platform returned
+    ~50 us/call, far below the link RTT, i.e. it measured dispatch, not
+    execution."""
     import jax
+    import jax.numpy as jnp
 
-    jax.block_until_ready(fn(*args))  # compile + warm
+    @jax.jit
+    def f(key_lanes, seq_lanes, pad_flag, *extra):
+        def body(_, carry):
+            salt, acc = carry
+            kl = key_lanes ^ salt  # cheap dependency; keeps dtype + distribution
+            out = inner(kl, seq_lanes, pad_flag, *extra)
+            count = out[-1]  # every kernel returns (..., count)
+            c = count.astype(jnp.uint32)
+            return c % jnp.uint32(2), acc + c
+
+        salt, acc = jax.lax.fori_loop(0, chain_iters, body, (jnp.uint32(0), jnp.uint32(0)))
+        return acc
+
+    return f
+
+
+def _timed_value(fn, args, reps: int) -> float:
+    """Best seconds-to-scalar-VALUE over reps. On the axon tunnel
+    block_until_ready returns ~0.1 ms for an 11 ms matmul (it does not
+    block); only fetching a literal value synchronizes, so we time to
+    float(result)."""
     best = float("inf")
-    for _ in range(iters):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        float(fn(*args))  # value fetch = real sync on remote platforms
         best = min(best, time.perf_counter() - t0)
-    return n_rows / best
+    return best
+
+
+def time_kernel(inner, args, n_rows: int, k_lo: int = 4, k_hi: int = 32, reps: int = 3) -> float:
+    """rows/s from the SLOPE between a short and a long kernel chain:
+    t(K) ~= overhead + K * t_kernel, so t_kernel = (t(k_hi) - t(k_lo)) /
+    (k_hi - k_lo). The intercept absorbs the tunnel RTT + dispatch, which
+    dwarf a single kernel on this rig."""
+    f_lo, f_hi = _chained(inner, k_lo), _chained(inner, k_hi)
+    float(f_lo(*args)), float(f_hi(*args))  # compile + warm both
+    t_lo = _timed_value(f_lo, args, reps)
+    t_hi = _timed_value(f_hi, args, reps)
+    t_kernel = max((t_hi - t_lo) / (k_hi - k_lo), 1e-9)
+    return n_rows / t_kernel
 
 
 def bench_dedup(n: int, k: int, s: int, backend: str):

@@ -123,6 +123,24 @@ class FileSystemCatalog(Catalog):
         sm = SchemaManager(self.file_io, path)
         if sm.latest() is not None and not ignore_if_exists:
             raise ValueError(f"table {ident} exists")
+        # reference CoreOptions.PRIMARY_KEY / PARTITION: constraints defined
+        # via options when the creating surface cannot express them — and
+        # rejected when BOTH forms are given
+        options = dict(options or {})
+        for opt_key, arg, label in (
+            ("primary-key", primary_keys, "primary key"),
+            ("partition", partition_keys, "partition"),
+        ):
+            if opt_key in options:
+                from_opt = [c.strip() for c in options.pop(opt_key).split(",") if c.strip()]
+                if arg:
+                    raise ValueError(
+                        f"cannot define {label} both explicitly and via the {opt_key!r} option"
+                    )
+                if opt_key == "primary-key":
+                    primary_keys = from_opt
+                else:
+                    partition_keys = from_opt
         schema = sm.create_table(row_type, partition_keys, primary_keys, options)
         return FileStoreTable(self.file_io, path, schema, self.commit_user)
 

@@ -120,7 +120,12 @@ class AppendOnlyWriter:
         self._buffered_bytes = 0
         self._spill = None
         self._io_manager = None
-        if options.options.get(CoreOptions.WRITE_BUFFER_SPILLABLE):
+        # write-buffer-for-append turns on the buffered+spillable append path
+        # even without the generic write-buffer-spillable switch (reference:
+        # append writers only use a write buffer when this is set)
+        if options.options.get(CoreOptions.WRITE_BUFFER_SPILLABLE) or options.options.get(
+            CoreOptions.WRITE_BUFFER_FOR_APPEND
+        ):
             from .disk import IOManager, SpillableBuffer
 
             self._io_manager = IOManager()
@@ -128,6 +133,7 @@ class AppendOnlyWriter:
                 self._io_manager,
                 in_memory_rows=options.options.get(CoreOptions.WRITE_BUFFER_SPILL_ROWS),
                 in_memory_bytes=int(options.options.get(CoreOptions.WRITE_BUFFER_SPILL_SIZE)),
+                max_disk_bytes=int(options.options.get(CoreOptions.WRITE_BUFFER_SPILL_MAX_DISK_SIZE)),
             )
         self._new_files: list[DataFileMeta] = []
         self._compact_before: list[DataFileMeta] = []
@@ -148,6 +154,7 @@ class AppendOnlyWriter:
         if (
             self._buffered_rows >= self.options.write_buffer_rows
             or self._buffered_bytes >= self.options.write_buffer_size
+            or (self._spill is not None and self._spill.disk_full)
         ):
             self.flush()
 

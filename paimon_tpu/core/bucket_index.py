@@ -53,10 +53,42 @@ class SimpleHashBucketAssigner:
     """Single-writer assigner (reference SimpleHashBucketAssigner): suitable
     whenever one process owns all buckets of the partitions it writes."""
 
-    def __init__(self, index_file: HashIndexFile, target_bucket_rows: int):
+    def __init__(
+        self,
+        index_file: HashIndexFile,
+        target_bucket_rows: int,
+        initial_buckets: int | None = None,
+        assign_id: int = 0,
+        num_assigners: int = 1,
+    ):
         self.index_file = index_file
         self.target = target_bucket_rows
+        # dynamic-bucket.initial-buckets: new keys round-robin across this
+        # many buckets from the start (write parallelism before any bucket
+        # fills); dynamic-bucket.assigner-parallelism: this assigner only
+        # creates buckets striped bucket % num_assigners == assign_id
+        # (reference HashBucketAssigner.assignBucket)
+        self.initial_buckets = initial_buckets
+        self.assign_id = assign_id
+        self.num_assigners = max(1, num_assigners)
         self._partitions: dict[tuple, _PartitionIndex] = {}
+        self._rr: dict[tuple, int] = {}  # per-partition round-robin cursor
+
+    def _allocate_new(self, partition: tuple, counts: dict[int, int]) -> int:
+        """Bucket for a brand-new key: striped to this assigner, round-robin
+        over the initial window while any of it has room, then growing."""
+        p = self.num_assigners
+        width = max(1, ((self.initial_buckets or 1) + p - 1) // p)
+        rr = self._rr.get(partition, 0)
+        base = 0
+        while True:
+            window = [self.assign_id + (base + j) * p for j in range(width)]
+            open_ = [b for b in window if counts.get(b, 0) < self.target]
+            if open_:
+                b = open_[rr % len(open_)]
+                self._rr[partition] = rr + 1
+                return b
+            base += width
 
     def bootstrap(self, partition: tuple, bucket_indexes: dict[int, np.ndarray]) -> None:
         self._partitions[partition] = _PartitionIndex(
@@ -84,12 +116,10 @@ class SimpleHashBucketAssigner:
             uniq, inv = np.unique(hashes[missing], return_inverse=True)
             alloc = np.empty(len(uniq), dtype=np.int32)
             counts = {b: len(hs) for b, hs in pi.buckets.items()}
-            cursor = 0
             for i in range(len(uniq)):
-                while counts.get(cursor, 0) >= self.target:
-                    cursor += 1
-                alloc[i] = cursor
-                counts[cursor] = counts.get(cursor, 0) + 1
+                b = self._allocate_new(partition, counts)
+                alloc[i] = b
+                counts[b] = counts.get(b, 0) + 1
             out[missing] = alloc[inv]
             for b in np.unique(alloc):
                 new_hashes = uniq[alloc == b]

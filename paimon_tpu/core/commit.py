@@ -238,7 +238,13 @@ class FileStoreCommit:
             return latest.index_manifest if latest else None
         replaced = {(e.partition, e.bucket, e.kind) for e in index_entries}
         out = []
-        dv_io = DeletionVectorsIndexFile(self.file_io, self.table_path)
+        dv_io = DeletionVectorsIndexFile(
+            self.file_io,
+            self.table_path,
+            target_size=int(
+                self.options.options.get(CoreOptions.DELETION_VECTOR_INDEX_FILE_TARGET_SIZE)
+            ),
+        )
         for e in prev:
             if (e.partition, e.bucket, e.kind) in replaced:
                 continue
@@ -371,22 +377,48 @@ class FileStoreCommit:
         self, metas: list[ManifestFileMeta], tmp_files: list[str]
     ) -> list[ManifestFileMeta]:
         """Compact many small manifests into fewer big ones (reference
-        ManifestFileMeta.merge at commit :843-852)."""
+        ManifestFileMeta.merge at commit :843-852). Two triggers:
+        - count: >= manifest.merge-min-count small manifests merge together
+          (DELETE entries survive — older manifests may still reference them)
+        - size (full compaction, reference manifest.full-compaction-threshold-size):
+          once the small/unmerged manifests exceed the threshold bytes, ALL
+          manifests rewrite into fresh compacted ones; with the whole history
+          merged, DELETE entries resolve away entirely."""
         min_count = self.options.options.get(CoreOptions.MANIFEST_MERGE_MIN_COUNT)
         target = int(self.options.options.get(CoreOptions.MANIFEST_TARGET_SIZE))
+        full_threshold = int(
+            self.options.options.get(CoreOptions.MANIFEST_FULL_COMPACTION_THRESHOLD_SIZE)
+        )
         small = [m for m in metas if m.file_size < target]
-        if len(small) < min_count:
+        total_bytes = sum(m.file_size for m in metas)
+        # convergence guard: a full compaction's own output is ~ideal_chunks
+        # manifests; only re-trigger when the history is genuinely fragmented
+        # beyond that, or every commit would rewrite everything (quadratic)
+        ideal_chunks = max(1, -(-total_bytes // target))
+        fragmented = len(metas) > 2 * ideal_chunks
+        if small and fragmented and sum(m.file_size for m in small) >= full_threshold:
+            entries = merge_entries(*(self.manifest_file.read(m.file_name) for m in metas))
+            out, small, big = [], [], []  # rewrite everything below
+        elif len(small) < min_count:
             return metas
-        big = [m for m in metas if m.file_size >= target]
-        entries = merge_entries_keep_deletes(*(self.manifest_file.read(m.file_name) for m in small))
-        out = list(big)
+        else:
+            big = [m for m in metas if m.file_size >= target]
+            entries = merge_entries_keep_deletes(*(self.manifest_file.read(m.file_name) for m in small))
+            out = list(big)
         if entries:
-            # chunk to roughly target size (estimate ~400 compressed bytes/entry)
-            per_file = max(1, target // 400)
-            for i in range(0, len(entries), per_file):
-                meta = self.manifest_file.write(entries[i : i + per_file], self.schema_id)
+            # chunk to target size with an ADAPTIVE bytes/entry estimate:
+            # after each write the measured size corrects the next chunk, so
+            # outputs land near target regardless of compression ratio
+            per_entry = 400.0
+            i = 0
+            while i < len(entries):
+                per_file = max(1, int(target / per_entry))
+                chunk = entries[i : i + per_file]
+                meta = self.manifest_file.write(chunk, self.schema_id)
                 tmp_files.append(meta.file_name)
                 out.append(meta)
+                per_entry = max(1.0, meta.file_size / max(len(chunk), 1))
+                i += len(chunk)
         return out
 
     def _cleanup(self, names: list[str]) -> None:

@@ -10,6 +10,7 @@ Parity: /root/reference/paimon-core/.../io/ —
 
 from __future__ import annotations
 
+import base64
 from dataclasses import dataclass, field, replace
 from typing import Iterator, Sequence
 
@@ -44,6 +45,7 @@ class DataFileMeta:
     creation_time_millis: int = 0
     file_source: str = "append"  # append | compact
     extra_files: tuple[str, ...] = ()
+    embedded_index: bytes | None = None  # small PTIX payload carried in the manifest
 
     def upgrade(self, level: int) -> "DataFileMeta":
         return replace(self, level=level)
@@ -65,6 +67,13 @@ class DataFileMeta:
             "creationTimeMillis": self.creation_time_millis,
             "fileSource": self.file_source,
             "extraFiles": list(self.extra_files),
+            # base64 so the meta stays JSON-serializable (reference
+            # DataFileMeta.embeddedIndex, file-index.in-manifest-threshold)
+            "embeddedIndex": (
+                None
+                if self.embedded_index is None
+                else base64.b64encode(self.embedded_index).decode()
+            ),
         }
 
     @staticmethod
@@ -85,6 +94,11 @@ class DataFileMeta:
             d.get("creationTimeMillis", 0),
             d.get("fileSource", "append"),
             tuple(d.get("extraFiles", ())),
+            (
+                None
+                if d.get("embeddedIndex") is None
+                else base64.b64decode(d["embeddedIndex"])
+            ),
         )
 
 
@@ -112,6 +126,7 @@ class KeyValueFileWriterFactory:
         target_file_size: int = 128 << 20,
         bloom_columns: Sequence[str] = (),
         bloom_fpp: float = 0.05,
+        index_in_manifest_threshold: int = 500,
         keyed: bool = True,
         format_options: dict | None = None,
         include_key_columns: bool = False,
@@ -128,6 +143,7 @@ class KeyValueFileWriterFactory:
         self.target_file_size = target_file_size
         self.bloom_columns = list(bloom_columns)
         self.bloom_fpp = bloom_fpp
+        self.index_in_manifest_threshold = index_in_manifest_threshold
         # keyed=False: append-only tables — plain rows on disk, no
         # _SEQUENCE_NUMBER/_VALUE_KIND columns, no key range
         # (reference AppendOnlyFileStore / AppendOnlyWriter)
@@ -153,15 +169,19 @@ class KeyValueFileWriterFactory:
 
     def write(
         self, kv: KVBatch, level: int, file_source: str = "append", prefix: str = "data",
-        sorted_input: bool = True,
+        sorted_input: bool = True, measured_row_bytes: float | None = None,
     ) -> list[DataFileMeta]:
         """Rolls into multiple files at target size. Input must be key-sorted
         unless sorted_input=False (changelog files preserve event order; key
-        min/max are then computed instead of taken from the edges)."""
+        min/max are then computed instead of taken from the edges).
+        measured_row_bytes overrides the schema-based width estimate (callers
+        with skewed var-length data pass actual bytes — the reference's
+        sort-compaction.range-strategy=size)."""
         n = kv.num_rows
         if n == 0:
             return []
-        rows_per_file = max(1, self.target_file_size // self._estimate_row_bytes(kv.data))
+        row_bytes = measured_row_bytes or self._estimate_row_bytes(kv.data)
+        rows_per_file = max(1, int(self.target_file_size / max(row_bytes, 1)))
         out: list[DataFileMeta] = []
         for start in range(0, n, rows_per_file):
             out.append(
@@ -197,12 +217,19 @@ class KeyValueFileWriterFactory:
         disk = kv.to_disk_batch(key_cols) if self.keyed else kv.data
         fmt.write(self.file_io, path, disk, compression, format_options=self.format_options)
         extra: list[str] = []
+        embedded: bytes | None = None
         if self.bloom_columns:
-            from ..format.fileindex import write_file_index
+            from ..format.fileindex import build_index_payload, index_path
 
-            idx = write_file_index(self.file_io, path, kv.data, self.bloom_columns, self.bloom_fpp)
-            if idx:
-                extra.append(name + ".index")
+            payload = build_index_payload(kv.data, self.bloom_columns, self.bloom_fpp)
+            if payload is not None:
+                if len(payload) <= self.index_in_manifest_threshold:
+                    # small index rides in the manifest entry: zero extra
+                    # opens per file per scan (reference in-manifest-threshold)
+                    embedded = payload
+                else:
+                    self.file_io.write_bytes(index_path(path), payload, overwrite=True)
+                    extra.append(name + ".index")
         value_stats = collect_stats(kv.data)
         key_stats = {k: value_stats[k] for k in self.key_names}
         delete_rows = int(np.isin(kv.kind, (int(RowKind.DELETE),)).sum())
@@ -222,6 +249,7 @@ class KeyValueFileWriterFactory:
             creation_time_millis=now_millis(),
             file_source=file_source,
             extra_files=tuple(extra),
+            embedded_index=embedded,
         )
 
 

@@ -96,40 +96,74 @@ class IndexFileEntry:
 class DeletionVectorsIndexFile:
     """Reads/writes the packed DV container in the table's index/ dir."""
 
-    def __init__(self, file_io: FileIO, table_path: str):
+    def __init__(self, file_io: FileIO, table_path: str, target_size: int = 2 << 20):
         self.file_io = file_io
         self.index_dir = f"{table_path}/index"
+        # deletion-vector.index-file.target-size: containers roll at this
+        # size into a chain (header carries "__next__"); callers keep the
+        # single-name contract, readers follow the chain
+        self.target_size = max(1, target_size)
 
     def write(self, dvs: Mapping[str, DeletionVector]) -> tuple[str, int]:
-        header: dict = {}
-        blobs: list[bytes] = []
-        offset = 0
-        total = 0
-        for data_file, dv in sorted(dvs.items()):
+        items = sorted(dvs.items())
+        total = sum(dv.cardinality for _, dv in dvs.items())
+        chunks: list[list] = [[]]
+        size = 0
+        for data_file, dv in items:
             blob = dv.to_bytes()
-            header[data_file] = {"offset": offset, "length": len(blob), "cardinality": dv.cardinality}
-            blobs.append(blob)
-            offset += len(blob)
-            total += dv.cardinality
-        hdr = json.dumps(header).encode()
-        payload = _MAGIC + struct.pack("<I", len(hdr)) + hdr + b"".join(blobs)
-        name = new_file_name("index")
-        self.file_io.write_bytes(f"{self.index_dir}/{name}", payload)
-        return name, total
+            if size and size + len(blob) > self.target_size:
+                chunks.append([])
+                size = 0
+            chunks[-1].append((data_file, blob, dv.cardinality))
+            size += len(blob)
+        next_name: str | None = None
+        for chunk in reversed(chunks):  # write tail first to know its name
+            header: dict = {}
+            blobs: list[bytes] = []
+            offset = 0
+            for data_file, blob, card in chunk:
+                header[data_file] = {"offset": offset, "length": len(blob), "cardinality": card}
+                blobs.append(blob)
+                offset += len(blob)
+            if next_name is not None:
+                header["__next__"] = next_name
+            hdr = json.dumps(header).encode()
+            payload = _MAGIC + struct.pack("<I", len(hdr)) + hdr + b"".join(blobs)
+            next_name = new_file_name("index")
+            self.file_io.write_bytes(f"{self.index_dir}/{next_name}", payload)
+        return next_name, total
 
-    def read_all(self, name: str) -> dict[str, DeletionVector]:
-        data = self.file_io.read_bytes(f"{self.index_dir}/{name}")
-        assert data[:4] == _MAGIC, "bad deletion-vector index magic"
-        (hlen,) = struct.unpack("<I", data[4:8])
-        header = json.loads(data[8 : 8 + hlen])
-        blob = data[8 + hlen :]
-        out = {}
-        for data_file, meta in header.items():
-            out[data_file] = DeletionVector.from_bytes(blob[meta["offset"] : meta["offset"] + meta["length"]])
+    def read_all(self, name: str | None) -> dict[str, DeletionVector]:
+        out: dict[str, DeletionVector] = {}
+        while name is not None:
+            data = self.file_io.read_bytes(f"{self.index_dir}/{name}")
+            assert data[:4] == _MAGIC, "bad deletion-vector index magic"
+            (hlen,) = struct.unpack("<I", data[4:8])
+            header = json.loads(data[8 : 8 + hlen])
+            blob = data[8 + hlen :]
+            name = header.pop("__next__", None)
+            for data_file, meta in header.items():
+                out[data_file] = DeletionVector.from_bytes(
+                    blob[meta["offset"] : meta["offset"] + meta["length"]]
+                )
         return out
 
     def delete(self, name: str) -> None:
         self.file_io.delete(f"{self.index_dir}/{name}")
+
+    def chain_names(self, name: str) -> list[str]:
+        """All container files of a chain starting at `name` (for cleaners
+        and cloners, which must treat the chain as one logical file)."""
+        out = []
+        while name is not None:
+            out.append(name)
+            try:
+                data = self.file_io.read_bytes(f"{self.index_dir}/{name}")
+                (hlen,) = struct.unpack("<I", data[4:8])
+                name = json.loads(data[8 : 8 + hlen]).get("__next__")
+            except (FileNotFoundError, OSError):
+                break
+        return out
 
 
 class DeletionVectorsMaintainer:

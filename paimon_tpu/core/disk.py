@@ -40,16 +40,28 @@ class SpillableBuffer:
     insertion order (reference ExternalBuffer semantics)."""
 
     def __init__(
-        self, io_manager: IOManager, in_memory_rows: int = 1 << 20, in_memory_bytes: int = 64 << 20
+        self,
+        io_manager: IOManager,
+        in_memory_rows: int = 1 << 20,
+        in_memory_bytes: int = 64 << 20,
+        max_disk_bytes: int | None = None,
     ):
         self.io_manager = io_manager
         self.in_memory_rows = in_memory_rows
         self.in_memory_bytes = in_memory_bytes
+        # write-buffer-spill.max-disk-size: past this, add() stops spilling
+        # (disk_full flips True) so the owner flushes instead
+        self.max_disk_bytes = max_disk_bytes
         self._memory: list[ColumnBatch] = []
         self._memory_rows = 0
         self._memory_bytes = 0
         self._spilled: list[str] = []
         self._spilled_rows = 0
+        self._spilled_disk_bytes = 0
+
+    @property
+    def disk_full(self) -> bool:
+        return self.max_disk_bytes is not None and self._spilled_disk_bytes >= self.max_disk_bytes
 
     @property
     def num_rows(self) -> int:
@@ -65,7 +77,9 @@ class SpillableBuffer:
         self._memory.append(batch)
         self._memory_rows += batch.num_rows
         self._memory_bytes += batch.byte_size()
-        if self._memory_rows > self.in_memory_rows or self._memory_bytes > self.in_memory_bytes:
+        if (
+            self._memory_rows > self.in_memory_rows or self._memory_bytes > self.in_memory_bytes
+        ) and not self.disk_full:
             self._spill()
 
     def _spill(self) -> None:
@@ -82,6 +96,7 @@ class SpillableBuffer:
         self._spilled.append(path)
         self._schema = self._memory[0].schema
         self._spilled_rows += self._memory_rows
+        self._spilled_disk_bytes += os.path.getsize(path)
         self._memory.clear()
         self._memory_bytes = 0
         self._memory_rows = 0
@@ -104,6 +119,7 @@ class SpillableBuffer:
                 pass
         self._spilled.clear()
         self._spilled_rows = 0
+        self._spilled_disk_bytes = 0
         self._memory.clear()
         self._memory_rows = 0
         self._memory_bytes = 0

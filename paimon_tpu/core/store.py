@@ -111,6 +111,9 @@ class KeyValueFileStore:
             target_file_size=co.target_file_size,
             bloom_columns=[c.strip() for c in bloom_cols.split(",")] if bloom_cols else (),
             bloom_fpp=co.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+            index_in_manifest_threshold=int(
+                co.options.get(CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD)
+            ),
             keyed=self.keyed,
             format_options=format_options,
             include_key_columns=co.options.get(CoreOptions.DATA_FILE_INCLUDE_KEY_COLUMNS),
@@ -192,7 +195,17 @@ class KeyValueFileStore:
                 wf,
                 merge,
                 deletion_vectors=dvs,
-                emit_full_changelog=self.options.changelog_producer == ChangelogProducer.FULL_COMPACTION,
+                emit_full_changelog=(
+                    self.options.changelog_producer == ChangelogProducer.FULL_COMPACTION
+                    or (
+                        # lookup producer with lookup-wait=false: changelog
+                        # production deferred to compaction (writer skips it)
+                        self.options.changelog_producer == ChangelogProducer.LOOKUP
+                        and not self.options.options.get(
+                            CoreOptions.CHANGELOG_PRODUCER_LOOKUP_WAIT
+                        )
+                    )
+                ),
                 row_deduplicate=self.options.options.get(CoreOptions.CHANGELOG_PRODUCER_ROW_DEDUPLICATE),
                 expire_predicate=self.record_expire_predicate(),
             )

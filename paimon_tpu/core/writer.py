@@ -134,13 +134,19 @@ class MergeTreeWriter:
         from ..options import ChangelogProducer
 
         producer = self.options.changelog_producer
-        if producer == ChangelogProducer.LOOKUP:
+        from ..options import CoreOptions
+
+        lookup_wait = self.options.options.get(CoreOptions.CHANGELOG_PRODUCER_LOOKUP_WAIT)
+        if producer == ChangelogProducer.LOOKUP and lookup_wait:
             # exact changelog at WRITE time: look up the previous visible
             # value of each incoming key (reference LookupChangelogMerge-
             # FunctionWrapper / LookupMergeTreeCompactRewriter — here the
             # "lookup" is a vectorized merge-read of the overlapping files
             # diffed against the new state with the same kernel as the
-            # full-compaction producer)
+            # full-compaction producer).  changelog-producer.lookup-wait=false
+            # defers production to the next compaction (store.py arms the
+            # compaction rewriter's changelog emitter for that case) so the
+            # commit never waits on the lookup.
             cl = self._lookup_changelog(merged, buffer_seq_ordered)
             if cl.num_rows:
                 self._changelog.extend(

@@ -115,14 +115,12 @@ def index_path(data_file_path: str) -> str:
     return data_file_path + ".index"
 
 
-def write_file_index(
-    file_io: FileIO,
-    data_file_path: str,
-    batch: ColumnBatch,
-    columns: Sequence[str],
-    fpp: float = 0.05,
-) -> str | None:
-    """Build bloom indexes for `columns` of this file; returns sidecar path."""
+def build_index_payload(
+    batch: ColumnBatch, columns: Sequence[str], fpp: float = 0.05
+) -> bytes | None:
+    """The PTIX container bytes for `columns`, or None when nothing to index.
+    Callers decide placement: sidecar file, or embedded in the manifest entry
+    when small (reference file-index.in-manifest-threshold)."""
     cols = [c for c in columns if c in batch.schema]
     if not cols or batch.num_rows == 0:
         return None
@@ -147,7 +145,20 @@ def write_file_index(
         blobs.append(blob)
         offset += len(blob)
     hdr = json.dumps(header).encode()
-    payload = _MAGIC + struct.pack("<I", len(hdr)) + hdr + b"".join(blobs)
+    return _MAGIC + struct.pack("<I", len(hdr)) + hdr + b"".join(blobs)
+
+
+def write_file_index(
+    file_io: FileIO,
+    data_file_path: str,
+    batch: ColumnBatch,
+    columns: Sequence[str],
+    fpp: float = 0.05,
+) -> str | None:
+    """Build bloom indexes for `columns` of this file; returns sidecar path."""
+    payload = build_index_payload(batch, columns, fpp)
+    if payload is None:
+        return None
     path = index_path(data_file_path)
     file_io.write_bytes(path, payload, overwrite=True)
     return path
@@ -158,11 +169,19 @@ class FileIndexPredicate:
     provably contains no matching row and is skipped."""
 
     def __init__(self, file_io: FileIO, idx_path: str):
-        data = file_io.read_bytes(idx_path)
+        self._load(file_io.read_bytes(idx_path))
+
+    def _load(self, data: bytes) -> None:
         assert data[:4] == _MAGIC, "bad index magic"
         (hlen,) = struct.unpack("<I", data[4:8])
         self.header = json.loads(data[8 : 8 + hlen])
         self.blob = data[8 + hlen :]
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "FileIndexPredicate":
+        self = cls.__new__(cls)
+        self._load(data)
+        return self
 
     def _bloom(self, name: str) -> BloomFilter | None:
         meta = self.header["columns"].get(name)

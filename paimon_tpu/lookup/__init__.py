@@ -30,12 +30,41 @@ class LookupFile:
     (reference HashLookupStoreWriter/Reader: the same shape, a hash table
     over serialized rows, written once and mmap-read)."""
 
-    def __init__(self, kv: KVBatch, key_names: Sequence[str]):
+    def __init__(
+        self,
+        kv: KVBatch,
+        key_names: Sequence[str],
+        bloom_fpp: float | None = None,
+        hash_load_factor: float | None = None,
+    ):
         self.kv = kv
         self.key_names = list(key_names)
         hashes = _key_hashes_of(kv.data, key_names)
         self.order = np.argsort(hashes, kind="stable").astype(np.int32)
         self.sorted_hashes = hashes[self.order]
+        self._build_accel(bloom_fpp, hash_load_factor)
+
+    def _build_accel(self, bloom_fpp: float | None, hash_load_factor: float | None) -> None:
+        """Probe accelerators (reference HashLookupStoreWriter): an optional
+        bloom over the key hashes (lookup.cache.bloom.filter.*) and a radix
+        slot table sized n/load-factor (lookup.hash-load-factor) that turns
+        the binary search into a one-bucket scan."""
+        n = len(self.sorted_hashes)
+        self.bloom = None
+        if bloom_fpp is not None and n:
+            from ..format.fileindex import BloomFilter
+
+            self.bloom = BloomFilter.for_items(n, bloom_fpp)
+            self.bloom.add_hashes(self.sorted_hashes)
+        self.slot_shift = None
+        if hash_load_factor is not None and n:
+            slots = 1
+            while slots < int(n / max(hash_load_factor, 0.1)):
+                slots <<= 1
+            self.slot_shift = max(64 - slots.bit_length() + 1, 0)
+            # slot boundaries: first sorted position whose hash prefix >= s
+            prefixes = (self.sorted_hashes >> np.uint64(self.slot_shift)).astype(np.uint64)
+            self.slot_starts = np.searchsorted(prefixes, np.arange(slots + 1, dtype=np.uint64))
 
     def save(self, file_io, path: str) -> None:
         """Persist rows + index: `<path>` (arrow IPC) and `<path>.hidx`."""
@@ -52,7 +81,14 @@ class LookupFile:
         file_io.write_bytes(f"{path}.hidx", idx, overwrite=True)
 
     @staticmethod
-    def load(file_io, path: str, value_schema, key_names: Sequence[str]) -> "LookupFile":
+    def load(
+        file_io,
+        path: str,
+        value_schema,
+        key_names: Sequence[str],
+        bloom_fpp: float | None = None,
+        hash_load_factor: float | None = None,
+    ) -> "LookupFile":
         import io as _io
 
         import pyarrow as pa
@@ -73,6 +109,7 @@ class LookupFile:
         n = kv.num_rows
         lf.sorted_hashes = np.frombuffer(raw[: n * 8], dtype=np.uint64).copy()
         lf.order = np.frombuffer(raw[n * 8 : n * 8 + n * 4], dtype=np.int32).copy()
+        lf._build_accel(bloom_fpp, hash_load_factor)
         return lf
 
     @property
@@ -85,8 +122,19 @@ class LookupFile:
     def probe(self, key_tuple: tuple, key_hash: np.uint64):
         """Latest row for the key in this file, or None. Files have unique
         keys, so at most one row matches (hash collisions verified exactly)."""
-        lo = int(np.searchsorted(self.sorted_hashes, key_hash, side="left"))
-        hi = int(np.searchsorted(self.sorted_hashes, key_hash, side="right"))
+        if self.bloom is not None and not bool(
+            self.bloom.might_contain_hashes(np.asarray([key_hash], dtype=np.uint64))[0]
+        ):
+            return None
+        if self.slot_shift is not None:
+            s = int(key_hash >> np.uint64(self.slot_shift))
+            b_lo, b_hi = int(self.slot_starts[s]), int(self.slot_starts[s + 1])
+            seg = self.sorted_hashes[b_lo:b_hi]
+            lo = b_lo + int(np.searchsorted(seg, key_hash, side="left"))
+            hi = b_lo + int(np.searchsorted(seg, key_hash, side="right"))
+        else:
+            lo = int(np.searchsorted(self.sorted_hashes, key_hash, side="left"))
+            hi = int(np.searchsorted(self.sorted_hashes, key_hash, side="right"))
         for i in range(lo, hi):
             row = int(self.order[i])
             if all(self.kv.data.column(k).values[row] == v for k, v in zip(self.key_names, key_tuple)):
@@ -134,6 +182,10 @@ class LookupLevels:
         deletion_vectors: dict | None = None,
         local_store_dir: str | None = None,
         file_io=None,
+        bloom_fpp: float | None = None,
+        hash_load_factor: float | None = None,
+        max_disk_bytes: int | None = None,
+        file_retention_millis: int | None = None,
     ):
         from ..core.levels import Levels
 
@@ -147,6 +199,57 @@ class LookupLevels:
         # of the remote data file (reference LookupLevels.createLookupFile)
         self.local_store_dir = local_store_dir
         self.file_io = file_io
+        self.bloom_fpp = bloom_fpp
+        self.hash_load_factor = hash_load_factor
+        self.max_disk_bytes = max_disk_bytes
+        self.file_retention_millis = file_retention_millis
+
+    def _sweep_local_store(self) -> None:
+        """Disk-tier hygiene (reference lookup.cache-max-disk-size /
+        lookup.cache-file-retention): persisted lookup files are re-buildable
+        caches, so drop expired ones and the oldest past the byte budget."""
+        if not (self.local_store_dir and self.file_io):
+            return
+        try:
+            stats = [
+                s
+                for s in self.file_io.list_status(self.local_store_dir)
+                if s.path.endswith(".lookup") or s.path.endswith(".hidx")
+            ]
+        except (FileNotFoundError, OSError):
+            return
+        import time
+
+        now_ms = time.time() * 1000
+        # group .lookup + .hidx as ONE logical entry: evicting half a pair
+        # leaves a .lookup whose load crashes on the missing .hidx
+        pairs: dict[str, list] = {}
+        for s in stats:
+            stem = s.path[: -len(".hidx")] if s.path.endswith(".hidx") else s.path
+            pairs.setdefault(stem, []).append(s)
+        keep = []
+        for stem, members in pairs.items():
+            mtime = max(
+                getattr(s, "mtime_millis", None) or getattr(s, "modification_time", 0)
+                for s in members
+            )
+            if (
+                self.file_retention_millis is not None
+                and mtime
+                and now_ms - mtime > self.file_retention_millis
+            ):
+                for s in members:
+                    self.file_io.delete(s.path)
+            else:
+                keep.append((mtime, members))
+        if self.max_disk_bytes is not None:
+            total = sum(s.size for _, members in keep for s in members)
+            for _, members in sorted(keep, key=lambda t: t[0]):  # oldest pair first
+                if total <= self.max_disk_bytes:
+                    break
+                for s in members:
+                    self.file_io.delete(s.path)
+                    total -= s.size
 
     def _load(self, meta: DataFileMeta) -> LookupFile:
         local = (
@@ -154,15 +257,19 @@ class LookupLevels:
         )
         has_dv = meta.file_name in self.deletion_vectors
         if local and not has_dv and self.file_io.exists(local):
-            return LookupFile.load(self.file_io, local, self.reader_factory.read_schema, self.key_names)
+            return LookupFile.load(
+                self.file_io, local, self.reader_factory.read_schema, self.key_names,
+                self.bloom_fpp, self.hash_load_factor,
+            )
         kv = self.reader_factory.read(meta)
         dv = self.deletion_vectors.get(meta.file_name)
         if dv is not None:
             mask = ~dv.deleted_mask(kv.num_rows)
             if not mask.all():
                 kv = kv.filter(mask)
-        lf = LookupFile(kv, self.key_names)
+        lf = LookupFile(kv, self.key_names, self.bloom_fpp, self.hash_load_factor)
         if local and not has_dv:  # DV'd files change between snapshots
+            self._sweep_local_store()
             lf.save(self.file_io, local)
         return lf
 

@@ -544,6 +544,108 @@ class CoreOptions:
         "file-index.bloom-filter.columns", None, "Columns with bloom file index."
     )
     FILE_INDEX_BLOOM_FPP = ConfigOption.float_("file-index.bloom-filter.fpp", 0.05, "Bloom false-positive rate.")
+    FILE_INDEX_READ_ENABLED = ConfigOption.bool_(
+        "file-index.read.enabled", True, "Evaluate file index (bloom sidecars / embedded) during planning."
+    )
+    FILE_INDEX_IN_MANIFEST_THRESHOLD = ConfigOption.memory(
+        "file-index.in-manifest-threshold",
+        "500 b",
+        "Index payloads smaller than this embed in the manifest entry "
+        "instead of a sidecar file (saves one open per file per scan).",
+    )
+    AUTO_CREATE = ConfigOption.bool_(
+        "auto-create", False, "Create the underlying table storage on first load when a schema is supplied."
+    )
+    PRIMARY_KEY = ConfigOption.string(
+        "primary-key", None,
+        "Define the primary key via options (comma-separated) when the "
+        "creating surface cannot express constraints (reference: cannot be "
+        "combined with an explicit primary key).",
+    )
+    PARTITION = ConfigOption.string(
+        "partition", None, "Define partition keys via options (comma-separated); same contract as primary-key."
+    )
+    CHANGELOG_PRODUCER_LOOKUP_WAIT = ConfigOption.bool_(
+        "changelog-producer.lookup-wait",
+        True,
+        "changelog-producer=lookup: commit waits for the lookup compaction "
+        "(false: defer changelog production to a later compaction).",
+    )
+    SNAPSHOT_EXPIRE_EXECUTION_MODE = ConfigOption.string(
+        "snapshot.expire.execution-mode", "sync", "sync | async (expire runs on a background thread)."
+    )
+    SNAPSHOT_WATERMARK_IDLE_TIMEOUT = ConfigOption.duration(
+        "snapshot.watermark-idle-timeout",
+        None,
+        "Streaming reads: advance the watermark to the snapshot commit time "
+        "when no new snapshot arrived for this long.",
+    )
+    DYNAMIC_BUCKET_INITIAL_BUCKETS = ConfigOption.int_(
+        "dynamic-bucket.initial-buckets", None, "Dynamic bucket mode: buckets pre-created per assigner."
+    )
+    DYNAMIC_BUCKET_ASSIGNER_PARALLELISM = ConfigOption.int_(
+        "dynamic-bucket.assigner-parallelism", None,
+        "Dynamic bucket mode: assigner operators; new buckets are striped "
+        "bucket %% parallelism == assigner_id (default: writer parallelism).",
+    )
+    CROSS_PARTITION_UPSERT_BOOTSTRAP_PARALLELISM = ConfigOption.int_(
+        "cross-partition-upsert.bootstrap-parallelism", 10,
+        "Threads reading existing keys when bootstrapping the cross-partition index.",
+    )
+    CROSS_PARTITION_UPSERT_INDEX_TTL = ConfigOption.duration(
+        "cross-partition-upsert.index-ttl", None,
+        "TTL for rows in the cross-partition key->(partition,bucket) index "
+        "(0/None = keep forever; shorter = less memory, risk of stale rows).",
+    )
+    DELETION_VECTOR_INDEX_FILE_TARGET_SIZE = ConfigOption.memory(
+        "deletion-vector.index-file.target-size", "2 mb",
+        "Roll the packed deletion-vector container at this size.",
+    )
+    LOOKUP_CACHE_MAX_MEMORY_SIZE = ConfigOption.memory(
+        "lookup.cache-max-memory-size", "256 mb", "Lookup in-memory cache byte budget."
+    )
+    LOOKUP_CACHE_MAX_DISK_SIZE = ConfigOption.memory(
+        "lookup.cache-max-disk-size", f"{1 << 50} b",
+        "Lookup on-disk cache byte budget (oldest persisted lookup files evicted first).",
+    )
+    LOOKUP_CACHE_FILE_RETENTION = ConfigOption.duration(
+        "lookup.cache-file-retention", "1 h", "Persisted lookup files older than this are re-buildable garbage."
+    )
+    LOOKUP_CACHE_BLOOM_FILTER_ENABLED = ConfigOption.bool_(
+        "lookup.cache.bloom.filter.enabled", True, "Guard lookup files with a bloom filter of their keys."
+    )
+    LOOKUP_CACHE_BLOOM_FILTER_FPP = ConfigOption.float_(
+        "lookup.cache.bloom.filter.fpp", 0.05, "Lookup bloom filter false-positive rate."
+    )
+    LOOKUP_HASH_LOAD_FACTOR = ConfigOption.float_(
+        "lookup.hash-load-factor", 0.75, "Fill ratio of the sorted-hash lookup sidecar's slot table."
+    )
+    MANIFEST_FULL_COMPACTION_THRESHOLD_SIZE = ConfigOption.memory(
+        "manifest.full-compaction-threshold-size", "16 mb",
+        "Rewrite ALL manifests into compacted base manifests once the "
+        "unmerged (delta) manifests exceed this total size.",
+    )
+    SORT_COMPACTION_RANGE_STRATEGY = ConfigOption.string(
+        "sort-compaction.range-strategy", "quantity",
+        "quantity: range-split sort compaction by row count; size: by bytes "
+        "(skewed row widths pack ranges evenly).",
+    )
+    SORT_COMPACTION_SAMPLE_MAGNIFICATION = ConfigOption.int_(
+        "sort-compaction.local-sample.magnification", 1000,
+        "Local sample size = magnification x parallelism when choosing range boundaries.",
+    )
+    WRITE_BUFFER_FOR_APPEND = ConfigOption.bool_(
+        "write-buffer-for-append", False,
+        "Append tables: buffer rows (with spill) instead of flushing a file per write call.",
+    )
+    WRITE_BUFFER_SPILL_MAX_DISK_SIZE = ConfigOption.memory(
+        "write-buffer-spill.max-disk-size", f"{1 << 50} b",
+        "Cap on bytes of spill segments on local disk; past it the buffer flushes instead of spilling.",
+    )
+    ZORDER_VAR_LENGTH_CONTRIBUTION = ConfigOption.int_(
+        "zorder.var-length-contribution", 8,
+        "Bytes a var-length column (string/bytes) contributes to the z-order interleave.",
+    )
     FIELDS_PREFIX = "fields."  # fields.<name>.aggregate-function / .sequence-group / .ignore-retract
 
     def __init__(self, options: Options | Mapping[str, Any] | None = None):

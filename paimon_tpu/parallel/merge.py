@@ -123,17 +123,27 @@ def _range_exchange(key_lanes, seq_lanes, pad_flag, axis: str, p: int, num_key: 
     return recv_keys, recv_seqs, recv_pad
 
 
-def range_partition_lanes(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndarray, pad: np.ndarray):
+def range_partition_lanes(
+    mesh: Mesh,
+    key_lanes: np.ndarray,
+    seq_lanes: np.ndarray,
+    pad: np.ndarray,
+    sample_per_device: int = 64,
+):
     """Standalone range shuffle over the "key" axis (the distributed sort /
     clustering primitive). Inputs (n, K)/(n, S)/(n,) sharded on rows; output:
     per-device contiguous key ranges, each locally merged (perm + keep_last
-    in the exchanged coordinate system)."""
+    in the exchanged coordinate system). sample_per_device tunes splitter
+    fidelity (reference sort-compaction.local-sample.magnification:
+    sample = magnification x parallelism)."""
     n, k = key_lanes.shape
     s = seq_lanes.shape[1]
     p_key = mesh.shape["key"]
 
     def shard_fn(kl, sl, pf):
-        rk, rs, rp = _range_exchange(kl.T, sl.T, pf, "key", p_key, k, s)
+        rk, rs, rp = _range_exchange(
+            kl.T, sl.T, pf, "key", p_key, k, s, sample=sample_per_device
+        )
         perm, _, keep_last, _ = _local_plan(k, s, rk, rs, rp)
         # emit everything in SORTED order so row i of lanes aligns with
         # keep_last[i] / pad[i] (one coordinate system for downstream)

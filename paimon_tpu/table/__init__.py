@@ -200,15 +200,62 @@ class FileStoreTable(Table):
                 ids |= set(range(nxt, latest + 1))
             return ids
 
-        return self.store.new_expire(protected).expire()
+        expire = self.store.new_expire(protected)
+        mode = str(self.options.options.get(CoreOptions.SNAPSHOT_EXPIRE_EXECUTION_MODE)).lower()
+        if mode == "async":
+            # reference ExpireExecutionMode.ASYNC: expiry must never add
+            # latency to the commit path — run it on a background thread.
+            # The future is kept on the table (tests/join points).
+            import concurrent.futures as cf
+
+            if not hasattr(self, "_expire_executor"):
+                self._expire_executor = cf.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="snapshot-expire"
+                )
+            self._expire_future = self._expire_executor.submit(expire.expire)
+
+            def _surface(fut):  # async failures must not vanish silently
+                exc = fut.exception()
+                if exc is not None:
+                    import sys
+
+                    sys.stderr.write(f"[paimon-tpu] async snapshot expire failed: {exc!r}\n")
+
+            self._expire_future.add_done_callback(_surface)
+            return 0
+        return expire.expire()
 
 
-def load_table(path: str, commit_user: str = "anonymous", dynamic_options: dict[str, str] | None = None) -> FileStoreTable:
+def load_table(
+    path: str,
+    commit_user: str = "anonymous",
+    dynamic_options: dict[str, str] | None = None,
+    row_type=None,
+) -> FileStoreTable:
     """Open an existing table from its path. The 'branch' option (in the
-    table's options or dynamic_options) pins the view to that branch."""
+    table's options or dynamic_options) pins the view to that branch.
+
+    auto-create=true (reference CoreOptions.AUTO_CREATE): when no table
+    exists at `path` and the caller supplies `row_type` (the engine-side
+    schema), the underlying storage is created on first load — primary/
+    partition keys come from the 'primary-key'/'partition' options."""
     file_io = get_file_io(path)
     schema = SchemaManager(file_io, path).latest()
     if schema is None:
+        opts = dict(dynamic_options or {})
+        if str(opts.get("auto-create", "")).lower() == "true" and row_type is not None:
+            opts.pop("auto-create")
+            pk = [c.strip() for c in opts.pop("primary-key", "").split(",") if c.strip()]
+            parts = [c.strip() for c in opts.pop("partition", "").split(",") if c.strip()]
+            # session-scoped options must NOT bake into schema-0 (the normal
+            # path applies them via copy() without persisting) — only table-
+            # shaping options persist
+            session_prefixes = ("scan.", "consumer", "incremental-between", "streaming-read")
+            persisted = {k: v for k, v in opts.items() if not k.startswith(session_prefixes)}
+            session = {k: v for k, v in opts.items() if k.startswith(session_prefixes)}
+            schema = SchemaManager(file_io, path).create_table(row_type, parts, pk, persisted)
+            table = FileStoreTable(file_io, path, schema, commit_user)
+            return table.copy(session) if session else table
         raise FileNotFoundError(f"no table at {path}")
     table = FileStoreTable(file_io, path, schema, commit_user)
     # branch first: branch_table rebuilds from the branch schema, so other

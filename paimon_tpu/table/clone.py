@@ -71,10 +71,15 @@ def pick_files(table: "FileStoreTable", snapshot_id: int | None = None):
             rel.append(f"manifest/{ml}")
     if snap.index_manifest:
         rel.append(f"manifest/{snap.index_manifest}")
+        from ..core.deletionvectors import DeletionVectorsIndexFile
         from ..core.indexmanifest import read_index_manifest
 
+        dv_io = DeletionVectorsIndexFile(table.file_io, table.path)
         for e in read_index_manifest(table.file_io, table.path, snap.index_manifest):
-            rel.append(f"index/{e.file_name}")
+            if e.kind == "DELETION_VECTORS":
+                rel += [f"index/{n}" for n in dv_io.chain_names(e.file_name)]
+            else:
+                rel.append(f"index/{e.file_name}")
     rel += _stats_dir_files(table, snap)
 
     manifest_dir = f"{table.path}/manifest"

@@ -26,42 +26,88 @@ __all__ = ["GlobalIndexAssigner", "CrossPartitionUpsertWrite"]
 
 
 class GlobalIndexAssigner:
-    def __init__(self, table: "FileStoreTable", target_bucket_rows: int):
+    def __init__(
+        self,
+        table: "FileStoreTable",
+        target_bucket_rows: int,
+        bootstrap_parallelism: int = 10,
+        index_ttl_millis: int | None = None,
+    ):
         self.table = table
         self.key_names = table.store.key_names
         self.target = target_bucket_rows
-        self.index: dict[tuple, tuple] = {}  # key -> (partition, bucket)
+        self.bootstrap_parallelism = max(1, bootstrap_parallelism)
+        # cross-partition-upsert.index-ttl: entries silently expire (the
+        # reference's rocksdb TTL) — an expired key re-allocates like a new
+        # one, trading index memory for possible stale duplicates
+        self.index_ttl_millis = index_ttl_millis
+        self.index: dict[tuple, tuple] = {}  # key -> (partition, bucket, born_millis)
         self._bucket_counts: dict[tuple, int] = {}  # (partition, bucket) -> rows
+
+    def _now(self) -> int:
+        from ..utils import now_millis
+
+        return now_millis()
+
+    def _get_live(self, key: tuple):
+        e = self.index.get(key)
+        if e is None:
+            return None
+        if self.index_ttl_millis is not None and self._now() - e[2] > self.index_ttl_millis:
+            del self.index[key]
+            return None
+        return e[:2]
 
     def bootstrap(self) -> None:
         """Read the key columns of every live file and resolve each key to its
         LATEST location by sequence number — applying -D/-U rows, so a moved
         or deleted key never resurrects its stale copy (reference
-        IndexBootstrap projects key + partition + bucket the same way)."""
+        IndexBootstrap projects key + partition + bucket the same way).
+        Buckets read in parallel (cross-partition-upsert.bootstrap-parallelism)."""
+        import concurrent.futures as cf
+
         store = self.table.store
         plan = store.new_scan().plan()
+        jobs = [
+            (partition, bucket, files)
+            for partition, buckets in plan.grouped().items()
+            for bucket, files in buckets.items()
+        ]
+
+        def read_bucket(job):
+            """Folds this bucket's rows into a one-entry-per-key dict BEFORE
+            returning: memory stays O(distinct keys), not O(row versions)."""
+            partition, bucket, files = job
+            rf = store.reader_factory(partition, bucket)
+            local: dict[tuple, tuple] = {}  # key -> (seq, alive)
+            for f in files:
+                kv = rf.read(f, fields=self.key_names)
+                alive = ~np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)))
+                cols = [kv.data.column(k).values for k in self.key_names]
+                seqs = kv.seq
+                for i in range(kv.num_rows):
+                    key = tuple(c[i] for c in cols)
+                    prev = local.get(key)
+                    if prev is None or seqs[i] > prev[0]:
+                        local[key] = (int(seqs[i]), bool(alive[i]))
+            return partition, bucket, sum(f.row_count for f in files), local
+
         latest: dict[tuple, tuple] = {}  # key -> (seq, partition, bucket, alive)
-        for partition, buckets in plan.grouped().items():
-            for bucket, files in buckets.items():
-                rf = store.reader_factory(partition, bucket)
-                for f in files:
-                    kv = rf.read(f, fields=self.key_names)
-                    alive = ~np.isin(kv.kind, (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)))
-                    cols = [kv.data.column(k).values for k in self.key_names]
-                    seqs = kv.seq
-                    for i in range(kv.num_rows):
-                        key = tuple(c[i] for c in cols)
-                        prev = latest.get(key)
-                        if prev is None or seqs[i] > prev[0]:
-                            latest[key] = (int(seqs[i]), partition, bucket, bool(alive[i]))
-                self._bucket_counts[(partition, bucket)] = sum(f.row_count for f in files)
+        with cf.ThreadPoolExecutor(max_workers=self.bootstrap_parallelism) as pool:
+            for partition, bucket, count, local in pool.map(read_bucket, jobs):
+                self._bucket_counts[(partition, bucket)] = count
+                for key, (seq, alive) in local.items():
+                    prev = latest.get(key)
+                    if prev is None or seq > prev[0]:
+                        latest[key] = (seq, partition, bucket, alive)
+        born = self._now()
         for key, (_, partition, bucket, alive) in latest.items():
             if alive:
-                self.index[key] = (partition, bucket)
+                self.index[key] = (partition, bucket, born)
 
     def assign(self, key: tuple, partition: tuple) -> tuple[tuple, int, tuple | None]:
         """(target_partition, bucket, old_location_or_None_if_same)."""
-        existing = self.index.get(key)
+        existing = self._get_live(key)
         if existing is not None:
             old_partition, old_bucket = existing
             if old_partition == partition:
@@ -69,10 +115,10 @@ class GlobalIndexAssigner:
             # partition changed: new row goes to the new partition; caller
             # retracts the old copy
             bucket = self._allocate(partition)
-            self.index[key] = (partition, bucket)
+            self.index[key] = (partition, bucket, self._now())
             return partition, bucket, existing
         bucket = self._allocate(partition)
-        self.index[key] = (partition, bucket)
+        self.index[key] = (partition, bucket, self._now())
         return partition, bucket, None
 
     def _allocate(self, partition: tuple) -> int:
@@ -83,7 +129,8 @@ class GlobalIndexAssigner:
         return b
 
     def delete(self, key: tuple) -> tuple | None:
-        return self.index.pop(key, None)
+        e = self.index.pop(key, None)
+        return None if e is None else e[:2]
 
 
 class CrossPartitionUpsertWrite:
@@ -100,7 +147,14 @@ class CrossPartitionUpsertWrite:
         self.partition_keys = store.partition_keys
         self.key_names = store.key_names
         target = store.options.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
-        self.assigner = GlobalIndexAssigner(table, target)
+        self.assigner = GlobalIndexAssigner(
+            table,
+            target,
+            bootstrap_parallelism=store.options.options.get(
+                CoreOptions.CROSS_PARTITION_UPSERT_BOOTSTRAP_PARALLELISM
+            ),
+            index_ttl_millis=store.options.options.get(CoreOptions.CROSS_PARTITION_UPSERT_INDEX_TTL),
+        )
         self.assigner.bootstrap()
         self._writers: dict[tuple, object] = {}
 

@@ -53,7 +53,11 @@ def _key_match_mask(batch, key_names, matching_batch) -> np.ndarray:
 
 def _delete_with_dvs(table: "FileStoreTable", predicate: Predicate, commit_identifier: int | None) -> int:
     store = table.store
-    idx = DeletionVectorsIndexFile(table.file_io, table.path)
+    idx = DeletionVectorsIndexFile(
+        table.file_io,
+        table.path,
+        target_size=int(store.options.options.get(CoreOptions.DELETION_VECTOR_INDEX_FILE_TARGET_SIZE)),
+    )
     plan = store.new_scan().plan()
     # PK tables: deleting only the latest version's position would resurrect
     # an older version of the key on merge — so resolve the predicate against

@@ -58,8 +58,14 @@ def remove_orphan_files(table: "FileStoreTable", older_than_millis: int = 24 * 3
                         live_data.add((bucket_dir, x))
         if snap.index_manifest:
             live_meta.add(snap.index_manifest)
+            from ..core.deletionvectors import DeletionVectorsIndexFile
+
+            dv_io = DeletionVectorsIndexFile(io, path)
             for ie in read_index_manifest(io, path, snap.index_manifest):
-                live_index.add(ie.file_name)
+                if ie.kind == "DELETION_VECTORS":
+                    live_index.update(dv_io.chain_names(ie.file_name))
+                else:
+                    live_index.add(ie.file_name)
 
     cutoff = now_millis() - older_than_millis
     removed: list[str] = []

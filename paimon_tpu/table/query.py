@@ -19,12 +19,27 @@ __all__ = ["LocalTableQuery"]
 
 
 class LocalTableQuery:
-    def __init__(self, table: "FileStoreTable", cache_bytes: int = 256 << 20, local_store_dir: str | None = None):
+    def __init__(
+        self, table: "FileStoreTable", cache_bytes: int | None = None, local_store_dir: str | None = None
+    ):
         if not table.is_primary_key_table:
             raise ValueError("point lookup requires a primary-key table")
         self.table = table
         self.store = table.store
+        from ..options import CoreOptions
+
+        opts = self.store.options.options
+        if cache_bytes is None:
+            cache_bytes = int(opts.get(CoreOptions.LOOKUP_CACHE_MAX_MEMORY_SIZE))
         self.cache = LookupFileCache(cache_bytes)
+        self._bloom_fpp = (
+            opts.get(CoreOptions.LOOKUP_CACHE_BLOOM_FILTER_FPP)
+            if opts.get(CoreOptions.LOOKUP_CACHE_BLOOM_FILTER_ENABLED)
+            else None
+        )
+        self._hash_load_factor = opts.get(CoreOptions.LOOKUP_HASH_LOAD_FACTOR)
+        self._max_disk_bytes = int(opts.get(CoreOptions.LOOKUP_CACHE_MAX_DISK_SIZE))
+        self._file_retention_ms = opts.get(CoreOptions.LOOKUP_CACHE_FILE_RETENTION)
         self.local_store_dir = local_store_dir
         self._levels: dict[tuple, LookupLevels] = {}
         self._snapshot_id: int | None = None
@@ -56,6 +71,10 @@ class LocalTableQuery:
                     deletion_vectors=dvs,
                     local_store_dir=self.local_store_dir,
                     file_io=self.table.file_io,
+                    bloom_fpp=self._bloom_fpp,
+                    hash_load_factor=self._hash_load_factor,
+                    max_disk_bytes=self._max_disk_bytes,
+                    file_retention_millis=self._file_retention_ms,
                 )
 
     def lookup(self, partition: tuple, key: "tuple | object"):

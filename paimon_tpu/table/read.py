@@ -168,6 +168,42 @@ class TableScan:
                     )
         return splits
 
+    def _file_index_predicate(self, keyed: bool):
+        """The predicate to test against per-file bloom indexes, or None when
+        index pruning is off/inapplicable. Keyed tables only test KEY-field
+        conjuncts (a value match in an old file can be overridden by a newer
+        one, but a key absent from every index cannot exist); append tables
+        test everything — same safety split as the stats-based filters.
+        Gated by file-index.read.enabled (reference FileIndexReadOptions)."""
+        if self.predicate is None:
+            return None
+        co = self.table.store.options
+        if not co.options.get(CoreOptions.FILE_INDEX_READ_ENABLED):
+            return None
+        if not keyed:
+            return self.predicate
+        from ..data.predicate import PredicateBuilder, and_
+
+        parts = PredicateBuilder.pick_by_fields(
+            PredicateBuilder.split_and(self.predicate), set(self.table.store.key_names)
+        )
+        return and_(*parts) if parts else None
+
+    def _index_accepts(self, f, bucket_dir: str, pred) -> bool:
+        """False only when the file's index PROVES no row matches."""
+        from ..format.fileindex import FileIndexPredicate
+
+        try:
+            if f.embedded_index is not None:
+                return FileIndexPredicate.from_bytes(f.embedded_index).test(pred)
+            if f"{f.file_name}.index" in f.extra_files:
+                return FileIndexPredicate(
+                    self.table.file_io, f"{bucket_dir}/{f.file_name}.index"
+                ).test(pred)
+        except (FileNotFoundError, OSError):
+            return True  # a missing/corrupt index never loses rows
+        return True
+
     def _partition_predicate(self):
         """partition tuple -> bool from the scan predicate's partition
         conjuncts; None when nothing prunes."""
@@ -278,6 +314,7 @@ class TableScan:
         created_after = co.options.get(CoreOptions.SCAN_FILE_CREATION_TIME_MILLIS)
         splits = []
         keyed = bool(self.table.schema.primary_keys)
+        index_pred = self._file_index_predicate(keyed)
         per_partition: dict[tuple, list[DataSplit]] = {}
         for partition, buckets in sorted(plan.grouped().items(), key=lambda kv: kv[0]):
             plist = per_partition.setdefault(partition, [])
@@ -286,6 +323,11 @@ class TableScan:
                     # reference scan.file-creation-time-millis: only files
                     # born after the bound (append/log-style consumption)
                     files = [f for f in files if f.creation_time_millis > created_after]
+                    if not files:
+                        continue
+                if index_pred is not None:
+                    bd = store.bucket_dir(partition, bucket)
+                    files = [f for f in files if self._index_accepts(f, bd, index_pred)]
                     if not files:
                         continue
                 snapshot = plan.snapshot.id if plan.snapshot else None

@@ -16,6 +16,7 @@ from ..core.kv import KVBatch
 from ..core.manifest import CommitMessage, ManifestCommittable
 from ..data.keys import build_string_pool, encode_key_lanes
 from ..ops.merge import merge_plan
+from ..options import CoreOptions
 from ..ops.zorder import hilbert_lanes, z_order_lanes
 from ..types import TypeRoot
 
@@ -49,12 +50,26 @@ def sort_compact(
             kv = KVBatch.concat([rf.read(f) for f in ordered])
             if kv.num_rows == 0:
                 continue
+            var_roots = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
             pools = {
                 c: build_string_pool([kv.data.column(c).values])
                 for c in columns
-                if kv.data.schema.field(c).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+                if kv.data.schema.field(c).type.root in var_roots
             }
             lanes = encode_key_lanes(kv.data, columns, pools)
+            # zorder.var-length-contribution: how many BYTES a var-length
+            # column contributes to the interleave (reference ZIndexer
+            # varTypeSize). Ranks are dense; spread them over the full 32-bit
+            # lane, then keep the top contribution*8 bits — fewer bits =
+            # coarser clustering for that column.
+            contrib = int(store.options.options.get(CoreOptions.ZORDER_VAR_LENGTH_CONTRIBUTION))
+            if order in ("zorder", "hilbert") and contrib < 4:
+                keep_bits = max(1, contrib * 8)
+                for ci, c in enumerate(columns):
+                    if kv.data.schema.field(c).type.root in var_roots and len(pools.get(c, ())):
+                        scale = np.uint64(0x100000000) // np.uint64(max(len(pools[c]), 1))
+                        spread = (lanes[:, ci].astype(np.uint64) * scale).astype(np.uint32)
+                        lanes[:, ci] = spread & np.uint32(~np.uint32((1 << (32 - keep_bits)) - 1))
             if order == "zorder":
                 lanes = z_order_lanes(lanes)
             elif order == "hilbert":
@@ -63,7 +78,22 @@ def sort_compact(
             perm = p.perm[p.valid_sorted]
             sorted_kv = kv.take(perm)
             wf = store.writer_factory(partition, bucket)
-            after = wf.write(sorted_kv, level=0, file_source="compact")
+            # sort-compaction.range-strategy=size: roll output files by
+            # MEASURED bytes (var-width skew packs evenly); quantity keeps
+            # the schema estimate (row-count driven)
+            measured = None
+            if store.options.options.get(CoreOptions.SORT_COMPACTION_RANGE_STRATEGY).lower() == "size":
+                total_bytes = 0.0
+                n_rows = sorted_kv.num_rows
+                for col in sorted_kv.data.columns.values():
+                    if col.values.dtype == np.dtype(object):
+                        sample = col.values[: min(n_rows, 4096)]
+                        # float scaling: integer floor undercounts up to 2x
+                        total_bytes += sum(len(str(v)) for v in sample) * (n_rows / max(len(sample), 1))
+                    else:
+                        total_bytes += col.values.nbytes
+                measured = total_bytes / max(n_rows, 1)
+            after = wf.write(sorted_kv, level=0, file_source="compact", measured_row_bytes=measured)
             messages.append(
                 CommitMessage(
                     partition,

@@ -91,6 +91,24 @@ class StreamTableScan:
                 return None
             time.sleep(min(poll_seconds, remaining))
 
+    def current_watermark(self) -> int | None:
+        """The watermark downstream operators should hold: normally the last
+        emitted snapshot's watermark; when no snapshot has arrived for
+        snapshot.watermark-idle-timeout, it advances to processing time so an
+        idle table does not stall event-time windows (reference
+        snapshot.watermark-idle-timeout)."""
+        from ..utils import now_millis
+
+        wm = getattr(self, "_last_watermark", None)
+        idle_ms = self.store.options.options.get(CoreOptions.SNAPSHOT_WATERMARK_IDLE_TIMEOUT)
+        if idle_ms is None:
+            return wm
+        last = getattr(self, "_last_emit_monotonic", None)
+        if last is None or (time.monotonic() - last) * 1000 >= idle_ms:
+            now = now_millis()
+            return now if wm is None else max(wm, now)
+        return wm
+
     def _past_bound(self, snap) -> bool:
         """scan.bounded.watermark: the stream ENDS once a snapshot's
         watermark passes the bound (reference BoundedChecker)."""
@@ -130,6 +148,8 @@ class StreamTableScan:
         planned = self._next
         splits = self._delta_splits(planned, snap)
         self._next += 1
+        self._last_watermark = snap.watermark
+        self._last_emit_monotonic = time.monotonic()
         if self.consumer_id and self.consumer_mode == "at-least-once":
             # progress advances as soon as the plan is handed out — to the
             # PLANNED snapshot, not past it: a crash between plan and

@@ -60,7 +60,12 @@ class TableWrite:
             from ..options import CoreOptions
 
             target = store.options.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
-            self._assigner = SimpleHashBucketAssigner(HashIndexFile(table.file_io, table.path), target)
+            self._assigner = SimpleHashBucketAssigner(
+                HashIndexFile(table.file_io, table.path),
+                target,
+                initial_buckets=store.options.options.get(CoreOptions.DYNAMIC_BUCKET_INITIAL_BUCKETS),
+                num_assigners=store.options.options.get(CoreOptions.DYNAMIC_BUCKET_ASSIGNER_PARALLELISM) or 1,
+            )
             self._bootstrapped: set[tuple] = set()
         self._init_local_merge()
 
