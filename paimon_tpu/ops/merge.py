@@ -79,6 +79,18 @@ def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes
     return out[0], perm, seg_start, keep_last, seg_id
 
 
+def segment_last_where(seg_id, masks, pos=None):
+    """In-kernel: per SEGMENT, the last sorted position where each mask row
+    is True (-1 = none). masks (F, m) bool in SORTED coords; returns (F, m)
+    indexed by segment id. The shared core of every partial-update selection
+    (local fused, local planned, and the distributed range-shuffle engine)."""
+    m = seg_id.shape[0]
+    if pos is None:
+        pos = jnp.arange(m, dtype=jnp.int32)
+    cand = jnp.where(masks, pos[None, :], -1)
+    return jax.vmap(lambda c: jax.ops.segment_max(c, seg_id, num_segments=m))(cand)
+
+
 def pack_selected(sel, perm):
     """In-kernel: pack the selected perms to the front (key order) and count
     them — the minimal device->host transfer for selection kernels."""
@@ -352,8 +364,7 @@ def _partial_update_fn():
         last_del = jax.ops.segment_max(del_cand, seg_id, num_segments=m)
         gate = pos[None, :] > last_del[seg_id][None, :]
         fv_sorted = field_valid[:, perm]  # (F, m)
-        cand = jnp.where(fv_sorted & add_sorted[None, :] & gate, pos[None, :], -1)
-        last_per_field = jax.vmap(lambda c: jax.ops.segment_max(c, seg_id, num_segments=m))(cand)
+        last_per_field = segment_last_where(seg_id, fv_sorted & add_sorted[None, :] & gate, pos)
         src = jnp.where(last_per_field >= 0, perm[jnp.clip(last_per_field, 0, m - 1)], -1)
         # segment produces a row iff any add row after its last delete
         add_cand = jnp.where(add_sorted, pos, -1)
@@ -385,8 +396,7 @@ def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int):
         last_del = jax.ops.segment_max(del_cand, seg_id, num_segments=m)
         gate = pos[None, :] > last_del[seg_id][None, :]
         fv_sorted = field_valid[:, perm]
-        cand = jnp.where(fv_sorted & add_sorted[None, :] & gate, pos[None, :], -1)
-        last_per_field = jax.vmap(lambda c: jax.ops.segment_max(c, seg_id, num_segments=m))(cand)
+        last_per_field = segment_last_where(seg_id, fv_sorted & add_sorted[None, :] & gate, pos)
         src = jnp.where(last_per_field >= 0, perm[jnp.clip(last_per_field, 0, m - 1)], -1)
         add_cand = jnp.where(add_sorted, pos, -1)
         last_add = jax.ops.segment_max(add_cand, seg_id, num_segments=m)

@@ -21,12 +21,18 @@ XLA owns the collectives.
 
 from .distributed import global_mesh, init_multi_host, is_commit_coordinator
 from .mesh import make_mesh
-from .merge import bucket_parallel_dedup, distributed_merge_step, range_partition_lanes
+from .merge import (
+    bucket_parallel_dedup,
+    distributed_merge_step,
+    distributed_partial_update_step,
+    range_partition_lanes,
+)
 
 __all__ = [
     "make_mesh",
     "bucket_parallel_dedup",
     "distributed_merge_step",
+    "distributed_partial_update_step",
     "range_partition_lanes",
     "init_multi_host",
     "is_commit_coordinator",

@@ -33,7 +33,12 @@ except ImportError:  # pragma: no cover
 
 from ..ops.merge import _plan_fn
 
-__all__ = ["bucket_parallel_dedup", "range_partition_lanes", "distributed_merge_step"]
+__all__ = [
+    "bucket_parallel_dedup",
+    "range_partition_lanes",
+    "distributed_merge_step",
+    "distributed_partial_update_step",
+]
 
 
 def _local_plan(num_key: int, num_seq: int, key_lanes, seq_lanes, pad_flag):
@@ -73,7 +78,10 @@ def bucket_parallel_dedup(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndarr
 # key axis: range shuffle + local merge
 # ---------------------------------------------------------------------------
 
-def _range_exchange(key_lanes, seq_lanes, pad_flag, axis: str, p: int, num_key: int, num_seq: int, sample: int = 64):
+def _range_exchange(
+    key_lanes, seq_lanes, pad_flag, axis: str, p: int, num_key: int, num_seq: int,
+    sample: int = 64, extra_lanes=None,
+):
     """Runs INSIDE shard_map on the `axis` group. Inputs are this device's
     shard: key_lanes (K, m), seq_lanes (S, m), pad_flag (m,). Returns the
     re-partitioned shard (K, P*m), (S, P*m), (P*m,) where this device now
@@ -109,6 +117,8 @@ def _range_exchange(key_lanes, seq_lanes, pad_flag, axis: str, p: int, num_key: 
     send_pad = build(jnp.uint32, pad_flag[order], jnp.uint32(1))
     send_keys = [build(jnp.uint32, key_lanes[i][order], big) for i in range(num_key)]
     send_seqs = [build(jnp.uint32, seq_lanes[i][order], jnp.uint32(0)) for i in range(num_seq)]
+    num_extra = 0 if extra_lanes is None else extra_lanes.shape[0]
+    send_extra = [build(jnp.uint32, extra_lanes[i][order], jnp.uint32(0)) for i in range(num_extra)]
     # --- the collective ------------------------------------------------------
     def a2a(x):  # (P, m) -> (P, m): row i goes to device i
         return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
@@ -120,7 +130,14 @@ def _range_exchange(key_lanes, seq_lanes, pad_flag, axis: str, p: int, num_key: 
         if num_seq
         else jnp.zeros((0, p * m), jnp.uint32)
     )
-    return recv_keys, recv_seqs, recv_pad
+    if extra_lanes is None:
+        return recv_keys, recv_seqs, recv_pad
+    recv_extra = (
+        jnp.stack([a2a(x).reshape(-1) for x in send_extra], axis=0)
+        if num_extra
+        else jnp.zeros((0, p * m), jnp.uint32)
+    )
+    return recv_keys, recv_seqs, recv_pad, recv_extra
 
 
 def range_partition_lanes(
@@ -200,3 +217,71 @@ def distributed_merge_step(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndar
         ),
     )
     return jax.jit(fn)(key_lanes, seq_lanes, pad)
+
+
+def distributed_partial_update_step(
+    mesh: Mesh,
+    key_lanes: np.ndarray,  # (B, n, K) uint32
+    seq_lanes: np.ndarray,  # (B, n, S) uint32
+    pad: np.ndarray,  # (B, n) uint32
+    field_valid: np.ndarray,  # (B, n, F) bool — per-field non-null mask
+):
+    """The partial-update merge engine ACROSS the range shuffle: per-field
+    payload masks ride the all_to_all with the lanes; after the exchange each
+    device owns a complete key range, so the per-key per-field "latest
+    non-null wins" segment reduction (reference
+    PartialUpdateMergeFunction.java:57) is locally exact.
+
+    Returns (out_keys (B, N, K), out_seqs (B, N, S), merged_valid (B, N),
+    field_src (B, F, N)) in the post-exchange SORTED coordinate system:
+    field_src[b, f, i] is the sorted-row index holding field f's winning
+    value for the key ending at sorted row i (-1 => field null), meaningful
+    where merged_valid is True. out_seqs lets callers verify WHICH row won
+    (latest-non-null contract), not just which key.
+    """
+    _, _, k = key_lanes.shape
+    s = seq_lanes.shape[2]
+    p_key = mesh.shape["key"]
+
+    def shard_fn(kl, sl, pf, fv):
+        def one_bucket(kb, sb, pb, fb):
+            rk, rs, rp, rx = _range_exchange(
+                kb.T, sb.T, pb, "key", p_key, k, s, extra_lanes=fb.T.astype(jnp.uint32)
+            )
+            perm, _, keep_last, seg_id = _local_plan(k, s, rk, rs, rp)
+            m = rp.shape[0]
+            from ..ops.merge import segment_last_where
+
+            fv_sorted = rx[:, perm] != 0  # (F, m) in sorted coords
+            last_per_field = segment_last_where(seg_id, fv_sorted)  # (F, m) by segment
+            src = last_per_field[:, seg_id]  # broadcast back to rows
+            merged_valid = keep_last & (rp[perm] == 0)
+            # src is shard-local sorted position; offset to GLOBAL sorted
+            # coords (each key-shard's block lands at axis_index * m)
+            offset = jax.lax.axis_index("key").astype(jnp.int32) * m
+            return (
+                rk[:, perm].T,
+                rs[:, perm].T,
+                merged_valid,
+                jnp.where(src >= 0, src + offset, -1),
+            )
+
+        return jax.vmap(one_bucket)(kl, sl, pf, fv)
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P("bucket", "key", None),
+            P("bucket", "key", None),
+            P("bucket", "key"),
+            P("bucket", "key", None),
+        ),
+        out_specs=(
+            P("bucket", "key", None),
+            P("bucket", "key", None),
+            P("bucket", "key"),
+            P("bucket", None, "key"),
+        ),
+    )
+    return jax.jit(fn)(key_lanes, seq_lanes, pad, field_valid)
