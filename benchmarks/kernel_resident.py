@@ -34,7 +34,10 @@ from paimon_tpu.utils import enable_compile_cache
 from paimon_tpu.utils.tpuguard import ensure_live_backend
 
 enable_compile_cache()
-PLATFORM = ensure_live_backend()
+# guard the device claim behind __main__: pallas_verdict imports this module
+# for time_kernel, and a second single-flight acquire from the SAME process
+# (different fd, same lock file) would deadlock against our own lock
+PLATFORM = ensure_live_backend() if __name__ == "__main__" else "(imported)"
 
 BASE = 975_400.0
 
@@ -93,7 +96,9 @@ def _chained(inner, chain_iters: int):
     def f(key_lanes, seq_lanes, pad_flag, *extra):
         def body(_, carry):
             salt, acc = carry
-            kl = key_lanes ^ salt  # cheap dependency; keeps dtype + distribution
+            # cheap data dependency; keeps dtype + distribution (lanes may be
+            # a list of mixed-dtype arrays after range narrowing)
+            kl = [x ^ salt.astype(x.dtype) for x in key_lanes]
             out = inner(kl, seq_lanes, pad_flag, *extra)
             count = out[-1]  # every kernel returns (..., count)
             c = count.astype(jnp.uint32)

@@ -53,6 +53,7 @@ def main(argv=None) -> int:
         "expire_partitions",
         "drop_partition",
         "mark_partition_done",
+        "query_service",
     ):
         p = sub.add_parser(name.replace("_", "-"))
         if name not in ("migrate_table", "clone", "compact_database"):
@@ -118,6 +119,11 @@ def main(argv=None) -> int:
         elif name == "mark_partition_done":
             p.add_argument("--partition", required=True, action="append",
                            help="k=v[,k=v...] (repeatable)")
+        elif name == "query_service":
+            p.add_argument("--host", default="127.0.0.1")
+            p.add_argument("--port", type=int, default=0, help="0 = ephemeral")
+            p.add_argument("--serve-seconds", type=float, default=None,
+                           help="exit after this many seconds (tests); default: run until interrupted")
 
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
@@ -301,6 +307,27 @@ def main(argv=None) -> int:
         specs = [dict(kv.split("=", 1) for kv in s.split(",")) for s in args.partition]
         paths = mark_partition_done(t, specs)
         print(json.dumps({"markers": paths}))
+    elif action == "query_service":
+        # reference flink/action/QueryServiceActionFactory: run the KV query
+        # service for a table; the address registers in the table's FS
+        # registry so RemoteTableQuery/KvQueryClient.for_table finds it
+        import time as _time
+
+        from .service import KvQueryServer
+
+        server = KvQueryServer(t, host=args.host, port=args.port)
+        host, port = server.start()
+        print(json.dumps({"service": "kv-query", "host": host, "port": port}), flush=True)
+        try:
+            if args.serve_seconds is not None:
+                _time.sleep(args.serve_seconds)
+            else:
+                while True:
+                    _time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
     elif action == "create_branch":
         from .table.branch import BranchManager
 

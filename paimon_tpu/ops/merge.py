@@ -60,7 +60,11 @@ def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes
     stable lexicographic sort on (pad, key lanes, seq lanes, iota), then
     segment detection over (pad, key lanes) only — sequence lanes do NOT
     split segments (same key, different seq = one merge group). Returns
-    (sorted_pad, perm, seg_start, keep_last, seg_id)."""
+    (sorted_pad, perm, seg_start, keep_last, seg_id).
+
+    Lane containers may be a (L, m) array OR a list of (m,) arrays of MIXED
+    uint dtypes (the range-narrowed upload path) — per-lane indexing and
+    per-lane compares avoid any cross-dtype stack."""
     m = pad_flag.shape[0]
     iota = jnp.arange(m, dtype=jnp.int32)
     operands = (
@@ -71,8 +75,9 @@ def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes
     )
     out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
     perm = out[-1]
-    seg_keys = jnp.stack(out[: 1 + num_key_lanes], axis=0)
-    neq = jnp.any(seg_keys[:, 1:] != seg_keys[:, :-1], axis=0)
+    neq = jnp.zeros(m - 1, dtype=jnp.bool_)
+    for lane in out[: 1 + num_key_lanes]:
+        neq = neq | (lane[1:] != lane[:-1])
     seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
     keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
     seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
@@ -99,10 +104,36 @@ def pack_selected(sel, perm):
     return packed, sel.sum()
 
 
-def prepare_lanes(key_lanes: np.ndarray, seq_lanes: np.ndarray | None):
-    """The shared host-side prep: drop constant lanes, pad rows to the
-    power-of-two bucket with 0xFFFFFFFF key sentinels + pad flags. Returns
-    (klp (K, m), slp (S, m), pad (m,), n, num_key, num_seq, m)."""
+def narrow_lane(col: np.ndarray) -> np.ndarray:
+    """Range-narrow one u32 lane for upload: subtract the min (a constant
+    shift preserves order and segment boundaries) and downcast to u16 when
+    the value range strictly fits (the dtype max is reserved as the pad
+    sentinel). On a link-bound rig this halves lane bytes — the common case:
+    dense ids, dictionary ranks, bucket-local sequence numbers.
+
+    Deliberately TWO tiers only (u16/u32, no u8): each distinct dtype combo
+    is a separate jit signature, so tiers trade link bytes against compile
+    cache entries (2^(k+s) worst case; the persistent compile cache makes
+    each a one-time cost). A batch whose range hovers around the u16
+    boundary can flap tiers between merges — acceptable with the disk cache,
+    revisit if profiles show recompile churn."""
+    if col.size == 0:
+        return col
+    lo = col.min()
+    ptp = int(col.max()) - int(lo)
+    if ptp < np.iinfo(np.uint16).max:  # strict: sentinel must sort after
+        return (col - lo).astype(np.uint16)
+    return (col - lo).astype(np.uint32)
+
+
+def prepare_lanes(key_lanes: np.ndarray, seq_lanes: np.ndarray | None, narrow: bool = True):
+    """The shared host-side prep: drop constant lanes, range-narrow each
+    remaining lane (u16 upload when the value range allows — the link is
+    the bottleneck on tunnel-attached chips), pad rows to the power-of-two
+    bucket with max-sentinel keys + pad flags. Returns
+    (klp, slp, pad, n, num_key, num_seq, m) where klp/slp are LISTS of (m,)
+    arrays of possibly-mixed uint dtypes (not 2-D matrices — lanes narrow
+    independently) and pad is (m,) u8."""
     key_lanes = np.ascontiguousarray(key_lanes)
     kl = drop_constant_lanes(key_lanes)
     if kl.shape[1] == 0 and key_lanes.shape[1]:
@@ -111,12 +142,15 @@ def prepare_lanes(key_lanes: np.ndarray, seq_lanes: np.ndarray | None):
     n, k = kl.shape
     s = 0 if sl is None else sl.shape[1]
     m = pad_size(n)
-    klp = np.full((k, m), 0xFFFFFFFF, dtype=np.uint32)
-    klp[:, :n] = kl.T
-    slp = np.zeros((s, m), dtype=np.uint32)
-    if s:
-        slp[:, :n] = sl.T
-    pad = np.zeros(m, dtype=np.uint32)
+    key_cols = [narrow_lane(kl[:, i]) if narrow else kl[:, i] for i in range(k)]
+    klp = [np.full(m, np.iinfo(c.dtype).max, dtype=c.dtype) for c in key_cols]
+    for buf, c in zip(klp, key_cols):
+        buf[:n] = c
+    seq_cols = [narrow_lane(sl[:, i]) if narrow else sl[:, i] for i in range(s)]
+    slp = [np.zeros(m, dtype=c.dtype) for c in seq_cols]
+    for buf, c in zip(slp, seq_cols):
+        buf[:n] = c
+    pad = np.zeros(m, dtype=np.uint8)
     pad[n:] = 1
     return klp, slp, pad, n, k, s, m
 
@@ -127,7 +161,8 @@ def _plan_fn(num_key_lanes: int, num_seq_lanes: int):
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
-        # key_lanes: (K, m) uint32; seq_lanes: (S, m) uint32; pad_flag: (m,) uint32
+        # key/seq lanes: (K, m)/(S, m) arrays OR lists of (m,) mixed-dtype
+        # uint arrays (narrowed upload); pad_flag: (m,) uint
         _, perm, seg_start, keep_last, seg_id = sorted_segments(
             num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
         )
@@ -243,7 +278,11 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla
             perm = out[-1]
             from .pallas_kernels import keep_last_mask
 
-            stacked = jnp.stack(out[: 1 + num_key_lanes], axis=0)
+            # upcast to u32 for the pallas kernel (narrowed lanes may be
+            # u8/u16; widening on device costs nothing vs the link)
+            stacked = jnp.stack(
+                [lane.astype(jnp.uint32) for lane in out[: 1 + num_key_lanes]], axis=0
+            )
             sel = keep_last_mask(stacked, interpret=jax.default_backend() == "cpu").astype(jnp.bool_)
         else:
             pad_sorted, perm, _, keep_last, _ = sorted_segments(

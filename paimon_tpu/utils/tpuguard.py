@@ -242,7 +242,10 @@ def ensure_live_backend(require_tpu: bool | None = None, probe_timeout_s: float 
     count, backend = probe_devices(timeout_s=probe_timeout_s)
     if count > 0:
         sf = SingleFlight()
-        if not sf.acquire(timeout_s=60.0):
+        # a fresh probe child holds the lock until its jax client tears down,
+        # which on the tunnel can take minutes — wait it out rather than
+        # failing a perf run that already knows the chip is reachable
+        if not sf.acquire(timeout_s=240.0):
             sys.stderr.write(
                 "[tpuguard] another TPU process holds the single-flight lock; "
                 "refusing to race for the device grant\n"

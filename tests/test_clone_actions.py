@@ -209,3 +209,41 @@ def test_drop_partition_and_mark_done_cli(part_table):
     assert len(out["markers"]) == 1
     marker = json.loads(t.file_io.read_bytes(out["markers"][0]))
     assert marker["creationTime"] <= marker["modificationTime"]
+
+
+def test_query_service_cli(src, tmp_path):
+    """query-service action serves lookups over TCP; the client resolves the
+    address from the table's service registry (reference QueryService)."""
+    import subprocess as sp
+    import sys as _sys
+    import time
+
+    cat, t = src
+    proc = sp.Popen(
+        [_sys.executable, "-m", "paimon_tpu", "query-service",
+         "--warehouse", str(tmp_path / "src"), "--table", "db.t"],
+        stdout=sp.PIPE, stderr=sp.PIPE, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu", "HOME": "/root"},
+    )
+    try:
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["service"] == "kv-query" and info["port"] > 0
+        from paimon_tpu.service import KvQueryClient
+
+        deadline = time.monotonic() + 10
+        client = None
+        while True:
+            try:
+                client = KvQueryClient(info["host"], info["port"])
+                if client.ping():
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "service never became reachable"
+            time.sleep(0.2)
+        row = client.lookup((), (42,))
+        assert row is not None and row[0] == 42
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -362,3 +362,40 @@ def test_aggregation_64bit_exactness(rng):
     row = out.data.to_pylist()[0]
     assert row[1] == sum(big_vals)  # exact int64, not int32 wraparound
     assert row[2] == d_vals[0] + d_vals[1] + d_vals[2]  # exact f64 association order
+
+
+def test_lane_narrowing_preserves_selection(rng):
+    """Range-narrowed (u8/u16) lane upload selects EXACTLY the same rows as
+    the wide u32 path — a constant shift + downcast preserves order and
+    segments; the dtype max stays reserved for the pad sentinel."""
+    from paimon_tpu.ops import merge as M
+
+    n = 5000
+    base = rng.integers(1_000_000, 1_000_000 + 40_000, size=n, dtype=np.uint32)  # u16 range
+    tiny = rng.integers(7, 7 + 200, size=n, dtype=np.uint32)  # u8 range
+    key_lanes = np.stack([base, tiny], axis=1)
+    seq = rng.permutation(n).astype(np.uint32).reshape(n, 1)
+
+    klp, slp, pad, _, k, s, m = M.prepare_lanes(key_lanes, seq)
+    assert [a.dtype for a in klp] == [np.dtype(np.uint16), np.dtype(np.uint16)]
+    assert pad.dtype == np.dtype(np.uint8)
+    wide_bytes = (k + s) * 4 * m
+    narrow_bytes = sum(a.nbytes for a in klp) + sum(a.nbytes for a in slp)
+    assert narrow_bytes <= wide_bytes / 2  # the link win is real
+
+    got = np.sort(M.deduplicate_select(key_lanes, seq))
+    klp_w, slp_w, pad_w, _, kw, sw, _ = M.prepare_lanes(key_lanes, seq, narrow=False)
+    packed, count = M._dedup_select_fn(kw, sw)(klp_w, slp_w, pad_w)
+    wide = np.sort(np.asarray(packed[: int(count)]))
+    assert got.tolist() == wide.tolist()
+
+
+def test_lane_narrowing_sentinel_boundary(rng):
+    """A lane whose range exactly fills u16 must NOT narrow into the
+    sentinel value (strict < check)."""
+    from paimon_tpu.ops import merge as M
+
+    col = np.array([0, 65534], dtype=np.uint32)  # ptp just under u16 max
+    assert M.narrow_lane(col).dtype == np.dtype(np.uint16)
+    col2 = np.array([0, 65535], dtype=np.uint32)  # ptp == u16 max: sentinel collision
+    assert M.narrow_lane(col2).dtype == np.dtype(np.uint32)
