@@ -15,6 +15,21 @@ import json
 import sys
 
 
+def _infer_row_type(first_file: str, fmt: str):
+    """Row type from the first data file's own schema (migrate actions)."""
+    from .data.batch import ColumnBatch
+
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        arrow_schema = pq.read_schema(first_file)
+    else:
+        import pyarrow.orc as po
+
+        arrow_schema = po.ORCFile(first_file).schema
+    return ColumnBatch.row_type_from_arrow(arrow_schema)
+
+
 def _table(args):
     from .catalog import FileSystemCatalog
 
@@ -54,9 +69,11 @@ def main(argv=None) -> int:
         "drop_partition",
         "mark_partition_done",
         "query_service",
+        "repair",
+        "migrate_database",
     ):
         p = sub.add_parser(name.replace("_", "-"))
-        if name not in ("migrate_table", "clone", "compact_database"):
+        if name not in ("migrate_table", "clone", "compact_database", "repair", "migrate_database"):
             _add_common(p)
         if name == "compact":
             p.add_argument("--full", action="store_true")
@@ -124,6 +141,17 @@ def main(argv=None) -> int:
             p.add_argument("--port", type=int, default=0, help="0 = ephemeral")
             p.add_argument("--serve-seconds", type=float, default=None,
                            help="exit after this many seconds (tests); default: run until interrupted")
+        elif name == "repair":
+            p.add_argument("--warehouse", required=True)
+            p.add_argument("--jdbc-path", required=True, help="sqlite db of the JdbcCatalog to repair")
+            p.add_argument("--user", default="cli")
+        elif name == "migrate_database":
+            p.add_argument("--warehouse", required=True)
+            p.add_argument("--database", required=True, help="target database")
+            p.add_argument("--source-dir", required=True,
+                           help="directory of per-table subdirectories of parquet/orc files")
+            p.add_argument("--format", default="parquet")
+            p.add_argument("--user", default="cli")
 
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
@@ -191,29 +219,52 @@ def main(argv=None) -> int:
         print(json.dumps({"compacted": compacted, "full": args.full}))
         return 0
 
+    if action == "repair":
+        from .catalog.jdbc import JdbcCatalog
+
+        cat = JdbcCatalog(args.jdbc_path, args.warehouse, commit_user=args.user)
+        print(json.dumps(cat.repair()))
+        return 0
+
+    if action == "migrate_database":
+        # reference MigrateDatabaseAction: one migrate_table per subdirectory
+        import os as _os
+
+        from .catalog import FileSystemCatalog
+        from .table.migrate import migrate_files
+
+        cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
+        migrated = []
+        for entry in sorted(_os.listdir(args.source_dir)):
+            sub = _os.path.join(args.source_dir, entry)
+            if not _os.path.isdir(sub):
+                continue
+            candidates = sorted(
+                _os.path.join(sub, f)
+                for f in _os.listdir(sub)
+                if f.endswith(f".{args.format}")
+            )
+            if not candidates:
+                continue
+            row_type = _infer_row_type(candidates[0], args.format)
+            migrate_files(cat, f"{args.database}.{entry}", sub, row_type, file_format=args.format)
+            migrated.append(f"{args.database}.{entry}")
+        print(json.dumps({"migrated": migrated}))
+        return 0
+
     if action == "migrate_table":
         import glob
 
         from .catalog import FileSystemCatalog
-        from .data.batch import ColumnBatch
         from .table.migrate import migrate_files
 
         cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
         # infer the row type from the first data file (reference Migrator
         # reads the hive schema; here the files carry it themselves)
-        candidates = sorted(glob.glob(f"{args.source_dir}/*.{args.format}"))
+        candidates = sorted(glob.glob(f"{glob.escape(args.source_dir)}/*.{args.format}"))
         if not candidates:
             ap.error(f"no *.{args.format} files found in {args.source_dir}")
-        first = candidates[0]
-        if args.format == "parquet":
-            import pyarrow.parquet as pq
-
-            arrow_schema = pq.read_schema(first)
-        else:
-            import pyarrow.orc as po
-
-            arrow_schema = po.ORCFile(first).schema
-        row_type = ColumnBatch.row_type_from_arrow(arrow_schema)
+        row_type = _infer_row_type(candidates[0], args.format)
         t = migrate_files(cat, args.table, args.source_dir, row_type, file_format=args.format)
         print(json.dumps({"migrated": args.table, "snapshot": t.store.snapshot_manager.latest_snapshot_id()}))
         return 0

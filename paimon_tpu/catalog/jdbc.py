@@ -194,6 +194,76 @@ class JdbcCatalog(Catalog):
                 (d.database, d.table, s.database, s.table),
             )
 
+    def repair(self) -> dict:
+        """Re-sync the SQL metadata plane with the warehouse filesystem
+        (reference flink/action/RepairAction + Catalog.repairCatalog).
+        Identity is the STORED LOCATION, not the naming convention — a
+        renamed table keeps its original path, so:
+        - rows whose location no longer holds a schema are dropped;
+        - on-disk schema trees whose location no catalog row references are
+          registered under their conventional name;
+        - databases with neither a warehouse directory nor table rows are
+          dropped.
+        Returns {"registered", "removed", "removed_databases"}."""
+        registered: list[str] = []
+        removed: list[str] = []
+        removed_dbs: list[str] = []
+        on_disk: dict[str, dict[str, str]] = {}  # db -> {table: location}
+        try:
+            entries = self.file_io.list_status(self.warehouse)
+        except (FileNotFoundError, OSError):
+            entries = []
+        for st in entries:
+            base = st.path.rstrip("/").rsplit("/", 1)[-1]
+            if not base.endswith(".db"):
+                continue
+            db = base[: -len(".db")]
+            tables: dict[str, str] = {}
+            for ts in self.file_io.list_status(st.path):
+                tname = ts.path.rstrip("/").rsplit("/", 1)[-1]
+                if SchemaManager(self.file_io, ts.path).latest() is not None:
+                    tables[tname] = ts.path.rstrip("/")
+            on_disk[db] = tables
+        with self._conn() as c:
+            live_locations: set[str] = set()
+            for db, tname, location in list(
+                c.execute("SELECT database_name, table_name, location FROM paimon_tables")
+            ):
+                if SchemaManager(self.file_io, location).latest() is None:
+                    c.execute(
+                        "DELETE FROM paimon_tables WHERE database_name = ? AND table_name = ?",
+                        (db, tname),
+                    )
+                    removed.append(f"{db}.{tname}")
+                else:
+                    live_locations.add(location.rstrip("/"))
+            for db, tables in on_disk.items():
+                c.execute("INSERT OR IGNORE INTO paimon_databases (name) VALUES (?)", (db,))
+                for tname, location in tables.items():
+                    if location in live_locations:
+                        continue  # already registered (possibly under another name)
+                    cur = c.execute(
+                        "INSERT OR IGNORE INTO paimon_tables (database_name, table_name, location) "
+                        "VALUES (?, ?, ?)",
+                        (db, tname, location),
+                    )
+                    if cur.rowcount:
+                        registered.append(f"{db}.{tname}")
+            for (db,) in list(c.execute("SELECT name FROM paimon_databases")):
+                if db in on_disk:
+                    continue
+                has_rows = c.execute(
+                    "SELECT 1 FROM paimon_tables WHERE database_name = ? LIMIT 1", (db,)
+                ).fetchone()
+                if not has_rows:
+                    c.execute("DELETE FROM paimon_databases WHERE name = ?", (db,))
+                    removed_dbs.append(db)
+        return {
+            "registered": sorted(registered),
+            "removed": sorted(removed),
+            "removed_databases": sorted(removed_dbs),
+        }
+
     def lock(self, identifier: "Identifier | str") -> "JdbcCatalogLock":
         ident = Identifier.parse(identifier) if isinstance(identifier, str) else identifier
         return JdbcCatalogLock(self.db_path, f"{ident.database}.{ident.table}")

@@ -247,3 +247,78 @@ def test_query_service_cli(src, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_clone_under_fault_injection(tmp_path):
+    """Clone's retry-on-vanish loop (reference PickFilesUtil.retryReadingFiles)
+    survives injected read failures: each failed attempt re-picks from the
+    current latest snapshot; once the fault budget is spent the copy lands
+    complete and correct."""
+    from paimon_tpu.fs.testing import FailingFileIO
+
+    cat = FileSystemCatalog(str(tmp_path / "src"), commit_user="setup")
+    t = cat.create_table("db.ft", SCHEMA, primary_keys=["id"], options={"bucket": "2"})
+    _write(t, 0, 200)
+    _write(t, 100, 300)
+
+    FailingFileIO.reset("clonefault", max_fails=5, possibility=30, seed=3)
+    faulty = FileSystemCatalog(f"fail://clonefault{tmp_path}/src", commit_user="setup")
+    ft = faulty.get_table("db.ft")
+    dst_cat = FileSystemCatalog(str(tmp_path / "dst"), commit_user="clone")
+    cloned = C.clone_table(ft, dst_cat, "mirror.ft", parallelism=2, max_retries=10)
+    assert _read_ids(cloned) == list(range(300))
+
+
+def test_repair_cli(tmp_path):
+    """repair re-syncs the JDBC metadata plane with the warehouse filesystem
+    (reference RepairAction): unregistered on-disk tables get rows, rows
+    without backing storage are dropped."""
+    from paimon_tpu.catalog.jdbc import JdbcCatalog
+
+    wh = str(tmp_path / "wh")
+    db_path = str(tmp_path / "meta.db")
+    jcat = JdbcCatalog(db_path, wh, commit_user="setup")
+    jcat.create_table("db.keep", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    jcat.create_table("db.ghost", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    # a table created OUTSIDE the jdbc catalog (e.g. by the FS catalog)
+    fcat = FileSystemCatalog(wh, commit_user="setup")
+    t = fcat.create_table("db.orphaned", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    _write(t, 0, 5)
+    # ghost's storage vanishes
+    import shutil
+
+    shutil.rmtree(f"{wh}/db.db/ghost")
+    out = json.loads(run_cli("repair", "--warehouse", wh, "--jdbc-path", db_path))
+    assert out == {"registered": ["db.orphaned"], "removed": ["db.ghost"], "removed_databases": []}
+    assert sorted(jcat.list_tables("db")) == ["keep", "orphaned"]
+    assert _read_ids(jcat.get_table("db.orphaned")) == list(range(5))
+
+    # a renamed table survives repair: identity is the stored LOCATION, not
+    # the naming convention (rename keeps the original path)
+    jcat.rename_table("db.keep", "db.kept2")
+    out = json.loads(run_cli("repair", "--warehouse", wh, "--jdbc-path", db_path))
+    assert out == {"registered": [], "removed": [], "removed_databases": []}
+    assert "kept2" in jcat.list_tables("db") and "keep" not in jcat.list_tables("db")
+
+
+def test_migrate_database_cli(tmp_path):
+    """migrate-database: one table per source subdirectory (reference
+    MigrateDatabaseAction)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    src = tmp_path / "lake"
+    for name in ("orders", "users"):
+        (src / name).mkdir(parents=True)
+        pq.write_table(
+            pa.table({"id": pa.array([1, 2, 3], pa.int64()), "v": pa.array([1.0, 2.0, 3.0])}),
+            src / name / "part-0.parquet",
+        )
+    wh = str(tmp_path / "wh")
+    out = json.loads(run_cli(
+        "migrate-database", "--warehouse", wh, "--database", "lakehouse",
+        "--source-dir", str(src),
+    ))
+    assert out["migrated"] == ["lakehouse.orders", "lakehouse.users"]
+    cat = FileSystemCatalog(wh)
+    assert _read_ids(cat.get_table("lakehouse.users")) == [1, 2, 3]
