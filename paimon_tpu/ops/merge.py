@@ -302,6 +302,94 @@ def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None
     return _dedup_select_fn(k, s, backend)(klp, slp, pad)
 
 
+def pack_delta_runs(col: np.ndarray, run_offsets: Sequence[int]):
+    """Delta-pack one u32 lane of ascending key-sorted runs for upload:
+    u16 within-run deltas + per-run u32 bases; the device reconstructs the
+    lane exactly with one cumsum. Halves the dominant link bytes for dense
+    keys (the VERDICT r2 #2 'delta/bit-packed lane upload'). Returns
+    (deltas u16 (m,), starts i32 (R,), bases u32 (R,), pad u8 (m,), n, m)
+    or None when any within-run delta exceeds u16 (caller falls back wide)."""
+    n = len(col)
+    if n == 0:
+        return None
+    if int(col.max()) - int(col.min()) < 0xFFFF:
+        # the whole range fits u16: narrow_lane's wide path already uploads
+        # the same bytes — delta packing would be pure overhead
+        return None
+    # drop empty runs (a filtered-out file yields a duplicate offset; a
+    # start equal to n would index past the column)
+    starts = np.asarray(
+        [s for s, e in zip(run_offsets[:-1], run_offsets[1:]) if e > s], dtype=np.int64
+    )
+    if len(starts) == 0:
+        return None
+    d = np.zeros(n, dtype=np.int64)
+    d[1:] = col[1:].astype(np.int64) - col[:-1].astype(np.int64)
+    d[starts] = 0  # run boundaries carry the base instead
+    if d.min() < 0 or d.max() > 0xFFFF:
+        return None  # not ascending / sparse keys: wide path wins
+    m = pad_size(n)
+    deltas = np.zeros(m, dtype=np.uint16)
+    deltas[:n] = d.astype(np.uint16)
+    r = len(starts)
+    rp = 4
+    while rp < r:
+        rp <<= 1
+    starts_p = np.full(rp, m, dtype=np.int32)  # pad runs start past the end
+    starts_p[:r] = starts
+    bases_p = np.zeros(rp, dtype=np.uint32)
+    bases_p[:r] = col[starts]
+    pad = np.zeros(m, dtype=np.uint8)
+    pad[n:] = 1
+    return deltas, starts_p, bases_p, pad, n, m
+
+
+@functools.lru_cache(maxsize=None)
+def _dedup_select_delta_fn(backend: str = "xla"):
+    """The dedup kernel for delta-packed single-lane keys: reconstruct the
+    u32 lane on device (cumsum + per-run rebase), then the standard
+    sort + keep-last + pack epilogue."""
+
+    @jax.jit
+    def f(deltas, starts, bases, pad_flag):
+        m = pad_flag.shape[0]
+        iota = jnp.arange(m, dtype=jnp.int32)
+        c = jnp.cumsum(deltas.astype(jnp.uint32), dtype=jnp.uint32)
+        run = jnp.clip(
+            jnp.searchsorted(starts, iota, side="right").astype(jnp.int32) - 1,
+            0,
+            starts.shape[0] - 1,
+        )
+        lane = bases[run] + (c - c[starts[run]])
+        lane = jnp.where(pad_flag == 0, lane, jnp.uint32(0xFFFFFFFF))
+        pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag)
+        sel = keep_last & (pad_sorted == 0)
+        return pack_selected(sel, perm)
+
+    return f
+
+
+def deduplicate_select_delta_async(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: str = "xla"):
+    """Delta-packed dispatch for single-lane run-sorted keys; None when the
+    lane does not qualify (multi-lane, non-ascending, sparse deltas, or a
+    range the u16 narrowing already covers)."""
+    if key_lanes.shape[1] != 1 or backend == "pallas":
+        return None
+    packed = pack_delta_runs(key_lanes[:, 0], run_offsets)
+    if packed is None:
+        return None
+    deltas, starts, bases, pad, _n, _m = packed
+    return _dedup_select_delta_fn(backend)(deltas, starts, bases, pad)
+
+
+def _dedup_dispatch(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: str):
+    """One dispatch-policy site: delta-packed when it wins, else wide."""
+    handle = deduplicate_select_delta_async(key_lanes, run_offsets, backend=backend)
+    if handle is None:
+        handle = deduplicate_select_async(key_lanes, None, backend=backend)
+    return handle
+
+
 def deduplicate_resolve(handle) -> np.ndarray:
     packed, count = handle
     c = int(count)
@@ -348,7 +436,7 @@ def deduplicate_tiled_dispatch(
     if n == 0:
         return []
     if n <= tile_rows or len(offsets) < 3:
-        return [(deduplicate_select_async(key_lanes, None, backend=backend), np.arange(n, dtype=np.int32))]
+        return [(_dedup_dispatch(key_lanes, offsets, backend), np.arange(n, dtype=np.int32))]
     lane0_runs = [key_lanes[offsets[r] : offsets[r + 1], 0] for r in range(len(offsets) - 1)]
     largest = max(lane0_runs, key=len)
     num_tiles = max(2, (n + tile_rows - 1) // tile_rows)
@@ -371,7 +459,8 @@ def deduplicate_tiled_dispatch(
             continue
         tile_lanes = np.concatenate(slices) if len(slices) > 1 else slices[0]
         tile_global = np.concatenate(rows) if len(rows) > 1 else rows[0]
-        handles.append((deduplicate_select_async(tile_lanes, None, backend=backend), tile_global))
+        tile_offsets = np.concatenate([[0], np.cumsum([len(s) for s in slices])]).tolist()
+        handles.append((_dedup_dispatch(tile_lanes, tile_offsets, backend), tile_global))
     return handles
 
 

@@ -399,3 +399,51 @@ def test_lane_narrowing_sentinel_boundary(rng):
     assert M.narrow_lane(col).dtype == np.dtype(np.uint16)
     col2 = np.array([0, 65535], dtype=np.uint32)  # ptp == u16 max: sentinel collision
     assert M.narrow_lane(col2).dtype == np.dtype(np.uint32)
+
+
+def test_delta_packed_dedup_matches_wide(rng):
+    """Delta-packed upload (u16 deltas + per-run bases, device cumsum
+    reconstruction) selects exactly the same rows as the wide path."""
+    from paimon_tpu.ops import merge as M
+
+    n = 40_000
+    # key range must exceed u16 (smaller ranges take the narrowed wide path)
+    keys = rng.integers(0, 1 << 20, size=n, dtype=np.uint32)
+    runs = 4
+    per = n // runs
+    lanes = np.empty((n, 1), dtype=np.uint32)
+    offsets = [0]
+    for r in range(runs):
+        lanes[r * per : (r + 1) * per, 0] = np.sort(keys[r * per : (r + 1) * per])
+        offsets.append((r + 1) * per)
+
+    handle = M.deduplicate_select_delta_async(lanes, offsets)
+    assert handle is not None  # dense ascending runs qualify
+    got = np.sort(M.deduplicate_resolve(handle))
+    wide = np.sort(M.deduplicate_select(lanes, None))
+    assert got.tolist() == wide.tolist()
+
+
+def test_delta_packed_fallback_conditions(rng):
+    from paimon_tpu.ops import merge as M
+
+    # sparse deltas (> u16): fall back
+    lanes = np.array([[0], [1 << 20]], dtype=np.uint32)
+    assert M.deduplicate_select_delta_async(lanes, [0, 2]) is None
+    # multi-lane keys: fall back
+    lanes2 = np.zeros((4, 2), dtype=np.uint32)
+    assert M.deduplicate_select_delta_async(lanes2, [0, 4]) is None
+    # non-ascending run: fall back
+    lanes3 = np.array([[1 << 20], [3]], dtype=np.uint32)
+    assert M.deduplicate_select_delta_async(lanes3, [0, 2]) is None
+    # u16-coverable range: narrowing already wins, delta declines
+    lanes4 = np.array([[0], [100]], dtype=np.uint32)
+    assert M.deduplicate_select_delta_async(lanes4, [0, 2]) is None
+    # trailing EMPTY run (filtered-out file): no crash, correct selection
+    lanes5 = np.arange(0, 5 << 18, 1 << 15, dtype=np.uint32).reshape(-1, 1)
+    h = M.deduplicate_select_delta_async(lanes5, [0, len(lanes5), len(lanes5)])
+    assert h is not None
+    assert sorted(M.deduplicate_resolve(h).tolist()) == list(range(len(lanes5)))
+    # tiled dispatch still returns correct rows through the fallback
+    got = np.sort(M.deduplicate_select_tiled(lanes3, [0, 2]))
+    assert got.tolist() == [0, 1]
