@@ -447,3 +447,111 @@ def test_delta_packed_fallback_conditions(rng):
     # tiled dispatch still returns correct rows through the fallback
     got = np.sort(M.deduplicate_select_tiled(lanes3, [0, 2]))
     assert got.tolist() == [0, 1]
+
+
+def _dedup_oracle(lanes: np.ndarray) -> np.ndarray:
+    """Expected dedup output: winner per key = greatest input index (runs
+    concatenated in ascending-seq order), results in global key order."""
+    n = len(lanes)
+    order = np.lexsort((np.arange(n),) + tuple(lanes[:, i] for i in reversed(range(lanes.shape[1]))))
+    srt = lanes[order]
+    neq = (srt[1:] != srt[:-1]).any(axis=1)
+    last = np.concatenate([neq, [True]])
+    return order[last]
+
+
+def _runs_fixture(rng, n, runs, key_hi, k=1):
+    per = n // runs
+    lanes = np.empty((n, k), dtype=np.uint32)
+    offsets = [0]
+    for r in range(runs):
+        lo, hi = r * per, (r + 1) * per if r < runs - 1 else n
+        block = rng.integers(0, key_hi, size=(hi - lo, k), dtype=np.uint32)
+        idx = np.lexsort(tuple(block[:, i] for i in reversed(range(k))))
+        lanes[lo:hi] = block[idx]
+        offsets.append(hi)
+    return lanes, offsets
+
+
+def test_compact_selection_exact_order(rng):
+    """The compact (bit-packed mask + run-id interleave) download format
+    reconstructs EXACTLY the same indices, in the same key order, as the
+    int32-index download — across run counts spanning all rbits tiers,
+    lane arities, and non-multiple-of-8 row counts."""
+    from paimon_tpu.ops import merge as M
+
+    cases = [
+        dict(n=40_000, runs=4, key_hi=1 << 20, k=1),   # delta-qualifying, rbits=2
+        dict(n=40_000, runs=4, key_hi=1 << 31, k=1),   # sparse: wide compact, rbits=2
+        dict(n=30_000, runs=6, key_hi=1 << 20, k=1),   # rbits=4 tier
+        dict(n=33_003, runs=20, key_hi=1 << 18, k=1),  # rbits=8 tier, odd n
+        dict(n=20_000, runs=4, key_hi=1 << 9, k=2),    # multi-lane: wide compact
+        dict(n=5_000, runs=1, key_hi=1 << 14, k=1),    # single run
+    ]
+    for case in cases:
+        lanes, offsets = _runs_fixture(rng, case["n"], case["runs"], case["key_hi"], case["k"])
+        handle = M._dedup_dispatch(lanes, offsets, backend="xla")
+        got = M.deduplicate_resolve(handle)
+        expect = _dedup_oracle(lanes)
+        assert got.tolist() == expect.tolist(), case
+
+
+def test_compact_selection_edge_shapes(rng):
+    from paimon_tpu.ops import merge as M
+
+    # empty middle run (filtered-out file)
+    lanes = np.array([[5], [9], [1], [9]], dtype=np.uint32)
+    handle = M._dedup_dispatch(lanes, [0, 2, 2, 4], backend="xla")
+    assert M.deduplicate_resolve(handle).tolist() == _dedup_oracle(lanes).tolist()
+    # all keys equal: one winner, the last input row
+    lanes2 = np.full((1000, 1), 7, dtype=np.uint32)
+    handle2 = M._dedup_dispatch(lanes2, [0, 500, 1000], backend="xla")
+    assert M.deduplicate_resolve(handle2).tolist() == [999]
+    # duplicate keys WITHIN one run (pre-merged files can't produce this,
+    # but the kernel contract allows it): last index still wins
+    lanes3 = np.array([[1], [1], [2], [1]], dtype=np.uint32)
+    handle3 = M._dedup_dispatch(lanes3, [0, 3, 4], backend="xla")
+    assert M.deduplicate_resolve(handle3).tolist() == _dedup_oracle(lanes3).tolist()
+
+
+def test_compact_selection_through_table_read(tmp_path, rng):
+    """End-to-end: the pipelined merge-read (which now downloads the compact
+    encoding) returns byte-identical results to the numpy sort engine."""
+    import paimon_tpu as pt
+    from paimon_tpu.catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(str(tmp_path), commit_user="t")
+    schema = pt.RowType.of(("id", pt.BIGINT(False)), ("v", pt.BIGINT()))
+    t = cat.create_table(
+        "db.t", schema, primary_keys=["id"],
+        options={"bucket": "1", "write-only": "true"},
+    )
+    ids = rng.permutation(9001).astype(np.int64)
+    for r in range(3):
+        chunk = np.sort(ids[r * 3000 : (r + 1) * 3000] if r < 2 else ids[6000:])
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write({"id": chunk, "v": chunk * 10 + r})
+        wb.new_commit().commit(w.prepare_commit())
+    rb = t.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert out.num_rows == 9001
+    got_ids = np.asarray(out.column("id").values)
+    assert got_ids.tolist() == sorted(ids.tolist())
+    # every id carries the value from its LAST write
+    last_run = {int(i): r for r in range(3) for i in (ids[r * 3000 : (r + 1) * 3000] if r < 2 else ids[6000:])}
+    got_v = np.asarray(out.column("v").values)
+    assert all(int(v) == int(i) * 10 + last_run[int(i)] for i, v in zip(got_ids, got_v))
+
+
+def test_compact_selection_many_runs_fallback(rng):
+    """Above 256 runs the u8 run-id encoding can't represent the interleave;
+    the dispatcher must fall back to the index download and stay exact."""
+    from paimon_tpu.ops import merge as M
+
+    n, runs = 6000, 300
+    lanes, offsets = _runs_fixture(rng, n, runs, 1 << 30, 1)
+    handle = M._dedup_dispatch(lanes, offsets, backend="xla")
+    assert not (isinstance(handle, tuple) and handle[0] == "compact")
+    got = M.deduplicate_resolve(handle)
+    assert got.tolist() == _dedup_oracle(lanes).tolist()
