@@ -370,18 +370,25 @@ def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None
     return _dedup_select_fn(k, s, backend)(klp, slp, pad)
 
 
-def _pad_starts(run_offsets: Sequence[int], m: int):
+def _real_starts(run_offsets: Sequence[int]) -> list[int]:
+    """Start offsets of the NON-EMPTY runs (a filtered-out file yields a
+    duplicate offset) — the single source for run filtering shared by the
+    wide-compact and delta-packed paths."""
+    starts = [s for s, e in zip(run_offsets[:-1], run_offsets[1:]) if e > s]
+    return starts or [0]
+
+
+def _pad_starts(starts_real: Sequence[int], m: int) -> np.ndarray:
     """Pad run starts to a pow2 length (min 4) so jit signatures stay
     bounded; pad entries point past the end (m) and thus never win a
-    searchsorted."""
-    starts = [s for s, e in zip(run_offsets[:-1], run_offsets[1:]) if e > s]
-    starts = starts or [0]
+    searchsorted. The padded length also fixes the run-id bit width
+    (_runid_bits) on both device and host."""
     rp = 4
-    while rp < len(starts):
+    while rp < len(starts_real):
         rp <<= 1
     out = np.full(rp, m, dtype=np.int32)
-    out[: len(starts)] = starts
-    return out, starts
+    out[: len(starts_real)] = starts_real
+    return out
 
 
 @functools.lru_cache(maxsize=None)
@@ -408,10 +415,11 @@ def deduplicate_select_compact_async(key_lanes: np.ndarray, run_offsets: Sequenc
     device; the caller falls back to the index-download path). Requires no
     explicit seq lanes (run order + sort stability carries the sequence
     tie-break)."""
-    if sum(1 for a, b in zip(run_offsets[:-1], run_offsets[1:]) if b > a) > 256:
+    starts_real = _real_starts(run_offsets)
+    if len(starts_real) > 256:
         return None  # run-ids are u8 on device
     klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, None)
-    starts_p, starts_real = _pad_starts(run_offsets, m)
+    starts_p = _pad_starts(starts_real, m)
     outs = _dedup_select_compact_fn(k, s)(klp, slp, pad, starts_p)
     return ("compact", outs, n, len(starts_real), _runid_bits(len(starts_p)))
 
@@ -431,13 +439,7 @@ def pack_delta_runs(col: np.ndarray, run_offsets: Sequence[int]):
         # the whole range fits u16: narrow_lane's wide path already uploads
         # the same bytes — delta packing would be pure overhead
         return None
-    # drop empty runs (a filtered-out file yields a duplicate offset; a
-    # start equal to n would index past the column)
-    starts = np.asarray(
-        [s for s, e in zip(run_offsets[:-1], run_offsets[1:]) if e > s], dtype=np.int64
-    )
-    if len(starts) == 0:
-        return None
+    starts = np.asarray(_real_starts(run_offsets), dtype=np.int64)
     d = np.zeros(n, dtype=np.int64)
     d[1:] = col[1:].astype(np.int64) - col[:-1].astype(np.int64)
     d[starts] = 0  # run boundaries carry the base instead
@@ -447,12 +449,8 @@ def pack_delta_runs(col: np.ndarray, run_offsets: Sequence[int]):
     deltas = np.zeros(m, dtype=np.uint16)
     deltas[:n] = d.astype(np.uint16)
     r = len(starts)
-    rp = 4
-    while rp < r:
-        rp <<= 1
-    starts_p = np.full(rp, m, dtype=np.int32)  # pad runs start past the end
-    starts_p[:r] = starts
-    bases_p = np.zeros(rp, dtype=np.uint32)
+    starts_p = _pad_starts(starts.tolist(), m)
+    bases_p = np.zeros(len(starts_p), dtype=np.uint32)
     bases_p[:r] = col[starts]
     pad = np.zeros(m, dtype=np.uint8)
     pad[n:] = 1

@@ -92,8 +92,10 @@ class ConfigOption(Generic[T]):
         return ConfigOption(key, default, lambda v: None if v is None else float(v), description)
 
     @staticmethod
-    def bool_(key: str, default: bool = False, description: str = ""):
-        return ConfigOption(key, default, lambda v: v if isinstance(v, bool) else str(v).lower() == "true", description)
+    def bool_(key: str, default: bool = False, description: str = "", fallback: tuple[str, ...] = ()):
+        return ConfigOption(
+            key, default, lambda v: v if isinstance(v, bool) else str(v).lower() == "true", description, fallback
+        )
 
     @staticmethod
     def memory(key: str, default: str, description: str = ""):
@@ -107,13 +109,13 @@ class ConfigOption(Generic[T]):
         return ConfigOption(key, d, lambda v: None if v is None else parse_duration_millis(v), description, fallback)
 
     @staticmethod
-    def enum(key: str, enum_cls, default, description: str = ""):
+    def enum(key: str, enum_cls, default, description: str = "", fallback: tuple[str, ...] = ()):
         def parse(v):
             if isinstance(v, enum_cls):
                 return v
             return enum_cls(str(v).lower().replace("_", "-"))
 
-        return ConfigOption(key, default, parse, description)
+        return ConfigOption(key, default, parse, description, fallback)
 
 
 class Options:
@@ -175,6 +177,12 @@ class StartupMode(str, enum.Enum):
     FROM_SNAPSHOT = "from-snapshot"
     FROM_SNAPSHOT_FULL = "from-snapshot-full"
     COMPACTED_FULL = "compacted-full"
+
+    @classmethod
+    def _missing_(cls, value):
+        if value == "full":  # deprecated legacy value (reference StartupMode.FULL)
+            return cls.LATEST_FULL
+        return None
 
 
 class ChangelogProducer(str, enum.Enum):
@@ -241,7 +249,12 @@ class CoreOptions:
     TARGET_FILE_SIZE = ConfigOption.memory("target-file-size", "128 mb", "Rolling target size for data files.")
     WRITE_BUFFER_SIZE = ConfigOption.memory("write-buffer-size", "256 mb", "Memtable size before flush.")
     WRITE_BUFFER_ROWS = ConfigOption.int_("write-buffer-rows", 1_000_000, "Memtable row cap before flush.")
-    WRITE_ONLY = ConfigOption.bool_("write-only", False, "Skip compaction (dedicated compact job mode).")
+    WRITE_ONLY = ConfigOption.bool_(
+        "write-only",
+        False,
+        "Skip compaction (dedicated compact job mode).",
+        fallback=("write.compaction-skip",),
+    )
     WRITE_BUFFER_SPILLABLE = ConfigOption.bool_(
         "write-buffer-spillable", False, "Spill the write buffer to local disk under memory pressure."
     )
@@ -258,7 +271,16 @@ class CoreOptions:
         "write-buffer-spill.size", "64 mb", "In-memory bytes before a spill segment is written."
     )
     MERGE_ENGINE = ConfigOption.enum("merge-engine", MergeEngine, MergeEngine.DEDUPLICATE, "How same-key records merge.")
-    IGNORE_DELETE = ConfigOption.bool_("ignore-delete", False, "Ignore -D records on write/merge.")
+    IGNORE_DELETE = ConfigOption.bool_(
+        "ignore-delete",
+        False,
+        "Ignore -D records on write/merge.",
+        fallback=(
+            "first-row.ignore-delete",
+            "deduplicate.ignore-delete",
+            "partial-update.ignore-delete",
+        ),
+    )
     SORT_ENGINE = ConfigOption.enum("sort-engine", SortEngine, SortEngine.XLA_SEGMENTED, "Merge kernel backend.")
     PARALLEL_MESH_ENABLED = ConfigOption.bool_(
         "parallel.mesh.enabled",
@@ -370,9 +392,13 @@ class CoreOptions:
     CHANGELOG_PRODUCER = ConfigOption.enum(
         "changelog-producer", ChangelogProducer, ChangelogProducer.NONE, "How changelog files are produced."
     )
-    SCAN_MODE = ConfigOption.enum("scan.mode", StartupMode, StartupMode.DEFAULT, "Startup mode for scans.")
+    SCAN_MODE = ConfigOption.enum(
+        "scan.mode", StartupMode, StartupMode.DEFAULT, "Startup mode for scans.", fallback=("log.scan",)
+    )
     SCAN_SNAPSHOT_ID = ConfigOption.int_("scan.snapshot-id", None, "Snapshot id for time travel.")
-    SCAN_TIMESTAMP_MILLIS = ConfigOption.int_("scan.timestamp-millis", None, "Timestamp for time travel.")
+    SCAN_TIMESTAMP_MILLIS = ConfigOption.int_(
+        "scan.timestamp-millis", None, "Timestamp for time travel.", fallback=("log.scan.timestamp-millis",)
+    )
     SCAN_TIMESTAMP = ConfigOption.string(
         "scan.timestamp", None, "Timestamp for time travel as 'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' (local time)."
     )
@@ -450,6 +476,7 @@ class CoreOptions:
         50,
         "Cap on files merged by one size-ratio/file-num pick (bounds a "
         "single compaction's input; reference compaction.max.file-num).",
+        fallback=("compaction.early-max.file-num",),
     )
     COMPACTION_OPTIMIZATION_INTERVAL = ConfigOption.int_(
         "compaction.optimization-interval", None, "Force full compaction every N millis."

@@ -340,3 +340,37 @@ def test_range_shuffle_sample_magnification():
     kept = np.asarray(out_k)[np.asarray(out_pad) == 0, 0]
     # all rows survive the exchange exactly once
     assert sorted(kept.tolist()) == sorted(kl[:, 0].tolist())
+
+
+def test_deprecated_alias_keys_accepted():
+    """Reference withDeprecatedKeys aliases resolve to their successors
+    (CoreOptions.java: write-only<-write.compaction-skip, scan.mode<-log.scan,
+    ignore-delete<-*.ignore-delete, compaction.max.file-num<-early-max,
+    scan.timestamp-millis<-log.scan.timestamp-millis)."""
+    from paimon_tpu.options import CoreOptions, Options, StartupMode
+
+    o = Options({
+        "write.compaction-skip": "true",
+        "log.scan": "from-snapshot",
+        "partial-update.ignore-delete": "true",
+        "compaction.early-max.file-num": "7",
+        "log.scan.timestamp-millis": "123",
+    })
+    assert o.get(CoreOptions.WRITE_ONLY) is True
+    assert o.get(CoreOptions.SCAN_MODE) == StartupMode.FROM_SNAPSHOT
+    assert o.get(CoreOptions.IGNORE_DELETE) is True
+    assert o.get(CoreOptions.COMPACTION_MAX_FILE_NUM) == 7
+    assert o.get(CoreOptions.SCAN_TIMESTAMP_MILLIS) == 123
+    # the canonical key wins over an alias when both are present
+    o2 = Options({"write-only": "false", "write.compaction-skip": "true"})
+    assert o2.get(CoreOptions.WRITE_ONLY) is False
+
+
+def test_deprecated_full_scan_mode_value():
+    """log.scan=full (the primary legacy value) maps to latest-full, as the
+    reference's deprecated StartupMode.FULL does."""
+    from paimon_tpu.options import CoreOptions, Options, StartupMode
+
+    o = Options({"log.scan": "full"})
+    assert o.get(CoreOptions.SCAN_MODE) == StartupMode.LATEST_FULL
+    assert StartupMode("full") is StartupMode.LATEST_FULL
