@@ -68,12 +68,30 @@ class ParquetFormat(FileFormat):
             pf = pq.ParquetFile(f)
             md = pf.metadata
             name_to_idx = {md.schema.column(i).name: i for i in range(md.num_columns)}
-            for rg in range(md.num_row_groups):
-                if predicate is not None and not predicate.test_stats(
+            keep = [
+                rg
+                for rg in range(md.num_row_groups)
+                if predicate is None
+                or predicate.test_stats(
                     _row_group_stats(md, rg, name_to_idx, predicate.referenced_fields(), schema)
-                ):
-                    continue
-                table = pf.read_row_groups([rg], columns=cols)
+                )
+            ]
+            # batch consecutive groups into one read call (pyarrow decodes
+            # columns and groups in parallel internally, where a
+            # group-at-a-time loop is single-threaded per step) — but bound
+            # each call's uncompressed bytes so a multi-GB file still
+            # streams instead of materializing whole
+            budget = 256 << 20
+            i = 0
+            while i < len(keep):
+                chunk = [keep[i]]
+                spent = md.row_group(keep[i]).total_byte_size
+                i += 1
+                while i < len(keep) and spent + md.row_group(keep[i]).total_byte_size <= budget:
+                    spent += md.row_group(keep[i]).total_byte_size
+                    chunk.append(keep[i])
+                    i += 1
+                table = pf.read_row_groups(chunk, columns=cols)
                 if table.num_rows:
                     yield ColumnBatch.from_arrow(table, read_schema)
         finally:

@@ -12,8 +12,28 @@ layout exactly:
   avro_io     — generic Avro object-container file read/write for the
                 manifest record schemas
   golden      — reference-layout table writer (fixtures) + reader/scanner
+
+Engine-facing consumption surfaces live here too:
+
+  arrow_surface — RecordBatchReader / pyarrow Dataset / Arrow Flight server
+  ml            — jax / torch input pipelines over table scans (the L5
+                  analog for TPU-native consumers)
 """
 
 from .golden import read_reference_table, write_reference_table
 
-__all__ = ["read_reference_table", "write_reference_table"]
+__all__ = [
+    "read_reference_table",
+    "write_reference_table",
+    "iter_batches",
+    "to_jax",
+    "TorchIterableDataset",
+]
+
+
+def __getattr__(name):  # lazy: ml pulls in torch/jax only when asked for
+    if name in ("iter_batches", "to_jax", "TorchIterableDataset"):
+        from . import ml
+
+        return getattr(ml, name)
+    raise AttributeError(name)

@@ -1,0 +1,257 @@
+"""ML-engine serving: the TPU-native analog of the reference's L5 engine
+connectors.
+
+The reference's connectors exist so *compute engines* can consume tables:
+Flink (`paimon-flink/.../source/FlinkSourceBuilder.java` builds a source
+whose splits are table splits), Spark (DataSourceV2), Hive
+(`PaimonInputFormat` — splits as engine splits). A TPU-native lake's
+first-class consumers are training and evaluation loops, so this module
+serves table scans as:
+
+- `iter_batches`   — dicts of numpy arrays (any framework, zero deps)
+- `to_jax`         — dicts of jax arrays, optionally `device_put` against a
+                     `jax.sharding.Mesh` axis (data-parallel input pipeline;
+                     multi-host callers shard splits by `process_index`)
+- `TorchIterableDataset` — a picklable torch `IterableDataset` that shards
+                     splits across DataLoader workers (the same split ->
+                     worker mapping the reference's enumerator does across
+                     Flink subtasks, `flink/source/ContinuousFileSplitEnumerator`)
+
+Splits remain the unit of work distribution exactly as in the reference;
+merge-on-read, predicate/projection pushdown, and time travel all come from
+the normal ReadBuilder path, so a training job sees the same snapshot
+semantics as any other reader.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator, Mapping, Sequence
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from ..data.predicate import Predicate
+    from ..table import FileStoreTable
+
+try:  # subclass torch's IterableDataset so DataLoader streams (not indexes);
+    from torch.utils.data import IterableDataset as _TorchIterableBase
+except Exception:  # torch absent: plain iterable (still works standalone)
+    _TorchIterableBase = object
+
+__all__ = ["iter_batches", "to_jax", "TorchIterableDataset"]
+
+
+def _numeric_names(schema, include_strings: bool) -> list[str]:
+    out = []
+    for f in schema.fields:
+        is_obj = f.type.numpy_dtype() == np.dtype(object)
+        if include_strings or not is_obj:
+            out.append(f.name)
+    return out
+
+
+def _batch_to_numpy(batch, names: Sequence[str], include_validity: bool) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for name in names:
+        col = batch.column(name)
+        out[name] = col.values
+        if include_validity and col.validity is not None:
+            out[f"{name}__valid"] = col.valid_mask()
+    return out
+
+
+def iter_batches(
+    table: "FileStoreTable",
+    *,
+    batch_rows: int = 65536,
+    projection: Sequence[str] | None = None,
+    predicate: "Predicate | None" = None,
+    shuffle_splits: bool = False,
+    seed: int | None = None,
+    include_strings: bool = True,
+    include_validity: bool = False,
+    splits=None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stream a batch scan as dicts of numpy arrays of <= batch_rows rows.
+
+    `shuffle_splits` permutes split order per epoch (seeded) — the standard
+    input-pipeline trick of shuffling at the shard level while each shard
+    stays sequential. Pass `splits` to serve a pre-planned/pre-assigned
+    subset (distributed workers split the plan among themselves the way
+    engine tasks split the reference's `FileStoreSourceSplit`s)."""
+    rb = table.new_read_builder()
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    if projection is not None:
+        rb = rb.with_projection(list(projection))
+    if splits is None:
+        splits = rb.new_scan().plan()
+    splits = list(splits)
+    if shuffle_splits:
+        np.random.default_rng(seed).shuffle(splits)
+    schema = table.row_type if projection is None else table.row_type.project(list(projection))
+    names = _numeric_names(schema, include_strings)
+    read = rb.new_read()
+    for split in splits:
+        batch = read.read(split)
+        for lo in range(0, batch.num_rows, batch_rows):
+            part = batch.slice(lo, min(lo + batch_rows, batch.num_rows))
+            yield _batch_to_numpy(part, names, include_validity)
+
+
+def to_jax(
+    table: "FileStoreTable",
+    *,
+    batch_rows: int = 65536,
+    projection: Sequence[str] | None = None,
+    predicate: "Predicate | None" = None,
+    shuffle_splits: bool = False,
+    seed: int | None = None,
+    include_validity: bool = False,
+    mesh=None,
+    data_axis: str = "data",
+    drop_remainder: bool | None = None,
+    splits=None,
+) -> Iterator[Mapping[str, "object"]]:
+    """`iter_batches` with jax placement. Strings are excluded (no jax
+    dtype). With `mesh`, every batch is `device_put` with a NamedSharding
+    over `data_axis` (row dimension sharded across the mesh axis — the
+    data-parallel feed); batches are trimmed to a multiple of the axis size
+    unless drop_remainder=False, in which case the tail pads by repeating
+    the last row (weights should mask it). Multi-host data parallelism:
+    plan once, shard the split list by `jax.process_index()`, and pass each
+    host its subset via `splits` — each host then feeds only its shard."""
+    import jax
+    import jax.numpy as jnp
+
+    sharding = None
+    axis = 1
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+        axis = int(np.prod([mesh.shape[a] for a in (data_axis,)]))
+    for np_batch in iter_batches(
+        table,
+        batch_rows=batch_rows,
+        projection=projection,
+        predicate=predicate,
+        shuffle_splits=shuffle_splits,
+        seed=seed,
+        include_strings=False,
+        include_validity=include_validity,
+        splits=splits,
+    ):
+        if not np_batch:
+            continue
+        n = len(next(iter(np_batch.values())))
+        if sharding is not None and n % axis:
+            if drop_remainder is None or drop_remainder:
+                n_keep = (n // axis) * axis
+                if n_keep == 0:
+                    continue
+                np_batch = {k: v[:n_keep] for k, v in np_batch.items()}
+            else:
+                pad = axis - (n % axis)
+                np_batch = {k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)]) for k, v in np_batch.items()}
+        if sharding is not None:
+            yield {k: jax.device_put(v, sharding) for k, v in np_batch.items()}
+        else:
+            yield {k: jnp.asarray(v) for k, v in np_batch.items()}
+
+
+class TorchIterableDataset(_TorchIterableBase):
+    """A picklable torch IterableDataset over a table scan.
+
+    Constructed from (warehouse, identifier) rather than a live table so
+    DataLoader workers can rebuild the catalog in their own process. The
+    scan is PLANNED ONCE at construction (in the parent) and the serialized
+    split list is what workers inherit — every worker shards the identical
+    snapshot-pinned plan round-robin by `get_worker_info()`, so one split is
+    read by exactly one worker even while writers keep committing (the
+    reference's enumerator assigns one immutable plan to subtasks the same
+    way). Shuffling permutes that one plan with a seed that is drawn once in
+    the parent; call `set_epoch(e)` between epochs to reshuffle
+    deterministically (DistributedSampler convention). Numeric columns
+    become torch tensors; string columns are excluded unless
+    `as_numpy=True` (then dicts of numpy arrays are yielded instead,
+    strings included)."""
+
+    def __init__(
+        self,
+        warehouse: str,
+        identifier: str,
+        *,
+        batch_rows: int = 65536,
+        projection: Sequence[str] | None = None,
+        options: Mapping[str, str] | None = None,
+        shuffle_splits: bool = False,
+        seed: int | None = None,
+        as_numpy: bool = False,
+    ):
+        self.warehouse = warehouse
+        self.identifier = identifier
+        self.batch_rows = batch_rows
+        self.projection = list(projection) if projection is not None else None
+        self.options = dict(options or {})
+        self.shuffle_splits = shuffle_splits
+        # drawn once in the parent so every forked worker shuffles the same
+        # permutation (a per-worker fresh seed would duplicate/drop splits)
+        self.seed = int(np.random.default_rng(seed).integers(1 << 31)) if shuffle_splits else 0
+        self.epoch = 0
+        self.as_numpy = as_numpy
+        self._split_dicts = [s.to_dict() for s in self._plan()]
+
+    def _plan(self):
+        table = self._table(in_worker=False)
+        rb = table.new_read_builder()
+        if self.projection is not None:
+            rb = rb.with_projection(self.projection)
+        return rb.new_scan().plan()
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle deterministically for a new epoch (call BEFORE creating
+        the DataLoader iterator, i.e. before workers fork)."""
+        self.epoch = int(epoch)
+
+    def _table(self, in_worker: bool):
+        from ..catalog import FileSystemCatalog
+
+        opts = dict(self.options)
+        if in_worker:
+            # forked DataLoader workers must not touch jax (a forked child
+            # inherits the parent's jax runtime locks and deadlocks); the
+            # numpy merge engine is byte-identical and fork-safe
+            opts.setdefault("sort-engine", "numpy")
+        t = FileSystemCatalog(self.warehouse).get_table(self.identifier)
+        return t.copy(opts) if opts else t
+
+    def __iter__(self):
+        from ..table.read import DataSplit
+
+        try:
+            from torch.utils.data import get_worker_info
+
+            info = get_worker_info()
+        except Exception:  # torch absent: single-worker semantics
+            info = None
+        table = self._table(in_worker=info is not None)
+        splits = [DataSplit.from_dict(d) for d in self._split_dicts]
+        if self.shuffle_splits:
+            np.random.default_rng((self.seed, self.epoch)).shuffle(splits)
+        if info is not None and info.num_workers > 1:
+            splits = splits[info.id :: info.num_workers]
+        it = iter_batches(
+            table,
+            batch_rows=self.batch_rows,
+            projection=self.projection,
+            include_strings=self.as_numpy,
+            splits=splits,
+        )
+        if self.as_numpy:
+            yield from it
+            return
+        import torch
+
+        for np_batch in it:
+            yield {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in np_batch.items()}
