@@ -113,6 +113,37 @@ def _runid_bits(num_runs: int) -> int:
     return 8
 
 
+def _bitpack_rows(vals, rbits: int):
+    """In-kernel: pack small uints (< 2^rbits) along the last axis,
+    8/rbits per byte — the device half of _unpack_runids."""
+    per = 8 // rbits
+    r2 = vals.astype(jnp.uint8).reshape(vals.shape[:-1] + (vals.shape[-1] // per, per))
+    byte = r2[..., 0]
+    for i in range(1, per):
+        byte = byte | (r2[..., i] << jnp.uint8(i * rbits))
+    return byte
+
+
+def _unpack_runids(packed: np.ndarray, c: int, rbits: int) -> np.ndarray:
+    """Host: first c rbits-wide values from a _bitpack_rows byte stream."""
+    per = 8 // rbits
+    pk = np.asarray(packed[: (c + per - 1) // per])
+    if rbits == 8:
+        return pk[:c]
+    lanes = [(pk >> (i * rbits)) & ((1 << rbits) - 1) for i in range(per)]
+    return np.stack(lanes, axis=1).ravel()[:c]
+
+
+def _interleave_winners(winners: np.ndarray, rs: np.ndarray) -> np.ndarray:
+    """Host: winners are grouped by run/block (ascending within each); rs is
+    the run-id per output position. The stable argsort maps output positions
+    ordered (run, output-order) onto winners element for element — radix
+    argsort over small ints is O(c)."""
+    out = np.empty(len(rs), dtype=np.int32)
+    out[np.argsort(rs, kind="stable")] = winners
+    return out
+
+
 def pack_selection_compact(sel, perm, starts):
     """In-kernel epilogue: encode the selection as (a) a bit-packed keep-mask
     in INPUT coordinates and (b) bit-packed run-ids of the winners in key
@@ -134,12 +165,7 @@ def pack_selection_compact(sel, perm, starts):
     _, runs_key_order = jax.lax.sort(
         [(~sel).astype(jnp.uint32), run_in.astype(jnp.uint32)], num_keys=1, is_stable=True
     )
-    rbits = _runid_bits(starts.shape[0])
-    per = 8 // rbits
-    r2 = runs_key_order.astype(jnp.uint8).reshape(m // per, per)
-    byte = r2[:, 0]
-    for i in range(1, per):
-        byte = byte | (r2[:, i] << jnp.uint8(i * rbits))
+    byte = _bitpack_rows(runs_key_order, _runid_bits(starts.shape[0]))
     return mask_bytes, byte, sel.sum()
 
 
@@ -152,24 +178,11 @@ def unpack_selection_compact(mask_bytes, runs_packed, count, n: int, num_runs: i
     c = int(count)
     if c == 0:
         return np.empty(0, dtype=np.int32)
-    per = 8 // rbits
-    nbytes_mask = (n + 7) // 8
-    keep = np.unpackbits(np.asarray(mask_bytes[:nbytes_mask]), count=n).astype(bool)
+    keep = np.unpackbits(np.asarray(mask_bytes[: (n + 7) // 8]), count=n).astype(bool)
     winners = np.flatnonzero(keep).astype(np.int32)  # grouped by run, ascending
     if num_runs <= 1:
         return winners
-    nb = (c + per - 1) // per
-    pk = np.asarray(runs_packed[:nb])
-    if rbits == 8:
-        rs = pk[:c]
-    else:
-        lanes = [(pk >> (i * rbits)) & ((1 << rbits) - 1) for i in range(per)]
-        rs = np.stack(lanes, axis=1).ravel()[:c]
-    # output positions ordered (run, output-order) match winners' grouped-by-
-    # run ascending layout element for element; radix argsort is O(c)
-    out = np.empty(c, dtype=np.int32)
-    out[np.argsort(rs, kind="stable")] = winners
-    return out
+    return _interleave_winners(winners, _unpack_runids(runs_packed, c, rbits))
 
 
 def narrow_lane(col: np.ndarray) -> np.ndarray:
@@ -629,6 +642,113 @@ def _partial_update_fn():
     return f
 
 
+def _ascending_block_starts(key_lanes: np.ndarray, max_blocks: int = 257) -> list[int] | None:
+    """Host-side: split the input rows into maximal lexicographically
+    non-decreasing blocks (block = run analog). Any input admits such a
+    partition, so compact selection encodings work without plumbing run
+    offsets: within a block, one winner per key means winners ascend with
+    key. Returns None once more than max_blocks-1 boundaries are found
+    (caller falls back to the index download)."""
+    n, k = key_lanes.shape
+    if n <= 1:
+        return [0]
+    a, b = key_lanes[:-1], key_lanes[1:]
+    gt = np.zeros(n - 1, dtype=np.bool_)  # strict lex decrease at i -> i+1
+    eq = np.ones(n - 1, dtype=np.bool_)
+    for i in range(k):
+        gt |= eq & (a[:, i] > b[:, i])
+        eq &= a[:, i] == b[:, i]
+    cuts = np.flatnonzero(gt)
+    if len(cuts) + 1 >= max_blocks:
+        return None
+    return [0] + (cuts + 1).tolist()
+
+
+def _partial_update_select(perm, pad_sorted, seg_id, field_valid, is_add, is_delete):
+    """In-kernel shared core of BOTH fused partial-update kernels (compact
+    and index-download): per-field last-valid-add-after-last-delete winner
+    per segment, plus segment existence. Keeping it single-sourced means the
+    two download encodings can never diverge semantically."""
+    m = perm.shape[0]
+    pos = jnp.arange(m, dtype=jnp.int32)
+    add_sorted = is_add[perm]
+    del_sorted = is_delete[perm]
+    del_cand = jnp.where(del_sorted, pos, -1)
+    last_del = jax.ops.segment_max(del_cand, seg_id, num_segments=m)
+    gate = pos[None, :] > last_del[seg_id][None, :]
+    fv_sorted = field_valid[:, perm]
+    last_per_field = segment_last_where(seg_id, fv_sorted & add_sorted[None, :] & gate, pos)
+    src = jnp.where(last_per_field >= 0, perm[jnp.clip(last_per_field, 0, m - 1)], -1)  # (F, m)
+    add_cand = jnp.where(add_sorted, pos, -1)
+    last_add = jax.ops.segment_max(add_cand, seg_id, num_segments=m)
+    exists = last_add > last_del  # (m,) indexed by segment id
+    return src, exists
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_partial_update_compact_fn(num_key: int, num_seq: int, num_fields: int):
+    """The fused partial-update kernel with compact downloads: instead of
+    the (F, k) int32 source matrix (the dominant link bytes of the
+    partial-update read on tunnel-attached chips), each field ships a
+    bit-packed winner mask over input rows, presence bits per segment, and
+    bit-packed block-ids of present winners; existence and keep-last ship
+    as bits + block-ids too. ~10x fewer bytes; exact reconstruction in
+    unpack_field_selection_compact."""
+
+    @jax.jit
+    def f(key_lanes, seq_lanes, pad_flag, field_valid, is_add, is_delete, starts):
+        m = pad_flag.shape[0]
+        pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
+            num_key, num_seq, key_lanes, seq_lanes, pad_flag
+        )
+        src, exists = _partial_update_select(perm, pad_sorted, seg_id, field_valid, is_add, is_delete)
+        # ---- compact encodings --------------------------------------------
+        rbits = _runid_bits(starts.shape[0])
+        mask_last, runs_last, count = pack_selection_compact(
+            keep_last & (pad_sorted == 0), perm, starts
+        )
+        exists_bits = jnp.packbits(exists)
+        present = src >= 0  # (F, m) by segment id
+        present_bits = jax.vmap(jnp.packbits)(present)
+        src_cl = jnp.clip(src, 0, m - 1)
+        win_mask = jnp.zeros((num_fields, m), jnp.bool_)
+        win_mask = win_mask.at[jnp.arange(num_fields)[:, None], src_cl].max(present)
+        win_bits = jax.vmap(jnp.packbits)(win_mask)
+        blk = jnp.clip(
+            jnp.searchsorted(starts, src_cl.reshape(-1), side="right").astype(jnp.int32) - 1,
+            0,
+            starts.shape[0] - 1,
+        ).reshape(num_fields, m)
+
+        def pack_front(pr, bi):
+            _, packed = jax.lax.sort(
+                [(~pr).astype(jnp.uint32), bi.astype(jnp.uint32)], num_keys=1, is_stable=True
+            )
+            return packed
+
+        blk_front = jax.vmap(pack_front)(present, blk)  # (F, m) present blocks first
+        blk_bits = _bitpack_rows(blk_front, rbits)  # (F, m*rbits//8)
+        return win_bits, present_bits, blk_bits, exists_bits, mask_last, runs_last, count
+
+    return f
+
+
+def unpack_field_selection_compact(
+    win_bits_f, present_bits_f, blk_bits_f, kk: int, n: int, rbits: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host half for ONE field: -> (present mask (kk,), winner input indices
+    for present segments, in segment order)."""
+    present = np.unpackbits(np.asarray(present_bits_f[: (kk + 7) // 8]), count=kk).astype(bool)
+    c = int(present.sum())
+    if c == 0:
+        return present, np.empty(0, dtype=np.int32)
+    winners = np.flatnonzero(
+        np.unpackbits(np.asarray(win_bits_f[: (n + 7) // 8]), count=n)
+    ).astype(np.int32)
+    vals = _interleave_winners(winners, _unpack_runids(blk_bits_f, c, rbits))
+    return present, vals
+
+
 @functools.lru_cache(maxsize=None)
 def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int):
     """Sort + segment + partial-update selection in ONE kernel: the plan never
@@ -639,22 +759,10 @@ def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int):
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag, field_valid, is_add, is_delete):
-        m = pad_flag.shape[0]
         pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
             num_key, num_seq, key_lanes, seq_lanes, pad_flag
         )
-        pos = jnp.arange(m, dtype=jnp.int32)
-        add_sorted = is_add[perm]
-        del_sorted = is_delete[perm]
-        del_cand = jnp.where(del_sorted, pos, -1)
-        last_del = jax.ops.segment_max(del_cand, seg_id, num_segments=m)
-        gate = pos[None, :] > last_del[seg_id][None, :]
-        fv_sorted = field_valid[:, perm]
-        last_per_field = segment_last_where(seg_id, fv_sorted & add_sorted[None, :] & gate, pos)
-        src = jnp.where(last_per_field >= 0, perm[jnp.clip(last_per_field, 0, m - 1)], -1)
-        add_cand = jnp.where(add_sorted, pos, -1)
-        last_add = jax.ops.segment_max(add_cand, seg_id, num_segments=m)
-        exists = last_add > last_del
+        src, exists = _partial_update_select(perm, pad_sorted, seg_id, field_valid, is_add, is_delete)
         packed, count = pack_selected(keep_last & (pad_sorted == 0), perm)
         return src, exists, packed, count
 
@@ -670,7 +778,9 @@ def fused_partial_update(
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Single-call partial-update merge: returns (src (F, k), exists (k,),
     last_take (k,)) in key order — the same contract as
-    merge_plan + partial_update_takes + keep-last takes, one device trip."""
+    merge_plan + partial_update_takes + keep-last takes, one device trip.
+    When the input decomposes into <=256 ascending-key blocks (always true
+    for real sections), downloads use the compact bit-packed encoding."""
     from ..types import RowKind
 
     klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, seq_lanes)
@@ -679,16 +789,41 @@ def fused_partial_update(
         is_delete = row_kind == int(RowKind.DELETE)
     else:
         is_delete = np.zeros_like(is_add)
-    fv = np.zeros((max(field_valid.shape[0], 1), m), dtype=np.bool_)
-    if field_valid.shape[0]:
-        fv[: field_valid.shape[0], :n] = field_valid
+    F = field_valid.shape[0]
+    fv = np.zeros((max(F, 1), m), dtype=np.bool_)
+    if F:
+        fv[:F, :n] = field_valid
+    starts_real = _ascending_block_starts(key_lanes) if F else None
+    if starts_real is not None:
+        starts_p = _pad_starts(starts_real, m)
+        rbits = _runid_bits(len(starts_p))
+        win_bits, present_bits, blk_bits, exists_bits, mask_last, runs_last, count = (
+            _fused_partial_update_compact_fn(k, s, fv.shape[0])(
+                klp, slp, pad, fv, pad_to(is_add, m, False), pad_to(is_delete, m, False), starts_p
+            )
+        )
+        kk = int(count)
+        last_take = unpack_selection_compact(
+            mask_last, runs_last, count, n, len(starts_real), rbits
+        )
+        exists = np.unpackbits(np.asarray(exists_bits[: (kk + 7) // 8]), count=kk).astype(bool)
+        # one download per tensor (not per field): 3 link round-trips total
+        per = 8 // rbits
+        winb = np.asarray(win_bits[:, : (n + 7) // 8])
+        prb = np.asarray(present_bits[:, : (kk + 7) // 8])
+        blb = np.asarray(blk_bits[:, : max(1, (kk + per - 1) // per)])
+        src_out = np.full((F, kk), -1, dtype=np.int32)
+        for f in range(F):
+            present, vals = unpack_field_selection_compact(winb[f], prb[f], blb[f], kk, n, rbits)
+            src_out[f, present] = vals
+        return src_out, exists, last_take
     src, exists, packed, count = _fused_partial_update_fn(k, s, fv.shape[0])(
         klp, slp, pad, fv, pad_to(is_add, m, False), pad_to(is_delete, m, False)
     )
     kk = int(count)
     # device-side slicing: only (F, k) + 2k elements cross the link
     return (
-        np.asarray(src[: field_valid.shape[0], :kk]),
+        np.asarray(src[:F, :kk]),
         np.asarray(exists[:kk]),
         np.asarray(packed[:kk]),
     )

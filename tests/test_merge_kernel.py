@@ -555,3 +555,29 @@ def test_compact_selection_many_runs_fallback(rng):
     assert not (isinstance(handle, tuple) and handle[0] == "compact")
     got = M.deduplicate_resolve(handle)
     assert got.tolist() == _dedup_oracle(lanes).tolist()
+
+
+def test_fused_partial_update_compact_tiers(rng):
+    """Compact per-field downloads across block counts spanning all rbits
+    tiers (2/4/8-bit block ids), odd sizes, all-null fields, and the >256
+    block fallback — all must match the unfused plan oracle exactly."""
+    from paimon_tpu.ops import merge as M
+
+    for n, blocks in ((4000, 3), (6003, 12), (9001, 40), (4000, 300)):
+        per = max(1, n // blocks)
+        keys = np.empty((n, 1), dtype=np.uint32)
+        for b in range((n + per - 1) // per):
+            lo, hi = b * per, min((b + 1) * per, n)
+            keys[lo:hi, 0] = np.sort(rng.integers(0, n // 2, size=hi - lo, dtype=np.uint32))
+        F = 3
+        fv = rng.random((F, n)) < [[0.7], [0.05], [0.0]]  # incl. nearly/fully null fields
+        kinds = np.zeros(n, dtype=np.uint8)
+        if blocks > 256:  # the fallback case must actually BE the fallback
+            assert M._ascending_block_starts(keys) is None
+        src, exists, last = M.fused_partial_update(keys, None, fv, kinds)
+        plan = M.merge_plan(keys, None)
+        src_o, exists_o = M.partial_update_takes(plan, fv, kinds)
+        last_o = plan.perm[plan.keep_last & plan.valid_sorted]
+        assert last.tolist() == last_o.tolist(), (n, blocks)
+        assert exists.tolist() == np.asarray(exists_o).astype(bool).tolist(), (n, blocks)
+        assert src.tolist() == np.asarray(src_o).tolist(), (n, blocks)
