@@ -32,10 +32,19 @@ enable_compile_cache()
 
 # Wedge-proof device access: detached probe (never killed), single-flight
 # lock around the grant, clean-exit signal handlers, loud CPU fallback.
-# PAIMON_TPU_REQUIRE=1 refuses the fallback (exit 3).
-from paimon_tpu.utils.tpuguard import ensure_live_backend
+# The retrying variant polls the probe cache for PAIMON_TPU_BENCH_RETRY_S
+# (default 900s) before accepting the fallback, so the round-end artifact
+# says "tpu" whenever the grant frees in time. PAIMON_TPU_REQUIRE=1 refuses
+# the fallback (exit 3).
+from paimon_tpu.utils.tpuguard import ensure_live_backend_retrying
 
-_PLATFORM = ensure_live_backend()
+_PLATFORM = ensure_live_backend_retrying()
+
+# freshest successful chip measurement: written on every TPU run, embedded
+# in the fallback row (with its timestamp) when the tunnel is down at
+# snapshot time — the artifact then still carries the chip evidence
+LATEST_CHIP = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "results", "LATEST_CHIP.json")
 
 BASELINE_ROWS_PER_SEC = 975_400.0
 N_ROWS = 1_000_000
@@ -108,17 +117,27 @@ def main():
     try:
         table = build_table(tmp)
         rows_per_sec = bench_read(table)
-        print(
-            json.dumps(
-                {
-                    "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
-                    "value": round(rows_per_sec, 1),
-                    "unit": "rows/s",
-                    "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
-                    "platform": _PLATFORM,
-                }
-            )
-        )
+        row = {
+            "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
+            "value": round(rows_per_sec, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+            "platform": _PLATFORM,
+        }
+        if _PLATFORM.startswith("cpu"):
+            try:
+                with open(LATEST_CHIP) as f:
+                    row["last_chip"] = json.load(f)
+            except (OSError, ValueError):
+                pass  # absent or torn file must never eat the result row
+        else:
+            chip = dict(row, measured_at=time.strftime("%Y-%m-%dT%H:%M:%S"))
+            os.makedirs(os.path.dirname(LATEST_CHIP), exist_ok=True)
+            tmp_path = LATEST_CHIP + ".tmp"
+            with open(tmp_path, "w") as f:
+                json.dump(chip, f)
+            os.replace(tmp_path, LATEST_CHIP)
+        print(json.dumps(row))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

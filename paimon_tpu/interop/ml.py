@@ -148,6 +148,17 @@ def to_jax(
         if sharding is not None and n % axis:
             if drop_remainder is None or drop_remainder:
                 n_keep = (n // axis) * axis
+                dropped = n - n_keep
+                # silent loss is worse than noise: a small table (or a tail
+                # batch) contributing zero rows to training must be visible
+                import warnings
+
+                warnings.warn(
+                    f"to_jax: dropping {dropped} tail row(s) of a {n}-row batch "
+                    f"(not a multiple of data-axis size {axis}); pass "
+                    f"drop_remainder=False to pad instead",
+                    stacklevel=2,
+                )
                 if n_keep == 0:
                     continue
                 np_batch = {k: v[:n_keep] for k, v in np_batch.items()}

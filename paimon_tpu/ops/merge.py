@@ -20,6 +20,7 @@ keep-mask -> index compaction — happens host-side in numpy where it's free.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Sequence
 
@@ -336,6 +337,28 @@ def deduplicate_take(plan: MergePlan) -> np.ndarray:
     return plan.perm[plan.keep_last & plan.valid_sorted]
 
 
+def _pallas_keep_last_select(pad_flag, key_lanes, seq_lanes=()):
+    """In-kernel: stable sort on (pad, keys..., seqs...) then the fused
+    pallas boundary sweep (keep_last_mask) -> (sel, perm). The single
+    implementation of the pallas dedup epilogue, shared by the wide and
+    delta-upload kernels so the interpret flag and u32-upcast rule can
+    never diverge between them."""
+    m = pad_flag.shape[0]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    operands = [pad_flag, *key_lanes, *seq_lanes, iota]
+    out = jax.lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
+    perm = out[-1]
+    from .pallas_kernels import keep_last_mask
+
+    # upcast to u32 for the pallas kernel (narrowed lanes may be u8/u16;
+    # widening on device costs nothing vs the link)
+    stacked = jnp.stack(
+        [lane.astype(jnp.uint32) for lane in out[: 1 + len(key_lanes)]], axis=0
+    )
+    sel = keep_last_mask(stacked, interpret=jax.default_backend() == "cpu").astype(jnp.bool_)
+    return sel, perm
+
+
 @functools.lru_cache(maxsize=None)
 def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla"):
     """Sort + keep-last + device-side compaction: returns ONLY the selected
@@ -347,24 +370,11 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
         if backend == "pallas":
-            m = pad_flag.shape[0]
-            iota = jnp.arange(m, dtype=jnp.int32)
-            operands = (
-                [pad_flag]
-                + [key_lanes[i] for i in range(num_key_lanes)]
-                + [seq_lanes[i] for i in range(num_seq_lanes)]
-                + [iota]
+            sel, perm = _pallas_keep_last_select(
+                pad_flag,
+                [key_lanes[i] for i in range(num_key_lanes)],
+                [seq_lanes[i] for i in range(num_seq_lanes)],
             )
-            out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
-            perm = out[-1]
-            from .pallas_kernels import keep_last_mask
-
-            # upcast to u32 for the pallas kernel (narrowed lanes may be
-            # u8/u16; widening on device costs nothing vs the link)
-            stacked = jnp.stack(
-                [lane.astype(jnp.uint32) for lane in out[: 1 + num_key_lanes]], axis=0
-            )
-            sel = keep_last_mask(stacked, interpret=jax.default_backend() == "cpu").astype(jnp.bool_)
         else:
             pad_sorted, perm, _, keep_last, _ = sorted_segments(
                 num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
@@ -381,6 +391,23 @@ def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None
     columns while the device sorts — resolve with deduplicate_resolve()."""
     klp, slp, pad, _, k, s, _ = prepare_lanes(key_lanes, seq_lanes)
     return _dedup_select_fn(k, s, backend)(klp, slp, pad)
+
+
+def _link_encodings_pay_off() -> bool:
+    """Compact/delta selection encodings trade device+host pack/unpack work
+    for link bytes. On the CPU backend there IS no link — "device" arrays
+    are host memory — so the encodings are pure overhead (they were part of
+    the r03 CPU-fallback bench regression). PAIMON_TPU_FORCE_COMPACT=1
+    overrides so tests exercise the device dispatch policy on CPU.
+
+    Decided from the CONFIGURED platform, never `jax.default_backend()`:
+    that call initializes the backend, and on a wedged tunnel an
+    accelerator-platform init blocks indefinitely — dispatch policy must
+    not be the call that first touches the device."""
+    if os.environ.get("PAIMON_TPU_FORCE_COMPACT", "") == "1":
+        return True
+    cfg = getattr(jax.config, "jax_platforms", None) or os.environ.get("JAX_PLATFORMS", "")
+    return str(cfg).split(",")[0] != "cpu"
 
 
 def _real_starts(run_offsets: Sequence[int]) -> list[int]:
@@ -470,6 +497,21 @@ def pack_delta_runs(col: np.ndarray, run_offsets: Sequence[int]):
     return deltas, starts_p, bases_p, pad, n, m, r
 
 
+def _delta_reconstruct_lane(deltas, starts, bases, pad_flag):
+    """In-kernel: rebuild the u32 key lane from the delta-packed upload
+    (one cumsum + per-run rebase) — shared by both delta epilogues."""
+    m = pad_flag.shape[0]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    c = jnp.cumsum(deltas.astype(jnp.uint32), dtype=jnp.uint32)
+    run = jnp.clip(
+        jnp.searchsorted(starts, iota, side="right").astype(jnp.int32) - 1,
+        0,
+        starts.shape[0] - 1,
+    )
+    lane = bases[run] + (c - c[starts[run]])
+    return jnp.where(pad_flag == 0, lane, jnp.uint32(0xFFFFFFFF))
+
+
 @functools.lru_cache(maxsize=None)
 def _dedup_select_delta_fn(backend: str = "xla"):
     """The dedup kernel for delta-packed single-lane keys: reconstruct the
@@ -478,16 +520,7 @@ def _dedup_select_delta_fn(backend: str = "xla"):
 
     @jax.jit
     def f(deltas, starts, bases, pad_flag):
-        m = pad_flag.shape[0]
-        iota = jnp.arange(m, dtype=jnp.int32)
-        c = jnp.cumsum(deltas.astype(jnp.uint32), dtype=jnp.uint32)
-        run = jnp.clip(
-            jnp.searchsorted(starts, iota, side="right").astype(jnp.int32) - 1,
-            0,
-            starts.shape[0] - 1,
-        )
-        lane = bases[run] + (c - c[starts[run]])
-        lane = jnp.where(pad_flag == 0, lane, jnp.uint32(0xFFFFFFFF))
+        lane = _delta_reconstruct_lane(deltas, starts, bases, pad_flag)
         pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag)
         sel = keep_last & (pad_sorted == 0)
         return pack_selection_compact(sel, perm, starts)
@@ -495,32 +528,57 @@ def _dedup_select_delta_fn(backend: str = "xla"):
     return f
 
 
+@functools.lru_cache(maxsize=None)
+def _dedup_select_delta_wide_fn(backend: str = "xla"):
+    """Delta-packed UPLOAD with the legacy index DOWNLOAD (pack_selected):
+    keeps the halved uplink bytes when the compact download encoding is
+    unavailable — run counts past its u8 run-id limit (>256), and the
+    pallas backend (whose epilogue is the mask kernel under benchmark)."""
+
+    @jax.jit
+    def f(deltas, starts, bases, pad_flag):
+        lane = _delta_reconstruct_lane(deltas, starts, bases, pad_flag)
+        if backend == "pallas":
+            sel, perm = _pallas_keep_last_select(pad_flag, [lane])
+        else:
+            pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag)
+            sel = keep_last & (pad_sorted == 0)
+        return pack_selected(sel, perm)
+
+    return f
+
+
 def deduplicate_select_delta_async(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: str = "xla"):
     """Delta-packed dispatch for single-lane run-sorted keys; None when the
     lane does not qualify (multi-lane, non-ascending, sparse deltas, or a
-    range the u16 narrowing already covers)."""
-    if key_lanes.shape[1] != 1 or backend == "pallas":
+    range the u16 narrowing already covers). Above 256 runs and on the
+    pallas backend, the upload stays delta-packed but the download falls
+    back to packed indices (_dedup_select_delta_wide_fn)."""
+    if key_lanes.shape[1] != 1:
         return None
     packed = pack_delta_runs(key_lanes[:, 0], run_offsets)
     if packed is None:
         return None
     deltas, starts, bases, pad, n, _m, num_runs = packed
-    if num_runs > 256:
-        return None  # run-ids are u8 on device
+    if num_runs > 256 or backend == "pallas":
+        return _dedup_select_delta_wide_fn(backend)(deltas, starts, bases, pad)
     outs = _dedup_select_delta_fn(backend)(deltas, starts, bases, pad)
     return ("compact", outs, n, num_runs, _runid_bits(len(starts)))
 
 
 def _dedup_dispatch(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: str):
-    """One dispatch-policy site: delta-packed when it wins, else wide —
-    both with the compact (bit-packed) download encoding. The pallas
-    backend keeps the index-download path (its epilogue is the mask
-    kernel under benchmark)."""
-    if backend == "pallas":
+    """One dispatch-policy site: delta-packed upload when it qualifies,
+    compact (bit-packed) download when the run count allows, wide
+    index-download otherwise. On the CPU backend every encoding is skipped
+    (_link_encodings_pay_off): there are no link bytes to save."""
+    if not _link_encodings_pay_off():
         return deduplicate_select_async(key_lanes, None, backend=backend)
     handle = deduplicate_select_delta_async(key_lanes, run_offsets, backend=backend)
-    if handle is None:
-        handle = deduplicate_select_compact_async(key_lanes, run_offsets)
+    if handle is not None:
+        return handle
+    if backend == "pallas":
+        return deduplicate_select_async(key_lanes, None, backend=backend)
+    handle = deduplicate_select_compact_async(key_lanes, run_offsets)
     if handle is None:  # >256 runs: index-download fallback
         handle = deduplicate_select_async(key_lanes, None, backend=backend)
     return handle
@@ -793,7 +851,7 @@ def fused_partial_update(
     fv = np.zeros((max(F, 1), m), dtype=np.bool_)
     if F:
         fv[:F, :n] = field_valid
-    starts_real = _ascending_block_starts(key_lanes) if F else None
+    starts_real = _ascending_block_starts(key_lanes) if F and _link_encodings_pay_off() else None
     if starts_real is not None:
         starts_p = _pad_starts(starts_real, m)
         rbits = _runid_bits(len(starts_p))

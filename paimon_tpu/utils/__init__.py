@@ -10,13 +10,43 @@ from typing import Any, Sequence
 __all__ = ["new_file_name", "partition_path", "now_millis", "dumps", "loads", "enable_compile_cache"]
 
 
+def _host_fingerprint() -> str:
+    """Stable id for THIS host's CPU ISA. XLA:CPU cache entries are AOT
+    machine code for the exact feature set of the compiling host; loading a
+    foreign host's entry degrades or breaks (cpu_aot_loader: "machine type
+    doesn't match ... could lead to SIGILL", and mismatched
+    +prefer-no-gather scalarizes every gather — the r03 CPU bench ran 19%
+    below r02 on exactly this). Scoping the cache dir by fingerprint keeps
+    same-host reuse (incl. remote-TPU compiles, which is the point of the
+    cache) while making cross-host pollution structurally impossible."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            text = f.read()
+        # x86 lists ISA extensions under "flags", aarch64 under "Features";
+        # if neither matches (exotic kernel), hash the whole first processor
+        # block — never a constant, or two different hosts would share a dir
+        sig = "\n".join(
+            line for line in text.splitlines() if line.startswith(("flags", "Features"))
+        ) or text.split("\n\n")[0]
+    except OSError:
+        import platform
+
+        sig = platform.processor() or platform.machine()
+    return hashlib.sha256(sig.encode()).hexdigest()[:12]
+
+
 def enable_compile_cache(path: str = "/root/.cache/jax") -> None:
     """Persistent XLA compile cache: remote compiles through the device
-    tunnel cost 15-40s each; repeat runs become compile-free."""
+    tunnel cost 15-40s each; repeat runs become compile-free. The cache
+    lives under a per-host-ISA subdirectory (see _host_fingerprint)."""
+    import os
+
     import jax
 
     for key, value in (
-        ("jax_compilation_cache_dir", path),
+        ("jax_compilation_cache_dir", os.path.join(path, _host_fingerprint())),
         ("jax_persistent_cache_min_compile_time_secs", 0.5),
     ):
         try:

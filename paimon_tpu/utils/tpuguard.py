@@ -266,3 +266,37 @@ def ensure_live_backend(require_tpu: bool | None = None, probe_timeout_s: float 
         raise SystemExit(3)
     jax.config.update("jax_platforms", "cpu")
     return "cpu (accelerator unreachable)"
+
+
+def ensure_live_backend_retrying(budget_s: float | None = None) -> str:
+    """Round-end benchmark entrypoint (VERDICT r3 #1): like
+    ensure_live_backend, but when the accelerator is unreachable keep
+    polling the probe-cache verdict for up to budget_s
+    (PAIMON_TPU_BENCH_RETRY_S, default 900) before accepting the CPU
+    fallback.  The poll is cheap (reads the cache file); new probes are
+    respawned by probe_devices whenever the cached verdict goes stale, and
+    a long-lived sentinel probe flips the verdict the moment a wedged
+    grant frees — so the artifact says "tpu" whenever the chip answers
+    within the budget, instead of silently pinning CPU on the first miss."""
+    if budget_s is None:
+        budget_s = float(os.environ.get("PAIMON_TPU_BENCH_RETRY_S", "900"))
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu (requested)"
+    deadline = time.monotonic() + budget_s
+    while True:
+        remaining = deadline - time.monotonic()
+        count, _backend = probe_devices(timeout_s=max(10.0, min(180.0, remaining)))
+        if count > 0:
+            return ensure_live_backend()
+        if time.monotonic() >= deadline:
+            # deadline path: the verdict is already known negative — don't
+            # let ensure_live_backend spend another full probe window
+            return ensure_live_backend(probe_timeout_s=10.0)
+        sys.stderr.write(
+            f"[tpuguard] accelerator not answering; retrying for another "
+            f"{int(remaining)}s before CPU fallback\n"
+        )
+        time.sleep(20.0)

@@ -6,6 +6,9 @@ import os
 
 # PAIMON_TEST_PLATFORM=tpu runs the kernel suites on the real chip
 _platform = os.environ.get("PAIMON_TEST_PLATFORM", "cpu")
+# exercise the device dispatch policy (compact/delta link encodings) even on
+# the CPU backend, where production dispatch skips them (no link to save)
+os.environ.setdefault("PAIMON_TPU_FORCE_COMPACT", "1")
 os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if _platform == "cpu" and "xla_force_host_platform_device_count" not in flags:

@@ -5,6 +5,8 @@ Mirrors the reference's SortMergeReaderTestBase + merge function tests
 results must be byte-identical to a straightforward per-key interpretation.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -581,3 +583,64 @@ def test_fused_partial_update_compact_tiers(rng):
         assert last.tolist() == last_o.tolist(), (n, blocks)
         assert exists.tolist() == np.asarray(exists_o).astype(bool).tolist(), (n, blocks)
         assert src.tolist() == np.asarray(src_o).tolist(), (n, blocks)
+
+
+@pytest.mark.skipif(
+    os.environ.get("PAIMON_TEST_PLATFORM", "cpu") != "cpu",
+    reason="gate-off asserts the configured-cpu dispatch state",
+)
+def test_dispatch_gate_off_wide_parity(rng, monkeypatch):
+    """With the FORCE_COMPACT override removed, the configured-cpu platform
+    makes the dispatcher skip every link encoding (no link bytes to save)
+    — and the wide path must return exactly the compact path's rows. This
+    pins the production CPU-fallback dispatch, which the suite otherwise
+    never exercises (conftest forces the device policy on)."""
+    from paimon_tpu.ops import merge as M
+
+    monkeypatch.delenv("PAIMON_TPU_FORCE_COMPACT", raising=False)
+    assert not M._link_encodings_pay_off()  # conftest pins jax_platforms=cpu
+    lanes, offsets = _runs_fixture(rng, 20_000, 4, 1 << 20, 1)
+    handle = M._dedup_dispatch(lanes, offsets, backend="xla")
+    assert not (isinstance(handle, tuple) and handle[0] == "compact")
+    assert M.deduplicate_resolve(handle).tolist() == _dedup_oracle(lanes).tolist()
+    # fused partial-update: gate-off (index download) == gate-on (compact)
+    keys = np.sort(rng.integers(0, 8_000, size=(8_000, 1), dtype=np.uint32), axis=0)
+    fv = rng.random((2, 8_000)) < 0.6
+    kinds = np.zeros(8_000, dtype=np.uint8)
+    src_off, exists_off, last_off = M.fused_partial_update(keys, None, fv, kinds)
+    monkeypatch.setenv("PAIMON_TPU_FORCE_COMPACT", "1")
+    assert M._link_encodings_pay_off()
+    src_on, exists_on, last_on = M.fused_partial_update(keys, None, fv, kinds)
+    assert src_off.tolist() == src_on.tolist()
+    assert exists_off.tolist() == exists_on.tolist()
+    assert last_off.tolist() == last_on.tolist()
+
+
+def test_delta_upload_pallas_and_many_runs(rng):
+    """The delta-packed UPLOAD survives past the compact download's limits
+    (ADVICE r3): >256 runs and the pallas backend both route through
+    _dedup_select_delta_wide_fn (delta upload + index download) instead of
+    dropping the upload optimization entirely."""
+    from paimon_tpu.ops import merge as M
+
+    n, runs = 13_000, 325
+    per = n // runs
+    # dense enough that every within-run gap fits u16 (40 samples over 2^17
+    # -> mean gap ~3.3k), but a total range past the u16 narrowing threshold
+    base = rng.integers(0, 1 << 17, size=n, dtype=np.uint32)
+    lanes = np.empty((n, 1), np.uint32)
+    offsets = [0]
+    for r in range(runs):
+        lo, hi = r * per, (r + 1) * per if r < runs - 1 else n
+        lanes[lo:hi, 0] = np.sort(base[lo:hi])
+        offsets.append(hi)
+    h = M.deduplicate_select_delta_async(lanes, offsets)
+    assert h is not None and not (isinstance(h, tuple) and h[0] == "compact")
+    assert np.sort(M.deduplicate_resolve(h)).tolist() == np.sort(_dedup_oracle(lanes)).tolist()
+    # pallas epilogue (interpret mode on cpu) over a small delta-qualifying set
+    lanes2, offsets2 = lanes[:4096], [0, 2048, 4096]
+    l2 = np.sort(lanes2[:2048, 0]); l3 = np.sort(lanes2[2048:, 0])
+    lanes2 = np.concatenate([l2, l3]).reshape(-1, 1)
+    hp = M.deduplicate_select_delta_async(lanes2, offsets2, backend="pallas")
+    assert hp is not None
+    assert np.sort(M.deduplicate_resolve(hp)).tolist() == np.sort(_dedup_oracle(lanes2)).tolist()
