@@ -400,12 +400,23 @@ def _link_encodings_pay_off() -> bool:
     the r03 CPU-fallback bench regression). PAIMON_TPU_FORCE_COMPACT=1
     overrides so tests exercise the device dispatch policy on CPU.
 
-    Decided from the CONFIGURED platform, never `jax.default_backend()`:
-    that call initializes the backend, and on a wedged tunnel an
-    accelerator-platform init blocks indefinitely — dispatch policy must
-    not be the call that first touches the device."""
+    Once a backend is LIVE this asks it directly (covers jax's silent
+    fall-through from an unreachable accelerator to cpu in a platform list
+    like "axon,cpu"). Before any backend exists it reads only the
+    CONFIGURED platform — never `jax.default_backend()`, which initializes
+    the backend, and on a wedged tunnel an accelerator-platform init blocks
+    indefinitely; dispatch policy must not be the call that first touches
+    the device. (Worst case: the first dispatch of a process guesses from
+    config, every later one sees the real backend.)"""
     if os.environ.get("PAIMON_TPU_FORCE_COMPACT", "") == "1":
         return True
+    try:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):  # already initialized: safe to ask
+            return jax.default_backend() != "cpu"
+    except Exception:
+        pass
     cfg = getattr(jax.config, "jax_platforms", None) or os.environ.get("JAX_PLATFORMS", "")
     return str(cfg).split(",")[0] != "cpu"
 

@@ -126,15 +126,26 @@ def _probe_child_alive() -> int | None:
         return pid  # no /proc: keep the conservative existence answer
 
 
-def probe_devices(timeout_s: float = 120.0) -> tuple[int, str]:
+def probe_devices(timeout_s: float = 120.0, stale_negative_after_s: float | None = None) -> tuple[int, str]:
     """(device_count, backend) — detached-probe edition.
 
     Spawns (or reuses) a detached child that initializes jax and writes its
     verdict to PROBE_CACHE; waits up to timeout_s for the verdict but NEVER
     kills the child on timeout (killing mid-init is what wedges the tunnel).
     A cached verdict completed less than PROBE_TTL_S ago (same JAX_PLATFORMS
-    env) is returned without any probe."""
+    env) is returned without any probe. stale_negative_after_s tightens that
+    TTL for NEGATIVE verdicts only — a retry loop wants a fresh probe soon
+    after a fast failure (connection refused completes in seconds and would
+    otherwise pin the negative answer for the full TTL), while positive
+    verdicts stay trusted."""
     cached = _read_cache()
+    if (
+        cached
+        and stale_negative_after_s is not None
+        and int(cached.get("n", 0)) == 0
+        and (time.time() - cached.get("completed", 0)) >= stale_negative_after_s
+    ):
+        cached = None  # treat as stale: respawn a probe below
     if cached:
         return int(cached.get("n", 0)), str(cached.get("backend", "unreachable"))
 
@@ -288,7 +299,12 @@ def ensure_live_backend_retrying(budget_s: float | None = None) -> str:
     deadline = time.monotonic() + budget_s
     while True:
         remaining = deadline - time.monotonic()
-        count, _backend = probe_devices(timeout_s=max(10.0, min(180.0, remaining)))
+        count, _backend = probe_devices(
+            timeout_s=max(10.0, min(180.0, remaining)),
+            # a fast-failing probe (connection refused) must not pin its
+            # negative verdict for the whole TTL while we still have budget
+            stale_negative_after_s=60.0,
+        )
         if count > 0:
             return ensure_live_backend()
         if time.monotonic() >= deadline:
