@@ -84,6 +84,7 @@ def __getattr__(name):
         "PaimonFlightServer": ("paimon_tpu.service.flight", "PaimonFlightServer"),
         "flight_scan": ("paimon_tpu.service.flight", "flight_scan"),
         "record_batch_reader": ("paimon_tpu.interop.arrow_surface", "record_batch_reader"),
+        "call": ("paimon_tpu.sql", "call"),
     }
     if name in lazy:
         import importlib

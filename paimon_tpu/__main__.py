@@ -153,8 +153,30 @@ def main(argv=None) -> int:
             p.add_argument("--format", default="parquet")
             p.add_argument("--user", default="cli")
 
+    p = sub.add_parser("call", help="execute a SQL CALL procedure statement")
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--user", default="cli")
+    p.add_argument("statement", help="e.g. \"CALL sys.compact(`table` => 'db.t')\"")
+
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
+
+    # Wedge-proof device policy for every action that reaches a kernel: on a
+    # healthy rig this takes the chip (single-flight lock); on a wedged
+    # tunnel it pins CPU loudly instead of hanging the CLI in backend init.
+    # (The env's sitecustomize pins the accelerator platform programmatically,
+    # so JAX_PLATFORMS=cpu alone would not protect a CLI user.)
+    from .utils.tpuguard import ensure_live_backend
+
+    ensure_live_backend(probe_timeout_s=float(__import__("os").environ.get("PAIMON_TPU_PROBE_TIMEOUT", "60")))
+
+    if action == "call":
+        from .catalog import FileSystemCatalog
+        from .sql import call as sql_call
+
+        cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
+        print(json.dumps(sql_call(cat, args.statement), default=str))
+        return 0
 
     if action == "clone":
         from .catalog import FileSystemCatalog

@@ -1,0 +1,105 @@
+"""CALL-procedure surface vs the reference's Flink procedures
+(paimon-flink-common/.../procedure/ProcedureUtil.java): statements written
+for the reference must drive the same maintenance operations here."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.sql import ProcedureError, call, parse_call
+from paimon_tpu.types import BIGINT, STRING, RowType
+
+
+@pytest.fixture
+def cat(tmp_warehouse):
+    c = FileSystemCatalog(tmp_warehouse, commit_user="sql")
+    t = c.create_table(
+        "db.t",
+        RowType.of(("k", BIGINT(False)), ("v", BIGINT())),
+        primary_keys=["k"],
+        options={"bucket": "1"},
+    )
+    for r in range(3):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        ids = np.arange(200, dtype=np.int64)
+        w.write({"k": ids, "v": ids + r})
+        wb.new_commit().commit(w.prepare_commit())
+    return c
+
+
+def _read_all(t):
+    rb = t.new_read_builder()
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+def test_parse_positional_named_and_literals():
+    name, args, kwargs = parse_call(
+        "CALL sys.compact(`table` => 'db.t', `full` => true)"
+    )
+    assert name == "compact" and args == [] and kwargs == {"table": "db.t", "full": True}
+    name, args, kwargs = parse_call("call create_tag('db.t', 'it''s', 2);")
+    assert name == "create_tag" and args == ["db.t", "it's", 2]
+    assert parse_call("CALL sys.p(null, 1.5, FALSE)")[1] == [None, 1.5, False]
+    with pytest.raises(ProcedureError):
+        parse_call("SELECT 1")
+    with pytest.raises(ProcedureError):
+        parse_call("CALL p(a => 1, 2)")  # positional after named
+
+
+def test_tag_rollback_branch_procedures(cat):
+    t = cat.get_table("db.t")
+    call(cat, "CALL sys.create_tag('db.t', 'v1', 1)")
+    call(cat, "CALL sys.create_tag('db.t', 'v2')")
+    assert set(t.tags()) == {"v1", "v2"}
+    call(cat, "CALL sys.delete_tag('db.t', 'v2')")
+    assert set(cat.get_table("db.t").tags()) == {"v1"}
+    call(cat, "CALL sys.create_branch('db.t', 'b1', tag => 'v1')")
+    from paimon_tpu.table.branch import BranchManager
+
+    assert "b1" in BranchManager(t.file_io, t.path).list_branches()
+    call(cat, "CALL sys.delete_branch('db.t', 'b1')")
+    assert "b1" not in BranchManager(t.file_io, t.path).list_branches()
+    call(cat, "CALL sys.rollback_to('db.t', '1')")
+    t = cat.get_table("db.t")
+    assert t.store.snapshot_manager.latest_snapshot().id == 1
+    out = _read_all(t)
+    assert np.asarray(out.column("v").values).tolist() == list(range(200))
+
+
+def test_compact_and_expire_procedures(cat):
+    t0 = cat.get_table("db.t")
+    assert len(t0.new_read_builder().new_scan().plan()) >= 1
+    got = call(cat, "CALL sys.compact(`table` => 'db.t', `full` => true)")
+    assert got["compacted"] is True
+    # full compaction rewrote to a single top-level run; rows unchanged
+    out = _read_all(cat.get_table("db.t"))
+    assert out.num_rows == 200
+    assert np.asarray(out.column("v").values).tolist() == [i + 2 for i in range(200)]
+    got = call(
+        cat,
+        "CALL sys.expire_snapshots(`table` => 'db.t', retain_max => 1, retain_min => 1)",
+    )
+    assert got["expired"] >= 1
+
+
+def test_compact_database_and_unknown_procedure(cat):
+    got = call(cat, "CALL sys.compact_database(including_databases => 'db', full => true)")
+    assert got["compacted"] == ["db.t"]
+    with pytest.raises(ProcedureError, match="available"):
+        call(cat, "CALL sys.no_such_proc('x')")
+    with pytest.raises(ProcedureError, match="CALL compact"):
+        call(cat, "CALL sys.compact('db.t', bogus_arg => 1)")
+
+
+def test_delete_and_consumer_procedures(cat):
+    got = call(cat, 'CALL sys.delete(\'db.t\', \'{"field": "k", "op": ">=", "value": 100}\')')
+    assert got["rows_deleted"] == 100
+    assert _read_all(cat.get_table("db.t")).num_rows == 100
+    call(cat, "CALL sys.reset_consumer('db.t', 'ci', 2)")
+    from paimon_tpu.table.consumer import ConsumerManager
+
+    t = cat.get_table("db.t")
+    assert ConsumerManager(t.file_io, t.path).consumer("ci") == 2
+    call(cat, "CALL sys.reset_consumer('db.t', 'ci')")
+    assert ConsumerManager(t.file_io, t.path).consumer("ci") is None
