@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Background chip-grant watcher (VERDICT r4 #1).
+
+Round 4 ended with ZERO chip evidence because every measurement attempt
+blocked a work turn on a wedged tunnel. This watcher inverts that: it runs
+detached for the whole round, keeps one sentinel probe in flight (via
+tpuguard's detached-probe cache), and the moment the grant frees it runs the
+full measurement suite unattended, appending each JSON result line to
+benchmarks/results/ROUND5_CHIP.jsonl as it lands (partial progress counts).
+
+Discipline rules it inherits from tpuguard (see paimon_tpu/utils/tpuguard.py):
+  - the watcher process itself NEVER imports jax (policy code must not init
+    a backend); it only reads the probe cache and spawns subprocesses
+  - suite steps run serially (single CPU core; single device grant)
+  - on a step timeout: SIGTERM (clean-exit handlers release the grant),
+    bounded wait, NEVER SIGKILL (a killed client wedges the tunnel for hours)
+
+Re-trigger protocol: the suite runs once per request token. After improving
+kernel/decode code, write a new token to benchmarks/results/WATCHER_REQUEST
+and the watcher re-runs the suite on the next grant. Status is mirrored to
+benchmarks/results/WATCHER_STATUS.json every loop for humans.
+
+Launch (detached):  nohup python benchmarks/chip_watcher.py >/dev/null 2>&1 &
+No reference counterpart: the reference benchmarks on a local JVM; a remote
+single-grant accelerator needs this scheduling layer.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paimon_tpu.utils.tpuguard import probe_devices  # noqa: E402  (no jax import)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results")
+CHIP_LOG = os.path.join(RESULTS, "ROUND5_CHIP.jsonl")
+REQUEST = os.path.join(RESULTS, "WATCHER_REQUEST")
+DONE = os.path.join(RESULTS, "WATCHER_DONE")
+STATUS = os.path.join(RESULTS, "WATCHER_STATUS.json")
+WATCHER_LOCK = "/tmp/paimon_tpu_chip_watcher.lock"
+LOG = os.path.join(RESULTS, "watcher.log")
+
+# Priority-ordered suite: headline first (also refreshes LATEST_CHIP.json),
+# then the below-1x BASELINE configs, then tiled cold+warm (VERDICT #7),
+# then the broad micro suite. Matches round-3 scales for comparability.
+SUITE = [
+    ("bench", [sys.executable, "bench.py"], 2400),
+    ("baseline_configs", [sys.executable, "benchmarks/baseline_configs.py",
+                          "--scale", "4", "--configs", "2,3,4,5"], 3600),
+    ("tiled_cold", [sys.executable, "benchmarks/tiled_scale.py",
+                    "--rows", "8388608"], 2400),
+    ("tiled_warm", [sys.executable, "benchmarks/tiled_scale.py",
+                    "--rows", "8388608"], 2400),
+    ("micro", [sys.executable, "benchmarks/micro_benchmarks.py"], 2400),
+    ("kernel_resident", [sys.executable, "benchmarks/kernel_resident.py"], 2400),
+]
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%Y-%m-%dT%H:%M:%S')}] {msg}\n"
+    with open(LOG, "a") as f:
+        f.write(line)
+
+
+def write_status(**kw) -> None:
+    kw["updated"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(kw, f, indent=1)
+    os.replace(tmp, STATUS)
+
+
+def read_token(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def append_results(step: str, stdout: bytes) -> int:
+    """Append every JSON line from a step's stdout to the chip log."""
+    n = 0
+    with open(CHIP_LOG, "a") as out:
+        for raw in stdout.decode(errors="replace").splitlines():
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                row = json.loads(raw)
+            except ValueError:
+                continue
+            row["step"] = step
+            row["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            out.write(json.dumps(row) + "\n")
+            out.flush()
+            n += 1
+    return n
+
+
+def run_step(name: str, cmd: list[str], timeout_s: int) -> bool:
+    """One suite step: PAIMON_TPU_REQUIRE=1 so a CPU fallback exits 3 and
+    never pollutes the chip log. SIGTERM-then-wait on timeout; no SIGKILL."""
+    env = dict(os.environ, PAIMON_TPU_REQUIRE="1", PAIMON_TPU_BENCH_RETRY_S="60")
+    log(f"step {name}: {' '.join(cmd)}")
+    proc = subprocess.Popen(
+        cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"step {name}: timeout after {timeout_s}s -> SIGTERM (never SIGKILL)")
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            log(f"step {name}: still alive after SIGTERM+300s; abandoning suite "
+                "run (process left to exit on its own — killing would wedge the grant)")
+            return False
+    n = append_results(name, out or b"")
+    tail = (err or b"")[-2000:].decode(errors="replace")
+    log(f"step {name}: rc={proc.returncode} rows_logged={n} stderr_tail={tail!r}")
+    return proc.returncode == 0 and n > 0
+
+
+def run_suite(token: str) -> None:
+    ok_steps, failed = [], []
+    with open(CHIP_LOG, "a") as f:
+        f.write(json.dumps({"_suite_start": token,
+                            "at": time.strftime("%Y-%m-%dT%H:%M:%S")}) + "\n")
+    for name, cmd, timeout_s in SUITE:
+        write_status(state="measuring", step=name, token=token,
+                     ok=ok_steps, failed=failed)
+        # re-check the grant between steps: if the tunnel wedged mid-suite,
+        # stop cleanly and keep whatever already landed in the log
+        n, _ = probe_devices(timeout_s=30.0, stale_negative_after_s=120.0)
+        if n == 0:
+            log(f"grant lost before step {name}; pausing suite")
+            failed.append(name + ":grant-lost")
+            break
+        (ok_steps if run_step(name, cmd, timeout_s) else failed).append(name)
+    with open(CHIP_LOG, "a") as f:
+        f.write(json.dumps({"_suite_end": token, "ok": ok_steps, "failed": failed,
+                            "at": time.strftime("%Y-%m-%dT%H:%M:%S")}) + "\n")
+    if ok_steps and not failed:
+        with open(DONE, "w") as f:
+            f.write(token)
+        log(f"suite complete for token {token!r}: {ok_steps}")
+    else:
+        log(f"suite partial for token {token!r}: ok={ok_steps} failed={failed} "
+            "(will retry on next grant)")
+
+
+def main() -> None:
+    os.makedirs(RESULTS, exist_ok=True)
+    # single watcher instance
+    lock_fd = os.open(WATCHER_LOCK, os.O_CREAT | os.O_RDWR, 0o666)
+    try:
+        fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        sys.stderr.write("another chip watcher is running; exiting\n")
+        return
+    os.write(lock_fd, f"{os.getpid()}\n".encode())
+    log(f"watcher up, pid={os.getpid()}")
+    if not read_token(REQUEST):
+        with open(REQUEST, "w") as f:
+            f.write("r5-initial")
+
+    while True:
+        want, have = read_token(REQUEST), read_token(DONE)
+        if want and want != have:
+            # keep exactly one sentinel probe in flight; a negative verdict
+            # goes stale immediately so the next loop respawns the sentinel
+            n, backend = probe_devices(timeout_s=30.0, stale_negative_after_s=30.0)
+            if n > 0:
+                write_status(state="grant-acquired", backend=backend, token=want)
+                log(f"grant free (backend={backend}); running suite for {want!r}")
+                run_suite(want)
+            else:
+                write_status(state="waiting-for-grant", token=want, backend=backend)
+        else:
+            write_status(state="idle", done_token=have)
+        time.sleep(60.0)
+
+
+if __name__ == "__main__":
+    main()

@@ -630,14 +630,92 @@ def deduplicate_select_tiled(
     return deduplicate_resolve_tiled(deduplicate_tiled_dispatch(key_lanes, run_offsets, tile_rows, backend))
 
 
+@functools.lru_cache(maxsize=None)
+def _dedup_select_batched_fn(num_key_lanes: int):
+    """vmapped sort + keep-last + pack over a (T, m) tile batch: every tile
+    of a key-range tiled merge runs in ONE dispatch under ONE compile
+    signature. This replaced the per-tile dispatch whose varying pad buckets
+    and narrowing dtypes caused a fresh remote AOT compile per tile — the
+    round-3 multi-tile collapse (104 K rows/s tiled vs 3.2 M single)."""
+
+    @jax.jit
+    def f(key_lanes, pad_flag):
+        def per_tile(kl, pf):  # kl: tuple of (m,) uint lanes; pf: (m,) u8
+            pad_sorted, perm, _, keep_last, _ = sorted_segments(
+                num_key_lanes, 0, kl, [], pf
+            )
+            return pack_selected(keep_last & (pad_sorted == 0), perm)
+
+        return jax.vmap(per_tile)(key_lanes, pad_flag)
+
+    return f
+
+
+# one batched tile dispatch stays under this many uint32-equivalent words
+_TILE_BATCH_BUDGET_WORDS = 64 * 1024 * 1024
+
+
+def _pow2_at_least(x: int) -> int:
+    p = 1
+    while p < x:
+        p <<= 1
+    return p
+
+
+def _tile_boundaries(lane0_runs: list[np.ndarray], num_tiles: int) -> np.ndarray:
+    """Approximate global quantiles of lane0 from per-run subsamples (each
+    run is key-sorted): balanced tiles regardless of how rows distribute
+    across runs. Unique boundaries keep every duplicate key in one tile."""
+    total = sum(len(r) for r in lane0_runs)
+    step = max(1, total // 65536)
+    sample = np.sort(np.concatenate([r[::step] for r in lane0_runs]))
+    cut_idx = np.linspace(0, len(sample) - 1, num_tiles + 1).astype(np.int64)[1:-1]
+    return np.unique(sample[cut_idx])
+
+
+def _gather_tiles(key_lanes, offsets, lane0_runs, boundaries):
+    """Cut every run at the key boundaries and concatenate run slices per
+    tile (run order preserved — stability carries the sequence tie-break).
+    Returns [(tile_lanes (nt, k) u32, tile_global_rows (nt,) i32), ...] for
+    the non-empty tiles, in ascending key-range order."""
+    per_run_cuts = [np.searchsorted(lr, boundaries, side="left") for lr in lane0_runs]
+    tiles = []
+    for t in range(len(boundaries) + 1):
+        slices, rows = [], []
+        for r, lr in enumerate(lane0_runs):
+            lo = 0 if t == 0 else int(per_run_cuts[r][t - 1])
+            hi = len(lr) if t == len(boundaries) else int(per_run_cuts[r][t])
+            if hi > lo:
+                base = offsets[r]
+                slices.append(key_lanes[base + lo : base + hi])
+                rows.append(np.arange(base + lo, base + hi, dtype=np.int32))
+        if slices:
+            tiles.append(
+                (
+                    np.concatenate(slices) if len(slices) > 1 else slices[0],
+                    np.concatenate(rows) if len(rows) > 1 else rows[0],
+                )
+            )
+    return tiles
+
+
 def deduplicate_tiled_dispatch(
     key_lanes: np.ndarray,
     run_offsets: Sequence[int],
     tile_rows: int = 256 * 1024,
     backend: str = "xla",
 ):
-    """Async version: dispatches every tile, returns a handle for
-    deduplicate_resolve_tiled."""
+    """Async dispatch of the key-range tiled dedup; resolve with
+    deduplicate_resolve_tiled.
+
+    Uniform-batch design (VERDICT r4 #2): all tiles share one pad bucket
+    m = pad_size(max tile rows), one narrowing dtype per lane (u16 iff every
+    tile's range fits), and one chunk shape (T_chunk, m) — so the whole
+    multi-tile merge compiles exactly ONE kernel, which the persistent
+    compile cache then serves to every later merge at this tile size.
+    Chunks are dispatched back-to-back without blocking; sections larger
+    than the device budget stream through as equal-shaped chunks (the
+    reference spills to disk instead: MergeSorter.java:110-116)."""
     key_lanes = np.ascontiguousarray(key_lanes)
     n = key_lanes.shape[0]
     offsets = list(run_offsets)
@@ -646,33 +724,58 @@ def deduplicate_tiled_dispatch(
     if n <= tile_rows or len(offsets) < 3:
         return [(_dedup_dispatch(key_lanes, offsets, backend), np.arange(n, dtype=np.int32))]
     lane0_runs = [key_lanes[offsets[r] : offsets[r + 1], 0] for r in range(len(offsets) - 1)]
-    largest = max(lane0_runs, key=len)
     num_tiles = max(2, (n + tile_rows - 1) // tile_rows)
-    cut_idx = np.linspace(0, len(largest) - 1, num_tiles + 1).astype(np.int64)[1:-1]
-    boundaries = np.unique(largest[cut_idx])
-    # per-run row ranges per tile (side='left': equal lane0 stays together)
-    per_run_cuts = [np.searchsorted(lr, boundaries, side="left") for lr in lane0_runs]
-    handles = []
-    for t in range(len(boundaries) + 1):
-        slices = []
-        rows = []
-        for r, lr in enumerate(lane0_runs):
-            lo = 0 if t == 0 else int(per_run_cuts[r][t - 1])
-            hi = len(lr) if t == len(boundaries) else int(per_run_cuts[r][t])
-            if hi > lo:
-                base = offsets[r]
-                slices.append(key_lanes[base + lo : base + hi])
-                rows.append(np.arange(base + lo, base + hi, dtype=np.int32))
-        if not slices:
-            continue
-        tile_lanes = np.concatenate(slices) if len(slices) > 1 else slices[0]
-        tile_global = np.concatenate(rows) if len(rows) > 1 else rows[0]
-        tile_offsets = np.concatenate([[0], np.cumsum([len(s) for s in slices])]).tolist()
-        handles.append((_dedup_dispatch(tile_lanes, tile_offsets, backend), tile_global))
-    return handles
+    boundaries = _tile_boundaries(lane0_runs, num_tiles)
+    tiles = _gather_tiles(key_lanes, offsets, lane0_runs, boundaries)
+    if len(tiles) == 1 or backend == "pallas":
+        # pallas epilogue is benchmarked per-tile; a single tile needs no batch
+        handles = []
+        for tile_lanes, tile_global in tiles:
+            handles.append((_dedup_dispatch(tile_lanes, [0, len(tile_lanes)], backend), tile_global))
+        return handles
+
+    k = key_lanes.shape[1]
+    m = pad_size(max(t[0].shape[0] for t in tiles))
+    # uniform per-lane narrowing: u16 only when EVERY tile's range fits (one
+    # dtype signature for the whole batch; per-tile min-shift keeps the win)
+    mins = np.stack([t[0].min(axis=0) for t in tiles])  # (T, k)
+    ptp_max = (np.stack([t[0].max(axis=0) for t in tiles]) - mins).max(axis=0)
+    dtypes = [np.uint16 if int(p) < 0xFFFF else np.uint32 for p in ptp_max]
+
+    words_per_tile = m * (len(dtypes) + 1)  # conservative: u16 lanes count full
+    t_chunk = _pow2_at_least(len(tiles))
+    max_chunk = max(1, _TILE_BATCH_BUDGET_WORDS // max(words_per_tile, 1))
+    while t_chunk > max_chunk and t_chunk > 1:
+        t_chunk >>= 1
+
+    fn = _dedup_select_batched_fn(k)
+    chunks = []
+    for c0 in range(0, len(tiles), t_chunk):
+        chunk = tiles[c0 : c0 + t_chunk]
+        lanes_b = tuple(
+            np.full((t_chunk, m), np.iinfo(d).max, dtype=d) for d in dtypes
+        )
+        pad_b = np.ones((t_chunk, m), dtype=np.uint8)
+        for i, (tl, _) in enumerate(chunk):
+            nt = tl.shape[0]
+            for j in range(k):
+                lanes_b[j][i, :nt] = (tl[:, j] - mins[c0 + i, j]).astype(dtypes[j])
+            pad_b[i, :nt] = 0
+        outs = fn(lanes_b, pad_b)  # async: next chunk assembles while this sorts
+        chunks.append((outs, [rows for _, rows in chunk]))
+    return ("batched", chunks)
 
 
 def deduplicate_resolve_tiled(handles) -> np.ndarray:
+    if isinstance(handles, tuple) and handles[0] == "batched":
+        out = []
+        for (packed, counts), rows_list in handles[1]:
+            counts_np = np.asarray(counts)
+            for t, rows in enumerate(rows_list):
+                c = int(counts_np[t])
+                if c:
+                    out.append(rows[np.asarray(packed[t, :c])])
+        return np.concatenate(out) if out else np.empty(0, dtype=np.int32)
     out = []
     for handle, rows in handles:
         local = deduplicate_resolve(handle)

@@ -246,6 +246,25 @@ def test_tiled_dedup_matches_single(rng):
     assert tiled.tolist() == single.tolist()
 
 
+def test_tiled_dedup_batched_multilane(rng):
+    """The uniform-batch tile path (one compile for all tiles) stays
+    byte-identical to single dispatch for composite keys, mixed u16/u32
+    narrowing, uneven runs, and every tile size."""
+    from paimon_tpu.ops.merge import deduplicate_select, deduplicate_select_tiled
+
+    runs, offsets = [], [0]
+    for size in (5000, 1700, 3100, 900, 2300):
+        k0 = np.sort(rng.choice(20_000, size=size, replace=False)).astype(np.uint32)
+        k1 = rng.integers(0, 1 << 24, size=size).astype(np.uint32)  # wide: stays u32
+        runs.append(np.stack([k0, k1], axis=1))
+        offsets.append(offsets[-1] + size)
+    lanes = np.concatenate(runs)
+    single = deduplicate_select(lanes)
+    for tile_rows in (256, 700, 2048, 6000):
+        tiled = deduplicate_select_tiled(lanes, offsets, tile_rows=tile_rows)
+        assert tiled.tolist() == single.tolist(), f"tile_rows={tile_rows}"
+
+
 # ---------------------------------------------------------------------------
 # round 2: fused partial-update / aggregation kernels vs the plan-based path
 # ---------------------------------------------------------------------------
