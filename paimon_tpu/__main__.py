@@ -161,14 +161,36 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
 
-    # Wedge-proof device policy for every action that reaches a kernel: on a
-    # healthy rig this takes the chip (single-flight lock); on a wedged
+    # Wedge-proof device policy, gated to actions that actually reach a
+    # kernel: on a healthy rig ensure_live_backend takes the chip
+    # (single-flight lock, held for the process lifetime); on a wedged
     # tunnel it pins CPU loudly instead of hanging the CLI in backend init.
-    # (The env's sitecustomize pins the accelerator platform programmatically,
-    # so JAX_PLATFORMS=cpu alone would not protect a CLI user.)
-    from .utils.tpuguard import ensure_live_backend
+    # Metadata-only actions (tags, branches, clone, expiry, repair, ...)
+    # must NOT probe or contend for the grant — they pin CPU outright, so a
+    # trivial `create-tag` never stalls behind a running bench.
+    # (The env's sitecustomize pins the accelerator platform
+    # programmatically, so JAX_PLATFORMS=cpu alone would not protect a CLI
+    # user either way.)
+    _KERNEL_ACTIONS = {"query", "compact", "sort_compact", "compact_database",
+                       "sync_table", "query_service", "delete"}
+    _KERNEL_PROCEDURES = {"compact", "compact_database", "delete", "merge_into",
+                          "rewrite_file_index", "query_service"}
+    reaches_kernel = action in _KERNEL_ACTIONS
+    if action == "call":
+        try:
+            from .sql import parse_call
 
-    ensure_live_backend(probe_timeout_s=float(__import__("os").environ.get("PAIMON_TPU_PROBE_TIMEOUT", "60")))
+            reaches_kernel = parse_call(args.statement)[0] in _KERNEL_PROCEDURES
+        except Exception:
+            reaches_kernel = True  # unparseable: keep the safe path
+    if reaches_kernel:
+        from .utils.tpuguard import ensure_live_backend
+
+        ensure_live_backend(probe_timeout_s=float(__import__("os").environ.get("PAIMON_TPU_PROBE_TIMEOUT", "60")))
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     if action == "call":
         from .catalog import FileSystemCatalog
@@ -214,31 +236,19 @@ def main(argv=None) -> int:
         return 0
 
     if action == "compact_database":
-        import re
-
+        # single implementation: the SQL procedure (CLI and CALL must agree)
         from .catalog import FileSystemCatalog
-        from .table.compactor import DedicatedCompactor
+        from .sql import _proc_compact_database
 
         cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
-        db_pat = re.compile(args.including_databases or ".*")
-        inc = re.compile(args.including_tables or ".*")
-        exc = re.compile(args.excluding_tables) if args.excluding_tables else None
-        compacted = []
-        for db in cat.list_databases():
-            if not db_pat.fullmatch(db):
-                continue
-            for name in cat.list_tables(db):
-                full = f"{db}.{name}"
-                if not inc.fullmatch(full) and not inc.fullmatch(name):
-                    continue
-                if exc and (exc.fullmatch(full) or exc.fullmatch(name)):
-                    continue
-                t = cat.get_table(full)
-                if not t.primary_keys:
-                    continue  # reference: only changelog tables in DIVIDED mode
-                if DedicatedCompactor(t).run_once(full=args.full):
-                    compacted.append(full)
-        print(json.dumps({"compacted": compacted, "full": args.full}))
+        out = _proc_compact_database(
+            cat,
+            including_databases=args.including_databases,
+            including_tables=args.including_tables,
+            excluding_tables=args.excluding_tables,
+            full=args.full,
+        )
+        print(json.dumps({**out, "full": args.full}))
         return 0
 
     if action == "repair":

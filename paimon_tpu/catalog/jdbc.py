@@ -194,7 +194,7 @@ class JdbcCatalog(Catalog):
                 (d.database, d.table, s.database, s.table),
             )
 
-    def repair(self) -> dict:
+    def repair(self, identifier: str | None = None) -> dict:
         """Re-sync the SQL metadata plane with the warehouse filesystem
         (reference flink/action/RepairAction + Catalog.repairCatalog).
         Identity is the STORED LOCATION, not the naming convention — a
@@ -204,7 +204,13 @@ class JdbcCatalog(Catalog):
           registered under their conventional name;
         - databases with neither a warehouse directory nor table rows are
           dropped.
+        `identifier` scopes the sync to one database ('db') or table
+        ('db.t') — the reference repair procedure's single-object form.
         Returns {"registered", "removed", "removed_databases"}."""
+        scope_db = scope_table = None
+        if identifier:
+            scope_db, _, scope_table = identifier.partition(".")
+            scope_table = scope_table or None
         registered: list[str] = []
         removed: list[str] = []
         removed_dbs: list[str] = []
@@ -218,9 +224,13 @@ class JdbcCatalog(Catalog):
             if not base.endswith(".db"):
                 continue
             db = base[: -len(".db")]
+            if scope_db and db != scope_db:
+                continue
             tables: dict[str, str] = {}
             for ts in self.file_io.list_status(st.path):
                 tname = ts.path.rstrip("/").rsplit("/", 1)[-1]
+                if scope_table and tname != scope_table:
+                    continue
                 if SchemaManager(self.file_io, ts.path).latest() is not None:
                     tables[tname] = ts.path.rstrip("/")
             on_disk[db] = tables
@@ -229,6 +239,8 @@ class JdbcCatalog(Catalog):
             for db, tname, location in list(
                 c.execute("SELECT database_name, table_name, location FROM paimon_tables")
             ):
+                if scope_db and (db != scope_db or (scope_table and tname != scope_table)):
+                    continue
                 if SchemaManager(self.file_io, location).latest() is None:
                     c.execute(
                         "DELETE FROM paimon_tables WHERE database_name = ? AND table_name = ?",
@@ -250,8 +262,8 @@ class JdbcCatalog(Catalog):
                     if cur.rowcount:
                         registered.append(f"{db}.{tname}")
             for (db,) in list(c.execute("SELECT name FROM paimon_databases")):
-                if db in on_disk:
-                    continue
+                if db in on_disk or scope_table or (scope_db and db != scope_db):
+                    continue  # scoped repair never drops other databases
                 has_rows = c.execute(
                     "SELECT 1 FROM paimon_tables WHERE database_name = ? LIMIT 1", (db,)
                 ).fetchone()

@@ -280,13 +280,19 @@ class KeyValueFileReaderFactory:
         meta: DataFileMeta,
         predicate: Predicate | None = None,
         fields: Sequence[str] | None = None,
-        system_columns: bool = True,
+        system_columns: bool | str = True,
     ) -> KVBatch:
         """fields: optional subset of read-schema fields to materialize (the
         returned KVBatch's data schema is projected accordingly). Row-group
         skipping depends only on `predicate`, so two reads of the same file
         with the same predicate but different `fields` are row-aligned —
-        the pipelined merge path relies on that."""
+        the pipelined merge path relies on that.
+
+        system_columns: True reads _SEQUENCE_NUMBER + _VALUE_KIND; "kind"
+        reads only _VALUE_KIND (seq zeros) — the keys-only merge pipeline
+        uses it when run stability replaces sequence comparison, skipping
+        the most expensive system column (random int64, ~uncompressible);
+        False decodes neither (caller holds them from the key pass)."""
         data_schema = self.schemas_by_id[meta.schema_id]
         disk_schema = kv_disk_schema(data_schema) if self.keyed else data_schema
         if not self.keyed:
@@ -298,7 +304,12 @@ class KeyValueFileReaderFactory:
         )
         # project to the file columns that exist for the read schema
         by_id = {f.id: f for f in data_schema.fields}
-        wanted_cols = [SEQUENCE_FIELD_NAME, VALUE_KIND_FIELD_NAME] if system_columns else []
+        if system_columns is True:
+            wanted_cols = [SEQUENCE_FIELD_NAME, VALUE_KIND_FIELD_NAME]
+        elif system_columns == "kind":
+            wanted_cols = [VALUE_KIND_FIELD_NAME]
+        else:
+            wanted_cols = []
         mapping: list[tuple[DataField, DataField | None]] = []
         for f in read_fields:
             src = by_id.get(f.id)
@@ -330,8 +341,11 @@ class KeyValueFileReaderFactory:
                 cols[f.name] = cast_column(col, src.type, f.type) if src.type != f.type else col
         out_schema = self.read_schema if fields is None else RowType(read_fields)
         data = ColumnBatch(out_schema, cols)
-        if system_columns:
+        if system_columns is True:
             seq = disk.column(SEQUENCE_FIELD_NAME).values.astype(np.int64, copy=False)
+            kind = disk.column(VALUE_KIND_FIELD_NAME).values.astype(np.uint8)
+        elif system_columns == "kind":
+            seq = np.zeros(n, dtype=np.int64)
             kind = disk.column(VALUE_KIND_FIELD_NAME).values.astype(np.uint8)
         else:  # caller already holds seq/kind from the key pass
             seq = np.zeros(n, dtype=np.int64)

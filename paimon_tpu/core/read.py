@@ -23,6 +23,30 @@ from .mergefn import MergeExecutor
 __all__ = ["MergeFileSplitRead", "order_runs_for_merge"]
 
 
+_arrow_decode_warm = False
+
+
+def _ensure_arrow_decode_initialized():
+    """One tiny in-memory parquet roundtrip on the CALLING thread before any
+    threaded decode: pyarrow's lazily-initialized process globals (thread
+    pools, codecs, kernel registries) segfault — reproducibly on this
+    single-core rig — when their first-ever initialization races across two
+    pool threads both entering read_row_groups. ~1ms, once per process."""
+    global _arrow_decode_warm
+    if _arrow_decode_warm:
+        return
+    import io as _io
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    buf = _io.BytesIO()
+    pq.write_table(pa.table({"x": [0]}), buf)
+    buf.seek(0)
+    pq.ParquetFile(buf).read()
+    _arrow_decode_warm = True
+
+
 def _parallel_map(fn, items):
     """Decode several files concurrently (pyarrow/zstd release the GIL, so
     threads give real parallelism on the host-side columnar decode — the
@@ -31,6 +55,7 @@ def _parallel_map(fn, items):
     items = list(items)
     if len(items) <= 1:
         return [fn(x) for x in items]
+    _ensure_arrow_decode_initialized()
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(max_workers=min(8, len(items))) as pool:
@@ -170,8 +195,12 @@ class MergeFileSplitRead:
         predicate, so their row sets are identical (datafile.read contract)."""
         key_names = [n for n in self.reader_factory.read_schema.field_names if n in self.key_names]
         rest_names = [n for n in self.reader_factory.read_schema.field_names if n not in self.key_names]
+        # run stability replaces sequence comparison when seq ranges are
+        # disjoint+ordered: skip decoding _SEQUENCE_NUMBER (random int64 is
+        # the costliest system column) and read only _VALUE_KIND
+        sys_cols = "kind" if seq_ascending else True
         heads = _parallel_map(
-            lambda f: self.reader_factory.read(f, predicate=key_filter, fields=key_names),
+            lambda f: self.reader_factory.read(f, predicate=key_filter, fields=key_names, system_columns=sys_cols),
             ordered_files,
         )
         kv_keys = KVBatch.concat(heads)

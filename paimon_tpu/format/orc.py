@@ -54,7 +54,10 @@ class OrcFormat(FileFormat):
 
         cols = list(projection) if projection is not None else schema.field_names
         read_schema = schema.project(cols)
-        f = file_io.open_input(path)
+        # real OS path -> pyarrow's own C++ IO (no Python-file shim; see
+        # FileIO.local_path); stream path only for non-local/intercepted IO
+        lp = file_io.local_path(path)
+        f = open(lp, "rb") if lp is not None else file_io.open_input(path)
         try:
             tail = None
             if predicate is not None:
@@ -65,7 +68,7 @@ class OrcFormat(FileFormat):
                 except Exception:  # malformed/foreign tail: read everything
                     tail = None
                 f.seek(0)
-            of = po.ORCFile(f)
+            of = po.ORCFile(lp if lp is not None else f)
             for stripe in range(of.nstripes):
                 if tail is not None and stripe < tail.nstripes:
                     if not predicate.test_stats(tail.stripe_stats(stripe)):

@@ -63,9 +63,14 @@ class ParquetFormat(FileFormat):
 
         cols = list(projection) if projection is not None else schema.field_names
         read_schema = schema.project(cols)
-        f = file_io.open_input(path)
+        # prefer a real OS path: pyarrow then memory-maps and reads through
+        # its own C++ IO instead of a Python-file shim (which is both slower
+        # and flaky under concurrent threaded decode — see FileIO.local_path)
+        lp = file_io.local_path(path)
+        f = lp if lp is not None else file_io.open_input(path)
+        pf = None
         try:
-            pf = pq.ParquetFile(f)
+            pf = pq.ParquetFile(f, memory_map=True)
             md = pf.metadata
             name_to_idx = {md.schema.column(i).name: i for i in range(md.num_columns)}
             keep = [
@@ -95,7 +100,10 @@ class ParquetFormat(FileFormat):
                 if table.num_rows:
                     yield ColumnBatch.from_arrow(table, read_schema)
         finally:
-            f.close()
+            if lp is None:
+                f.close()
+            elif pf is not None:
+                pf.close()
 
 
 def _row_group_stats(

@@ -144,6 +144,17 @@ class FileIO:
         """Seekable stream for format readers (pyarrow accepts file objects)."""
         return io.BytesIO(self.read_bytes(path))
 
+    def local_path(self, path: str) -> str | None:
+        """OS filesystem path for `path`, or None when the backing store is
+        not the local filesystem. Format readers prefer a real path: pyarrow
+        then does its own C++ file IO (memory-mappable) instead of calling
+        back into a Python file object — the Python-file shim is also unsafe
+        under concurrent multi-threaded reads (flaky segfaults when two pool
+        threads hit first-use lazily-initialized state). Wrappers that
+        intercept reads (Failing/Traceable) inherit this None default, so
+        fault injection always sees the stream path."""
+        return None
+
 
 def _rename_noreplace(src: str, dst: str) -> bool:
     """renameat2(AT_FDCWD, src, AT_FDCWD, dst, RENAME_NOREPLACE): atomically
@@ -289,6 +300,9 @@ class LocalFileIO(FileIO):
 
     def open_input(self, path: str) -> io.BufferedIOBase:
         return open(self._p(path), "rb")
+
+    def local_path(self, path: str) -> str:
+        return self._p(path)
 
 
 _REGISTRY: dict[str, Callable[[], FileIO]] = {}

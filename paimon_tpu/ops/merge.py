@@ -410,15 +410,25 @@ def _link_encodings_pay_off() -> bool:
     config, every later one sees the real backend.)"""
     if os.environ.get("PAIMON_TPU_FORCE_COMPACT", "") == "1":
         return True
+    return not resolved_platform_is_cpu()
+
+
+def resolved_platform_is_cpu() -> bool:
+    """Best platform answer available WITHOUT initializing a backend (policy
+    code must never be the first backend-touching call — a wedged-tunnel
+    accelerator init blocks indefinitely). Once a backend is live this asks
+    it directly (covers jax's silent fall-through from an unreachable
+    accelerator to cpu in a platform list like "axon,cpu"); before that it
+    reads only the CONFIGURED platform."""
     try:
         from jax._src import xla_bridge
 
         if getattr(xla_bridge, "_backends", None):  # already initialized: safe to ask
-            return jax.default_backend() != "cpu"
+            return jax.default_backend() == "cpu"
     except Exception:
         pass
     cfg = getattr(jax.config, "jax_platforms", None) or os.environ.get("JAX_PLATFORMS", "")
-    return str(cfg).split(",")[0] != "cpu"
+    return str(cfg).split(",")[0] == "cpu"
 
 
 def _real_starts(run_offsets: Sequence[int]) -> list[int]:

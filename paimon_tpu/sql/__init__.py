@@ -28,7 +28,7 @@ from typing import TYPE_CHECKING, Any, Callable
 if TYPE_CHECKING:
     from ..catalog import Catalog
 
-__all__ = ["call", "parse_call", "procedures"]
+__all__ = ["call", "parse_call", "procedures", "query", "execute"]
 
 _CALL_RE = re.compile(r"^\s*CALL\s+(?:`?sys`?\.)?`?(\w+)`?\s*\((.*)\)\s*;?\s*$", re.I | re.S)
 
@@ -48,6 +48,7 @@ def _tokenize_args(body: str) -> list[str]:
         if c == "'":
             buf.append(c)
             i += 1
+            closed = False
             while i < n:
                 buf.append(body[i])
                 if body[i] == "'":
@@ -56,11 +57,16 @@ def _tokenize_args(body: str) -> list[str]:
                         i += 2
                         continue
                     i += 1
+                    closed = True
                     break
                 i += 1
+            if not closed:
+                raise ProcedureError(f"unterminated string literal in arguments: {body!r}")
             continue
         if c == "`":
-            j = body.index("`", i + 1)
+            j = body.find("`", i + 1)
+            if j < 0:
+                raise ProcedureError(f"unterminated backquote in arguments: {body!r}")
             buf.append(body[i : j + 1])
             i = j + 1
             continue
@@ -155,7 +161,7 @@ def _proc_compact_database(cat, including_databases: str | None = None,
     db_pat = re.compile(including_databases or ".*")
     inc = re.compile(including_tables or ".*")
     exc = re.compile(excluding_tables) if excluding_tables else None
-    compacted = []
+    compacted, skipped = [], []
     for db in cat.list_databases():
         if not db_pat.fullmatch(db):
             continue
@@ -166,11 +172,14 @@ def _proc_compact_database(cat, including_databases: str | None = None,
             if exc and (exc.fullmatch(ident) or exc.fullmatch(name)):
                 continue
             t = cat.get_table(ident)
-            if not t.primary_keys:
-                continue
-            if DedicatedCompactor(t).run_once(full=full):
-                compacted.append(ident)
-    return {"compacted": compacted}
+            try:
+                # pk tables and append (unaware-bucket) tables both compact —
+                # reference CompactDatabaseAction covers both kinds
+                if DedicatedCompactor(t).run_once(full=full):
+                    compacted.append(ident)
+            except (ValueError, NotImplementedError) as e:
+                skipped.append({"table": ident, "reason": str(e)})
+    return {"compacted": compacted, "skipped": skipped}
 
 
 def _proc_create_tag(cat, table: str, tag: str, snapshot_id: int | None = None):
@@ -297,23 +306,407 @@ def _proc_reset_consumer(cat, table: str, consumer_id: str,
     return {"consumer": consumer_id, "next_snapshot": next_snapshot_id}
 
 
+def _parse_where(where: str):
+    """WHERE argument -> Predicate|None. SQL expression strings are the
+    reference contract (DeleteAction takes a SQL filter); the legacy JSON
+    blob the CLI accepted stays supported for back-compat."""
+    where = where.strip()
+    if where.startswith("{"):
+        import json as _json
+
+        from ..data import predicate as P
+
+        d = _json.loads(where)
+        op = d.get("op", "=")
+        fns = {"=": P.equal, "!=": P.not_equal, ">": P.greater_than,
+               ">=": P.greater_or_equal, "<": P.less_than, "<=": P.less_or_equal}
+        if op == "in":
+            return P.in_(d["field"], d["value"])
+        if op == "is_null":
+            return P.is_null(d["field"])
+        return fns[op](d["field"], d["value"])
+    from .expr import ExprError, parse_where
+
+    try:
+        return parse_where(where)
+    except ExprError as e:
+        raise ProcedureError(str(e)) from e
+
+
 def _proc_delete(cat, table: str, where: str):
-    """DeleteAction analog; `where` is the predicate-json the CLI accepts."""
-    import json as _json
-
-    from ..data import predicate as P
-
-    d = _json.loads(where)
-    op = d.get("op", "=")
-    fns = {"=": P.equal, "!=": P.not_equal, ">": P.greater_than,
-           ">=": P.greater_or_equal, "<": P.less_than, "<=": P.less_or_equal}
-    if op == "in":
-        pred = P.in_(d["field"], d["value"])
-    elif op == "is_null":
-        pred = P.is_null(d["field"])
-    else:
-        pred = fns[op](d["field"], d["value"])
+    """DeleteAction analog; `where` is a SQL expression ("dt = '2024-01-01'
+    AND hh >= 10"), matching the reference's delete procedure contract."""
+    pred = _parse_where(where)
+    if pred is None:
+        raise ProcedureError("refusing unconditional DELETE; pass an explicit WHERE")
     return {"rows_deleted": _t(cat, table).delete_where(pred)}
+
+
+def _proc_merge_into(cat, target_table: str, target_alias: str = "",
+                     source_sqls: str = "", source_table: str = "",
+                     merge_condition: str = "",
+                     matched_upsert_condition: str = "",
+                     matched_upsert_setting: str = "",
+                     not_matched_insert_condition: str = "",
+                     not_matched_insert_values: str = "",
+                     matched_delete_condition: str = ""):
+    """MergeIntoProcedure.java:96 — string surface onto table.rowops.MergeInto.
+    '' is the placeholder for unused arguments (reference convention). The
+    short delete form `CALL sys.merge_into(tgt, alias, '', src, cond, del)`
+    is handled by _merge_into_dispatch on the POSITIONAL shape only — a
+    named matched_upsert_condition is never reinterpreted as a delete."""
+    from .expr import ExprError, batch_resolver, eval_mask, eval_value, parse_assignments, parse_expr
+
+    if source_sqls:
+        raise ProcedureError(
+            "source_sqls is not supported (no SQL DDL engine); register the "
+            "source as a catalog table and pass source_table"
+        )
+    if not source_table:
+        raise ProcedureError("source_table is required")
+    if matched_upsert_condition and not matched_upsert_setting:
+        raise ProcedureError("matched-upsert must set the 'matched_upsert_setting' argument")
+
+    t = _t(cat, target_table)
+    src_t = _t(cat, source_table)
+    rb = src_t.new_read_builder()
+    source = rb.new_read().read_all(rb.new_scan().plan())
+
+    tgt_names = {a for a in (target_alias, target_table.split(".")[-1], "tgt", "t") if a}
+    src_names = {a for a in (source_table.split(".")[-1], "src", "s") if a} - tgt_names
+
+    def make_resolver(src_b, tgt_b):
+        def resolve(alias, name):
+            order = []
+            if alias is None:
+                order = [b for b in (src_b, tgt_b) if b is not None]
+            elif alias in src_names:
+                order = [src_b]
+            elif alias in tgt_names:
+                if tgt_b is None:
+                    raise ProcedureError(f"'{alias}.{name}': no target row in NOT MATCHED clause")
+                order = [tgt_b]
+            else:
+                raise ProcedureError(f"unknown table alias {alias!r} in merge_into")
+            for b in order:
+                if name in b.schema:
+                    c = b.column(name)
+                    import numpy as _np
+
+                    return _np.asarray(c.values), c.validity
+            raise ProcedureError(f"unknown column {name!r} in merge_into")
+
+        return resolve
+
+    def cond_fn(expr_text):
+        if not expr_text or expr_text.strip().upper() == "TRUE":
+            return None
+        ast = parse_expr(expr_text)
+
+        def fn(src_b, tgt_b=None):
+            return eval_mask(ast, make_resolver(src_b, tgt_b), src_b.num_rows)
+
+        return fn
+
+    def value_fn(ast):
+        def fn(src_b, tgt_b=None):
+            return eval_value(ast, make_resolver(src_b, tgt_b), src_b.num_rows)
+
+        return fn
+
+    # the merge condition must equi-join on the full target primary key —
+    # the same restriction the reference enforces for PK tables
+    if merge_condition:
+        ast = parse_expr(merge_condition)
+        parts = ast[1] if ast[0] == "and" else [ast]
+        joined = set()
+        for p in parts:
+            ok = (
+                p[0] == "cmp" and p[1] == "=" and p[2][0] == "col" and p[3][0] == "col"
+                and p[2][2] == p[3][2]
+            )
+            if not ok:
+                raise ProcedureError(
+                    f"merge_condition must be an equi-join on the primary key, got {merge_condition!r}"
+                )
+            joined.add(p[2][2])
+        if joined != set(t.primary_keys):
+            raise ProcedureError(
+                f"merge_condition must cover the full primary key {sorted(t.primary_keys)}, got {sorted(joined)}"
+            )
+
+    from ..table.rowops import MergeInto
+
+    m = MergeInto(t, source)
+    try:
+        if matched_upsert_setting:
+            assigns = parse_assignments(matched_upsert_setting)
+            if assigns and assigns[0][0] == "*":
+                set_map = {
+                    f.name: f"src.{f.name}"
+                    for f in t.row_type.fields
+                    if f.name not in t.primary_keys and f.name in source.schema
+                }
+            else:
+                set_map = {col: value_fn(ast) for col, ast in assigns}
+            m.when_matched_update(set_map, condition=cond_fn(matched_upsert_condition))
+        if matched_delete_condition:
+            m.when_matched_delete(condition=cond_fn(matched_delete_condition))
+        if not_matched_insert_values:
+            if not_matched_insert_values.strip() == "*":
+                values = None
+            else:
+                if "=" in not_matched_insert_values:  # 'col = expr, ...' form
+                    values = {
+                        col: value_fn(ast)
+                        for col, ast in parse_assignments(not_matched_insert_values)
+                    }
+                else:
+                    # positional list over the target schema (reference syntax)
+                    from .expr import _Parser, _tokenize  # noqa: SLF001
+
+                    p = _Parser(_tokenize(not_matched_insert_values), not_matched_insert_values)
+                    asts = [p.parse_operand()]
+                    while p.peek() == ("op", ","):
+                        p.next()
+                        asts.append(p.parse_operand())
+                    fields = t.row_type.fields
+                    if len(asts) != len(fields):
+                        raise ProcedureError(
+                            f"not_matched_insert_values has {len(asts)} expressions; "
+                            f"target has {len(fields)} columns"
+                        )
+                    values = {f.name: value_fn(a) for f, a in zip(fields, asts)}
+            m.when_not_matched_insert(values=values, condition=cond_fn(not_matched_insert_condition))
+        r = m.execute()
+    except ExprError as e:
+        raise ProcedureError(str(e)) from e
+    return {"rows_updated": r.rows_updated, "rows_deleted": r.rows_deleted,
+            "rows_inserted": r.rows_inserted}
+
+
+def _merge_into_dispatch(cat, *args, **kwargs):
+    """The reference's positional dispatch rule, applied ONLY to positional
+    calls: exactly 6 positional arguments = the short delete form
+    (tgt, alias, sqls, src, merge_cond, delete_cond). Named arguments always
+    mean what they say."""
+    if len(args) == 6 and not kwargs:
+        return _proc_merge_into(
+            cat, args[0], args[1], args[2], args[3], args[4],
+            matched_delete_condition=args[5],
+        )
+    return _proc_merge_into(cat, *args, **kwargs)
+
+
+def _infer_migrate_row_type(path: str, file_format: str):
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+
+        from ..data.batch import ColumnBatch
+
+        return ColumnBatch.row_type_from_arrow(pq.read_schema(path))
+    if file_format == "orc":
+        import pyarrow.orc as po
+
+        from ..data.batch import ColumnBatch
+
+        with open(path, "rb") as fh:
+            return ColumnBatch.row_type_from_arrow(po.ORCFile(fh).schema)
+    raise ProcedureError(f"cannot infer schema from format {file_format!r}")
+
+
+def _proc_migrate_table(cat, table: str, source_dir: str, file_format: str = "parquet",
+                        options: str = ""):
+    """MigrateTableProcedure: adopt a directory of foreign-format files as a
+    table without rewriting them (file-level adoption commit)."""
+    import glob as _glob
+
+    from ..table.migrate import migrate_files
+
+    candidates = sorted(_glob.glob(f"{_glob.escape(source_dir)}/*.{file_format}"))
+    if not candidates:
+        raise ProcedureError(f"no *.{file_format} files found in {source_dir}")
+    row_type = _infer_migrate_row_type(candidates[0], file_format)
+    t = migrate_files(cat, table, source_dir, row_type, file_format=file_format)
+    return {"migrated": table, "snapshot": t.store.snapshot_manager.latest_snapshot_id()}
+
+
+def _proc_migrate_database(cat, database: str, source_dir: str, file_format: str = "parquet"):
+    """MigrateDatabaseProcedure: one migrate_table per subdirectory."""
+    import os as _os
+
+    migrated = []
+    for entry in sorted(_os.listdir(source_dir)):
+        sub = _os.path.join(source_dir, entry)
+        if _os.path.isdir(sub) and any(f.endswith(f".{file_format}") for f in _os.listdir(sub)):
+            _proc_migrate_table(cat, f"{database}.{entry}", sub, file_format)
+            migrated.append(f"{database}.{entry}")
+    return {"migrated": migrated}
+
+
+def _proc_migrate_file(cat, source_table: str, target_table: str,
+                       delete_origin: bool = True):
+    """MigrateFileProcedure: move the data files of one append table into
+    another existing append table (same schema) as an adoption commit."""
+    from ..table.migrate import adopt_table_files
+
+    try:
+        moved = adopt_table_files(cat, source_table, target_table)
+    except ValueError as e:
+        raise ProcedureError(str(e)) from e
+    if delete_origin:
+        cat.drop_table(source_table)
+    return {"migrated_into": target_table, "files": moved,
+            "origin_deleted": bool(delete_origin)}
+
+
+def _proc_repair(cat, identifier: str | None = None):
+    """RepairProcedure: sync catalog metadata with the filesystem truth."""
+    repair = getattr(cat, "repair", None)
+    if repair is None:
+        raise ProcedureError(f"catalog {type(cat).__name__} does not support repair")
+    return repair() if identifier is None else repair(identifier)
+
+
+def _proc_query_service(cat, table: str, serve_seconds: float | None = None,
+                        host: str = "127.0.0.1", port: int = 0):
+    """QueryServiceProcedure: start the KV query service for a table. Unlike
+    the reference's (which parks a streaming job), this returns after
+    `serve_seconds` (None = return immediately, server runs as a daemon)."""
+    import time as _time
+
+    from ..service import KvQueryServer
+
+    server = KvQueryServer(_t(cat, table), host=host, port=port)
+    h, p = server.start()
+    if serve_seconds:
+        _time.sleep(float(serve_seconds))
+        server.shutdown()
+        return {"service": "kv-query", "host": h, "port": p, "stopped": True}
+    return {"service": "kv-query", "host": h, "port": p, "server": server}
+
+
+def _proc_rewrite_file_index(cat, table: str, partitions: str | None = None):
+    """RewriteFileIndexProcedure.java:50 — build file indexes for data files
+    written BEFORE indexing was enabled (or with a different index config).
+    Scans the latest snapshot, (re)builds the configured bloom indexes for
+    files lacking them, and commits a COMPACT-kind metadata-only replacement
+    (same data file, new extra_files/embedded_index)."""
+    import dataclasses
+
+    from ..format.fileindex import build_index_payload, index_path
+    from ..options import CoreOptions
+
+    t = _t(cat, table)
+    opts = t.options
+    cols_opt = opts.options.get(CoreOptions.FILE_INDEX_BLOOM_COLUMNS)
+    if not cols_opt:
+        raise ProcedureError(
+            "table has no file-index.bloom-filter.columns configured; "
+            "set the option, then CALL sys.rewrite_file_index"
+        )
+    bloom_cols = [c.strip() for c in cols_opt.split(",") if c.strip()]
+    fpp = opts.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP)
+    threshold = opts.options.get(CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD)
+    part_filter = _parse_partition_specs(partitions) if partitions else None
+
+    store = t.store
+    snap = store.snapshot_manager.latest_snapshot_id()
+    if snap is None:
+        return {"rewritten": 0}
+    plan = store.new_scan().plan()
+    from ..core.manifest import CommitMessage
+
+    by_pb: dict[tuple, CommitMessage] = {}
+    rewritten = 0
+    for e in plan.entries:
+        f = e.file
+        if f.embedded_index is not None or any(x.endswith(".index") for x in f.extra_files):
+            continue  # already indexed
+        if part_filter is not None:
+            part_names = t.partition_keys
+            spec_match = any(
+                all(str(dict(zip(part_names, e.partition)).get(k)) == v for k, v in spec.items())
+                for spec in part_filter
+            )
+            if not spec_match:
+                continue
+        rf = store.reader_factory(e.partition, e.bucket)
+        present = [c for c in bloom_cols if c in t.row_type]
+        if not present:
+            continue
+        kv = rf.read(f, fields=present, system_columns=False)
+        payload = build_index_payload(kv.data, present, fpp)
+        if payload is None:
+            continue
+        extra = list(f.extra_files)
+        embedded = None
+        if len(payload) <= threshold:
+            embedded = payload
+        else:
+            data_path = f"{rf.bucket_dir}/{f.file_name}"
+            t.file_io.write_bytes(index_path(data_path), payload, overwrite=True)
+            extra.append(f.file_name + ".index")
+        new_meta = dataclasses.replace(f, extra_files=tuple(extra), embedded_index=embedded)
+        key = (e.partition, e.bucket)
+        msg = by_pb.get(key)
+        if msg is None:
+            msg = by_pb[key] = CommitMessage(
+                partition=e.partition, bucket=e.bucket, total_buckets=e.total_buckets
+            )
+        msg.compact_before.append(f)
+        msg.compact_after.append(new_meta)
+        rewritten += 1
+    if by_pb:
+        from ..table.write import BatchWriteBuilder, TableCommit
+
+        TableCommit(t).commit_messages(BatchWriteBuilder.COMMIT_IDENTIFIER, list(by_pb.values()))
+    return {"rewritten": rewritten, "columns": bloom_cols}
+
+
+# --- privilege procedures (reference procedure/privilege/*) ----------------
+
+
+def _priv(cat):
+    from ..catalog.privilege import PrivilegeManager
+
+    mgr = getattr(cat, "privilege_manager", None) or getattr(cat, "manager", None)
+    if not isinstance(mgr, PrivilegeManager):
+        raise ProcedureError(
+            "catalog has no privilege support; open it as a PrivilegedCatalog"
+        )
+    return mgr
+
+
+def _proc_init_file_based_privilege(cat, root_password: str):
+    _priv(cat).init(root_password)
+    return {"initialized": True}
+
+
+def _proc_create_privileged_user(cat, user: str, password: str):
+    _priv(cat).create_user(user, password)
+    return {"user": user}
+
+
+def _proc_drop_privileged_user(cat, user: str):
+    _priv(cat).drop_user(user)
+    return {"dropped_user": user}
+
+
+def _proc_grant_privilege_to_user(cat, user: str, privilege: str,
+                                  database: str | None = None,
+                                  table: str | None = None):
+    obj = f"{database}.{table}" if database and table else (database or "*")
+    _priv(cat).grant(user, obj, privilege)
+    return {"user": user, "granted": privilege, "on": obj}
+
+
+def _proc_revoke_privilege_from_user(cat, user: str, privilege: str,
+                                     database: str | None = None,
+                                     table: str | None = None):
+    obj = f"{database}.{table}" if database and table else (database or "*")
+    _priv(cat).revoke(user, obj, privilege)
+    return {"user": user, "revoked": privilege, "on": obj}
 
 
 procedures: dict[str, Callable[..., Any]] = {
@@ -332,6 +725,18 @@ procedures: dict[str, Callable[..., Any]] = {
     "remove_orphan_files": _proc_remove_orphan_files,
     "reset_consumer": _proc_reset_consumer,
     "delete": _proc_delete,
+    "merge_into": _merge_into_dispatch,
+    "migrate_table": _proc_migrate_table,
+    "migrate_database": _proc_migrate_database,
+    "migrate_file": _proc_migrate_file,
+    "repair": _proc_repair,
+    "query_service": _proc_query_service,
+    "rewrite_file_index": _proc_rewrite_file_index,
+    "init_file_based_privilege": _proc_init_file_based_privilege,
+    "create_privileged_user": _proc_create_privileged_user,
+    "drop_privileged_user": _proc_drop_privileged_user,
+    "grant_privilege_to_user": _proc_grant_privilege_to_user,
+    "revoke_privilege_from_user": _proc_revoke_privilege_from_user,
 }
 
 
@@ -348,3 +753,18 @@ def call(catalog: "Catalog", statement: str) -> Any:
     except TypeError as e:
         # surface signature mistakes as procedure errors with the usage
         raise ProcedureError(f"CALL {name}: {e}") from e
+
+
+def query(catalog: "Catalog", statement: str):
+    """Execute one SELECT statement (see sql.select for the grammar)."""
+    from .select import query as _query
+
+    return _query(catalog, statement)
+
+
+def execute(catalog: "Catalog", statement: str) -> Any:
+    """One string entry point for both statement kinds: CALL -> procedure
+    dict, SELECT -> ColumnBatch."""
+    if re.match(r"^\s*SELECT\b", statement, re.I):
+        return query(catalog, statement)
+    return call(catalog, statement)

@@ -17,7 +17,78 @@ from ..fs import get_file_io
 from ..types import RowType
 from ..utils import now_millis
 
-__all__ = ["migrate_files"]
+__all__ = ["migrate_files", "adopt_table_files"]
+
+
+def adopt_table_files(
+    catalog: Catalog,
+    source_identifier: "Identifier | str",
+    target_identifier: "Identifier | str",
+) -> int:
+    """MigrateFileProcedure analog: adopt the data files of one append table
+    into another existing append table with the same schema — a file-level
+    adoption commit, no data rewrite (reference Migrator.executeMigrate's
+    file-move path). Returns the number of files adopted. The source table is
+    left intact for the caller to drop (which reclaims the originals)."""
+    src = catalog.get_table(source_identifier)
+    tgt = catalog.get_table(target_identifier)
+    if src.primary_keys or tgt.primary_keys:
+        raise ValueError("migrate_file supports append (no primary key) tables only")
+    if [f.type for f in src.row_type.fields] != [f.type for f in tgt.row_type.fields]:
+        raise ValueError("migrate_file requires identical schemas")
+    import dataclasses
+
+    plan = src.store.new_scan().plan()
+    # rebase adopted sequence numbers above the target's current maximum so
+    # commit-time ordering invariants hold
+    base = 0
+    for e in tgt.store.new_scan().plan().entries:
+        base = max(base, e.file.max_sequence_number + 1)
+    by_partition: dict[tuple, list[DataFileMeta]] = {}
+    moved = 0
+    from ..utils import new_file_name
+
+    # COPY files in, then commit: a crash mid-adoption leaves only orphan
+    # copies in the target (cleaned by remove_orphan_files) — the source
+    # table stays fully intact either way (a move ordering would break the
+    # source manifests on a mid-loop failure). The caller drops the source
+    # table afterwards, which reclaims the originals.
+    for e in plan.entries:
+        src_dir = src.store.bucket_dir(e.partition, e.bucket)
+        tgt_dir = tgt.store.bucket_dir(e.partition, 0)
+        tgt.file_io.mkdirs(tgt_dir)
+        # fresh target-local name: adopted tables may carry identical
+        # foreign names (e.g. two hive dirs both holding part-0.parquet)
+        ext = e.file.file_name.rsplit(".", 1)[-1]
+        name = new_file_name("data", ext)
+        tgt.file_io.write_bytes(
+            f"{tgt_dir}/{name}", src.file_io.read_bytes(f"{src_dir}/{e.file.file_name}")
+        )
+        # index sidecars follow their data file, renamed to match
+        new_extra = []
+        for x in e.file.extra_files:
+            if x == f"{e.file.file_name}.index":
+                tgt.file_io.write_bytes(
+                    f"{tgt_dir}/{name}.index", src.file_io.read_bytes(f"{src_dir}/{x}")
+                )
+                new_extra.append(f"{name}.index")
+            else:
+                new_extra.append(x)
+        span = e.file.max_sequence_number - e.file.min_sequence_number
+        meta = dataclasses.replace(
+            e.file, file_name=name, extra_files=tuple(new_extra),
+            min_sequence_number=base, max_sequence_number=base + span,
+        )
+        base += span + 1
+        by_partition.setdefault(e.partition, []).append(meta)
+        moved += 1
+    if by_partition:
+        messages = [
+            CommitMessage(part, 0, 1, new_files=files)
+            for part, files in by_partition.items()
+        ]
+        tgt.store.new_commit().commit(ManifestCommittable(now_millis(), messages=messages))
+    return moved
 
 
 def migrate_files(

@@ -138,6 +138,7 @@ def probe_devices(timeout_s: float = 120.0, stale_negative_after_s: float | None
     after a fast failure (connection refused completes in seconds and would
     otherwise pin the negative answer for the full TTL), while positive
     verdicts stay trusted."""
+    stale_completed = None
     cached = _read_cache()
     if (
         cached
@@ -145,7 +146,11 @@ def probe_devices(timeout_s: float = 120.0, stale_negative_after_s: float | None
         and int(cached.get("n", 0)) == 0
         and (time.time() - cached.get("completed", 0)) >= stale_negative_after_s
     ):
-        cached = None  # treat as stale: respawn a probe below
+        # treat as stale: respawn below, and remember this verdict's stamp so
+        # the wait loop doesn't hand the SAME still-on-disk negative straight
+        # back (which would skip the whole timeout)
+        stale_completed = cached.get("completed", 0)
+        cached = None
     if cached:
         return int(cached.get("n", 0)), str(cached.get("backend", "unreachable"))
 
@@ -165,7 +170,7 @@ def probe_devices(timeout_s: float = 120.0, stale_negative_after_s: float | None
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         cached = _read_cache()
-        if cached:
+        if cached and (stale_completed is None or cached.get("completed", 0) > stale_completed):
             return int(cached.get("n", 0)), str(cached.get("backend", "unreachable"))
         if _probe_child_alive() is None:
             # child exited without a fresh verdict (crashed): report, don't respawn in a loop

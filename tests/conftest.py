@@ -9,6 +9,10 @@ _platform = os.environ.get("PAIMON_TEST_PLATFORM", "cpu")
 # exercise the device dispatch policy (compact/delta link encodings) even on
 # the CPU backend, where production dispatch skips them (no link to save)
 os.environ.setdefault("PAIMON_TPU_FORCE_COMPACT", "1")
+# likewise pin the device merge kernels: production adapts to the host
+# lexsort engine on a CPU-only backend (mergefn.effective_sort_engine), but
+# the suite's job is to exercise the device dispatch path on the virtual mesh
+os.environ.setdefault("PAIMON_TPU_FORCE_DEVICE_ENGINE", "1")
 os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if _platform == "cpu" and "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,335 @@
+"""Round-5 SQL surface: expression grammar, WHERE-string delete, merge_into,
+rewrite_file_index, migrate_*, repair, query_service, privilege procedures —
+the full 22-procedure parity set (reference
+paimon-flink-common/.../procedure/ + procedure/privilege/)."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.sql import ProcedureError, call
+from paimon_tpu.sql.expr import ExprError, parse_expr, parse_where
+from paimon_tpu.types import BIGINT, DOUBLE, INT, STRING, RowType
+
+
+@pytest.fixture
+def cat(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="sql5")
+
+
+def _mk(cat, name="db.t", rows=200, pk=("k",)):
+    t = cat.create_table(
+        name,
+        RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("s", STRING())),
+        primary_keys=list(pk),
+        options={"bucket": "1"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    ids = np.arange(rows, dtype=np.int64)
+    w.write({"k": ids, "v": ids * 10, "s": [f"s-{i % 7}" for i in range(rows)]})
+    wb.new_commit().commit(w.prepare_commit())
+    return t
+
+
+def _rows(t):
+    rb = t.new_read_builder()
+    return sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+
+
+# --- expression grammar ----------------------------------------------------
+
+def test_where_parser_filters_like_reference_strings():
+    from paimon_tpu.data.batch import ColumnBatch
+
+    schema = RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("s", STRING()))
+    b = ColumnBatch.from_pydict(
+        schema,
+        {"k": list(range(10)), "v": [i * 10 for i in range(10)],
+         "s": [f"ab{i}" if i % 2 else f"cd{i}" for i in range(10)]},
+    )
+    cases = {
+        "k >= 7": {7, 8, 9},
+        "k >= 3 AND k < 5": {3, 4},
+        "k = 1 OR k = 8": {1, 8},
+        "NOT k < 8": {8, 9},
+        "k IN (2, 4, 99)": {2, 4},
+        "k NOT IN (0,1,2,3,4,5,6,7)": {8, 9},
+        "k BETWEEN 2 AND 4": {2, 3, 4},
+        "v / 10 = k AND TRUE": set(range(10)),  # arith folds only literals -> error
+        "s LIKE 'ab%'": {1, 3, 5, 7, 9},
+        "s LIKE '%5'": {5},
+        "100 <= v": {i for i in range(10) if i * 10 >= 100},
+    }
+    for text, want in cases.items():
+        if text.startswith("v / 10"):
+            with pytest.raises(ExprError):
+                parse_where(text)
+            continue
+        pred = parse_where(text)
+        mask = pred.eval(b)
+        got = {i for i in range(10) if mask[i]}
+        assert got == want, text
+    assert parse_where("TRUE") is None
+    with pytest.raises(ExprError):
+        parse_where("k = ")  # truncated
+    with pytest.raises(ExprError):
+        parse_where("s = 'unterminated")
+    with pytest.raises(ExprError):
+        parse_where("k = v")  # col-col needs the two-table mode
+
+
+def test_expr_ast_shapes():
+    ast = parse_expr("a.x = 1 AND b > 2 OR c IS NOT NULL")
+    assert ast[0] == "or"
+    assert parse_expr("x + 2 * y")[0] == "arith"
+
+
+# --- delete with a SQL WHERE ----------------------------------------------
+
+def test_delete_procedure_takes_sql_where(cat):
+    _mk(cat)
+    got = call(cat, "CALL sys.delete('db.t', 'k >= 100 AND k < 150')")
+    assert got["rows_deleted"] == 50
+    rows = _rows(cat.get_table("db.t"))
+    assert len(rows) == 150
+    assert all(not (100 <= r[0] < 150) for r in rows)
+    # legacy JSON blob stays accepted
+    got = call(cat, 'CALL sys.delete(\'db.t\', \'{"field": "k", "op": "<", "value": 10}\')')
+    assert got["rows_deleted"] == 10
+    with pytest.raises(ProcedureError):
+        call(cat, "CALL sys.delete('db.t', 'TRUE')")
+
+
+# --- merge_into ------------------------------------------------------------
+
+def test_merge_into_upsert_and_insert(cat):
+    _mk(cat, rows=100)
+    src = cat.create_table(
+        "db.src",
+        RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("s", STRING())),
+        primary_keys=["k"],
+        options={"bucket": "1"},
+    )
+    wb = src.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [50, 60, 200, 201], "v": [1, 2, 3, 4], "s": ["a", "b", "c", "d"]})
+    wb.new_commit().commit(w.prepare_commit())
+
+    got = call(cat, (
+        "CALL sys.merge_into("
+        "target_table => 'db.t', source_table => 'db.src', "
+        "merge_condition => 't.k = src.k', "
+        "matched_upsert_condition => 'src.v < 2', "
+        "matched_upsert_setting => 'v = src.v + 1000', "
+        "not_matched_insert_values => '*')"
+    ))
+    assert got == {"rows_updated": 1, "rows_deleted": 0, "rows_inserted": 2}
+    rows = {r[0]: r for r in _rows(cat.get_table("db.t"))}
+    assert rows[50][1] == 1001      # matched + condition true: updated
+    assert rows[60][1] == 600       # matched + condition false: untouched
+    assert rows[200][1] == 3 and rows[201][1] == 4  # inserted
+
+
+def test_merge_into_short_delete_form_and_star_setting(cat):
+    _mk(cat, rows=50)
+    src = cat.create_table(
+        "db.sd",
+        RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("s", STRING())),
+        primary_keys=["k"],
+        options={"bucket": "1"},
+    )
+    wb = src.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1, 2, 3], "v": [7, 8, 9], "s": ["x", "y", "z"]})
+    wb.new_commit().commit(w.prepare_commit())
+    # reference short form: 6 positional args = delete-only
+    got = call(cat, "CALL sys.merge_into('db.t', 'T', '', 'db.sd', 'T.k = sd.k', 'sd.v >= 8')")
+    assert got["rows_deleted"] == 2 and got["rows_updated"] == 0
+    rows = {r[0] for r in _rows(cat.get_table("db.t"))}
+    assert 1 in rows and 2 not in rows and 3 not in rows
+    # '*' upsert setting copies all non-pk source columns
+    got = call(cat, (
+        "CALL sys.merge_into(target_table => 'db.t', source_table => 'db.sd', "
+        "merge_condition => 't.k = sd.k', matched_upsert_condition => '', "
+        "matched_upsert_setting => '*')"
+    ))
+    assert got["rows_updated"] == 1  # only k=1 still matches
+    rows = {r[0]: r for r in _rows(cat.get_table("db.t"))}
+    assert rows[1][1] == 7 and rows[1][2] == "x"
+
+
+def test_merge_into_rejects_bad_condition(cat):
+    _mk(cat, rows=10)
+    src = cat.create_table(
+        "db.bad",
+        RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("s", STRING())),
+        primary_keys=["k"], options={"bucket": "1"},
+    )
+    wb = src.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1], "v": [1], "s": ["q"]})
+    wb.new_commit().commit(w.prepare_commit())
+    with pytest.raises(ProcedureError, match="primary key"):
+        call(cat, (
+            "CALL sys.merge_into(target_table => 'db.t', source_table => 'db.bad', "
+            "merge_condition => 't.v = bad.v', matched_upsert_condition => '', "
+            "matched_upsert_setting => 'v = bad.v')"
+        ))
+    # a NAMED matched_upsert_condition without its setting is a usage error,
+    # never reinterpreted as a delete condition (that would silently destroy
+    # matched rows)
+    with pytest.raises(ProcedureError, match="matched_upsert_setting"):
+        call(cat, (
+            "CALL sys.merge_into(target_table => 'db.t', source_table => 'db.bad', "
+            "merge_condition => 't.k = bad.k', matched_upsert_condition => 'bad.v > 0')"
+        ))
+    with pytest.raises(ProcedureError, match="source_sqls"):
+        call(cat, (
+            "CALL sys.merge_into(target_table => 'db.t', source_table => 'db.bad', "
+            "source_sqls => 'CREATE VIEW x AS ...', merge_condition => 't.k = bad.k', "
+            "matched_upsert_setting => '*')"
+        ))
+
+
+# --- rewrite_file_index ----------------------------------------------------
+
+def test_rewrite_file_index_builds_missing_indexes(cat):
+    from paimon_tpu.core.schema import SchemaChange
+    from paimon_tpu.data import predicate as P
+
+    t = cat.create_table(
+        "db.fi",
+        RowType.of(("id", BIGINT(False)), ("x", DOUBLE())),
+        primary_keys=["id"],
+        options={"bucket": "1", "write-only": "true"},
+    )
+    # two files with overlapping ranges (evens/odds): min-max cannot prune
+    for start in (0, 1):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        ids = np.arange(start, 200, 2, dtype=np.int64)
+        w.write({"id": ids, "x": ids * 0.5})
+        wb.new_commit().commit(w.prepare_commit())
+    entries = t.store.new_scan().plan().entries
+    assert all(e.file.embedded_index is None and not e.file.extra_files for e in entries)
+
+    with pytest.raises(ProcedureError, match="file-index"):
+        call(cat, "CALL sys.rewrite_file_index('db.fi')")
+    cat.alter_table("db.fi", SchemaChange.set_option("file-index.bloom-filter.columns", "id"))
+    got = call(cat, "CALL sys.rewrite_file_index('db.fi')")
+    assert got["rewritten"] == 2
+
+    t2 = cat.get_table("db.fi")
+    entries = t2.store.new_scan().plan().entries
+    assert all(
+        e.file.embedded_index is not None or any(x.endswith(".index") for x in e.file.extra_files)
+        for e in entries
+    )
+    # the new indexes actually prune at plan time
+    rb = t2.new_read_builder().with_filter(P.equal("id", 151))
+    assert sum(len(s.files) for s in rb.new_scan().plan()) == 1
+    # idempotent: second call finds nothing to do
+    assert call(cat, "CALL sys.rewrite_file_index('db.fi')")["rewritten"] == 0
+    # data unchanged
+    assert len(_rows(t2)) == 200
+
+
+# --- migrate / repair / query_service -------------------------------------
+
+def test_migrate_table_and_database_procedures(cat, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for db_dir, tname in (("ext/t1", "t1"), ("ext/t2", "t2")):
+        d = tmp_path / db_dir
+        d.mkdir(parents=True)
+        pq.write_table(pa.table({"a": list(range(10)), "b": [f"r{i}" for i in range(10)]}),
+                       d / "part-0.parquet")
+    got = call(cat, f"CALL sys.migrate_table('db.m1', '{tmp_path}/ext/t1', 'parquet')")
+    assert got["migrated"] == "db.m1"
+    assert len(_rows(cat.get_table("db.m1"))) == 10
+    got = call(cat, f"CALL sys.migrate_database('mdb', '{tmp_path}/ext', 'parquet')")
+    assert got["migrated"] == ["mdb.t2"]  # t1's dir is now empty (files moved)
+    assert len(_rows(cat.get_table("mdb.t2"))) == 10
+
+
+def test_migrate_file_adopts_and_drops_origin(cat, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for n in ("a", "b"):
+        d = tmp_path / "raw" / n
+        d.mkdir(parents=True)
+        pq.write_table(pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]}), d / "f.parquet")
+    call(cat, f"CALL sys.migrate_table('db.ma', '{tmp_path}/raw/a', 'parquet')")
+    call(cat, f"CALL sys.migrate_table('db.mb', '{tmp_path}/raw/b', 'parquet')")
+    got = call(cat, "CALL sys.migrate_file('db.ma', 'db.mb', true)")
+    assert got["files"] == 1 and got["origin_deleted"]
+    assert len(_rows(cat.get_table("db.mb"))) == 6
+    with pytest.raises(Exception):
+        cat.get_table("db.ma")  # dropped
+    # pk tables are rejected (reference restriction)
+    _mk(cat, "db.pk1")
+    _mk(cat, "db.pk2")
+    with pytest.raises(ProcedureError, match="append"):
+        call(cat, "CALL sys.migrate_file('db.pk1', 'db.pk2', false)")
+
+
+def test_repair_procedure_requires_capable_catalog(cat, tmp_warehouse):
+    with pytest.raises(ProcedureError, match="repair"):
+        call(cat, "CALL sys.repair()")
+    import os
+
+    from paimon_tpu.catalog.jdbc import JdbcCatalog
+
+    jc = JdbcCatalog(os.path.join(tmp_warehouse, "meta.db"), tmp_warehouse, commit_user="sql5")
+    _mk(jc, "jdb.jt", rows=10)
+    out = call(jc, "CALL sys.repair()")
+    assert isinstance(out, dict)
+
+
+def test_query_service_procedure(cat):
+    _mk(cat, "db.q", rows=20)
+    got = call(cat, "CALL sys.query_service('db.q')")
+    try:
+        assert got["service"] == "kv-query" and got["port"] > 0
+        from paimon_tpu.service import KvQueryClient
+
+        c = KvQueryClient(got["host"], got["port"])
+        assert c.lookup((), (5,)) is not None
+        c.close()
+    finally:
+        got["server"].shutdown()
+
+
+# --- privilege procedures --------------------------------------------------
+
+def test_privilege_procedures(tmp_warehouse):
+    from paimon_tpu.catalog.privilege import PrivilegedCatalog
+
+    cat = PrivilegedCatalog(tmp_warehouse, "root", "rootpw")
+    call(cat, "CALL sys.init_file_based_privilege('rootpw')")
+    call(cat, "CALL sys.create_privileged_user('alice', 'pw1')")
+    got = call(cat, (
+        "CALL sys.grant_privilege_to_user('alice', 'SELECT', 'db', 't')"
+    ))
+    assert got["granted"] == "SELECT" and got["on"] == "db.t"
+    mgr = cat.manager
+    assert mgr.has("alice", "db.t", "SELECT")
+    call(cat, "CALL sys.revoke_privilege_from_user('alice', 'SELECT', 'db', 't')")
+    assert not mgr.has("alice", "db.t", "SELECT")
+    call(cat, "CALL sys.drop_privileged_user('alice')")
+    # the full reference procedure set is reachable by name
+    from paimon_tpu.sql import procedures
+
+    reference_set = {
+        "compact", "compact_database", "create_branch", "create_tag", "delete_branch",
+        "delete_tag", "drop_partition", "expire_partitions", "expire_snapshots",
+        "fast_forward", "mark_partition_done", "merge_into", "migrate_database",
+        "migrate_file", "migrate_table", "query_service", "remove_orphan_files",
+        "repair", "reset_consumer", "rewrite_file_index", "rollback_to", "delete",
+        "init_file_based_privilege", "create_privileged_user", "drop_privileged_user",
+        "grant_privilege_to_user", "revoke_privilege_from_user",
+    }
+    assert reference_set <= set(procedures)

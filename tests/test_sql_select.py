@@ -1,0 +1,92 @@
+"""SELECT surface: SQL text -> merged rows through the Table API scan path,
+with real pushdown (predicate file-skipping, projection decode-pruning,
+LIMIT early-stop). Reference leaves SELECT to host engines; this is the
+self-contained evaluator documented in sql/select.py."""
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.sql import execute, query
+from paimon_tpu.sql.select import QueryError
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+
+@pytest.fixture
+def cat(tmp_warehouse):
+    c = FileSystemCatalog(tmp_warehouse, commit_user="sel")
+    t = c.create_table(
+        "db.t",
+        RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("x", DOUBLE()), ("s", STRING())),
+        primary_keys=["k"],
+        options={"bucket": "1", "write-only": "true"},
+    )
+    # two overlapping runs: SELECT sees MERGED rows (upsert semantics), not
+    # raw file contents
+    for r in range(2):
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        ids = np.arange(r * 50, 100 + r * 50, dtype=np.int64)
+        w.write({"k": ids, "v": ids * (r + 1), "x": ids * 0.5, "s": [f"g{int(i) % 3}" for i in ids]})
+        wb.new_commit().commit(w.prepare_commit())
+    return c
+
+
+def test_select_star_merges(cat):
+    out = query(cat, "SELECT * FROM db.t")
+    assert out.num_rows == 150
+    rows = {r[0]: r[1] for r in out.to_pylist()}
+    assert rows[75] == 150  # overlapped key: second commit won
+    assert rows[25] == 25   # first-run-only key
+
+
+def test_select_projection_where_order_limit(cat):
+    out = query(cat, "SELECT k, v FROM db.t WHERE k >= 140 ORDER BY k DESC LIMIT 3")
+    assert out.schema.field_names == ["k", "v"]
+    assert [r[0] for r in out.to_pylist()] == [149, 148, 147]
+    out = query(cat, "SELECT s, k FROM db.t WHERE s LIKE 'g1' AND k < 10 ORDER BY k")
+    assert all(r[0] == "g1" for r in out.to_pylist())
+    out = query(cat, "SELECT k FROM db.t LIMIT 7")
+    assert out.num_rows == 7
+
+
+def test_select_aggregates(cat):
+    out = query(cat, "SELECT count(*), min(k), max(k), avg(v) FROM db.t WHERE k < 50")
+    (row,) = out.to_pylist()
+    assert row[0] == 50 and row[1] == 0 and row[2] == 49
+    assert abs(row[3] - float(np.arange(50).mean())) < 1e-9
+    out = query(cat, "SELECT sum(v) FROM db.t")
+    total = sum(r[1] for r in query(cat, "SELECT k, v FROM db.t").to_pylist())
+    assert out.to_pylist()[0][0] == total
+
+
+def test_select_pushdown_skips_files(cat):
+    # predicate pushdown reaches planning: k >= 140 lives only in run 2
+    t = cat.get_table("db.t")
+    rb = t.new_read_builder()
+    n_all = sum(len(s.files) for s in rb.new_scan().plan())
+    assert n_all == 2
+    from paimon_tpu.sql.expr import parse_where
+
+    rb2 = t.new_read_builder().with_filter(parse_where("k >= 140"))
+    assert sum(len(s.files) for s in rb2.new_scan().plan()) == 1
+
+
+def test_select_system_table(cat):
+    out = query(cat, "SELECT * FROM db.t$snapshots")
+    assert out.num_rows == 2  # two commits
+
+
+def test_execute_dispatches_both_kinds(cat):
+    assert execute(cat, "SELECT count(*) FROM db.t").to_pylist()[0][0] == 150
+    got = execute(cat, "CALL sys.create_tag('db.t', 'sel-tag')")
+    assert got["tag"] == "sel-tag"
+
+
+def test_select_errors(cat):
+    with pytest.raises(QueryError):
+        query(cat, "SELECT nope FROM db.t")
+    with pytest.raises(QueryError):
+        query(cat, "SELECT k, count(*) FROM db.t")
+    with pytest.raises(QueryError):
+        query(cat, "DELETE FROM db.t")
