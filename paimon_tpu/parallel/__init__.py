@@ -23,6 +23,8 @@ from .distributed import global_mesh, init_multi_host, is_commit_coordinator
 from .mesh import make_mesh
 from .merge import (
     bucket_parallel_dedup,
+    distributed_aggregate_step,
+    distributed_changelog_step,
     distributed_merge_step,
     distributed_partial_update_step,
     range_partition_lanes,
@@ -33,6 +35,8 @@ __all__ = [
     "bucket_parallel_dedup",
     "distributed_merge_step",
     "distributed_partial_update_step",
+    "distributed_aggregate_step",
+    "distributed_changelog_step",
     "range_partition_lanes",
     "init_multi_host",
     "is_commit_coordinator",

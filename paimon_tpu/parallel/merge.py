@@ -38,6 +38,8 @@ __all__ = [
     "range_partition_lanes",
     "distributed_merge_step",
     "distributed_partial_update_step",
+    "distributed_aggregate_step",
+    "distributed_changelog_step",
 ]
 
 
@@ -285,3 +287,112 @@ def distributed_partial_update_step(
         ),
     )
     return jax.jit(fn)(key_lanes, seq_lanes, pad, field_valid)
+
+def _keyed_payload_step(mesh: Mesh, key_lanes, seq_lanes, pad, extra, payload_fn):
+    """Shared scaffold for merge engines whose mesh form is: one uint32
+    payload lane rides the all_to_all, then a per-segment reduction after the
+    local plan. payload_fn(rx0, perm, seg_id, live, m) -> (m,) payload.
+    Returns (out_keys (B, N, K), merged_valid (B, N), payload (B, N))."""
+    _, _, k = key_lanes.shape
+    s = seq_lanes.shape[2]
+    p_key = mesh.shape["key"]
+
+    def shard_fn(kl, sl, pf, xv):
+        def one_bucket(kb, sb, pb, xb):
+            rk, rs, rp, rx = _range_exchange(
+                kb.T, sb.T, pb, "key", p_key, k, s, extra_lanes=xb[None, :]
+            )
+            perm, _, keep_last, seg_id = _local_plan(k, s, rk, rs, rp)
+            live = rp[perm] == 0
+            payload = payload_fn(rx[0], perm, seg_id, live, rp.shape[0])
+            return rk[:, perm].T, keep_last & live, payload
+
+        return jax.vmap(one_bucket)(kl, sl, pf, xv)
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P("bucket", "key", None),
+            P("bucket", "key", None),
+            P("bucket", "key"),
+            P("bucket", "key"),
+        ),
+        out_specs=(P("bucket", "key", None), P("bucket", "key"), P("bucket", "key")),
+    )
+    return jax.jit(fn)(key_lanes, seq_lanes, pad, extra)
+
+
+def distributed_aggregate_step(
+    mesh: Mesh,
+    key_lanes: np.ndarray,  # (B, n, K) uint32
+    seq_lanes: np.ndarray,  # (B, n, S) uint32
+    pad: np.ndarray,  # (B, n) uint32
+    values: np.ndarray,  # (B, n) float32 — the aggregated payload column
+):
+    """The AGGREGATION merge engine across the range shuffle (reference
+    mergetree/compact/aggregate/FieldSumAgg.java under
+    AggregateMergeFunction): payload values ride the all_to_all bitcast to
+    uint32 lanes; after the exchange each device owns a complete key range,
+    so the per-key segment SUM is locally exact. Insert-only rows (retract
+    handling lives in the host aggregators, ops/aggregates.py).
+
+    Returns (out_keys (B, N, K), merged_valid (B, N), sums (B, N)) in the
+    post-exchange sorted coordinate system: sums[b, i] is key i's total where
+    merged_valid[b, i] is True."""
+
+    def seg_sum(rx0, perm, seg_id, live, m):
+        vals = jax.lax.bitcast_convert_type(rx0, jnp.float32)[perm]
+        vals = jnp.where(live, vals, 0.0)
+        return jax.ops.segment_sum(vals, seg_id, num_segments=m)[seg_id]
+
+    extra = jax.lax.bitcast_convert_type(jnp.asarray(values), jnp.uint32)
+    return _keyed_payload_step(mesh, key_lanes, seq_lanes, pad, extra, seg_sum)
+
+
+# changelog row codes emitted by distributed_changelog_step
+CHANGELOG_NONE = 0     # key unchanged by this batch (or batch rows all lost)
+CHANGELOG_INSERT = 1   # key is new: emit +I
+CHANGELOG_UPDATE = 2   # key existed and the batch won: emit -U (old) / +U (new)
+
+
+def distributed_changelog_step(
+    mesh: Mesh,
+    key_lanes: np.ndarray,  # (B, n, K) uint32 — OLD state rows + NEW batch rows
+    seq_lanes: np.ndarray,  # (B, n, S) uint32 — new rows carry higher seqs
+    pad: np.ndarray,  # (B, n) uint32
+    is_new: np.ndarray,  # (B, n) uint32 — 1 = row belongs to the incoming batch
+):
+    """The changelog-producing rewrite ACROSS the mesh shuffle (reference
+    mergetree/compact/ChangelogMergeTreeRewriter.java:47 /
+    FullChangelogMergeFunctionWrapper): merge OLD top-level state with the
+    NEW batch in one distributed pass and derive, per key, which changelog
+    rows a full-compaction producer must emit — +I for a previously-unseen
+    key, -U/+U when an existing key's winner comes from the batch, nothing
+    when the batch lost or didn't touch the key. The is_new source flag rides
+    the all_to_all with the lanes, so the derivation is exact after the
+    exchange.
+
+    Returns (out_keys (B, N, K), merged_valid (B, N), code (B, N)) sorted;
+    code uses CHANGELOG_{NONE,INSERT,UPDATE}, meaningful where merged_valid
+    (the code at a key's keep_last row decides from src_new there whether the
+    winner came from the batch)."""
+
+    def derive_code(rx0, perm, seg_id, live, m):
+        src_new = (rx0[perm] != 0) & live
+        src_old = (rx0[perm] == 0) & live
+        any_new = jax.ops.segment_max(src_new.astype(jnp.int32), seg_id, num_segments=m)
+        any_old = jax.ops.segment_max(src_old.astype(jnp.int32), seg_id, num_segments=m)
+        return jnp.where(
+            any_new[seg_id] == 0,
+            CHANGELOG_NONE,
+            jnp.where(
+                any_old[seg_id] == 0,
+                CHANGELOG_INSERT,
+                jnp.where(src_new, CHANGELOG_UPDATE, CHANGELOG_NONE),
+            ),
+        )
+
+    return _keyed_payload_step(
+        mesh, key_lanes, seq_lanes, pad, jnp.asarray(is_new), derive_code
+    )
