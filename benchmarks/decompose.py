@@ -165,6 +165,67 @@ def main():
             ),
             flush=True,
         )
+
+        # --- the ADAPTIVE HOST pipeline (what table reads actually run on a
+        # CPU-only backend, mergefn.effective_sort_engine): keys-only decode
+        # without _SEQUENCE_NUMBER, host lexsort dedup, value-column decode,
+        # winner gather ----------------------------------------------------
+        host = {}
+        key_cols = ["id"]
+        rest = [n for n in t.row_type.field_names if n not in key_cols]
+
+        def h_decode_keys():
+            return [rf.read(f, fields=key_cols, system_columns="kind") for f in files]
+
+        host["decode_keys_ms"], heads = best_of(h_decode_keys)
+        kvk = KVBatch.concat(heads)
+
+        def h_sort():
+            from paimon_tpu.core.mergefn import _numpy_dedup_select
+
+            lanes2 = encode_key_lanes(kvk.data, ["id"], {})
+            return _numpy_dedup_select(lanes2, None)
+
+        host["host_sort_ms"], take2 = best_of(h_sort)
+
+        def h_decode_values():
+            return [rf.read(f, fields=rest, system_columns=False) for f in files]
+
+        host["decode_values_ms"], tails = best_of(h_decode_values)
+
+        def h_gather():
+            # the REAL pipeline gathers the full reassembled batch (keys +
+            # concatenated value columns), not the keys-only head
+            from paimon_tpu.data.batch import Column, ColumnBatch
+
+            cols = {}
+            for name in t.row_type.field_names:
+                if name in key_cols:
+                    cols[name] = kvk.data.column(name)
+                else:
+                    cols[name] = Column.concat([x.data.column(name) for x in tails])
+            full = KVBatch(ColumnBatch(t.row_type, cols), kvk.seq, kvk.kind)
+            return full.take(take2)
+
+        host["gather_ms"], _ = best_of(h_gather)
+        h_total = sum(host.values())
+        for stage, v in host.items():
+            print(
+                json.dumps(
+                    {"metric": f"merge-read.host.{stage[:-3]}",
+                     "value": round(v * 1000, 2), "unit": "ms",
+                     "share": round(v / h_total, 3)}
+                ),
+                flush=True,
+            )
+        print(
+            json.dumps(
+                {"metric": "merge-read.host.total", "value": round(h_total * 1000, 2),
+                 "unit": "ms", "rows_per_s": round(args.rows / h_total, 1),
+                 "platform": PLATFORM}
+            ),
+            flush=True,
+        )
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
