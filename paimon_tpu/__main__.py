@@ -158,6 +158,11 @@ def main(argv=None) -> int:
     p.add_argument("--user", default="cli")
     p.add_argument("statement", help="e.g. \"CALL sys.compact(`table` => 'db.t')\"")
 
+    p = sub.add_parser("sql", help="execute a SELECT or CALL statement")
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--user", default="cli")
+    p.add_argument("statement", help="e.g. \"SELECT k, v FROM db.t WHERE k > 5 LIMIT 10\"")
+
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
 
@@ -176,6 +181,16 @@ def main(argv=None) -> int:
     _KERNEL_PROCEDURES = {"compact", "compact_database", "delete", "merge_into",
                           "rewrite_file_index", "query_service"}
     reaches_kernel = action in _KERNEL_ACTIONS
+    if action == "sql":
+        import re as _re
+
+        # SELECT merges on read -> kernel, EXCEPT system tables ($snapshots,
+        # $files, ...): those are static metadata batches with no merge
+        if _re.match(r"^\s*SELECT\b", args.statement, _re.I):
+            fm = _re.search(r"\bFROM\s+`?([\w.$]+)`?", args.statement, _re.I)
+            reaches_kernel = not (fm and "$" in fm.group(1))
+        else:
+            action = "call"  # fall through to the CALL gate below
     if action == "call":
         try:
             from .sql import parse_call
@@ -198,6 +213,19 @@ def main(argv=None) -> int:
 
         cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
         print(json.dumps(sql_call(cat, args.statement), default=str))
+        return 0
+
+    if action == "sql":
+        from .catalog import FileSystemCatalog
+        from .sql import execute as sql_execute
+
+        cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
+        out = sql_execute(cat, args.statement)
+        if hasattr(out, "to_pylist"):  # SELECT -> one JSON row per line
+            for row in out.to_pylist():
+                print(json.dumps(list(row), default=str))
+        else:
+            print(json.dumps(out, default=str))
         return 0
 
     if action == "clone":

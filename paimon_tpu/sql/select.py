@@ -10,6 +10,7 @@ covering the query shapes maintenance runbooks actually use::
     SELECT a, b FROM db.t WHERE k >= 10 AND s LIKE 'x%' ORDER BY a DESC LIMIT 5
     SELECT * FROM db.t$snapshots                    -- system tables work too
     SELECT count(*), sum(v), min(v) FROM db.t WHERE k < 100
+    SELECT region, count(*), avg(amount) FROM db.t GROUP BY region ORDER BY region
 
 Pushdown is real, not cosmetic: WHERE lowers onto the predicate algebra
 (file/row-group skipping via stats + bloom indexes), the projection prunes
@@ -40,6 +41,7 @@ class QueryError(ValueError):
 _SELECT_RE = re.compile(
     r"^\s*SELECT\s+(?P<cols>.*?)\s+FROM\s+(?P<table>`?[\w.$]+`?)"
     r"(?:\s+WHERE\s+(?P<where>.*?))?"
+    r"(?:\s+GROUP\s+BY\s+(?P<group>.*?))?"
     r"(?:\s+ORDER\s+BY\s+(?P<order>.*?))?"
     r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
     re.I | re.S,
@@ -95,7 +97,13 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
     items = _split_select_list(cols_text)
     aggs = [_parse_agg(i) for i in items]
     is_agg = any(a is not None for a in aggs)
-    if is_agg and not all(a is not None for a in aggs):
+    group_text = m.group("group")
+    group_cols = [g.strip().strip("`") for g in group_text.split(",")] if group_text else []
+    if group_cols:
+        bad = [i for i, a in zip(items, aggs) if a is None and i.strip("`") not in group_cols]
+        if bad:
+            raise QueryError(f"non-aggregate select items must appear in GROUP BY: {bad}")
+    elif is_agg and not all(a is not None for a in aggs):
         raise QueryError("cannot mix aggregate and plain columns without GROUP BY")
 
     order_text = m.group("order")
@@ -113,7 +121,18 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
         rb = t.new_read_builder()
         if pred is not None:
             rb = rb.with_filter(pred)
-        if not is_agg:
+        if group_cols:
+            # decode only what the aggregation consumes
+            needed = list(dict.fromkeys(
+                group_cols
+                + [a[1] for a in aggs if a is not None and a[1] != "*"]
+                + [c for c in _order_cols(order_text) if c in t.row_type]
+            ))
+            for n in needed:
+                if n not in t.row_type:
+                    raise QueryError(f"unknown column {n!r} in {table_name}")
+            rb = rb.with_projection(needed)
+        elif not is_agg:
             if cols_text != "*":
                 names = [i.strip("`") for i in items]
                 for n in names:
@@ -126,6 +145,19 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
                 rb = rb.with_limit(limit)
         out = rb.new_read().read_all(rb.new_scan().plan())
 
+    if group_cols:
+        # ORDER BY may reference group columns outside the select list: carry
+        # them as hidden output columns through the sort, then project away
+        labels = [i.strip("`") if a is None else re.sub(r"\s+", "", i).lower()
+                  for i, a in zip(items, aggs)]
+        hidden = [c for c in _order_cols(order_text)
+                  if c in group_cols and c not in [i.strip("`") for i, a in zip(items, aggs) if a is None]]
+        out = _group_aggregate(out, items + hidden, aggs + [None] * len(hidden), group_cols)
+        if order_text:
+            out = out.take(_order_index(out, order_text))
+        if limit is not None:
+            out = out.slice(0, min(limit, out.num_rows))
+        return out.select(labels) if hidden else out
     if is_agg:
         return _aggregate(out, items, aggs)
 
@@ -208,3 +240,124 @@ def _aggregate(batch: "ColumnBatch", items: list[str], aggs) -> "ColumnBatch":
         values.append(v)
     schema = RowType(tuple(DataField(i, n, ty) for i, (n, ty) in enumerate(zip(names, types))))
     return ColumnBatch.from_pydict(schema, {n: [v] for n, v in zip(names, values)})
+
+def _group_aggregate(batch: "ColumnBatch", items, aggs, group_cols) -> "ColumnBatch":
+    """Vectorized GROUP BY: per-column inverse codes combined into one group
+    id, then reduceat over the group-sorted rows (sum/min/max/count; avg =
+    sum/count). Output rows are in first-appearance order of each group's
+    key, matching a streaming aggregator."""
+    from ..data.batch import ColumnBatch
+    from ..types import BIGINT, DOUBLE, DataField, RowType
+
+    n = batch.num_rows
+    for g in group_cols:
+        if g not in batch.schema:
+            raise QueryError(f"unknown GROUP BY column {g!r}")
+
+    def _codes(col):
+        """Dense group codes for one column, null-aware: NULL rows form their
+        own group (SQL GROUP BY semantics); sentinel-filled values never
+        merge with real values."""
+        vals = np.asarray(col.values)
+        valid = col.validity
+        if (valid is None or valid.all()) and vals.dtype != object:
+            _, codes = np.unique(vals, return_inverse=True)
+            return codes
+        if valid is None or valid.all():
+            try:  # pure-string object columns sort fine
+                _, codes = np.unique(vals, return_inverse=True)
+                return codes
+            except TypeError:
+                pass
+        mapping: dict = {}
+        codes = np.empty(n, dtype=np.int64)
+        vlist = vals.tolist() if vals.dtype != object else vals
+        for i in range(n):
+            key = None if (valid is not None and not valid[i]) else vlist[i]
+            codes[i] = mapping.setdefault(key, len(mapping))
+        return codes
+
+    if n == 0:
+        gid = np.empty(0, dtype=np.int64)
+        uniq_first = np.empty(0, dtype=np.int64)
+    else:
+        gid = np.zeros(n, dtype=np.int64)
+        for g in group_cols:
+            codes = _codes(batch.column(g))
+            gid = gid * (int(codes.max()) + 1 if len(codes) else 1) + codes
+        # remap combined ids to dense group numbers in first-appearance order
+        _, first_idx, inv = np.unique(gid, return_index=True, return_inverse=True)
+        rank = np.argsort(np.argsort(first_idx))  # unique-id index -> appearance rank
+        gid = rank[inv]
+        uniq_first = np.sort(first_idx)  # each group's first row, appearance order
+
+    n_groups = len(uniq_first)
+    row_order = np.argsort(gid, kind="stable")
+    sorted_gid = gid[row_order]
+    starts = np.searchsorted(sorted_gid, np.arange(n_groups))
+    counts = np.diff(np.concatenate([starts, [n]]))
+
+    names, types, columns = [], [], []
+    for item, agg in zip(items, aggs):
+        if agg is None:  # a group column: its value at each group's first row
+            name = item.strip("`")
+            col = batch.column(name)
+            arr = np.asarray(col.values)[uniq_first].tolist()
+            if col.validity is not None:  # NULL group key surfaces as None
+                arr = [None if not col.validity[i] else v for i, v in zip(uniq_first.tolist(), arr)]
+            names.append(name)
+            types.append(batch.schema.field(name).type)
+            columns.append(arr)
+            continue
+        fn, colname = agg
+        label = re.sub(r"\s+", "", item).lower()
+        if fn == "count":
+            if colname == "*":
+                vals_out = counts.astype(np.int64).tolist()
+            else:
+                c = batch.column(colname)
+                valid = c.validity if c.validity is not None else np.ones(n, dtype=bool)
+                vals_out = (
+                    np.add.reduceat(valid[row_order].astype(np.int64), starts).tolist()
+                    if n else []
+                )
+            names.append(label); types.append(BIGINT()); columns.append(vals_out)
+            continue
+        if colname == "*":
+            raise QueryError(f"{fn}(*) is not valid")
+        c = batch.column(colname)
+        ty = DOUBLE() if fn == "avg" else batch.schema.field(colname).type
+        vals = np.asarray(c.values)[row_order]
+        valid = c.validity
+        if vals.dtype == object or (valid is not None and not valid.all()):
+            # null-aware / object fallback: per-group reduction over the
+            # VALID values only (a fully-null group aggregates to NULL)
+            sorted_valid = (valid[row_order] if valid is not None else np.ones(n, dtype=bool))
+            out = []
+            py_vals = vals.tolist() if vals.dtype != object else vals
+            for gi in range(n_groups):
+                lo = int(starts[gi])
+                hi = lo + int(counts[gi])
+                vv = [py_vals[i] for i in range(lo, hi) if sorted_valid[i]]
+                if not vv:
+                    out.append(None)
+                elif fn == "sum":
+                    out.append(sum(vv))
+                elif fn == "min":
+                    out.append(min(vv))
+                elif fn == "max":
+                    out.append(max(vv))
+                else:
+                    out.append(float(sum(vv)) / len(vv))
+        elif fn == "sum":
+            out = (np.add.reduceat(vals, starts) if n else np.zeros(0, vals.dtype)).tolist()
+        elif fn == "min":
+            out = (np.minimum.reduceat(vals, starts) if n else np.zeros(0, vals.dtype)).tolist()
+        elif fn == "max":
+            out = (np.maximum.reduceat(vals, starts) if n else np.zeros(0, vals.dtype)).tolist()
+        else:  # avg
+            out = ((np.add.reduceat(vals.astype(np.float64), starts) / counts) if n else np.zeros(0)).tolist()
+        names.append(label); types.append(ty); columns.append(out)
+
+    schema = RowType(tuple(DataField(i, nm, ty) for i, (nm, ty) in enumerate(zip(names, types))))
+    return ColumnBatch.from_pydict(schema, dict(zip(names, columns)))

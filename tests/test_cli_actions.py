@@ -86,3 +86,14 @@ def test_cli_migrate(tmp_warehouse, tmp_path):
     rows = [json.loads(line) for line in run_cli(
         "query", "--warehouse", tmp_warehouse, "--table", "db.mig", "--limit", "10").splitlines()]
     assert rows == [[1, "x"], [2, "y"]]
+
+
+def test_cli_sql_action(wh):
+    rows = [json.loads(line) for line in run_cli(
+        "sql", "--warehouse", wh, "SELECT id, v FROM db.t WHERE id >= 8 ORDER BY id").splitlines()]
+    assert [r[0] for r in rows] == [8, 9]
+    agg = [json.loads(line) for line in run_cli(
+        "sql", "--warehouse", wh, "SELECT count(*), max(id) FROM db.t").splitlines()]
+    assert agg == [[10, 9]]
+    out = json.loads(run_cli("sql", "--warehouse", wh, "CALL sys.create_tag('db.t', 'via-sql')"))
+    assert out["tag"] == "via-sql"

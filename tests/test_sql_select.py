@@ -90,3 +90,63 @@ def test_select_errors(cat):
         query(cat, "SELECT k, count(*) FROM db.t")
     with pytest.raises(QueryError):
         query(cat, "DELETE FROM db.t")
+
+
+def test_select_group_by(cat):
+    out = query(cat, "SELECT s, count(*), sum(v), avg(x) FROM db.t GROUP BY s ORDER BY s")
+    rows = out.to_pylist()
+    assert [r[0] for r in rows] == ["g0", "g1", "g2"]
+    # oracle over the merged table
+    merged = query(cat, "SELECT s, v, x FROM db.t").to_pylist()
+    import collections
+    cnt = collections.Counter(r[0] for r in merged)
+    sums = collections.defaultdict(int)
+    xs = collections.defaultdict(list)
+    for s, v, x in merged:
+        sums[s] += v
+        xs[s].append(x)
+    for s, c, sv, ax in rows:
+        assert c == cnt[s] and sv == sums[s]
+        assert abs(ax - sum(xs[s]) / len(xs[s])) < 1e-9
+    assert sum(r[1] for r in rows) == 150
+
+
+def test_select_group_by_distinct_and_composite(cat):
+    out = query(cat, "SELECT s FROM db.t GROUP BY s ORDER BY s")
+    assert [r[0] for r in out.to_pylist()] == ["g0", "g1", "g2"]
+    # composite grouping: (s, k % nothing) — use two real columns
+    out = query(cat, "SELECT s, k, max(v) FROM db.t WHERE k < 6 GROUP BY s, k ORDER BY k")
+    rows = out.to_pylist()
+    assert len(rows) == 6  # k is unique, so (s, k) groups are singletons
+    assert all(r[2] is not None for r in rows)
+    with pytest.raises(QueryError, match="GROUP BY"):
+        query(cat, "SELECT s, v FROM db.t GROUP BY s")
+    with pytest.raises(QueryError, match="unknown"):
+        query(cat, "SELECT count(*) FROM db.t GROUP BY nope")
+
+
+def test_select_group_by_nulls_and_hidden_order(cat, tmp_warehouse):
+    from paimon_tpu.types import BIGINT, STRING, RowType
+
+    c2 = FileSystemCatalog(tmp_warehouse, commit_user="sel2")
+    t = c2.create_table(
+        "db.nulls",
+        RowType.of(("k", np.int64 and BIGINT(False)), ("g", STRING()), ("v", BIGINT())),
+        primary_keys=["k"], options={"bucket": "1"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1, 2, 3, 4, 5], "g": ["a", None, "a", None, "b"],
+             "v": [10, 20, None, 40, None]})
+    wb.new_commit().commit(w.prepare_commit())
+
+    out = query(c2, "SELECT g, count(*), count(v), sum(v), min(v), avg(v) FROM db.nulls GROUP BY g")
+    rows = {r[0]: r for r in out.to_pylist()}
+    assert set(rows) == {"a", "b", None}
+    assert rows["a"][1:] == (2, 1, 10, 10, 10.0)   # NULL v excluded everywhere
+    assert rows[None][1:] == (2, 2, 60, 20, 30.0)  # NULL group key is its own group
+    assert rows["b"][1:] == (1, 0, None, None, None)  # all-null group -> NULL aggs
+    # ORDER BY a group column that is NOT in the select list
+    out = query(c2, "SELECT count(*) FROM db.nulls WHERE g IS NOT NULL GROUP BY g ORDER BY g")
+    assert [r[0] for r in out.to_pylist()] == [2, 1]
+    assert out.schema.field_names == ["count(*)"]
