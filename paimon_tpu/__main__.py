@@ -185,10 +185,15 @@ def main(argv=None) -> int:
         import re as _re
 
         # SELECT merges on read -> kernel, EXCEPT system tables ($snapshots,
-        # $files, ...): those are static metadata batches with no merge
+        # $files, ...): those are static metadata batches with no merge.
+        # DDL (CREATE/DROP/SHOW/DESCRIBE) is metadata-only.
         if _re.match(r"^\s*SELECT\b", args.statement, _re.I):
             fm = _re.search(r"\bFROM\s+`?([\w.$]+)`?", args.statement, _re.I)
             reaches_kernel = not (fm and "$" in fm.group(1))
+        elif _re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", args.statement, _re.I):
+            reaches_kernel = False  # DDL is metadata-only
+        elif _re.match(r"^\s*INSERT\b", args.statement, _re.I):
+            reaches_kernel = True  # writes flush through the merge kernels
         else:
             action = "call"  # fall through to the CALL gate below
     if action == "call":
@@ -221,9 +226,11 @@ def main(argv=None) -> int:
 
         cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
         out = sql_execute(cat, args.statement)
-        if hasattr(out, "to_pylist"):  # SELECT -> one JSON row per line
+        if hasattr(out, "to_pylist"):  # SELECT/SHOW -> one JSON row per line
             for row in out.to_pylist():
                 print(json.dumps(list(row), default=str))
+        elif isinstance(out, str):  # SHOW CREATE TABLE
+            print(out)
         else:
             print(json.dumps(out, default=str))
         return 0

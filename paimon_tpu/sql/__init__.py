@@ -763,8 +763,16 @@ def query(catalog: "Catalog", statement: str):
 
 
 def execute(catalog: "Catalog", statement: str) -> Any:
-    """One string entry point for both statement kinds: CALL -> procedure
-    dict, SELECT -> ColumnBatch."""
+    """One string entry point: SELECT -> ColumnBatch, CALL -> procedure
+    dict, DDL (CREATE/DROP/SHOW/DESCRIBE) -> dict | ColumnBatch | str."""
     if re.match(r"^\s*SELECT\b", statement, re.I):
         return query(catalog, statement)
+    if re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", statement, re.I):
+        from .ddl import ddl as _ddl
+
+        return _ddl(catalog, statement)
+    if re.match(r"^\s*INSERT\b", statement, re.I):
+        from .dml import insert
+
+        return insert(catalog, statement)
     return call(catalog, statement)

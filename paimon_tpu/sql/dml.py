@@ -1,0 +1,131 @@
+"""INSERT statements: the write half of the string surface.
+
+    INSERT INTO db.t VALUES (1, 'x', 2.5), (2, 'y', NULL)
+    INSERT INTO db.t (k, s) VALUES (3, 'z')          -- missing columns -> NULL
+    INSERT INTO db.t SELECT ... FROM db.src WHERE ...
+    INSERT OVERWRITE db.t VALUES (...) / SELECT ...  -- overwrite commit
+
+The reference's engines lower INSERT onto the batch write path
+(FlinkTableSink / SparkWrite); this lowers onto the same
+`new_batch_write_builder` — upsert semantics on PK tables, append otherwise,
+OVERWRITE via the overwrite commit kind.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import TYPE_CHECKING, Any
+
+from .expr import ExprError, _Parser, _const_fold, _NOT_CONST, _tokenize
+
+if TYPE_CHECKING:
+    from ..catalog import Catalog
+
+__all__ = ["insert", "DmlError"]
+
+
+class DmlError(ValueError):
+    pass
+
+
+_INSERT_RE = re.compile(
+    r"^\s*INSERT\s+(?P<mode>INTO|OVERWRITE)\s+`?(?P<name>[\w.]+)`?\s*"
+    r"(?:\((?P<cols>[^)]*)\)\s*)?"
+    r"(?P<body>VALUES\s*.*|SELECT\s+.*?)\s*;?\s*$",
+    re.I | re.S,
+)
+
+
+def _parse_rows(values_text: str, n_cols: int, src: str) -> list[list[Any]]:
+    """VALUES (lit, ...), (lit, ...) -> row lists (literals const-folded)."""
+    try:
+        p = _Parser(_tokenize(values_text), src)
+    except ExprError as e:
+        raise DmlError(str(e)) from e
+    rows: list[list[Any]] = []
+    while True:
+        p.expect("op", "(")
+        row = []
+        while True:
+            node = p.parse_operand()
+            v = _const_fold(node)
+            if v is _NOT_CONST:
+                raise DmlError(f"VALUES entries must be literals in {src!r}")
+            row.append(v)
+            if p.peek() == ("op", ","):
+                p.next()
+                continue
+            break
+        p.expect("op", ")")
+        if len(row) != n_cols:
+            raise DmlError(f"row has {len(row)} values, expected {n_cols} in {src!r}")
+        rows.append(row)
+        if p.peek() == ("op", ","):
+            p.next()
+            continue
+        if p.peek()[0] == "eof":
+            return rows
+        raise DmlError(f"trailing tokens after VALUES in {src!r}")
+
+
+def insert(catalog: "Catalog", statement: str) -> dict:
+    m = _INSERT_RE.match(statement)
+    if not m:
+        raise DmlError(f"not an INSERT statement: {statement!r}")
+    t = catalog.get_table(m.group("name"))
+    overwrite = m.group("mode").upper() == "OVERWRITE"
+    cols = (
+        [c.strip().strip("`") for c in m.group("cols").split(",") if c.strip()]
+        if m.group("cols")
+        else t.row_type.field_names
+    )
+    for c in cols:
+        if c not in t.row_type:
+            raise DmlError(f"unknown column {c!r} in {m.group('name')}")
+
+    body = m.group("body")
+    if re.match(r"^SELECT\b", body, re.I):
+        from .select import QueryError, query
+
+        try:
+            result = query(catalog, body)
+        except QueryError as e:
+            raise DmlError(str(e)) from e
+        if len(result.schema.field_names) != len(cols):
+            raise DmlError(
+                f"SELECT produces {len(result.schema.field_names)} columns, "
+                f"INSERT target has {len(cols)}"
+            )
+        data = {}
+        for c, src_name in zip(cols, result.schema.field_names):
+            col = result.column(src_name)
+            if col.validity is not None and not col.validity.all():
+                data[c] = col.to_pylist()  # nulls must survive as None
+            else:
+                data[c] = col.values  # numpy passthrough, no python round trip
+        n = result.num_rows
+    else:
+        rows = _parse_rows(body[len("VALUES"):], len(cols), statement)
+        data = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+        n = len(rows)
+
+    missing = [f.name for f in t.row_type.fields if f.name not in cols]
+    for name in missing:
+        if not t.row_type.field(name).type.nullable:
+            raise DmlError(f"column {name!r} is NOT NULL and has no value")
+        data[name] = [None] * n
+    # explicit NULLs against NOT NULL columns are rejected the same way
+    for name in cols:
+        if not t.row_type.field(name).type.nullable:
+            vals = data[name]
+            it = vals.tolist() if hasattr(vals, "tolist") else vals
+            if any(v is None for v in it):
+                raise DmlError(f"column {name!r} is NOT NULL; NULL value in row")
+
+    wb = t.new_batch_write_builder()
+    if overwrite:
+        wb = wb.with_overwrite()
+    w = wb.new_write()
+    w.write({name: data[name] for name in t.row_type.field_names})
+    wb.new_commit().commit(w.prepare_commit())
+    return {"inserted": n, "table": m.group("name"), "overwrite": overwrite}

@@ -43,6 +43,12 @@ def sort_compact(
     plan = store.new_scan().plan()
     messages: list[CommitMessage] = []
     total = 0
+    from ..options import SortEngine
+
+    # CPU-only backend: clustering is a plain stable sort of the curve
+    # codes — the host lexsort wins (same adaptive rule as merge reads,
+    # mergefn.effective_sort_engine); resolved once for the whole call
+    use_host_sort = store.merge_executor().effective_sort_engine() == SortEngine.NUMPY
     for partition, buckets in plan.grouped().items():
         for bucket, files in buckets.items():
             rf = store.reader_factory(partition, bucket)
@@ -74,8 +80,13 @@ def sort_compact(
                 lanes = z_order_lanes(lanes)
             elif order == "hilbert":
                 lanes = hilbert_lanes(lanes)
-            p = merge_plan(lanes)  # device sort; stability keeps arrival order on ties
-            perm = p.perm[p.valid_sorted]
+            if use_host_sort:
+                from ..data.keys import lexsort_rows
+
+                perm = lexsort_rows(lanes)
+            else:
+                p = merge_plan(lanes)  # device sort; stability keeps arrival order on ties
+                perm = p.perm[p.valid_sorted]
             sorted_kv = kv.take(perm)
             wf = store.writer_factory(partition, bucket)
             # sort-compaction.range-strategy=size: roll output files by
