@@ -245,12 +245,13 @@ def ddl(catalog: "Catalog", statement: str) -> Any:
         return {"created_database": m.group("name")}
     m = _DROP_DB_RE.match(statement)
     if m:
-        try:
-            catalog.drop_database(m.group("name"))
-        except FileNotFoundError:
+        # existence check up front: FileIO.delete is a no-op on missing paths,
+        # so the catalog's drop never raises by itself
+        if m.group("name") not in catalog.list_databases():
             if not m.group("ife"):
-                raise DdlError(f"database {m.group('name')} does not exist") from None
+                raise DdlError(f"database {m.group('name')} does not exist")
             return {"dropped_database": None}
+        catalog.drop_database(m.group("name"))
         return {"dropped_database": m.group("name")}
     if _SHOW_DBS_RE.match(statement):
         return _show_batch("database_name", sorted(catalog.list_databases()))
@@ -267,7 +268,10 @@ def ddl(catalog: "Catalog", statement: str) -> Any:
             raise DdlError(f"table {m.group('name')} does not exist") from None
         cols = []
         for f in t.row_type.fields:
-            cols.append(f"  `{f.name}` {_sql_type_text(f.type)}")
+            comment = ""
+            if getattr(f, "description", None):
+                comment = f" COMMENT '{f.description.replace(chr(39), chr(39) * 2)}'"
+            cols.append(f"  `{f.name}` {_sql_type_text(f.type)}{comment}")
         if t.primary_keys:
             cols.append(f"  PRIMARY KEY ({', '.join(t.primary_keys)}) NOT ENFORCED")
         out = f"CREATE TABLE {m.group('name')} (\n" + ",\n".join(cols) + "\n)"

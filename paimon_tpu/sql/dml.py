@@ -37,42 +37,46 @@ _INSERT_RE = re.compile(
 
 
 def _parse_rows(values_text: str, n_cols: int, src: str) -> list[list[Any]]:
-    """VALUES (lit, ...), (lit, ...) -> row lists (literals const-folded)."""
+    """VALUES (lit, ...), (lit, ...) -> row lists (literals const-folded).
+    Every parse failure (tokenizer AND grammar) surfaces as DmlError."""
     try:
         p = _Parser(_tokenize(values_text), src)
-    except ExprError as e:
-        raise DmlError(str(e)) from e
-    rows: list[list[Any]] = []
-    while True:
-        p.expect("op", "(")
-        row = []
+        rows: list[list[Any]] = []
         while True:
-            node = p.parse_operand()
-            v = _const_fold(node)
-            if v is _NOT_CONST:
-                raise DmlError(f"VALUES entries must be literals in {src!r}")
-            row.append(v)
+            p.expect("op", "(")
+            row = []
+            while True:
+                node = p.parse_operand()
+                v = _const_fold(node)
+                if v is _NOT_CONST:
+                    raise DmlError(f"VALUES entries must be literals in {src!r}")
+                row.append(v)
+                if p.peek() == ("op", ","):
+                    p.next()
+                    continue
+                break
+            p.expect("op", ")")
+            if len(row) != n_cols:
+                raise DmlError(f"row has {len(row)} values, expected {n_cols} in {src!r}")
+            rows.append(row)
             if p.peek() == ("op", ","):
                 p.next()
                 continue
-            break
-        p.expect("op", ")")
-        if len(row) != n_cols:
-            raise DmlError(f"row has {len(row)} values, expected {n_cols} in {src!r}")
-        rows.append(row)
-        if p.peek() == ("op", ","):
-            p.next()
-            continue
-        if p.peek()[0] == "eof":
-            return rows
-        raise DmlError(f"trailing tokens after VALUES in {src!r}")
+            if p.peek()[0] == "eof":
+                return rows
+            raise DmlError(f"trailing tokens after VALUES in {src!r}")
+    except ExprError as e:
+        raise DmlError(str(e)) from e
 
 
 def insert(catalog: "Catalog", statement: str) -> dict:
     m = _INSERT_RE.match(statement)
     if not m:
         raise DmlError(f"not an INSERT statement: {statement!r}")
-    t = catalog.get_table(m.group("name"))
+    try:
+        t = catalog.get_table(m.group("name"))
+    except FileNotFoundError:
+        raise DmlError(f"table {m.group('name')} does not exist") from None
     overwrite = m.group("mode").upper() == "OVERWRITE"
     cols = (
         [c.strip().strip("`") for c in m.group("cols").split(",") if c.strip()]

@@ -165,3 +165,25 @@ def test_insert_rejects_explicit_null_in_not_null(cat):
         execute(cat, "INSERT INTO db.nn VALUES (NULL, 'x')")
     execute(cat, "INSERT INTO db.nn VALUES (1, NULL)")  # nullable NULL ok
     assert execute(cat, "SELECT count(*) FROM db.nn").to_pylist()[0][0] == 1
+
+
+def test_ddl_dml_error_types(cat):
+    from paimon_tpu.sql.dml import DmlError
+
+    # DROP DATABASE of a missing db errors (no dead except path)
+    with pytest.raises(DdlError, match="does not exist"):
+        ddl(cat, "DROP DATABASE nope")
+    assert ddl(cat, "DROP DATABASE IF EXISTS nope") == {"dropped_database": None}
+    # INSERT into a missing table and malformed VALUES -> DmlError
+    with pytest.raises(DmlError, match="does not exist"):
+        execute(cat, "INSERT INTO db.nope VALUES (1)")
+    ddl(cat, "CREATE TABLE db.et (k BIGINT NOT NULL, PRIMARY KEY (k) NOT ENFORCED)")
+    with pytest.raises(DmlError):
+        execute(cat, "INSERT INTO db.et VALUES 1")
+    # SHOW CREATE TABLE preserves COMMENTs (round-trip keeps descriptions)
+    ddl(cat, "CREATE TABLE db.cm (k BIGINT NOT NULL, s STRING COMMENT 'it''s a, (note)', "
+             "PRIMARY KEY (k) NOT ENFORCED)")
+    created = ddl(cat, "SHOW CREATE TABLE db.cm")
+    assert "COMMENT 'it''s a, (note)'" in created
+    ddl(cat, created.replace("db.cm", "db.cm2"))
+    assert cat.get_table("db.cm2").row_type.field("s").description == "it's a, (note)"
