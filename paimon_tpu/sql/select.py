@@ -40,6 +40,7 @@ class QueryError(ValueError):
 
 _SELECT_RE = re.compile(
     r"^\s*SELECT\s+(?P<cols>.*?)\s+FROM\s+(?P<table>`?[\w.$]+`?)"
+    r"(?:\s+FOR\s+(?P<tt_kind>VERSION|TIMESTAMP|TAG)\s+AS\s+OF\s+(?P<tt_val>'[^']*'|[^\s;]+))?"
     r"(?:\s+WHERE\s+(?P<where>.*?))?"
     r"(?:\s+GROUP\s+BY\s+(?P<group>.*?))?"
     r"(?:\s+ORDER\s+BY\s+(?P<order>.*?))?"
@@ -84,6 +85,36 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
         raise QueryError(f"not a SELECT statement: {statement!r}")
     table_name = m.group("table").strip("`")
     t = catalog.get_table(table_name)
+
+    if m.group("tt_kind"):
+        # time travel (Spark grammar: FOR VERSION|TIMESTAMP AS OF; TAG as an
+        # explicit alias): lowers onto the scan options
+        kind = m.group("tt_kind").upper()
+        val = m.group("tt_val").strip("'")
+        if not val:
+            raise QueryError(f"FOR {kind} AS OF requires a non-empty value")
+        if not hasattr(t, "copy"):
+            raise QueryError("time travel applies to data tables, not system tables")
+        if kind == "VERSION":
+            # scan.version resolves a snapshot id OR a tag name — the same
+            # unified semantic the reference gives Spark's VERSION AS OF
+            t = t.copy({"scan.version": val})
+        elif kind == "TAG":
+            t = t.copy({"scan.tag-name": val})
+        else:  # TIMESTAMP
+            if val.isdigit():
+                t = t.copy({"scan.timestamp-millis": val})
+            else:
+                import datetime as _dt
+
+                try:
+                    _dt.datetime.fromisoformat(val)
+                except ValueError:
+                    raise QueryError(
+                        f"TIMESTAMP AS OF expects epoch millis or "
+                        f"'YYYY-MM-DD[ HH:MM:SS]', got {val!r}"
+                    ) from None
+                t = t.copy({"scan.timestamp": val})
 
     where_text = m.group("where")
     pred = None

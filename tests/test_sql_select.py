@@ -150,3 +150,22 @@ def test_select_group_by_nulls_and_hidden_order(cat, tmp_warehouse):
     out = query(c2, "SELECT count(*) FROM db.nulls WHERE g IS NOT NULL GROUP BY g ORDER BY g")
     assert [r[0] for r in out.to_pylist()] == [2, 1]
     assert out.schema.field_names == ["count(*)"]
+
+
+def test_select_time_travel(cat):
+    t = cat.get_table("db.t")
+    t.create_tag("after-first", snapshot_id=1)
+    # snapshot 1 = first commit only (100 rows, v = k)
+    out = query(cat, "SELECT count(*), max(v) FROM db.t FOR VERSION AS OF 1;")
+    assert out.to_pylist()[0] in ((100, 99), [100, 99])
+    # VERSION AS OF resolves tags too (the reference's unified scan.version)
+    out = query(cat, "SELECT count(*) FROM db.t FOR VERSION AS OF 'after-first'")
+    assert out.to_pylist()[0][0] == 100
+    out = query(cat, "SELECT count(*) FROM db.t FOR TAG AS OF 'after-first'")
+    assert out.to_pylist()[0][0] == 100
+    # latest view for contrast
+    assert query(cat, "SELECT count(*) FROM db.t").to_pylist()[0][0] == 150
+    with pytest.raises(QueryError, match="non-empty"):
+        query(cat, "SELECT * FROM db.t FOR TAG AS OF ''")
+    with pytest.raises(QueryError, match="TIMESTAMP AS OF"):
+        query(cat, "SELECT * FROM db.t FOR TIMESTAMP AS OF 'not-a-date'")
