@@ -186,17 +186,24 @@ def main(argv=None) -> int:
 
         # SELECT merges on read -> kernel, EXCEPT system tables ($snapshots,
         # $files, ...): those are static metadata batches with no merge.
-        # DDL (CREATE/DROP/SHOW/DESCRIBE) is metadata-only.
+        # DDL (CREATE/DROP/SHOW/DESCRIBE) is metadata-only; ANALYZE and
+        # INSERT scan/flush through the merge kernels. CALL statements gate
+        # by procedure name, same as the dedicated `call` action.
         if _re.match(r"^\s*SELECT\b", args.statement, _re.I):
             fm = _re.search(r"\bFROM\s+`?([\w.$]+)`?", args.statement, _re.I)
             reaches_kernel = not (fm and "$" in fm.group(1))
         elif _re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", args.statement, _re.I):
             reaches_kernel = False  # DDL is metadata-only
-        elif _re.match(r"^\s*INSERT\b", args.statement, _re.I):
-            reaches_kernel = True  # writes flush through the merge kernels
+        elif _re.match(r"^\s*(INSERT|ANALYZE)\b", args.statement, _re.I):
+            reaches_kernel = True
         else:
-            action = "call"  # fall through to the CALL gate below
-    if action == "call":
+            try:
+                from .sql import parse_call
+
+                reaches_kernel = parse_call(args.statement)[0] in _KERNEL_PROCEDURES
+            except Exception:
+                reaches_kernel = True  # unparseable: keep the safe path
+    elif action == "call":
         try:
             from .sql import parse_call
 

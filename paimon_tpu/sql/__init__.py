@@ -767,7 +767,7 @@ def execute(catalog: "Catalog", statement: str) -> Any:
     dict, DDL (CREATE/DROP/SHOW/DESCRIBE) -> dict | ColumnBatch | str."""
     if re.match(r"^\s*SELECT\b", statement, re.I):
         return query(catalog, statement)
-    if re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", statement, re.I):
+    if re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?|ANALYZE)\b", statement, re.I):
         from .ddl import ddl as _ddl
 
         return _ddl(catalog, statement)

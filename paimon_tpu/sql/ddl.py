@@ -61,6 +61,18 @@ _DESCRIBE_RE = re.compile(r"^\s*(?:DESCRIBE|DESC)\s+`?(?P<name>[\w.$]+)`?\s*;?\s
 _ALTER_RE = re.compile(
     r"^\s*ALTER\s+TABLE\s+`?(?P<name>[\w.]+)`?\s+(?P<rest>.*?);?\s*$", re.I | re.S
 )
+_ANALYZE_RE = re.compile(
+    r"^\s*ANALYZE\s+TABLE\s+`?(?P<name>[\w.]+)`?"
+    r"\s+COMPUTE\s+STATISTICS(?P<cols>\s+FOR\s+ALL\s+COLUMNS)?\s*;?\s*$",
+    re.I,
+)
+
+
+def _get_table(catalog: "Catalog", name: str):
+    try:
+        return catalog.get_table(name)
+    except FileNotFoundError:
+        raise DdlError(f"table {name} does not exist") from None
 
 
 def _split_top(body: str) -> list[str]:
@@ -262,10 +274,7 @@ def ddl(catalog: "Catalog", statement: str) -> Any:
         return _show_batch("table_name", rows)
     m = _SHOW_CREATE_RE.match(statement)
     if m:
-        try:
-            t = catalog.get_table(m.group("name"))
-        except FileNotFoundError:
-            raise DdlError(f"table {m.group('name')} does not exist") from None
+        t = _get_table(catalog, m.group("name"))
         cols = []
         for f in t.row_type.fields:
             comment = ""
@@ -283,10 +292,7 @@ def ddl(catalog: "Catalog", statement: str) -> Any:
         return out
     m = _DESCRIBE_RE.match(statement)
     if m:
-        try:
-            t = catalog.get_table(m.group("name"))
-        except FileNotFoundError:
-            raise DdlError(f"table {m.group('name')} does not exist") from None
+        t = _get_table(catalog, m.group("name"))
         from ..data.batch import ColumnBatch
         from ..types import STRING
 
@@ -306,6 +312,16 @@ def ddl(catalog: "Catalog", statement: str) -> Any:
     m = _ALTER_RE.match(statement)
     if m:
         return _alter(catalog, m.group("name"), m.group("rest"))
+    m = _ANALYZE_RE.match(statement)
+    if m:
+        # Spark's ANALYZE TABLE ... COMPUTE STATISTICS [FOR ALL COLUMNS]
+        # (reference PaimonAnalyzeTableColumnCommand.scala)
+        from ..table.statistics import analyze_table
+
+        t = _get_table(catalog, m.group("name"))
+        stats = analyze_table(t, with_columns=bool(m.group("cols")))
+        return {"analyzed": m.group("name"), "rows": stats.merged_record_count,
+                "columns": sorted(stats.col_stats) if stats.col_stats else []}
     raise DdlError(f"unrecognized DDL statement: {statement!r}")
 
 

@@ -187,3 +187,17 @@ def test_ddl_dml_error_types(cat):
     assert "COMMENT 'it''s a, (note)'" in created
     ddl(cat, created.replace("db.cm", "db.cm2"))
     assert cat.get_table("db.cm2").row_type.field("s").description == "it's a, (note)"
+
+
+def test_analyze_table_statement(cat):
+    ddl(cat, "CREATE TABLE db.an (k BIGINT NOT NULL, v DOUBLE, PRIMARY KEY (k) NOT ENFORCED) WITH ('bucket' = '1')")
+    execute(cat, "INSERT INTO db.an VALUES (1, 0.5), (2, 1.5), (3, 2.5)")
+    out = execute(cat, "ANALYZE TABLE db.an COMPUTE STATISTICS FOR ALL COLUMNS")
+    assert out["analyzed"] == "db.an" and out["rows"] == 3
+    assert "v" in out["columns"]
+    from paimon_tpu.table.statistics import read_statistics
+
+    stats = read_statistics(cat.get_table("db.an"))
+    assert stats is not None and stats.merged_record_count == 3
+    with pytest.raises(DdlError, match="does not exist"):
+        execute(cat, "ANALYZE TABLE db.nope COMPUTE STATISTICS")
