@@ -108,6 +108,12 @@ _NEGATIONS = {
     "isNotNull": "isNull",
     "in": "notIn",
     "notIn": "in",
+    "startsWith": "notStartsWith",
+    "notStartsWith": "startsWith",
+    "endsWith": "notEndsWith",
+    "notEndsWith": "endsWith",
+    "contains": "notContains",
+    "notContains": "contains",
 }
 
 
@@ -161,6 +167,9 @@ class LeafPredicate(Predicate):
             m = _masked_cmp(v, valid, ">=", lo) & _masked_cmp(v, valid, "<=", hi)
         elif f in ("startsWith", "endsWith", "contains"):
             m = _string_match(v, f, lit)
+        elif f in ("notStartsWith", "notEndsWith", "notContains"):
+            # SQL three-valued logic: NULL rows match neither LIKE nor NOT LIKE
+            m = ~_string_match(v, f[3].lower() + f[4:], lit)
         else:
             raise ValueError(f"unknown predicate function {f}")
         return np.asarray(m, dtype=np.bool_) & valid

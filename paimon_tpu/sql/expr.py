@@ -235,11 +235,12 @@ class _Parser:
             if pat[0] != "str":
                 raise ExprError(f"LIKE needs a string pattern in {self.src!r}")
             return ("like", left, pat[1], negated)
-        if t == ("kw", "between") and not negated:
+        if t == ("kw", "between"):
             self.next()
             lo = self.parse_operand()
             self.expect("kw", "and")
-            return ("between", left, lo, self.parse_operand())
+            node = ("between", left, lo, self.parse_operand())
+            return ("not", node) if negated else node
         if negated:
             raise ExprError(f"dangling NOT in {self.src!r}")
         # bare operand as boolean (e.g. a boolean column)
@@ -389,6 +390,19 @@ def to_predicate(node, src: str = ""):
             return to_predicate(("in", inner[1], inner[2], not inner[3]), src)
         if inner[0] == "like":
             return to_predicate(("like", inner[1], inner[2], not inner[3]), src)
+        if inner[0] == "not":  # double negation
+            return to_predicate(inner[1], src)
+        if inner[0] == "and":  # De Morgan
+            return to_predicate(("or", [("not", x) for x in inner[1]]), src)
+        if inner[0] == "or":
+            return to_predicate(("and", [("not", x) for x in inner[1]]), src)
+        if inner[0] == "between":
+            # NOT (x BETWEEN lo AND hi) = x < lo OR x > hi; reuses the cmp
+            # lowering (and its bounds validation)
+            return to_predicate(
+                ("or", [("cmp", "<", inner[1], inner[2]), ("cmp", ">", inner[1], inner[3])]),
+                src,
+            )
         raise ExprError(f"NOT over this construct is not supported in {src!r}")
     if kind == "cmp":
         op, left, right = node[1], node[2], node[3]
@@ -411,18 +425,22 @@ def to_predicate(node, src: str = ""):
         return P.not_in(col, node[2]) if node[3] else P.in_(col, node[2])
     if kind == "like":
         col, pat, negated = _col_name(node[1], src), node[2], node[3]
-        if negated:
-            raise ExprError(f"NOT LIKE cannot be pushed down in {src!r}")
         body = pat.strip("%")
         if "%" in body or "_" in pat:
             raise ExprError(f"only prefix/suffix/contains LIKE patterns are supported: {pat!r}")
         if pat.startswith("%") and pat.endswith("%"):
-            return P.contains(col, body)
-        if pat.endswith("%"):
-            return P.starts_with(col, body)
-        if pat.startswith("%"):
-            return P.ends_with(col, body)
-        return P.equal(col, pat)
+            pred = P.contains(col, body)
+        elif pat.endswith("%"):
+            pred = P.starts_with(col, body)
+        elif pat.startswith("%"):
+            pred = P.ends_with(col, body)
+        else:
+            pred = P.equal(col, pat)
+        if negated:
+            pred = pred.negate()
+            if pred is None:
+                raise ExprError(f"NOT LIKE cannot be expressed for {pat!r}")
+        return pred
     if kind == "between":
         col = _col_name(node[1], src)
         lo, hi = _const_fold(node[2]), _const_fold(node[3])
