@@ -467,71 +467,108 @@ Resolver = Callable[[Any, str], tuple[np.ndarray, np.ndarray | None]]
 """(alias, column) -> (values, validity|None); alias None = unqualified."""
 
 
-def eval_value(node, resolve: Resolver, n: int):
-    """Value AST -> ndarray of length n (literals broadcast)."""
+def _eval_vv(node, resolve: Resolver, n: int):
+    """Value AST -> (values, valid) where valid=None means all rows known.
+    Unknown rows carry garbage values (columns store sentinel fills); the
+    boolean layer masks them via Kleene `known` tracking."""
     kind = node[0]
     if kind == "lit":
-        return np.full(n, node[1]) if node[1] is not None else np.full(n, None, dtype=object)
+        if node[1] is None:
+            return np.zeros(n), np.zeros(n, dtype=bool)
+        return np.full(n, node[1]), None
     if kind == "col":
-        values, _ = resolve(node[1], node[2])
-        return values
+        return resolve(node[1], node[2])
     if kind == "neg":
-        return -eval_value(node[1], resolve, n)
+        v, k = _eval_vv(node[1], resolve, n)
+        return -v, k
     if kind == "arith":
-        return _APPLY[node[1]](eval_value(node[2], resolve, n), eval_value(node[3], resolve, n))
+        lv, lk = _eval_vv(node[2], resolve, n)
+        rv, rk = _eval_vv(node[3], resolve, n)
+        return _APPLY[node[1]](lv, rv), _and_valid(lk, rk)
     raise ExprError(f"cannot evaluate {kind!r} as a value")
 
 
-def eval_mask(node, resolve: Resolver, n: int) -> np.ndarray:
-    """Boolean AST -> bool ndarray of length n."""
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+_CMP = {"=": lambda a, b: a == b, "<>": lambda a, b: a != b, "!=": lambda a, b: a != b,
+        "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+        ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+
+
+def eval_value(node, resolve: Resolver, n: int):
+    """Value AST -> ndarray of length n (literals broadcast). Rows whose
+    value is unknown (NULL operands anywhere in the expression) come back as
+    None — SET v = NULL writes NULL, not the storage sentinel."""
+    v, k = _eval_vv(node, resolve, n)
+    if k is None or k.all():
+        return v
+    out = np.asarray(v, dtype=object).copy()
+    out[~k] = None
+    return out
+
+
+def _eval_tk(node, resolve: Resolver, n: int):
+    """Boolean AST -> (truth, known) under SQL/Kleene three-valued logic;
+    known=None means every row is known."""
     kind = node[0]
     if kind == "lit":
         if isinstance(node[1], bool):
-            return np.full(n, node[1], dtype=bool)
+            return np.full(n, node[1], dtype=bool), None
         raise ExprError(f"constant {node[1]!r} is not a boolean")
-    if kind == "and":
-        out = eval_mask(node[1][0], resolve, n)
+    if kind in ("and", "or"):
+        t, k = _eval_tk(node[1][0], resolve, n)
         for x in node[1][1:]:
-            out = out & eval_mask(x, resolve, n)
-        return out
-    if kind == "or":
-        out = eval_mask(node[1][0], resolve, n)
-        for x in node[1][1:]:
-            out = out | eval_mask(x, resolve, n)
-        return out
+            t2, k2 = _eval_tk(x, resolve, n)
+            if kind == "and":
+                # known iff both known, or either is known-False
+                nk = None if (k is None and k2 is None) else (
+                    _bool(k, n) & _bool(k2, n)
+                    | (_bool(k, n) & ~t)
+                    | (_bool(k2, n) & ~t2)
+                )
+                t = t & t2
+            else:
+                nk = None if (k is None and k2 is None) else (
+                    _bool(k, n) & _bool(k2, n)
+                    | (_bool(k, n) & t)
+                    | (_bool(k2, n) & t2)
+                )
+                t = t | t2
+            k = nk
+        return t, k
     if kind == "not":
-        return ~eval_mask(node[1], resolve, n)
+        t, k = _eval_tk(node[1], resolve, n)
+        return ~t, k
     if kind == "cmp":
-        left = eval_value(node[2], resolve, n)
-        right = eval_value(node[3], resolve, n)
-        op = node[1]
-        if op == "=":
-            return np.asarray(left == right)
-        if op in ("<>", "!="):
-            return np.asarray(left != right)
-        if op == "<":
-            return np.asarray(left < right)
-        if op == "<=":
-            return np.asarray(left <= right)
-        if op == ">":
-            return np.asarray(left > right)
-        return np.asarray(left >= right)
+        lv, lk = _eval_vv(node[2], resolve, n)
+        rv, rk = _eval_vv(node[3], resolve, n)
+        return np.asarray(_CMP[node[1]](lv, rv), dtype=bool), _and_valid(lk, rk)
     if kind == "isnull":
-        _, validity = resolve(node[1][1], node[1][2]) if node[1][0] == "col" else (None, None)
-        null = np.zeros(n, dtype=bool) if validity is None else ~validity
-        return ~null if node[2] else null
+        # IS NULL is always KNOWN, and applies to any operand: unknownness of
+        # the operand expression IS the nullness being tested
+        _, lk = _eval_vv(node[1], resolve, n)
+        null = ~_bool(lk, n)
+        return (~null if node[2] else null), None
     if kind == "in":
-        left = eval_value(node[1], resolve, n)
-        mask = np.isin(left, np.asarray(node[2]))
-        return ~mask if node[3] else mask
+        lv, lk = _eval_vv(node[1], resolve, n)
+        mask = np.isin(lv, np.asarray(node[2]))
+        return (~mask if node[3] else mask), lk
     if kind == "between":
-        left = eval_value(node[1], resolve, n)
-        return (left >= eval_value(node[2], resolve, n)) & (left <= eval_value(node[3], resolve, n))
+        lv, lk = _eval_vv(node[1], resolve, n)
+        lov, lok = _eval_vv(node[2], resolve, n)
+        hiv, hik = _eval_vv(node[3], resolve, n)
+        return (lv >= lov) & (lv <= hiv), _and_valid(lk, _and_valid(lok, hik))
     if kind == "like":
-        left = eval_value(node[1], resolve, n)
+        lv, lk = _eval_vv(node[1], resolve, n)
         pat, negated = node[2], node[3]
         body = pat.strip("%")
-        s = np.asarray(left, dtype=object)
+        s = np.asarray(lv, dtype=object)
         if pat.startswith("%") and pat.endswith("%"):
             mask = np.array([body in (x or "") for x in s], dtype=bool)
         elif pat.endswith("%"):
@@ -539,9 +576,21 @@ def eval_mask(node, resolve: Resolver, n: int) -> np.ndarray:
         elif pat.startswith("%"):
             mask = np.array([(x or "").endswith(body) for x in s], dtype=bool)
         else:
-            mask = s == pat
-        return ~mask if negated else mask
+            mask = np.asarray(s == pat, dtype=bool)
+        return (~mask if negated else mask), lk
     raise ExprError(f"cannot evaluate {kind!r} as a mask")
+
+
+def _bool(k, n):
+    return np.ones(n, dtype=bool) if k is None else k
+
+
+def eval_mask(node, resolve: Resolver, n: int) -> np.ndarray:
+    """Boolean AST -> bool ndarray of length n. SQL WHERE semantics: a row
+    passes only when the expression is known TRUE (UNKNOWN filters out) —
+    Kleene logic carried through NOT/AND/OR, same as the predicate path."""
+    t, k = _eval_tk(node, resolve, n)
+    return t if k is None else (t & k)
 
 
 def batch_resolver(aliases: Mapping[str, Any]) -> Resolver:

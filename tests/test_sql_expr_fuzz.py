@@ -113,3 +113,39 @@ def test_negated_string_match_null_semantics():
     notlike = np.asarray(parse_where("s NOT LIKE 'a%'").eval(b), dtype=bool)
     assert like.tolist() == [True, False, False]
     assert notlike.tolist() == [False, False, True]  # NULL row excluded from BOTH
+
+
+def test_two_table_eval_three_valued():
+    """merge_into's condition evaluator: NULLs are UNKNOWN, not sentinel
+    values — `v < 10` must not match a NULL v (whose storage fill is 0),
+    and Kleene NOT/AND/OR carries unknownness correctly."""
+    from paimon_tpu.sql.expr import batch_resolver, eval_mask, parse_expr
+    from paimon_tpu.types import BIGINT, RowType
+
+    schema = RowType.of(("k", BIGINT(False)), ("v", BIGINT()))
+    src = ColumnBatch.from_pydict(schema, {"k": [1, 2, 3], "v": [5, None, 50]})
+    resolve = batch_resolver({"src": src})
+    def m(text):
+        return eval_mask(parse_expr(text), resolve, 3).tolist()
+    assert m("src.v < 10") == [True, False, False]        # NULL(fill 0) must NOT match
+    assert m("NOT src.v < 10") == [False, False, True]    # NOT UNKNOWN = UNKNOWN
+    assert m("src.v IS NULL") == [False, True, False]
+    assert m("src.v < 10 OR src.k = 2") == [True, True, False]   # known-True wins over UNKNOWN
+    assert m("src.v < 10 AND src.k >= 1") == [True, False, False]
+    assert m("NOT (src.v < 10 OR src.v > 40)") == [False, False, False]  # row3 True->False; row1 F; row2 UNKNOWN
+    assert m("src.v + 1 > 50") == [False, False, True]    # arith propagates unknownness
+
+
+def test_eval_value_null_semantics():
+    """SET v = NULL writes None (not the storage sentinel); NULL propagates
+    through arithmetic; IS NULL applies to derived expressions."""
+    from paimon_tpu.sql.expr import batch_resolver, eval_mask, eval_value, parse_expr
+    from paimon_tpu.types import BIGINT, RowType
+
+    schema = RowType.of(("k", BIGINT(False)), ("v", BIGINT()))
+    src = ColumnBatch.from_pydict(schema, {"k": [1, 2], "v": [5, None]})
+    resolve = batch_resolver({"src": src})
+    assert eval_value(parse_expr("NULL"), resolve, 2).tolist() == [None, None]
+    assert eval_value(parse_expr("src.v + 1"), resolve, 2).tolist() == [6, None]
+    assert eval_mask(parse_expr("src.v + 1 IS NULL"), resolve, 2).tolist() == [False, True]
+    assert eval_mask(parse_expr("NULL IS NULL"), resolve, 2).tolist() == [True, True]
