@@ -194,8 +194,10 @@ def main(argv=None) -> int:
             reaches_kernel = not (fm and "$" in fm.group(1))
         elif _re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", args.statement, _re.I):
             reaches_kernel = False  # DDL is metadata-only
-        elif _re.match(r"^\s*(INSERT|ANALYZE)\b", args.statement, _re.I):
-            reaches_kernel = True
+        elif _re.match(r"^\s*(INSERT|UPDATE|DELETE|ANALYZE)\b", args.statement, _re.I):
+            reaches_kernel = True  # writes/scans flush through the merge kernels
+        elif _re.match(r"^\s*TRUNCATE\b", args.statement, _re.I):
+            reaches_kernel = False  # empty overwrite commit: metadata-only
         else:
             try:
                 from .sql import parse_call

@@ -775,4 +775,16 @@ def execute(catalog: "Catalog", statement: str) -> Any:
         from .dml import insert
 
         return insert(catalog, statement)
+    if re.match(r"^\s*UPDATE\b", statement, re.I):
+        from .dml import update
+
+        return update(catalog, statement)
+    if re.match(r"^\s*DELETE\s+FROM\b", statement, re.I):
+        from .dml import delete as dml_delete
+
+        return dml_delete(catalog, statement)
+    if re.match(r"^\s*TRUNCATE\b", statement, re.I):
+        from .dml import truncate
+
+        return truncate(catalog, statement)
     return call(catalog, statement)

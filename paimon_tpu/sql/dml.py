@@ -1,14 +1,18 @@
-"""INSERT statements: the write half of the string surface.
+"""DML statements: the write half of the string surface.
 
     INSERT INTO db.t VALUES (1, 'x', 2.5), (2, 'y', NULL)
     INSERT INTO db.t (k, s) VALUES (3, 'z')          -- missing columns -> NULL
     INSERT INTO db.t SELECT ... FROM db.src WHERE ...
     INSERT OVERWRITE db.t VALUES (...) / SELECT ...  -- overwrite commit
+    UPDATE db.t SET v = v + 1, s = 'x' WHERE k < 10
+    DELETE FROM db.t WHERE k >= 100
+    TRUNCATE TABLE db.t
 
-The reference's engines lower INSERT onto the batch write path
-(FlinkTableSink / SparkWrite); this lowers onto the same
-`new_batch_write_builder` — upsert semantics on PK tables, append otherwise,
-OVERWRITE via the overwrite commit kind.
+The reference's engines lower these onto the batch write path
+(FlinkTableSink / SparkWrite; UpdatePaimonTableCommand /
+DeleteFromPaimonTableCommand for the row-level commands); this lowers onto
+the same `new_batch_write_builder` / rowops — upsert semantics on PK
+tables, append otherwise, OVERWRITE/TRUNCATE via the overwrite commit kind.
 """
 
 from __future__ import annotations
@@ -16,12 +20,22 @@ from __future__ import annotations
 import re
 from typing import TYPE_CHECKING, Any
 
-from .expr import ExprError, _Parser, _const_fold, _NOT_CONST, _tokenize
+from .expr import (
+    ExprError,
+    _NOT_CONST,
+    _Parser,
+    _const_fold,
+    _tokenize,
+    batch_resolver,
+    eval_value,
+    parse_assignments,
+    parse_where,
+)
 
 if TYPE_CHECKING:
     from ..catalog import Catalog
 
-__all__ = ["insert", "DmlError"]
+__all__ = ["insert", "update", "delete", "truncate", "DmlError"]
 
 
 class DmlError(ValueError):
@@ -73,10 +87,7 @@ def insert(catalog: "Catalog", statement: str) -> dict:
     m = _INSERT_RE.match(statement)
     if not m:
         raise DmlError(f"not an INSERT statement: {statement!r}")
-    try:
-        t = catalog.get_table(m.group("name"))
-    except FileNotFoundError:
-        raise DmlError(f"table {m.group('name')} does not exist") from None
+    t = _table(catalog, m.group("name"))
     overwrite = m.group("mode").upper() == "OVERWRITE"
     cols = (
         [c.strip().strip("`") for c in m.group("cols").split(",") if c.strip()]
@@ -133,3 +144,116 @@ def insert(catalog: "Catalog", statement: str) -> dict:
     w.write({name: data[name] for name in t.row_type.field_names})
     wb.new_commit().commit(w.prepare_commit())
     return {"inserted": n, "table": m.group("name"), "overwrite": overwrite}
+
+_UPDATE_HEAD_RE = re.compile(
+    r"^\s*UPDATE\s+`?(?P<name>[\w.]+)`?\s+SET\s+(?P<rest>.*?)\s*;?\s*$", re.I | re.S
+)
+
+
+def _split_on_where(text: str) -> tuple[str, str | None]:
+    """Split 'SET-list [WHERE expr]' at the top-level WHERE keyword — quote-
+    aware, so a string literal containing the word WHERE never splits."""
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "'":
+            j = text.find("'", i + 1)
+            while j != -1 and text[j : j + 2] == "''":
+                j = text.find("'", j + 2)
+            if j == -1:
+                break  # unterminated: let the expression parser report it
+            i = j + 1
+            continue
+        if text[i : i + 5].upper() == "WHERE" and (i == 0 or not text[i - 1].isalnum()) and (
+            i + 5 >= n or not text[i + 5].isalnum()
+        ):
+            return text[:i].strip(), text[i + 5 :].strip()
+        i += 1
+    return text.strip(), None
+_DELETE_RE = re.compile(
+    r"^\s*DELETE\s+FROM\s+`?(?P<name>[\w.]+)`?(?:\s+WHERE\s+(?P<where>.*?))?\s*;?\s*$",
+    re.I | re.S,
+)
+_TRUNCATE_RE = re.compile(r"^\s*TRUNCATE\s+TABLE\s+`?(?P<name>[\w.]+)`?\s*;?\s*$", re.I)
+
+
+def _table(catalog: "Catalog", name: str):
+    try:
+        return catalog.get_table(name)
+    except FileNotFoundError:
+        raise DmlError(f"table {name} does not exist") from None
+
+
+def update(catalog: "Catalog", statement: str) -> dict:
+    """UPDATE t SET a = expr, ... [WHERE ...] -> Table.update_where.
+    SET expressions may reference the row's own columns (v = v + 1),
+    optionally qualified with the table name."""
+    m = _UPDATE_HEAD_RE.match(statement)
+    if not m:
+        raise DmlError(f"not an UPDATE statement: {statement!r}")
+    name = m.group("name")
+    t = _table(catalog, name)
+    sets_text, where_text = _split_on_where(m.group("rest"))
+    try:
+        assigns = parse_assignments(sets_text)
+        pred = parse_where(where_text) if where_text else None
+    except ExprError as e:
+        raise DmlError(str(e)) from e
+    if assigns and assigns[0][0] == "*":
+        raise DmlError("UPDATE SET requires explicit column assignments")
+    if pred is None:
+        from ..data.predicate import is_not_null, is_null, or_
+
+        # unconditional UPDATE: an always-true predicate (null-safe)
+        c = t.row_type.field_names[0]
+        pred = or_(is_null(c), is_not_null(c))
+
+    # accept the table's short name, full identifier, and 't' as aliases
+    aliases = {a for a in (name, name.split(".")[-1], "t") if a}
+
+    def make_value(ast):
+        def fn(batch):
+            return eval_value(ast, batch_resolver({a: batch for a in aliases}), batch.num_rows)
+
+        return fn
+
+    assignments = {col: make_value(ast) for col, ast in assigns}
+    try:
+        n = t.update_where(pred, assignments)
+    except (ValueError, KeyError) as e:
+        raise DmlError(str(e)) from e
+    return {"rows_updated": n, "table": name}
+
+
+def delete(catalog: "Catalog", statement: str) -> dict:
+    """DELETE FROM t WHERE ... -> table.delete_where (an explicit WHERE is
+    required; TRUNCATE TABLE is the wipe-everything statement)."""
+    m = _DELETE_RE.match(statement)
+    if not m:
+        raise DmlError(f"not a DELETE statement: {statement!r}")
+    t = _table(catalog, m.group("name"))
+    if not m.group("where"):
+        raise DmlError("DELETE without WHERE: use TRUNCATE TABLE to wipe a table")
+    try:
+        pred = parse_where(m.group("where"))
+    except ExprError as e:
+        raise DmlError(str(e)) from e
+    if pred is None:
+        raise DmlError("DELETE without an effective filter: use TRUNCATE TABLE")
+    return {"rows_deleted": t.delete_where(pred), "table": m.group("name")}
+
+
+def truncate(catalog: "Catalog", statement: str) -> dict:
+    """TRUNCATE TABLE t: one overwrite commit with no rows (time travel to
+    the pre-truncate snapshot still works, as in the reference). The
+    explicit match-all partition filter overrides dynamic-partition-
+    overwrite, which would otherwise clear only the (zero) touched
+    partitions and silently keep every row of a partitioned table."""
+    m = _TRUNCATE_RE.match(statement)
+    if not m:
+        raise DmlError(f"not a TRUNCATE statement: {statement!r}")
+    t = _table(catalog, m.group("name"))
+    wb = t.new_batch_write_builder().with_overwrite(lambda p: True)
+    w = wb.new_write()
+    wb.new_commit().commit(w.prepare_commit())
+    return {"truncated": m.group("name")}

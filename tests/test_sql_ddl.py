@@ -201,3 +201,59 @@ def test_analyze_table_statement(cat):
     assert stats is not None and stats.merged_record_count == 3
     with pytest.raises(DdlError, match="does not exist"):
         execute(cat, "ANALYZE TABLE db.nope COMPUTE STATISTICS")
+
+
+def test_update_delete_truncate_statements(cat):
+    from paimon_tpu.sql.dml import DmlError
+
+    ddl(cat, "CREATE TABLE db.u (k BIGINT NOT NULL, v BIGINT, s STRING, PRIMARY KEY (k) NOT ENFORCED) WITH ('bucket' = '1')")
+    execute(cat, "INSERT INTO db.u VALUES (1, 10, 'a'), (2, 20, 'b'), (3, 30, 'c'), (4, NULL, 'd')")
+    # UPDATE with self-referencing expression + WHERE
+    out = execute(cat, "UPDATE db.u SET v = v + 100, s = 'up' WHERE k <= 2")
+    assert out["rows_updated"] == 2
+    rows = {r[0]: r for r in execute(cat, "SELECT k, v, s FROM db.u").to_pylist()}
+    assert rows[1][1] == 110 and rows[1][2] == "up"
+    assert rows[2][1] == 120 and rows[3][1] == 30
+    # NULL v row: v + 100 stays NULL under three-valued arithmetic
+    out = execute(cat, "UPDATE db.u SET v = v + 1 WHERE k = 4")
+    assert out["rows_updated"] == 1
+    assert {r[0]: r[1] for r in execute(cat, "SELECT k, v FROM db.u").to_pylist()}[4] is None
+    # DELETE FROM requires a WHERE; deletes through the merge view
+    out = execute(cat, "DELETE FROM db.u WHERE s = 'up'")
+    assert out["rows_deleted"] == 2
+    assert execute(cat, "SELECT count(*) FROM db.u").to_pylist()[0][0] == 2
+    with pytest.raises(DmlError, match="TRUNCATE"):
+        execute(cat, "DELETE FROM db.u")
+    # TRUNCATE wipes; time travel still sees the old data
+    execute(cat, "TRUNCATE TABLE db.u")
+    assert execute(cat, "SELECT count(*) FROM db.u").to_pylist()[0][0] == 0
+    snaps = execute(cat, "SELECT count(*) FROM db.u$snapshots").to_pylist()[0][0]
+    old = execute(cat, f"SELECT count(*) FROM db.u FOR VERSION AS OF {snaps - 1}")
+    assert old.to_pylist()[0][0] == 2
+    with pytest.raises(DmlError, match="does not exist"):
+        execute(cat, "UPDATE db.nope SET v = 1 WHERE k = 1")
+
+
+def test_update_truncate_review_fixes(cat):
+    # WHERE inside a string literal does not split the statement
+    ddl(cat, "CREATE TABLE db.w (k BIGINT NOT NULL, s STRING, PRIMARY KEY (k) NOT ENFORCED) WITH ('bucket' = '1')")
+    execute(cat, "INSERT INTO db.w VALUES (1, 'x')")
+    out = execute(cat, "UPDATE db.w SET s = 'no WHERE clause'")
+    assert out["rows_updated"] == 1
+    assert execute(cat, "SELECT s FROM db.w").to_pylist()[0][0] == "no WHERE clause"
+    # table-qualified SET expressions resolve (short name and full ident)
+    execute(cat, "INSERT INTO db.w VALUES (2, 'y')")
+    out = execute(cat, "UPDATE db.w SET s = w.s WHERE k = 2")
+    assert out["rows_updated"] == 1
+    # unconditional UPDATE touches rows whose first column is NULL (append table)
+    ddl(cat, "CREATE TABLE db.ap (a BIGINT, b BIGINT) WITH ('bucket' = '1')")
+    execute(cat, "INSERT INTO db.ap VALUES (NULL, 5), (1, 6)")
+    out = execute(cat, "UPDATE db.ap SET b = 0")
+    assert out["rows_updated"] == 2
+    assert {r[1] for r in execute(cat, "SELECT a, b FROM db.ap").to_pylist()} == {0}
+    # TRUNCATE actually wipes a PARTITIONED table (dynamic overwrite override)
+    ddl(cat, "CREATE TABLE db.pt (k BIGINT NOT NULL, dt STRING, PRIMARY KEY (k, dt) NOT ENFORCED) "
+             "PARTITIONED BY (dt) WITH ('bucket' = '1')")
+    execute(cat, "INSERT INTO db.pt VALUES (1, 'a'), (2, 'b')")
+    execute(cat, "TRUNCATE TABLE db.pt")
+    assert execute(cat, "SELECT count(*) FROM db.pt").to_pylist()[0][0] == 0
