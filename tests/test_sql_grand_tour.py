@@ -1,0 +1,61 @@
+"""The SQL grand tour: a full table lifecycle driven ONLY by statement
+strings — what a reference user's runbook looks like after porting. Every
+statement family in one flow: DDL, DML, SELECT (+time travel), ALTER,
+ANALYZE, CALL procedures (compact/tags/merge_into/rewrite_file_index),
+TRUNCATE."""
+
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.sql import execute
+
+
+def test_sql_grand_tour(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="tour")
+    S = lambda stmt: execute(cat, stmt)  # noqa: E731
+
+    # DDL: a partitioned PK table + a staging table
+    S("CREATE TABLE shop.orders ("
+      "  oid BIGINT NOT NULL, region STRING NOT NULL, amount DOUBLE,"
+      "  status STRING COMMENT 'open|done', PRIMARY KEY (oid, region) NOT ENFORCED"
+      ") PARTITIONED BY (region) WITH ('bucket' = '2', 'write-only' = 'true')")
+    S("CREATE TABLE shop.staging ("
+      "  oid BIGINT NOT NULL, region STRING NOT NULL, amount DOUBLE, status STRING,"
+      "  PRIMARY KEY (oid, region) NOT ENFORCED) WITH ('bucket' = '1')")
+
+    # DML: load, then churn
+    S("INSERT INTO shop.orders VALUES "
+      "(1, 'eu', 10, 'open'), (2, 'eu', 20, 'open'), (3, 'us', 30, 'open'), (4, 'us', 40, 'done')")
+    S("UPDATE shop.orders SET status = 'done' WHERE amount >= 30")
+    assert S("SELECT count(*) FROM shop.orders WHERE status = 'done'").to_pylist()[0][0] == 2
+    S("DELETE FROM shop.orders WHERE oid = 2")
+
+    # tag the current state, then merge in corrections from staging
+    S("CALL sys.create_tag('shop.orders', 'pre-fix')")
+    S("INSERT INTO shop.staging VALUES (1, 'eu', 11, 'fixed'), (9, 'eu', 99, 'new')")
+    out = S("CALL sys.merge_into(target_table => 'shop.orders', source_table => 'shop.staging', "
+            "merge_condition => 'orders.oid = staging.oid AND orders.region = staging.region', "
+            "matched_upsert_setting => '*', not_matched_insert_values => '*')")
+    assert out == {"rows_updated": 1, "rows_deleted": 0, "rows_inserted": 1}
+
+    # SELECT: aggregates + GROUP BY + time travel back past the merge
+    rows = S("SELECT region, count(*), sum(amount) FROM shop.orders GROUP BY region ORDER BY region").to_pylist()
+    assert [r[0] for r in rows] == ["eu", "us"] and rows[0][1] == 2
+    pre = S("SELECT count(*) FROM shop.orders FOR TAG AS OF 'pre-fix'").to_pylist()[0][0]
+    assert pre == 3  # before the merge added oid 9 and fixed oid 1
+
+    # maintenance: compact, backfill an index, analyze, evolve the schema
+    S("CALL sys.compact(`table` => 'shop.orders', `full` => true)")
+    S("ALTER TABLE shop.orders SET ('file-index.bloom-filter.columns' = 'oid')")
+    assert S("CALL sys.rewrite_file_index('shop.orders')")["rewritten"] >= 1
+    assert S("ANALYZE TABLE shop.orders COMPUTE STATISTICS FOR ALL COLUMNS")["rows"] == 4
+    S("ALTER TABLE shop.orders ADD COLUMN note STRING")
+    assert S("SELECT note FROM shop.orders LIMIT 1").to_pylist()[0][0] is None
+
+    # introspection round-trip, then wipe
+    created = S("SHOW CREATE TABLE shop.orders")
+    S(created.replace("shop.orders", "shop.orders_copy"))
+    assert [r[0] for r in S("SHOW TABLES IN shop").to_pylist()] == [
+        "shop.orders", "shop.orders_copy", "shop.staging"]
+    S("TRUNCATE TABLE shop.staging")
+    assert S("SELECT count(*) FROM shop.staging").to_pylist()[0][0] == 0
