@@ -94,4 +94,4 @@ def __getattr__(name):
     raise AttributeError(f"module 'paimon_tpu' has no attribute {name!r}")
 
 
-__version__ = "0.2.0"
+__version__ = "0.5.0"
