@@ -85,6 +85,8 @@ def __getattr__(name):
         "flight_scan": ("paimon_tpu.service.flight", "flight_scan"),
         "record_batch_reader": ("paimon_tpu.interop.arrow_surface", "record_batch_reader"),
         "call": ("paimon_tpu.sql", "call"),
+        "query": ("paimon_tpu.sql", "query"),
+        "execute_sql": ("paimon_tpu.sql", "execute"),
     }
     if name in lazy:
         import importlib
