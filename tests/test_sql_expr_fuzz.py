@@ -149,3 +149,74 @@ def test_eval_value_null_semantics():
     assert eval_value(parse_expr("src.v + 1"), resolve, 2).tolist() == [6, None]
     assert eval_mask(parse_expr("src.v + 1 IS NULL"), resolve, 2).tolist() == [False, True]
     assert eval_mask(parse_expr("NULL IS NULL"), resolve, 2).tolist() == [True, True]
+
+
+def test_fuzz_two_table_kleene():
+    """Fuzz the two-table evaluator against a three-valued row oracle:
+    random condition trees over a null-bearing batch; UNKNOWN (None) must
+    collapse to False only at the top (SQL WHERE), with Kleene AND/OR/NOT
+    inside."""
+    from paimon_tpu.sql.expr import batch_resolver, eval_mask, parse_expr
+    from paimon_tpu.types import BIGINT, RowType
+
+    rng = np.random.default_rng(77)
+    n = 300
+    ks = list(range(n))
+    vs = [int(x) if x >= 0 else None for x in rng.integers(-20, 80, n)]
+    schema = RowType.of(("k", BIGINT(False)), ("v", BIGINT()))
+    src = ColumnBatch.from_pydict(schema, {"k": ks, "v": vs})
+    resolve = batch_resolver({"src": src})
+
+    def gen(depth=0):
+        """-> (text, row_fn) with row_fn -> True|False|None (Kleene)."""
+        if depth < 2 and rng.random() < 0.5:
+            kind = rng.choice(["and", "or", "not"])
+            if kind == "not":
+                t, f = gen(depth + 1)
+                return f"NOT ({t})", lambda r, f=f: (None if f(r) is None else (not f(r)))
+            lt, lf = gen(depth + 1)
+            rt, rf = gen(depth + 1)
+            if kind == "and":
+                def fn(r, lf=lf, rf=rf):
+                    a, b = lf(r), rf(r)
+                    if a is False or b is False:
+                        return False
+                    if a is None or b is None:
+                        return None
+                    return True
+                return f"({lt}) AND ({rt})", fn
+            def fn(r, lf=lf, rf=rf):
+                a, b = lf(r), rf(r)
+                if a is True or b is True:
+                    return True
+                if a is None or b is None:
+                    return None
+                return False
+            return f"({lt}) OR ({rt})", fn
+        leaf = rng.choice(["cmp_v", "cmp_k", "isnull", "in_v", "arith"])
+        if leaf == "cmp_v":
+            op = rng.choice(["<", ">=", "=", "<>"])
+            c = int(rng.integers(0, 60))
+            py = {"<": lambda x: x < c, ">=": lambda x: x >= c,
+                  "=": lambda x: x == c, "<>": lambda x: x != c}[op]
+            return f"src.v {op} {c}", lambda r, py=py: (None if r["v"] is None else py(r["v"]))
+        if leaf == "cmp_k":
+            c = int(rng.integers(0, n))
+            return f"src.k < {c}", lambda r, c=c: r["k"] < c
+        if leaf == "isnull":
+            neg = rng.random() < 0.5
+            t = f"src.v IS {'NOT ' if neg else ''}NULL"
+            return t, lambda r, neg=neg: (r["v"] is not None) if neg else (r["v"] is None)
+        if leaf == "in_v":
+            vals = sorted(int(x) for x in rng.integers(0, 60, 3))
+            t = f"src.v IN ({', '.join(map(str, vals))})"
+            return t, lambda r, vals=vals: (None if r["v"] is None else r["v"] in vals)
+        c = int(rng.integers(0, 60))
+        return f"src.v + 1 > {c}", lambda r, c=c: (None if r["v"] is None else r["v"] + 1 > c)
+
+    rows = [{"k": k, "v": v} for k, v in zip(ks, vs)]
+    for trial in range(150):
+        text, fn = gen()
+        mask = eval_mask(parse_expr(text), resolve, n)
+        want = np.array([fn(r) is True for r in rows], dtype=bool)
+        assert np.array_equal(np.asarray(mask, dtype=bool), want), f"trial {trial}: {text}"
