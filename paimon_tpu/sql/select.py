@@ -40,6 +40,7 @@ class QueryError(ValueError):
 
 _SELECT_RE = re.compile(
     r"^\s*SELECT\s+(?P<cols>.*?)\s+FROM\s+(?P<table>`?[\w.$]+`?)"
+    r"(?:\s*/\*\+\s*OPTIONS\s*\((?P<hints>.*?)\)\s*\*/)?"
     r"(?:\s+FOR\s+(?P<tt_kind>VERSION|TIMESTAMP|TAG)\s+AS\s+OF\s+(?P<tt_val>'[^']*'|[^\s;]+))?"
     r"(?:\s+WHERE\s+(?P<where>.*?))?"
     r"(?:\s+GROUP\s+BY\s+(?P<group>.*?))?"
@@ -86,6 +87,23 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
     table_name = m.group("table").strip("`")
     t = catalog.get_table(table_name)
 
+    # per-query dynamic options: OPTIONS hints + time travel accumulate into
+    # ONE table copy
+    dynamic: dict[str, str] = {}
+    if m.group("hints") is not None:
+        # Flink's dynamic table options: SELECT ... FROM t /*+ OPTIONS('k'='v') */
+        # (reference FlinkConnectorOptions dynamic hints) — per-query overrides
+        # of ANY table option: scan modes, time travel, merge knobs
+        from .ddl import DdlError, _parse_options
+
+        try:
+            hints = _parse_options(m.group("hints"))
+        except DdlError as e:
+            raise QueryError(f"cannot parse OPTIONS hint: {e}") from e
+        if not hints:
+            raise QueryError("empty OPTIONS hint")
+        dynamic.update(hints)
+
     if m.group("tt_kind"):
         # time travel (Spark grammar: FOR VERSION|TIMESTAMP AS OF; TAG as an
         # explicit alias): lowers onto the scan options
@@ -93,28 +111,32 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
         val = m.group("tt_val").strip("'")
         if not val:
             raise QueryError(f"FOR {kind} AS OF requires a non-empty value")
-        if not hasattr(t, "copy"):
-            raise QueryError("time travel applies to data tables, not system tables")
         if kind == "VERSION":
             # scan.version resolves a snapshot id OR a tag name — the same
             # unified semantic the reference gives Spark's VERSION AS OF
-            t = t.copy({"scan.version": val})
+            dynamic["scan.version"] = val
         elif kind == "TAG":
-            t = t.copy({"scan.tag-name": val})
-        else:  # TIMESTAMP
-            if val.isdigit():
-                t = t.copy({"scan.timestamp-millis": val})
-            else:
-                import datetime as _dt
+            dynamic["scan.tag-name"] = val
+        elif val.isdigit():
+            dynamic["scan.timestamp-millis"] = val
+        else:
+            import datetime as _dt
 
-                try:
-                    _dt.datetime.fromisoformat(val)
-                except ValueError:
-                    raise QueryError(
-                        f"TIMESTAMP AS OF expects epoch millis or "
-                        f"'YYYY-MM-DD[ HH:MM:SS]', got {val!r}"
-                    ) from None
-                t = t.copy({"scan.timestamp": val})
+            try:
+                _dt.datetime.fromisoformat(val)
+            except ValueError:
+                raise QueryError(
+                    f"TIMESTAMP AS OF expects epoch millis or "
+                    f"'YYYY-MM-DD[ HH:MM:SS]', got {val!r}"
+                ) from None
+            dynamic["scan.timestamp"] = val
+
+    if dynamic:
+        if not hasattr(t, "copy"):
+            raise QueryError(
+                "OPTIONS hints / time travel apply to data tables, not system tables"
+            )
+        t = t.copy(dynamic)
 
     where_text = m.group("where")
     pred = None

@@ -169,3 +169,17 @@ def test_select_time_travel(cat):
         query(cat, "SELECT * FROM db.t FOR TAG AS OF ''")
     with pytest.raises(QueryError, match="TIMESTAMP AS OF"):
         query(cat, "SELECT * FROM db.t FOR TIMESTAMP AS OF 'not-a-date'")
+
+
+def test_select_options_hints(cat):
+    # time travel via the Flink dynamic-options hint
+    out = query(cat, "SELECT count(*) FROM db.t /*+ OPTIONS('scan.snapshot-id' = '1') */")
+    assert out.to_pylist()[0][0] == 100
+    # any table option: force a tiny merge tile size (behavioral no-op, same rows)
+    out = query(cat, "SELECT count(*) FROM db.t /*+ OPTIONS('merge-read-batch-rows' = '64') */")
+    assert out.to_pylist()[0][0] == 150
+    # hints compose with WHERE etc.
+    out = query(cat, "SELECT k FROM db.t /*+ OPTIONS('scan.snapshot-id' = '1') */ WHERE k < 5 ORDER BY k")
+    assert [r[0] for r in out.to_pylist()] == [0, 1, 2, 3, 4]
+    with pytest.raises(QueryError):
+        query(cat, "SELECT * FROM db.t /*+ OPTIONS(bad) */")
