@@ -158,10 +158,12 @@ def main(argv=None) -> int:
     p.add_argument("--user", default="cli")
     p.add_argument("statement", help="e.g. \"CALL sys.compact(`table` => 'db.t')\"")
 
-    p = sub.add_parser("sql", help="execute a SELECT or CALL statement")
+    p = sub.add_parser("sql", help="execute SQL statements (SELECT/DDL/DML/CALL)")
     p.add_argument("--warehouse", required=True)
     p.add_argument("--user", default="cli")
-    p.add_argument("statement", help="e.g. \"SELECT k, v FROM db.t WHERE k > 5 LIMIT 10\"")
+    p.add_argument("--file", help="run a multi-statement .sql script file")
+    p.add_argument("statement", nargs="?", default=None,
+                   help="e.g. \"SELECT k, v FROM db.t WHERE k > 5 LIMIT 10\"")
 
     args = ap.parse_args(argv)
     action = args.action.replace("-", "_")
@@ -184,25 +186,42 @@ def main(argv=None) -> int:
     if action == "sql":
         import re as _re
 
+        # argument validation BEFORE any device-policy work: a usage mistake
+        # must never probe the tunnel or contend for the chip grant
+        if args.file and args.statement:
+            ap.error("pass a statement or --file, not both")
+        if not args.file and args.statement is None:
+            ap.error("sql needs a statement or --file")
         # SELECT merges on read -> kernel, EXCEPT system tables ($snapshots,
         # $files, ...): those are static metadata batches with no merge.
         # DDL (CREATE/DROP/SHOW/DESCRIBE) is metadata-only; ANALYZE and
         # INSERT scan/flush through the merge kernels. CALL statements gate
-        # by procedure name, same as the dedicated `call` action.
-        if _re.match(r"^\s*SELECT\b", args.statement, _re.I):
-            fm = _re.search(r"\bFROM\s+`?([\w.$]+)`?", args.statement, _re.I)
+        # by procedure name, same as the dedicated `call` action. Script
+        # files and multi-statement strings take the safe kernel path
+        # (classified with the real quote-aware splitter).
+        from .sql import split_statements as _split
+
+        single = None if args.file else _split(args.statement)
+        if single is not None and len(single) == 1:
+            stmt = single[0]
+        else:
+            stmt = None  # script: mixed statements -> safe path
+        if stmt is None:
+            reaches_kernel = True
+        elif _re.match(r"^\s*SELECT\b", stmt, _re.I):
+            fm = _re.search(r"\bFROM\s+`?([\w.$]+)`?", stmt, _re.I)
             reaches_kernel = not (fm and "$" in fm.group(1))
-        elif _re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", args.statement, _re.I):
+        elif _re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?)\b", stmt, _re.I):
             reaches_kernel = False  # DDL is metadata-only
-        elif _re.match(r"^\s*(INSERT|UPDATE|DELETE|ANALYZE)\b", args.statement, _re.I):
+        elif _re.match(r"^\s*(INSERT|UPDATE|DELETE|ANALYZE)\b", stmt, _re.I):
             reaches_kernel = True  # writes/scans flush through the merge kernels
-        elif _re.match(r"^\s*TRUNCATE\b", args.statement, _re.I):
+        elif _re.match(r"^\s*TRUNCATE\b", stmt, _re.I):
             reaches_kernel = False  # empty overwrite commit: metadata-only
         else:
             try:
                 from .sql import parse_call
 
-                reaches_kernel = parse_call(args.statement)[0] in _KERNEL_PROCEDURES
+                reaches_kernel = parse_call(stmt)[0] in _KERNEL_PROCEDURES
             except Exception:
                 reaches_kernel = True  # unparseable: keep the safe path
     elif action == "call":
@@ -231,17 +250,28 @@ def main(argv=None) -> int:
 
     if action == "sql":
         from .catalog import FileSystemCatalog
-        from .sql import execute as sql_execute
+        from .sql import execute as sql_execute, split_statements
 
         cat = FileSystemCatalog(args.warehouse, commit_user=args.user)
-        out = sql_execute(cat, args.statement)
-        if hasattr(out, "to_pylist"):  # SELECT/SHOW -> one JSON row per line
-            for row in out.to_pylist():
-                print(json.dumps(list(row), default=str))
-        elif isinstance(out, str):  # SHOW CREATE TABLE
-            print(out)
+        if args.file:
+            with open(args.file) as f:
+                statements = split_statements(f.read())
+        elif args.statement is not None:
+            statements = split_statements(args.statement)
         else:
-            print(json.dumps(out, default=str))
+            ap.error("sql needs a statement or --file")
+
+        def emit(out):
+            if hasattr(out, "to_pylist"):  # SELECT/SHOW -> one JSON row per line
+                for row in out.to_pylist():
+                    print(json.dumps(list(row), default=str))
+            elif isinstance(out, str):  # SHOW CREATE TABLE
+                print(out)
+            else:
+                print(json.dumps(out, default=str))
+
+        for stmt in statements:
+            emit(sql_execute(cat, stmt))
         return 0
 
     if action == "clone":

@@ -28,7 +28,8 @@ from typing import TYPE_CHECKING, Any, Callable
 if TYPE_CHECKING:
     from ..catalog import Catalog
 
-__all__ = ["call", "parse_call", "procedures", "query", "execute"]
+__all__ = ["call", "parse_call", "procedures", "query", "execute",
+           "execute_script", "split_statements"]
 
 _CALL_RE = re.compile(r"^\s*CALL\s+(?:`?sys`?\.)?`?(\w+)`?\s*\((.*)\)\s*;?\s*$", re.I | re.S)
 
@@ -760,6 +761,58 @@ def query(catalog: "Catalog", statement: str):
     from .select import query as _query
 
     return _query(catalog, statement)
+
+
+def split_statements(script: str) -> list[str]:
+    """Split a SQL script on top-level semicolons. ONE scanner pass with
+    quote state carried across newlines: single-quoted literals (with ''
+    escapes, including multi-line literals) and backticked identifiers keep
+    their ';' and '--'; `-- line comments` outside quotes are stripped."""
+    stmts: list[str] = []
+    buf: list[str] = []
+    i, n = 0, len(script)
+    while i < n:
+        c = script[i]
+        if c == "'":
+            j = script.find("'", i + 1)
+            while j != -1 and script[j : j + 2] == "''":
+                j = script.find("'", j + 2)
+            if j == -1:  # unterminated: keep verbatim; the parser reports it
+                buf.append(script[i:])
+                break
+            buf.append(script[i : j + 1])
+            i = j + 1
+            continue
+        if c == "`":
+            j = script.find("`", i + 1)
+            if j == -1:
+                buf.append(script[i:])
+                break
+            buf.append(script[i : j + 1])
+            i = j + 1
+            continue
+        if script[i : i + 2] == "--":
+            j = script.find("\n", i)
+            i = n if j == -1 else j  # keep the newline as whitespace
+            continue
+        if c == ";":
+            stmts.append("".join(buf).strip())
+            buf = []
+            i += 1
+            continue
+        buf.append(c)
+        i += 1
+    tail = "".join(buf).strip()
+    if tail:
+        stmts.append(tail)
+    return [s for s in stmts if s]
+
+
+def execute_script(catalog: "Catalog", script: str) -> list[Any]:
+    """Run a multi-statement SQL script in order; returns one result per
+    statement. A failure stops the script (statements already executed have
+    committed — same per-statement atomicity as the reference's engines)."""
+    return [execute(catalog, s) for s in split_statements(script)]
 
 
 def execute(catalog: "Catalog", statement: str) -> Any:

@@ -257,3 +257,35 @@ def test_update_truncate_review_fixes(cat):
     execute(cat, "INSERT INTO db.pt VALUES (1, 'a'), (2, 'b')")
     execute(cat, "TRUNCATE TABLE db.pt")
     assert execute(cat, "SELECT count(*) FROM db.pt").to_pylist()[0][0] == 0
+
+
+def test_execute_script_and_split(cat):
+    from paimon_tpu.sql import execute_script, split_statements
+
+    stmts = split_statements(
+        "CREATE TABLE db.sc (k BIGINT NOT NULL, s STRING, PRIMARY KEY (k) NOT ENFORCED);\n"
+        "-- a comment; with a semicolon\n"
+        "INSERT INTO db.sc VALUES (1, 'a;b'), (2, 'it''s');  -- trailing comment\n"
+        "SELECT count(*) FROM db.sc"
+    )
+    assert len(stmts) == 3, stmts
+    results = execute_script(cat, ";\n".join(stmts))
+    assert results[0] == {"created": "db.sc"}
+    assert results[1]["inserted"] == 2
+    assert results[2].to_pylist()[0][0] == 2
+    # literal semicolon survived
+    rows = {r[0]: r[1] for r in execute(cat, "SELECT k, s FROM db.sc").to_pylist()}
+    assert rows[1] == "a;b" and rows[2] == "it's"
+
+
+def test_split_statements_edge_cases():
+    from paimon_tpu.sql import split_statements
+
+    # multi-line string literal keeps '--' and newlines intact
+    stmts = split_statements("INSERT INTO db.t VALUES (1, 'line1\n-- not a comment\nline3');")
+    assert stmts == ["INSERT INTO db.t VALUES (1, 'line1\n-- not a comment\nline3')"]
+    # backticked identifiers guard ';' and '--'
+    assert split_statements("SELECT * FROM `weird;--name`") == ["SELECT * FROM `weird;--name`"]
+    # comments stripped outside quotes; statements split
+    assert split_statements("-- header\nSELECT 1 FROM a; SELECT 2 FROM b -- tail") == [
+        "SELECT 1 FROM a", "SELECT 2 FROM b"]
