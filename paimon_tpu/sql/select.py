@@ -39,7 +39,7 @@ class QueryError(ValueError):
 
 
 _SELECT_RE = re.compile(
-    r"^\s*SELECT\s+(?P<cols>.*?)\s+FROM\s+(?P<table>`?[\w.$]+`?)"
+    r"^\s*SELECT\s+(?:(?P<distinct>DISTINCT)\s+)?(?P<cols>.*?)\s+FROM\s+(?P<table>`?[\w.$]+`?)"
     r"(?:\s*/\*\+\s*OPTIONS\s*\((?P<hints>.*?)\)\s*\*/)?"
     r"(?:\s+FOR\s+(?P<tt_kind>VERSION|TIMESTAMP|TAG)\s+AS\s+OF\s+(?P<tt_val>'[^']*'|[^\s;]+))?"
     r"(?:\s+WHERE\s+(?P<where>.*?))?"
@@ -152,6 +152,13 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
     is_agg = any(a is not None for a in aggs)
     group_text = m.group("group")
     group_cols = [g.strip().strip("`") for g in group_text.split(",")] if group_text else []
+    if m.group("distinct"):
+        # SELECT DISTINCT a, b = GROUP BY a, b with no aggregates
+        if is_agg or group_cols:
+            raise QueryError("DISTINCT cannot combine with aggregates or GROUP BY")
+        if cols_text == "*":
+            raise QueryError("DISTINCT requires an explicit column list")
+        group_cols = [i.strip("`") for i in items]
     if group_cols:
         bad = [i for i, a in zip(items, aggs) if a is None and i.strip("`") not in group_cols]
         if bad:

@@ -183,3 +183,14 @@ def test_select_options_hints(cat):
     assert [r[0] for r in out.to_pylist()] == [0, 1, 2, 3, 4]
     with pytest.raises(QueryError):
         query(cat, "SELECT * FROM db.t /*+ OPTIONS(bad) */")
+
+
+def test_select_distinct(cat):
+    out = query(cat, "SELECT DISTINCT s FROM db.t ORDER BY s")
+    assert [r[0] for r in out.to_pylist()] == ["g0", "g1", "g2"]
+    out = query(cat, "SELECT DISTINCT s, k FROM db.t WHERE k < 3 ORDER BY k")
+    assert len(out.to_pylist()) == 3  # (s, k) pairs, k unique
+    with pytest.raises(QueryError, match="DISTINCT"):
+        query(cat, "SELECT DISTINCT count(*) FROM db.t")
+    with pytest.raises(QueryError, match="column list"):
+        query(cat, "SELECT DISTINCT * FROM db.t")
