@@ -95,6 +95,9 @@ class FileSystemCatalog(Catalog):
         if not cascade and self.list_tables(name):
             raise ValueError(f"database {name} is not empty")
         self.file_io.delete(self._db_path(name), recursive=True)
+        from ..utils.cache import invalidate_table_path
+
+        invalidate_table_path(self._db_path(name))
 
     # ---- tables --------------------------------------------------------
     def table_path(self, identifier: "Identifier | str") -> str:
@@ -177,11 +180,18 @@ class FileSystemCatalog(Catalog):
 
     def drop_table(self, identifier: "Identifier | str") -> None:
         self.file_io.delete(self.table_path(identifier), recursive=True)
+        # a recreated table at the same path re-mints snapshot ids
+        from ..utils.cache import invalidate_table_path
+
+        invalidate_table_path(self.table_path(identifier))
 
     def rename_table(self, src: "Identifier | str", dst: "Identifier | str") -> None:
         ok = self.file_io.rename(self.table_path(src), self.table_path(dst))
         if not ok:
             raise ValueError(f"cannot rename {src} -> {dst} (destination exists)")
+        from ..utils.cache import invalidate_table_path
+
+        invalidate_table_path(self.table_path(src))
 
     def alter_table(self, identifier: "Identifier | str", *changes: dict) -> TableSchema:
         path = self.table_path(identifier)

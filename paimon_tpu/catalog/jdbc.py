@@ -173,6 +173,9 @@ class JdbcCatalog(Catalog):
             )
         if location:
             self.file_io.delete(location, recursive=True)
+            from ..utils.cache import invalidate_table_path
+
+            invalidate_table_path(location)
 
     def rename_table(self, src: "Identifier | str", dst: "Identifier | str") -> None:
         s = Identifier.parse(src) if isinstance(src, str) else src

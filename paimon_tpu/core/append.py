@@ -89,7 +89,13 @@ def concat_rewrite(
     # sequence span so ordering and writer restore stay correct
     base = min(f.min_sequence_number for f in files)
     kv = KVBatch(kv.data, np.arange(base, base + kv.num_rows, dtype=np.int64), kv.kind)
-    return writer_factory.write(kv, level=0, file_source="compact")
+    out = writer_factory.write(kv, level=0, file_source="compact")
+    # the concatenated inputs leave the live view: free their cache budget
+    from ..utils.cache import invalidate_data_file
+
+    for f in files:
+        invalidate_data_file(f.file_name)
+    return out
 
 
 class AppendOnlyWriter:

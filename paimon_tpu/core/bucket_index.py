@@ -15,7 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import numpy as np
-import zstandard
+from ..utils.compression import zstd_compress, zstd_decompress
 
 from ..fs import FileIO
 from ..utils import new_file_name
@@ -34,12 +34,12 @@ class HashIndexFile:
 
     def write(self, hashes: np.ndarray) -> str:
         name = new_file_name("index-hash")
-        payload = zstandard.ZstdCompressor(level=3).compress(np.sort(hashes.astype(np.uint64)).tobytes())
+        payload = zstd_compress(np.sort(hashes.astype(np.uint64)).tobytes())
         self.file_io.write_bytes(f"{self.index_dir}/{name}", payload)
         return name
 
     def read(self, name: str) -> np.ndarray:
-        raw = zstandard.ZstdDecompressor().decompress(self.file_io.read_bytes(f"{self.index_dir}/{name}"))
+        raw = zstd_decompress(self.file_io.read_bytes(f"{self.index_dir}/{name}"))
         return np.frombuffer(raw, dtype=np.uint64).copy()
 
 

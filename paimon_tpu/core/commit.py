@@ -53,6 +53,7 @@ class FileStoreCommit:
         commit_user: str,
         schema_id: int,
         options: CoreOptions | None = None,
+        cache=None,
     ):
         self.file_io = file_io
         self.table_path = table_path
@@ -90,9 +91,12 @@ class FileStoreCommit:
                 self._lock = FileBasedCatalogLock(file_io, table_path, timeout=timeout, stale_ttl=stale_ttl)
             else:
                 raise ValueError(f"unknown commit.catalog-lock.type: {lock_type!r} (expected 'file' or 'jdbc')")
-        self.snapshot_manager = SnapshotManager(file_io, table_path)
-        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
-        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
+        # manifest object cache: every commit re-reads the latest snapshot's
+        # base+delta manifests (conflict check, manifest merge) — immutable
+        # files, so the decoded entries come from the shared cache
+        self.snapshot_manager = SnapshotManager(file_io, table_path, cache=cache)
+        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest", cache=cache)
+        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest", cache=cache)
 
     # ---- idempotence ----------------------------------------------------
     def filter_committed(self, committables: Sequence[ManifestCommittable]) -> list[ManifestCommittable]:

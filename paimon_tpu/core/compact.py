@@ -331,6 +331,14 @@ class MergeTreeCompactManager:
             result.before.extend(flat_before)
             result.after.extend(after)
             result.changelog.extend(changelog)
+            # rewritten inputs left the live LSM view: drop their decoded
+            # batches so the byte budget tracks the hot working set (upgraded
+            # files in result.before keep the same physical file — NOT
+            # invalidated; a time-travel read of a rewritten file re-decodes)
+            from ..utils.cache import invalidate_data_file
+
+            for f in flat_before:
+                invalidate_data_file(f.file_name)
         if not result.is_empty():
             self.levels.update(result.before, result.after)
         return result

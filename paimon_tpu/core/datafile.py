@@ -267,6 +267,7 @@ class KeyValueFileReaderFactory:
         schemas_by_id: dict[int, RowType],
         file_format: str = "parquet",
         keyed: bool = True,
+        cache=None,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
@@ -274,6 +275,14 @@ class KeyValueFileReaderFactory:
         self.schemas_by_id = schemas_by_id
         self.format_id = file_format
         self.keyed = keyed
+        # utils.cache data-file cache: data files are immutable, so fully
+        # decoded (schema-evolved, cast) KVBatches are cached keyed by
+        # (file, projection, system-columns mode, read-field signature).
+        # Only predicate-FREE reads participate — predicate pushdown skips
+        # row groups, changing the row set per predicate. Cached batches are
+        # shared: callers must never mutate column arrays in place (the read
+        # path is copy-on-filter throughout).
+        self.cache = cache
 
     def read(
         self,
@@ -293,10 +302,31 @@ class KeyValueFileReaderFactory:
         uses it when run stability replaces sequence comparison, skipping
         the most expensive system column (random int64, ~uncompressible);
         False decodes neither (caller holds them from the key pass)."""
-        data_schema = self.schemas_by_id[meta.schema_id]
-        disk_schema = kv_disk_schema(data_schema) if self.keyed else data_schema
         if not self.keyed:
             system_columns = False
+        if predicate is None and self.cache is not None and self.cache.enabled:
+            read_names = self.read_schema.field_names if fields is None else list(fields)
+            # the read-field signature pins projection AND schema evolution:
+            # the same file re-read after an ALTER maps/casts differently
+            sig = tuple((f.id, f.name, repr(f.type)) for f in (self.read_schema.field(n) for n in read_names))
+            key = ("data", self.bucket_dir, meta.file_name, system_columns, sig, fields is None)
+            return self.cache.get_or_load(
+                key,
+                lambda: self._decode(meta, None, fields, system_columns),
+                lambda kv: kv.byte_size(),
+                file_id=meta.file_name,
+            )
+        return self._decode(meta, predicate, fields, system_columns)
+
+    def _decode(
+        self,
+        meta: DataFileMeta,
+        predicate: Predicate | None,
+        fields: Sequence[str] | None,
+        system_columns: bool | str,
+    ) -> KVBatch:
+        data_schema = self.schemas_by_id[meta.schema_id]
+        disk_schema = kv_disk_schema(data_schema) if self.keyed else data_schema
         read_fields = (
             self.read_schema.fields
             if fields is None

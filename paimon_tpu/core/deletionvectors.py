@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from typing import Iterable, Mapping
 
 import numpy as np
-import zstandard
+from ..utils.compression import zstd_compress, zstd_decompress
 
 from ..fs import FileIO
 from ..utils import new_file_name
@@ -60,11 +60,11 @@ class DeletionVector:
         return mask
 
     def to_bytes(self) -> bytes:
-        return zstandard.ZstdCompressor(level=3).compress(self.positions.tobytes())
+        return zstd_compress(self.positions.tobytes())
 
     @staticmethod
     def from_bytes(data: bytes) -> "DeletionVector":
-        raw = zstandard.ZstdDecompressor().decompress(data)
+        raw = zstd_decompress(data)
         return DeletionVector(np.frombuffer(raw, dtype=np.uint32).copy())
 
 

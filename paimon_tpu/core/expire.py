@@ -33,9 +33,15 @@ class SnapshotExpire:
         self.file_io = file_io
         self.table_path = table_path
         self.options = options or CoreOptions()
-        self.snapshot_manager = SnapshotManager(file_io, table_path)
-        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
-        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
+        # reads go through the shared manifest cache (scan populated most of
+        # these already); deletions below invalidate via the global helpers
+        # so every cached variant dies with the file, whoever cached it
+        from ..utils.cache import table_caches
+
+        cache, _ = table_caches(self.options)
+        self.snapshot_manager = SnapshotManager(file_io, table_path, cache=cache)
+        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest", cache=cache)
+        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest", cache=cache)
         self.protected_ids = protected_ids or (lambda: ())
 
     def _changelog_decoupled(self) -> bool:
@@ -127,6 +133,7 @@ class SnapshotExpire:
                     dead_manifests.add(lst)
 
         from ..utils import partition_path
+        from ..utils.cache import invalidate_data_file, invalidate_manifest_path, invalidate_snapshot
 
         touched_dirs: set[str] = set()
         for (partition, bucket, file_name), extra in dead_files:
@@ -135,12 +142,15 @@ class SnapshotExpire:
             pp = self._bucket_dir(partition, bucket)
             touched_dirs.add(pp)
             self.file_io.delete(f"{pp}/{file_name}")
+            invalidate_data_file(file_name)
             for x in extra:
                 self.file_io.delete(f"{pp}/{x}")
         for name in dead_manifests:
             self.file_io.delete(f"{self.table_path}/manifest/{name}")
+            invalidate_manifest_path(f"{self.table_path}/manifest/{name}")
         for sid in expire_ids:
             self.file_io.delete(sm.snapshot_path(sid))
+            invalidate_snapshot(self.table_path, sid)
         # the hint must point at the smallest SURVIVING snapshot: protected
         # (tag/consumer) snapshots inside the expired range stay on disk, and
         # walks that trust the hint (earliest_snapshot_id, user scans) would
@@ -190,6 +200,8 @@ class SnapshotExpire:
                     expire.append(cid)
                 else:
                     break
+        from ..utils.cache import invalidate_data_file, invalidate_manifest_path
+
         n = 0
         for cid in expire:
             if cid in protected:
@@ -200,10 +212,13 @@ class SnapshotExpire:
                     for e in self.manifest_file.read(meta.file_name):
                         d = self._bucket_dir(e.partition, e.bucket)
                         self.file_io.delete(f"{d}/{e.file.file_name}")
+                        invalidate_data_file(e.file.file_name)
                         for x in e.file.extra_files:
                             self.file_io.delete(f"{d}/{x}")
                     self.manifest_file.delete(meta.file_name)
+                    invalidate_manifest_path(f"{self.table_path}/manifest/{meta.file_name}")
                 self.manifest_list.delete(snap.changelog_manifest_list)
+                invalidate_manifest_path(f"{self.table_path}/manifest/{snap.changelog_manifest_list}")
             self.file_io.delete(sm.changelog_path(cid))
             n += 1
         return n

@@ -13,13 +13,15 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Iterable, Sequence
-
-import zstandard
+from typing import TYPE_CHECKING, Iterable, Sequence
 
 from ..fs import FileIO
 from ..utils import dumps, loads, new_file_name
+from ..utils.compression import ZSTD_MAGIC, zstd_compress, zstd_decompress
 from .datafile import DataFileMeta
+
+if TYPE_CHECKING:
+    from ..utils.cache import ByteBudgetLRU
 
 __all__ = [
     "FileKind",
@@ -97,9 +99,13 @@ class _JsonlZst:
     bytes, so mixed-format histories (option flipped mid-life, or a table
     laid out by the reference) read transparently."""
 
-    def __init__(self, file_io: FileIO, directory: str):
+    def __init__(self, file_io: FileIO, directory: str, cache: "ByteBudgetLRU | None" = None):
         self.file_io = file_io
         self.directory = directory
+        # decoded-object cache (utils.cache manifest cache): manifest files
+        # are immutable once written, so decoded entry lists are cached
+        # process-wide keyed by full path. None = this accessor bypasses it.
+        self.cache = cache
         self._table_cfg = None  # lazy (format, resolver, compression)
 
     def _config(self):
@@ -138,7 +144,7 @@ class _JsonlZst:
     def _write_lines(self, name: str, dicts: Iterable[dict]) -> int:
         raw = "\n".join(dumps(d) for d in dicts).encode()
         _, _, compression = self._config()
-        data = raw if compression == "none" else zstandard.ZstdCompressor(level=3).compress(raw)
+        data = raw if compression == "none" else zstd_compress(raw, level=3)
         path = f"{self.directory}/{name}"
         self.file_io.write_bytes(path, data)
         return len(data)
@@ -148,14 +154,33 @@ class _JsonlZst:
 
     def _read_lines_from(self, data: bytes) -> list[dict]:
         # sniff: zstd magic, else plain jsonl (manifest.compression=none)
-        if data[:4] == b"\x28\xb5\x2f\xfd":
-            raw = zstandard.ZstdDecompressor().decompress(data)
+        if data[:4] == ZSTD_MAGIC:
+            raw = zstd_decompress(data)
         else:
             raw = data
         return [loads(line) for line in raw.decode().splitlines() if line]
 
+    def _cached_read(self, kind: str, name: str, decode):
+        """Decode-once manifest reads: cache stores an immutable tuple keyed
+        by (kind, full path); callers get a fresh list so accidental caller
+        mutation can never poison the cache."""
+        if self.cache is None or not self.cache.enabled:
+            return decode()
+        path = f"{self.directory}/{name}"
+        key = (kind, path)
+        cached = self.cache.get(key)
+        if cached is not None:
+            return list(cached)
+        out = decode()
+        # weight ≈ decoded footprint: entries dominate; dicts/dataclasses of
+        # a manifest entry run a few hundred bytes each
+        self.cache.put(key, tuple(out), weight=max(len(out) * 512, 256), file_id=path)
+        return list(out)
+
     def delete(self, name: str) -> None:
         self.file_io.delete(f"{self.directory}/{name}")
+        if self.cache is not None:
+            self.cache.invalidate_file(f"{self.directory}/{name}")
 
 
 class ManifestFile(_JsonlZst):
@@ -176,6 +201,9 @@ class ManifestFile(_JsonlZst):
         return ManifestFileMeta(name, size, added, len(entries) - added, schema_id)
 
     def read(self, name: str) -> list[ManifestEntry]:
+        return self._cached_read("manifest", name, lambda: self._decode(name))
+
+    def _decode(self, name: str) -> list[ManifestEntry]:
         data = self._read_raw(name)
         if data[:4] == _AVRO_MAGIC:
             from ..interop.manifest_codec import read_entries_avro
@@ -205,6 +233,9 @@ class ManifestList(_JsonlZst):
         return name
 
     def read(self, name: str) -> list[ManifestFileMeta]:
+        return self._cached_read("manifest-list", name, lambda: self._decode(name))
+
+    def _decode(self, name: str) -> list[ManifestFileMeta]:
         data = self._read_raw(name)
         if data[:4] == _AVRO_MAGIC:
             from ..interop.manifest_codec import read_metas_avro

@@ -49,14 +49,24 @@ class ScanPlan:
 
 
 class FileStoreScan:
-    def __init__(self, file_io: FileIO, table_path: str, key_names: Sequence[str], manifest_parallelism: int | None = None):
+    def __init__(
+        self,
+        file_io: FileIO,
+        table_path: str,
+        key_names: Sequence[str],
+        manifest_parallelism: int | None = None,
+        cache=None,
+    ):
         self.file_io = file_io
         self.table_path = table_path
         self.key_names = list(key_names)
         self.manifest_parallelism = manifest_parallelism
-        self.snapshot_manager = SnapshotManager(file_io, table_path)
-        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest")
-        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest")
+        # manifest object cache (utils.cache): repeated plan() calls and
+        # streaming follow-ups stop re-fetching + re-decoding the snapshot,
+        # manifest lists, and manifest files of unchanged history
+        self.snapshot_manager = SnapshotManager(file_io, table_path, cache=cache)
+        self.manifest_file = ManifestFile(file_io, f"{table_path}/manifest", cache=cache)
+        self.manifest_list = ManifestList(file_io, f"{table_path}/manifest", cache=cache)
         self._snapshot_id: int | None = None
         self._kind = "all"  # all | delta | changelog
         self._partition_filter: Callable[[tuple], bool] | None = None
@@ -108,12 +118,13 @@ class FileStoreScan:
 
     def _read_manifests(self, metas) -> list:
         """Manifest files decode independently: scan.manifest.parallelism
-        threads them (reference ScanParallelExecutor), order preserved."""
+        threads them over the process-wide shared pool (reference
+        ScanParallelExecutor; a pool per plan() would pay thread spawn/join
+        on every small scan), order preserved."""
         if self.manifest_parallelism and self.manifest_parallelism > 1 and len(metas) > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            from ..utils import shared_executor
 
-            with ThreadPoolExecutor(max_workers=self.manifest_parallelism) as ex:
-                return list(ex.map(lambda m: self.manifest_file.read(m.file_name), metas))
+            return list(shared_executor().map(lambda m: self.manifest_file.read(m.file_name), metas))
         return [self.manifest_file.read(m.file_name) for m in metas]
 
     def _plan(self) -> ScanPlan:

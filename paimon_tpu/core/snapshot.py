@@ -95,10 +95,14 @@ class SnapshotManager:
     LATEST = "LATEST"
     EARLIEST = "EARLIEST"
 
-    def __init__(self, file_io: FileIO, table_path: str):
+    def __init__(self, file_io: FileIO, table_path: str, cache=None):
         self.file_io = file_io
         self.table_path = table_path
         self.snapshot_dir = f"{table_path}/snapshot"
+        # utils.cache manifest cache: snapshot files are immutable per id
+        # until deleted (expire invalidates; rollback invalidates before the
+        # id can be re-minted with different content)
+        self.cache = cache
 
     def snapshot_path(self, snapshot_id: int) -> str:
         return f"{self.snapshot_dir}/snapshot-{snapshot_id}"
@@ -108,12 +112,26 @@ class SnapshotManager:
         the snapshot itself already expired (reference
         SnapshotManager.tryGetChangelog): streaming consumers resuming from
         an old position keep reading changelog history."""
+        if self.cache is not None and self.cache.enabled:
+            key = ("snapshot", self.table_path, snapshot_id)
+            cached = self.cache.get(key)
+            if cached is not None:
+                return cached
         try:
-            return Snapshot.from_json(self.file_io.read_bytes(self.snapshot_path(snapshot_id)))
+            raw = self.file_io.read_bytes(self.snapshot_path(snapshot_id))
         except FileNotFoundError:
             if self.changelog_exists(snapshot_id):
                 return self.changelog(snapshot_id)
             raise
+        snap = Snapshot.from_json(raw)
+        if self.cache is not None and self.cache.enabled:
+            self.cache.put(
+                ("snapshot", self.table_path, snapshot_id),
+                snap,
+                weight=len(raw) * 2,
+                file_id=self.snapshot_path(snapshot_id),
+            )
+        return snap
 
     def snapshot_exists(self, snapshot_id: int) -> bool:
         return self.file_io.exists(self.snapshot_path(snapshot_id))
@@ -162,6 +180,23 @@ class SnapshotManager:
         return sorted(out)
 
     def latest_snapshot_id(self) -> int | None:
+        # latest-pointer cache: a cached id L is still the latest iff
+        # snapshot-L exists and snapshot-(L+1) does not (ids are contiguous
+        # and monotonic), so validation is two stat calls instead of
+        # hint-read + forward walk + listing fallback. Self-correcting under
+        # concurrent commits (L+1 appears -> probe fails -> full resolve)
+        # and rollback (L vanishes -> probe fails).
+        cache_key = ("latest", self.table_path)
+        if self.cache is not None and self.cache.enabled:
+            cached = self.cache.get(cache_key)
+            if cached is not None and self.snapshot_exists(cached) and not self.snapshot_exists(cached + 1):
+                return cached
+        latest = self._resolve_latest_id()
+        if latest is not None and self.cache is not None and self.cache.enabled:
+            self.cache.put(cache_key, latest, weight=64)
+        return latest
+
+    def _resolve_latest_id(self) -> int | None:
         hint = self._hint(self.LATEST)
         if hint is not None:
             # the hint may lag; walk forward (reference SnapshotManager)
@@ -194,6 +229,10 @@ class SnapshotManager:
     # ---- hints ---------------------------------------------------------
     def commit_latest_hint(self, snapshot_id: int) -> None:
         self.file_io.try_overwrite(f"{self.snapshot_dir}/{self.LATEST}", str(snapshot_id).encode())
+        if self.cache is not None and self.cache.enabled:
+            # seed the latest-pointer cache; a stale seed (concurrent commit
+            # raced ahead) fails the exists(L+1) validation and re-resolves
+            self.cache.put(("latest", self.table_path), snapshot_id, weight=64)
 
     def commit_earliest_hint(self, snapshot_id: int) -> None:
         self.file_io.try_overwrite(f"{self.snapshot_dir}/{self.EARLIEST}", str(snapshot_id).encode())

@@ -58,7 +58,13 @@ class KeyValueFileStore:
         self.key_names = schema.trimmed_primary_keys
         self.partition_keys = list(schema.partition_keys)
         self.schema_manager = SchemaManager(file_io, table_path)
-        self.snapshot_manager = SnapshotManager(file_io, table_path)
+        # byte-budget caches (utils.cache): process-wide, shared by scan /
+        # read / commit / compaction / lookup through this store's accessors;
+        # None when the table opted out via a 0 budget
+        from ..utils.cache import table_caches
+
+        self.manifest_obj_cache, self.data_file_obj_cache = table_caches(self.options)
+        self.snapshot_manager = SnapshotManager(file_io, table_path, cache=self.manifest_obj_cache)
         self._schemas_cache: dict[int, RowType] = {}
 
     # ---- layout --------------------------------------------------------
@@ -129,6 +135,7 @@ class KeyValueFileStore:
             self.schemas_by_id(),
             file_format=self.options.file_format,
             keyed=self.keyed,
+            cache=self.data_file_obj_cache,
         )
 
     def new_scan(self) -> FileStoreScan:
@@ -137,11 +144,17 @@ class KeyValueFileStore:
             self.table_path,
             self.key_names,
             manifest_parallelism=self.options.options.get(CoreOptions.SCAN_MANIFEST_PARALLELISM),
+            cache=self.manifest_obj_cache,
         )
 
     def new_commit(self) -> FileStoreCommit:
         return FileStoreCommit(
-            self.file_io, self.table_path, self.commit_user, self.schema.id, self.options
+            self.file_io,
+            self.table_path,
+            self.commit_user,
+            self.schema.id,
+            self.options,
+            cache=self.manifest_obj_cache,
         )
 
     def new_expire(self, protected_ids=None) -> SnapshotExpire:

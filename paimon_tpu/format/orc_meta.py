@@ -109,9 +109,9 @@ def _decompress_stream(raw: bytes, kind: str) -> bytes:
         elif kind == "zlib":
             out += zlib.decompress(chunk, -15)  # raw deflate
         elif kind == "zstd":
-            import zstandard
+            from ..utils.compression import zstd_decompress
 
-            out += zstandard.ZstdDecompressor().decompress(chunk, max_output_size=1 << 26)
+            out += zstd_decompress(chunk)
         elif kind == "lz4":
             import pyarrow as pa
 

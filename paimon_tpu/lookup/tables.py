@@ -8,6 +8,12 @@ projection, kept as an index into the primary map), NoPrimaryKeyLookupTable
 and refreshes by snapshot follow-up; here the local store is host dicts over
 ColumnBatches and refresh() drains the same streaming scan the changelog
 consumers use (+I/+U apply, -U/-D retract).
+
+Caching: bootstrap and refresh reads go through the store's reader factory,
+so decoded data files land in (and are served from) the process-wide
+data-file cache (utils.cache) — a lookup table bootstrapping next to a query
+workload, or several lookup tables over one physical table, decode each
+immutable file once. Snapshot expiry invalidates through the same subsystem.
 """
 
 from __future__ import annotations

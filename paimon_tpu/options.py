@@ -628,6 +628,19 @@ class CoreOptions:
         "deletion-vector.index-file.target-size", "2 mb",
         "Roll the packed deletion-vector container at this size.",
     )
+    CACHE_MANIFEST_MAX_MEMORY = ConfigOption.memory(
+        "cache.manifest.max-memory-size",
+        "256 mb",
+        "Byte budget of the process-wide decoded manifest/metadata object "
+        "cache (manifest entry lists, manifest-list metas, snapshots, the "
+        "latest-snapshot pointer). '0 b' opts this table out.",
+    )
+    CACHE_DATA_FILE_MAX_MEMORY = ConfigOption.memory(
+        "cache.data-file.max-memory-size",
+        "128 mb",
+        "Byte budget of the process-wide decoded data-file (KVBatch) cache "
+        "over predicate-free reader_factory reads. '0 b' opts this table out.",
+    )
     LOOKUP_CACHE_MAX_MEMORY_SIZE = ConfigOption.memory(
         "lookup.cache-max-memory-size", "256 mb", "Lookup in-memory cache byte budget."
     )

@@ -87,6 +87,10 @@ class BranchManager:
 
     def delete(self, name: str) -> None:
         self.file_io.delete(self.branch_path(name), recursive=True)
+        # a recreated branch of the same name re-mints snapshot ids
+        from ..utils.cache import invalidate_table_path
+
+        invalidate_table_path(self.branch_path(name))
 
     def created_from(self, name: str) -> int | None:
         try:

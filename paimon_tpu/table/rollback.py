@@ -66,13 +66,26 @@ def rollback_to(table: "FileStoreTable", target: "int | str") -> None:
             drop_files -= files
             drop_manifests -= manifests
 
+    from ..utils.cache import (
+        invalidate_data_file,
+        invalidate_latest_pointer,
+        invalidate_manifest_path,
+        invalidate_snapshot,
+    )
+
     for partition, bucket, name, extra in drop_files:
         bucket_dir = table.store.bucket_dir(partition, bucket)
         file_io.delete(f"{bucket_dir}/{name}")
+        invalidate_data_file(name)
         for x in extra:
             file_io.delete(f"{bucket_dir}/{x}")
     for name in drop_manifests:
         file_io.delete(f"{table.path}/manifest/{name}")
+        invalidate_manifest_path(f"{table.path}/manifest/{name}")
     for sid in range(target_id + 1, latest + 1):
         file_io.delete(sm.snapshot_path(sid))
+        # critical: future commits re-mint these ids with different content —
+        # a stale cached snapshot would resurrect the rolled-back history
+        invalidate_snapshot(table.path, sid)
+    invalidate_latest_pointer(table.path)
     sm.commit_latest_hint(target_id)

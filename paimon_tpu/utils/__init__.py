@@ -1,13 +1,70 @@
-"""Small shared utilities (naming, paths, json)."""
+"""Small shared utilities (naming, paths, json, shared decode pool)."""
 
 from __future__ import annotations
 
 import json
+import threading
 import time
 import uuid
 from typing import Any, Sequence
 
-__all__ = ["new_file_name", "partition_path", "now_millis", "dumps", "loads", "enable_compile_cache"]
+__all__ = [
+    "new_file_name",
+    "partition_path",
+    "now_millis",
+    "dumps",
+    "loads",
+    "enable_compile_cache",
+    "shared_executor",
+]
+
+
+_SHARED_POOL = None
+_SHARED_POOL_LOCK = threading.Lock()
+
+
+def _reset_shared_pool_after_fork() -> None:
+    # a forked child inherits the pool OBJECT but none of its worker
+    # threads — submitting to it would block forever. Drop it (and the lock,
+    # which another thread may have held at fork time); the child lazily
+    # builds its own.
+    global _SHARED_POOL, _SHARED_POOL_LOCK
+    _SHARED_POOL = None
+    _SHARED_POOL_LOCK = threading.Lock()
+
+
+import os as _os  # noqa: E402
+
+if hasattr(_os, "register_at_fork"):
+    _os.register_at_fork(after_in_child=_reset_shared_pool_after_fork)
+
+
+def shared_executor():
+    """The process-wide decode thread pool (lazily created, never torn down
+    mid-run). Manifest and data-file decodes release the GIL in pyarrow/zstd,
+    so threads give real parallelism — but constructing a ThreadPoolExecutor
+    per call costs thread spawn/join on every small read. One shared pool
+    amortizes that. Tasks submitted here must never themselves submit to this
+    pool (deadlock under a full queue); both call sites (scan manifest reads,
+    read-path file decodes) are leaf work. Fork-safe: see
+    _reset_shared_pool_after_fork.
+
+    Sizing: PAIMON_TPU_SHARED_POOL_WORKERS env overrides; default covers the
+    common 8-way decode fan-out even on small hosts."""
+    global _SHARED_POOL
+    if _SHARED_POOL is None:
+        with _SHARED_POOL_LOCK:
+            if _SHARED_POOL is None:
+                import os
+                from concurrent.futures import ThreadPoolExecutor
+
+                workers = int(os.environ.get("PAIMON_TPU_SHARED_POOL_WORKERS", "0"))
+                if workers <= 0:
+                    workers = min(16, max(8, (os.cpu_count() or 4) + 4))
+                _SHARED_POOL = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="paimon-decode"
+                )
+    return _SHARED_POOL
 
 
 def _host_fingerprint() -> str:

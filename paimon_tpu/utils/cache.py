@@ -1,0 +1,346 @@
+"""Byte-budget caching subsystem: process-wide size-aware LRU caches for
+immutable decoded objects.
+
+Parity: /root/reference/paimon-common/.../memory/MemoryPoolFactory +
+paimon-core/.../utils/ObjectsCache / SegmentsCache — upstream Paimon treats
+manifest caching as a first-class perf feature: manifest files, manifest
+lists, and snapshots are immutable once written, so their decoded forms are
+cached process-wide and keyed by file name. This module grows the same idea
+two ways:
+
+  * the **manifest cache** holds decoded metadata objects — ManifestEntry
+    lists, ManifestFileMeta lists, parsed Snapshots, and the validated
+    latest-snapshot pointer — weighted by their decoded (uncompressed) byte
+    size;
+  * the **data-file cache** holds decoded KVBatch/ColumnBatch results of
+    `KeyValueFileReaderFactory.read`, keyed by (file name, projection,
+    system-columns mode, read-schema signature) and weighted by
+    `KVBatch.byte_size()`.
+
+Both caches are module-level singletons (file names embed uuid4, so keys are
+globally unique across tables and processes can share one budget), budgeted
+through table options `cache.manifest.max-memory-size` /
+`cache.data-file.max-memory-size` ('0 b' opts a table out entirely), and
+observable through the metrics registry as group "cache" tagged by cache
+name: counters hits/misses/evictions/invalidations, gauges bytes/entries.
+
+Invalidation contract: cached values are treated as immutable by every
+client (readers copy-on-filter, never mutate in place). Physical deletions —
+snapshot expiry, changelog expiry, rollback, compaction dropping files from
+the LSM view — call the invalidate_* helpers below so the budget tracks the
+live working set and deleted snapshots stop resolving.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import TYPE_CHECKING, Any, Callable
+
+if TYPE_CHECKING:
+    from ..options import CoreOptions
+
+__all__ = [
+    "ByteBudgetLRU",
+    "manifest_cache",
+    "data_file_cache",
+    "table_caches",
+    "configure",
+    "clear_all",
+    "invalidate_data_file",
+    "invalidate_manifest_path",
+    "invalidate_snapshot",
+    "invalidate_latest_pointer",
+]
+
+# process-wide defaults, overridable per table via options (the most recent
+# explicitly-configured table wins — budgets are process-global, like the
+# reference CacheManager created from catalog options)
+DEFAULT_MANIFEST_BUDGET = 256 << 20
+DEFAULT_DATA_FILE_BUDGET = 128 << 20
+
+
+class ByteBudgetLRU:
+    """Thread-safe size-aware LRU keyed by immutable identity.
+
+    Entries carry an explicit byte weight; inserts evict from the cold end
+    until the total fits `max_bytes`. A value heavier than the whole budget
+    is simply not cached (loader result is still returned). An optional
+    per-entry `file_id` feeds a secondary index so every projection/variant
+    of one physical file can be dropped with a single `invalidate_file`.
+    """
+
+    def __init__(self, name: str, max_bytes: int):
+        self.name = name
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[Any, tuple[Any, int, str | None]]" = OrderedDict()
+        self._by_file: dict[str, set] = {}
+        self._bytes = 0
+        self._metrics()
+
+    def _metrics(self):
+        """The cache's metric group, resolved per call: registry.reset()
+        (tests) replaces the group, and counters bound at construction would
+        keep counting into orphaned objects."""
+        from ..metrics import registry
+
+        g = registry.group("cache", cache=self.name)
+        if "bytes" not in g.metrics:
+            g.gauge("bytes", lambda: self._bytes)
+            g.gauge("entries", lambda: len(self._entries))
+            g.gauge("max_bytes", lambda: self.max_bytes)
+        return g
+
+    # ---- core ops ------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def contains_file(self, file_id: str) -> bool:
+        with self._lock:
+            return file_id in self._by_file
+
+    def get(self, key):
+        """The cached value, or None on miss (values are never None)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self._metrics().counter("misses").inc()
+                return None
+            self._entries.move_to_end(key)
+            self._metrics().counter("hits").inc()
+            return entry[0]
+
+    def put(self, key, value, weight: int, file_id: str | None = None) -> None:
+        if not self.enabled or value is None:
+            return
+        weight = max(int(weight), 64)  # floor: key + bookkeeping overhead
+        if weight > self.max_bytes:
+            return  # oversized value would evict the whole working set
+        with self._lock:
+            if key in self._entries:
+                self._drop(key)
+            self._entries[key] = (value, weight, file_id)
+            self._bytes += weight
+            if file_id is not None:
+                self._by_file.setdefault(file_id, set()).add(key)
+            while self._bytes > self.max_bytes and self._entries:
+                cold_key, (_, w, fid) = self._entries.popitem(last=False)
+                self._bytes -= w
+                if fid is not None:
+                    keys = self._by_file.get(fid)
+                    if keys is not None:
+                        keys.discard(cold_key)
+                        if not keys:
+                            del self._by_file[fid]
+                self._metrics().counter("evictions").inc()
+
+    def get_or_load(
+        self,
+        key,
+        loader: Callable[[], Any],
+        weigher: Callable[[Any], int],
+        file_id: str | None = None,
+    ):
+        """Cached value or `loader()` (run OUTSIDE the lock — concurrent
+        misses may load twice; last writer wins, both results identical
+        because the underlying file is immutable)."""
+        if not self.enabled:
+            return loader()
+        value = self.get(key)
+        if value is not None:
+            return value
+        value = loader()
+        self.put(key, value, weigher(value), file_id)
+        return value
+
+    # ---- invalidation --------------------------------------------------
+    def _drop(self, key) -> None:
+        value, weight, file_id = self._entries.pop(key)
+        self._bytes -= weight
+        if file_id is not None:
+            keys = self._by_file.get(file_id)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_file[file_id]
+
+    def invalidate(self, key) -> bool:
+        with self._lock:
+            if key not in self._entries:
+                return False
+            self._drop(key)
+            self._metrics().counter("invalidations").inc()
+            return True
+
+    def invalidate_file(self, file_id: str) -> int:
+        """Drop every entry derived from one physical file."""
+        with self._lock:
+            keys = self._by_file.pop(file_id, None)
+            if not keys:
+                return 0
+            n = 0
+            for key in list(keys):
+                if key in self._entries:
+                    value, weight, _ = self._entries.pop(key)
+                    self._bytes -= weight
+                    self._metrics().counter("invalidations").inc()
+                    n += 1
+            return n
+
+    def invalidate_prefix(self, path_prefix: str) -> int:
+        """Drop every entry whose file_id lives under `path_prefix` — the
+        recursive-delete hook (drop table, delete branch): file names under
+        the deleted tree can be re-minted with different content."""
+        with self._lock:
+            victims = [fid for fid in self._by_file if fid.startswith(path_prefix)]
+        n = 0
+        for fid in victims:
+            n += self.invalidate_file(fid)
+        return n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_file.clear()
+            self._bytes = 0
+
+    def set_budget(self, max_bytes: int) -> None:
+        with self._lock:
+            self.max_bytes = int(max_bytes)
+            while self._bytes > self.max_bytes and self._entries:
+                cold_key, (_, w, fid) = self._entries.popitem(last=False)
+                self._bytes -= w
+                if fid is not None:
+                    keys = self._by_file.get(fid)
+                    if keys is not None:
+                        keys.discard(cold_key)
+                        if not keys:
+                            del self._by_file[fid]
+                self._metrics().counter("evictions").inc()
+
+
+# ---------------------------------------------------------------------------
+# process-wide instances
+# ---------------------------------------------------------------------------
+
+_caches: dict[str, ByteBudgetLRU] = {}
+_caches_lock = threading.Lock()
+
+
+def _reset_after_fork() -> None:
+    # a forked child inherits cache RLocks that another thread may have held
+    # at fork time (dead-thread locks never release), and a fork mid-put can
+    # leave entries/bytes torn. Re-arm the locks IN PLACE (pre-fork store
+    # objects keep their references) and start the child cold.
+    global _caches_lock
+    _caches_lock = threading.Lock()
+    for c in _caches.values():
+        c._lock = threading.RLock()
+        c._entries.clear()
+        c._by_file.clear()
+        c._bytes = 0
+
+
+import os as _os  # noqa: E402
+
+if hasattr(_os, "register_at_fork"):
+    _os.register_at_fork(after_in_child=_reset_after_fork)
+
+
+def _get(name: str, default_budget: int) -> ByteBudgetLRU:
+    cache = _caches.get(name)
+    if cache is None:
+        with _caches_lock:
+            cache = _caches.get(name)
+            if cache is None:
+                cache = ByteBudgetLRU(name, default_budget)
+                _caches[name] = cache
+    return cache
+
+
+def manifest_cache() -> ByteBudgetLRU:
+    """Decoded metadata objects: manifest entry lists, manifest-list metas,
+    parsed snapshots, the validated latest-snapshot pointer."""
+    return _get("manifest", DEFAULT_MANIFEST_BUDGET)
+
+
+def data_file_cache() -> ByteBudgetLRU:
+    """Decoded KVBatch results of reader_factory.read (predicate-free reads
+    only — predicate pushdown changes the row set)."""
+    return _get("data-file", DEFAULT_DATA_FILE_BUDGET)
+
+
+def configure(manifest_bytes: int | None = None, data_file_bytes: int | None = None) -> None:
+    if manifest_bytes is not None:
+        manifest_cache().set_budget(manifest_bytes)
+    if data_file_bytes is not None:
+        data_file_cache().set_budget(data_file_bytes)
+
+
+def table_caches(options: "CoreOptions") -> tuple[ByteBudgetLRU | None, ByteBudgetLRU | None]:
+    """(manifest cache, data-file cache) for one table's options — None when
+    the table opted out with a 0 budget. An explicitly-set option resizes the
+    process-wide budget (last writer wins; budgets are global like the
+    reference CacheManager's)."""
+    from ..options import CoreOptions
+
+    m_opt, d_opt = CoreOptions.CACHE_MANIFEST_MAX_MEMORY, CoreOptions.CACHE_DATA_FILE_MAX_MEMORY
+    m_budget = int(options.options.get(m_opt))
+    d_budget = int(options.options.get(d_opt))
+    m = manifest_cache() if m_budget > 0 else None
+    d = data_file_cache() if d_budget > 0 else None
+    if m is not None and options.options.contains(m_opt) and m.max_bytes != m_budget:
+        m.set_budget(m_budget)
+    if d is not None and options.options.contains(d_opt) and d.max_bytes != d_budget:
+        d.set_budget(d_budget)
+    return m, d
+
+
+def clear_all() -> None:
+    for cache in list(_caches.values()):
+        cache.clear()
+
+
+# ---- invalidation helpers (called from deletion paths regardless of any
+# single table's enablement — dropping from an empty cache is a no-op) ------
+
+
+def invalidate_data_file(file_name: str) -> None:
+    """A data file left the filesystem (expire/rollback) or the live LSM
+    view (compaction drop): every cached projection of it goes."""
+    data_file_cache().invalidate_file(file_name)
+
+
+def invalidate_manifest_path(path: str) -> None:
+    """`path` is the full manifest/manifest-list/snapshot file path."""
+    manifest_cache().invalidate_file(path)
+
+
+def invalidate_snapshot(table_path: str, snapshot_id: int) -> None:
+    manifest_cache().invalidate_file(f"{table_path}/snapshot/snapshot-{snapshot_id}")
+
+
+def invalidate_latest_pointer(table_path: str) -> None:
+    manifest_cache().invalidate(("latest", table_path))
+
+
+def invalidate_table_path(table_path: str) -> None:
+    """A whole table (or branch) directory was recursively deleted: snapshot
+    ids under it can be re-minted with different content, so every metadata
+    entry below the path goes, plus its latest pointer. Data-file entries are
+    keyed by uuid-unique names and can never be re-minted — left to LRU."""
+    manifest_cache().invalidate_prefix(table_path.rstrip("/") + "/")
+    manifest_cache().invalidate(("latest", table_path))

@@ -1,0 +1,74 @@
+"""zstd codec facade: the real `zstandard` module when installed, else
+pyarrow's bundled zstd (always present — pyarrow is a hard dependency).
+
+The on-disk bytes are identical either way (standard zstd frames, content
+size embedded in the frame header), so files written under one backend read
+under the other. pyarrow's Codec.decompress needs the decompressed size up
+front, which both backends' one-shot compress embed in the frame header —
+`_frame_content_size` parses it (RFC 8878 §3.1.1). Streaming-written frames
+without a content size only occur on foreign files; those need the real
+`zstandard` module and fail with a clear message otherwise.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ZSTD_MAGIC", "zstd_available", "zstd_compress", "zstd_decompress"]
+
+ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+
+try:  # pragma: no cover - depends on environment
+    import zstandard as _zstd
+except ImportError:
+    _zstd = None
+
+
+def zstd_available() -> bool:
+    """True when SOME zstd backend exists (practically always: pyarrow)."""
+    if _zstd is not None:
+        return True
+    import pyarrow as pa
+
+    return pa.Codec.is_available("zstd")
+
+
+def zstd_compress(data: bytes, level: int = 3) -> bytes:
+    if _zstd is not None:
+        return _zstd.ZstdCompressor(level=level).compress(data)
+    import pyarrow as pa
+
+    return pa.Codec("zstd", compression_level=level).compress(data, asbytes=True)
+
+
+def _frame_content_size(data: bytes) -> int | None:
+    """Decompressed size from the zstd frame header, None when absent."""
+    if len(data) < 6 or data[:4] != ZSTD_MAGIC:
+        return None
+    fhd = data[4]
+    fcs_flag = fhd >> 6
+    single_segment = (fhd >> 5) & 1
+    dict_flag = fhd & 3
+    pos = 5 + (0 if single_segment else 1) + (0, 1, 2, 4)[dict_flag]
+    if fcs_flag == 0:
+        if not single_segment:
+            return None
+        return data[pos] if pos < len(data) else None
+    size_bytes = (0, 2, 4, 8)[fcs_flag]
+    field = data[pos : pos + size_bytes]
+    if len(field) < size_bytes:
+        return None
+    value = int.from_bytes(field, "little")
+    return value + 256 if fcs_flag == 1 else value
+
+
+def zstd_decompress(data: bytes) -> bytes:
+    if _zstd is not None:
+        return _zstd.ZstdDecompressor().decompress(data)
+    import pyarrow as pa
+
+    size = _frame_content_size(data)
+    if size is None:
+        raise ValueError(
+            "zstd frame carries no content size (streaming-written?); "
+            "decoding it needs the optional 'zstandard' module"
+        )
+    return pa.Codec("zstd").decompress(data, decompressed_size=size, asbytes=True)

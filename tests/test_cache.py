@@ -1,0 +1,239 @@
+"""Byte-budget cache subsystem (utils.cache): LRU eviction at the byte
+budget, thread safety under concurrent readers, invalidation after snapshot
+expiry / rollback / compaction, and cached-vs-uncached read parity."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.metrics import registry
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+from paimon_tpu.utils.cache import ByteBudgetLRU, data_file_cache, manifest_cache
+
+SCHEMA = RowType.of(("k", BIGINT()), ("s", STRING()), ("v", DOUBLE()))
+
+
+# ---------------------------------------------------------------------------
+# unit: the LRU itself
+# ---------------------------------------------------------------------------
+
+
+def test_lru_evicts_at_byte_budget():
+    c = ByteBudgetLRU("t-evict", 1000)
+    for i in range(3):
+        c.put(("k", i), f"v{i}", 300)
+    assert len(c) == 3 and c.total_bytes == 900
+    c.get(("k", 0))  # refresh: LRU order is now 1, 2, 0
+    c.put(("k", 3), "v3", 300)
+    assert ("k", 1) not in c, "coldest entry must evict first"
+    assert ("k", 0) in c and ("k", 2) in c and ("k", 3) in c
+    assert c.total_bytes <= 1000
+    stats = registry.group("cache", cache="t-evict")
+    assert stats.counter("evictions").count == 1
+
+
+def test_lru_oversized_value_not_cached():
+    c = ByteBudgetLRU("t-big", 1000)
+    c.put(("small",), "s", 100)
+    c.put(("big",), "b", 5000)  # heavier than the whole budget
+    assert ("big",) not in c and ("small",) in c
+
+
+def test_lru_get_or_load_and_hit_miss_counters():
+    c = ByteBudgetLRU("t-load", 10_000)
+    calls = []
+    v1 = c.get_or_load(("a",), lambda: calls.append(1) or "val", lambda v: 100)
+    v2 = c.get_or_load(("a",), lambda: calls.append(1) or "val", lambda v: 100)
+    assert v1 == v2 == "val" and len(calls) == 1
+    g = registry.group("cache", cache="t-load")
+    assert g.counter("hits").count == 1 and g.counter("misses").count >= 1
+
+
+def test_lru_invalidate_file_drops_every_variant():
+    c = ByteBudgetLRU("t-inval", 10_000)
+    c.put(("proj-a", "f1"), 1, 100, file_id="f1")
+    c.put(("proj-b", "f1"), 2, 100, file_id="f1")
+    c.put(("proj-a", "f2"), 3, 100, file_id="f2")
+    assert c.invalidate_file("f1") == 2
+    assert ("proj-a", "f1") not in c and ("proj-b", "f1") not in c
+    assert ("proj-a", "f2") in c and c.total_bytes == 100
+
+
+def test_lru_set_budget_shrinks():
+    c = ByteBudgetLRU("t-shrink", 10_000)
+    for i in range(10):
+        c.put(i, i, 1000)
+    c.set_budget(2500)
+    assert c.total_bytes <= 2500 and len(c) == 2
+    assert 9 in c and 8 in c  # hottest survive
+
+
+def test_lru_thread_safety_under_concurrent_readers():
+    c = ByteBudgetLRU("t-threads", 40_000)  # forces constant eviction
+    errors = []
+
+    def worker(seed: int):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(400):
+                k = int(rng.integers(0, 50))
+                v = c.get_or_load(("key", k), lambda k=k: ("value", k), lambda v: 2000)
+                if v != ("value", k):
+                    errors.append((k, v))
+                if rng.random() < 0.05:
+                    c.invalidate_file(f"file-{k}")
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert c.total_bytes <= 40_000
+
+
+# ---------------------------------------------------------------------------
+# integration: the two cache clients over a real table
+# ---------------------------------------------------------------------------
+
+
+def _write(table, keys, step, kinds=None, compact=False):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(
+        {
+            "k": list(keys),
+            "s": [f"s{int(k)}-{step}" for k in keys],
+            "v": [float(step) + float(k) / 1000 for k in keys],
+        },
+        kinds=kinds,
+    )
+    if compact:
+        w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _read_dict(table):
+    rb = table.new_read_builder()
+    return {r[0]: r for r in rb.new_read().read_all(rb.new_scan().plan()).to_pylist()}
+
+
+def test_cached_reads_match_uncached(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table(
+        "db.par",
+        SCHEMA,
+        primary_keys=["k"],
+        options={"bucket": "2", "num-sorted-run.compaction-trigger": "3", "target-file-size": "4 kb"},
+    )
+    plain = t.copy(
+        {"cache.manifest.max-memory-size": "0 b", "cache.data-file.max-memory-size": "0 b"}
+    )
+    for step in range(5):
+        _write(t, range(step * 7, step * 7 + 25), step, compact=(step == 3))
+        assert _read_dict(t) == _read_dict(plain), f"cache parity broke at step {step}"
+
+
+def test_second_plan_hits_manifest_cache(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table("db.hits", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    _write(t, range(50), 0)
+    g = registry.group("cache", cache="manifest")
+    rb = t.new_read_builder()
+    plan1 = rb.new_scan().plan()
+    hits_before = g.counter("hits").count
+    plan2 = rb.new_scan().plan()
+    assert g.counter("hits").count > hits_before
+    assert [s.to_dict() for s in plan1] == [s.to_dict() for s in plan2]
+
+
+def test_cached_manifest_lists_are_mutation_proof(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table("db.mut", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    _write(t, range(10), 0)
+    scan = t.store.new_scan()
+    snap = scan.snapshot_manager.latest_snapshot()
+    metas = scan.manifest_list.read(snap.delta_manifest_list)
+    metas.append("junk")  # caller mutation must not poison the cache
+    again = scan.manifest_list.read(snap.delta_manifest_list)
+    assert "junk" not in again and len(again) == len(metas) - 1
+
+
+def test_expire_invalidates_deleted_files(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table(
+        "db.exp",
+        SCHEMA,
+        primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "1",
+            "snapshot.time-retained": "0 ms",
+            # merge manifests every commit so the overwrite's DELETE entries
+            # resolve away and expire can physically delete the dead files
+            "manifest.merge-min-count": "1",
+        },
+    )
+    _write(t, range(30), 0)
+    assert _read_dict(t)  # populate data + manifest caches for snapshot 1
+    old_files = [e.file.file_name for e in t.store.new_scan().plan().entries]
+    assert any(data_file_cache().contains_file(f) for f in old_files)
+    sm = t.store.snapshot_manager
+    assert sm.snapshot(1) is not None  # cached snapshot object
+
+    # overwrite drops the old files logically; the next commit's auto-expire
+    # (retained-max 1, time-retained 0) deletes them physically once the
+    # merged manifests stop referencing them
+    wb = t.new_batch_write_builder().with_overwrite()
+    w = wb.new_write()
+    w.write({"k": [1], "s": ["a"], "v": [1.0]})
+    wb.new_commit().commit(w.prepare_commit())
+    _write(t, [2], 2)
+    bucket_files = set(
+        st.path.rsplit("/", 1)[-1] for st in t.file_io.list_files(f"{t.path}/bucket-0")
+    )
+    assert not (bucket_files & set(old_files)), "precondition: old files physically deleted"
+
+    for f in old_files:
+        assert not data_file_cache().contains_file(f), f"stale cache entry for deleted file {f}"
+    with pytest.raises(FileNotFoundError):
+        sm.snapshot(1)  # cached snapshot must not outlive the file
+    got = _read_dict(t)
+    assert got[1][1] == "a" and 2 in got
+
+
+def test_rollback_invalidates_snapshot_and_latest_pointer(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table("db.rb", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    _write(t, [1], 1)
+    _write(t, [1], 2)
+    assert _read_dict(t)[1][2] == pytest.approx(2.001)  # caches snapshot 2 + latest ptr
+    t.rollback_to(1)
+    _write(t, [1], 3)  # re-mints snapshot id 2 with different content
+    got = _read_dict(t)
+    assert got[1][2] == pytest.approx(3.001), "stale snapshot cache resurrected rolled-back state"
+
+
+def test_compaction_drop_invalidates_rewritten_inputs(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table(
+        "db.cmp", SCHEMA, primary_keys=["k"], options={"bucket": "1", "write-only": "true"}
+    )
+    for step in range(3):
+        _write(t, range(0, 40), step)
+    before = _read_dict(t)
+    input_files = [e.file.file_name for e in t.store.new_scan().plan().entries]
+    assert any(data_file_cache().contains_file(f) for f in input_files)
+    compactor_view = t.copy({"write-only": "false"})
+    wb = compactor_view.new_batch_write_builder()
+    w = wb.new_write()
+    w.compact(full=True)
+    wb.new_commit().commit(w.prepare_commit())
+    for f in input_files:
+        assert not data_file_cache().contains_file(f), f"rewritten input {f} still cached"
+    assert _read_dict(t) == before

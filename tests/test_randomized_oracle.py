@@ -78,6 +78,79 @@ def test_random_ops_match_dict_oracle(tmp_warehouse, seed):
         assert got == history[logical - 1], f"time travel divergence at snapshot {snap.id}"
 
 
+@pytest.mark.parametrize("seed", [3])
+def test_random_ops_cache_parity(tmp_warehouse, seed):
+    """Byte-budget caches must be invisible to semantics: the same randomized
+    churn (upserts, deletes, compactions, snapshot expiry) read through a
+    cache-enabled handle and a cache-disabled handle of ONE physical table
+    must always agree with each other and with the dict oracle — including
+    right after expire/compaction invalidation."""
+    rng = np.random.default_rng(seed)
+    cat = FileSystemCatalog(f"{tmp_warehouse}/cachepar{seed}", commit_user="oracle")
+    t = cat.create_table(
+        "db.cp",
+        SCHEMA,
+        primary_keys=["k"],
+        options={
+            "bucket": "2",
+            "num-sorted-run.compaction-trigger": "3",
+            "target-file-size": "4 kb",
+            "manifest.merge-min-count": "2",
+            "snapshot.num-retained.min": "2",
+            "snapshot.num-retained.max": "4",
+            "snapshot.time-retained": "0 ms",
+            "cache.manifest.max-memory-size": "64 mb",
+            "cache.data-file.max-memory-size": "64 mb",
+        },
+    )
+    # cache-disabled view of the same physical table: ground truth from disk
+    plain = t.copy(
+        {"cache.manifest.max-memory-size": "0 b", "cache.data-file.max-memory-size": "0 b"}
+    )
+    oracle: dict[int, tuple] = {}
+    for step in range(12):
+        n = int(rng.integers(1, 50))
+        keys = rng.integers(0, 100, n)
+        rows = {}
+        for k in keys:
+            rows[int(k)] = (int(k), f"s{int(k)}-{step}", float(step) + float(k) / 1000)
+        deletes = (
+            [int(k) for k in rng.choice(list(oracle), size=min(len(oracle), 4), replace=False)]
+            if oracle and rng.random() < 0.4
+            else []
+        )
+        rows = {k: v for k, v in rows.items() if k not in deletes}
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        if rows:
+            w.write(
+                {
+                    "k": [v[0] for v in rows.values()],
+                    "s": [v[1] for v in rows.values()],
+                    "v": [v[2] for v in rows.values()],
+                }
+            )
+        if deletes:
+            w.write(
+                {"k": deletes, "s": [None] * len(deletes), "v": [None] * len(deletes)},
+                kinds=["-D"] * len(deletes),
+            )
+        if rng.random() < 0.3:
+            w.compact(full=rng.random() < 0.5)
+        wb.new_commit().commit(w.prepare_commit())
+        oracle.update(rows)
+        for k in deletes:
+            oracle.pop(k, None)
+
+        def read_dict(table):
+            rb = table.new_read_builder()
+            return {r[0]: r for r in rb.new_read().read_all(rb.new_scan().plan()).to_pylist()}
+
+        got_cached = read_dict(t)
+        got_plain = read_dict(plain)
+        assert got_cached == got_plain == oracle, f"cache parity divergence at step {step}"
+
+
 def test_random_ops_partitioned_dynamic_bucket(tmp_warehouse):
     """Combined paths: partitions + dynamic buckets + deletes + compactions
     against the dict oracle."""
