@@ -112,6 +112,34 @@ def bench_read(table) -> float:
     return N_ROWS / best
 
 
+def bench_decode(table) -> dict:
+    """One native-decoder pass over the standard merge-read table: the
+    per-stage decode breakdown (pages decoded/skipped, bytes expanded, wall
+    millis) from the decode{} metric group (benchmarks/decode_bench.py is
+    the dedicated per-encoding comparison)."""
+    from paimon_tpu.metrics import decode_metrics
+
+    native = table.copy(
+        {"format.parquet.decoder": "native", "cache.data-file.max-memory-size": "0 b"}
+    )
+    rb = native.new_read_builder()
+    g = decode_metrics()
+    c0 = {k: g.counter(k).count for k in ("pages_decoded", "pages_skipped", "bytes_expanded", "files_fallback")}
+    t0 = time.perf_counter()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    dt = time.perf_counter() - t0
+    assert out.num_rows == N_ROWS, out.num_rows
+    return {
+        "metric": "native decode breakdown (full scan)",
+        "pages_decoded": g.counter("pages_decoded").count - c0["pages_decoded"],
+        "pages_skipped": g.counter("pages_skipped").count - c0["pages_skipped"],
+        "bytes_expanded": g.counter("bytes_expanded").count - c0["bytes_expanded"],
+        "files_fallback": g.counter("files_fallback").count - c0["files_fallback"],
+        "wall_ms": round(dt * 1000, 1),
+        "unit": "counters",
+    }
+
+
 def bench_scan_cache(table) -> float:
     """Cold-vs-warm repeated scan (plan + read_all) through the byte-budget
     caches (benchmarks/scan_cache.py is the dedicated micro-benchmark; this
@@ -142,6 +170,7 @@ def main():
         table = build_table(tmp)
         rows_per_sec = bench_read(table)
         scan_cache_speedup = bench_scan_cache(table)
+        decode_row = bench_decode(table)
         row = {
             "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
             "value": round(rows_per_sec, 1),
@@ -173,6 +202,7 @@ def main():
                 }
             )
         )
+        print(json.dumps(dict(decode_row, platform=_PLATFORM)))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

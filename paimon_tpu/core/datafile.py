@@ -268,6 +268,7 @@ class KeyValueFileReaderFactory:
         file_format: str = "parquet",
         keyed: bool = True,
         cache=None,
+        format_options: dict | None = None,
     ):
         self.file_io = file_io
         self.bucket_dir = bucket_dir
@@ -277,12 +278,17 @@ class KeyValueFileReaderFactory:
         self.keyed = keyed
         # utils.cache data-file cache: data files are immutable, so fully
         # decoded (schema-evolved, cast) KVBatches are cached keyed by
-        # (file, projection, system-columns mode, read-field signature).
-        # Only predicate-FREE reads participate — predicate pushdown skips
-        # row groups, changing the row set per predicate. Cached batches are
-        # shared: callers must never mutate column arrays in place (the read
-        # path is copy-on-filter throughout).
+        # (file, projection, system-columns mode, read-field signature,
+        # decoder identity). Only predicate-FREE reads participate —
+        # predicate pushdown skips row groups/pages, changing the row set
+        # per predicate. Cached batches are shared: callers must never
+        # mutate column arrays in place (the read path is copy-on-filter
+        # throughout).
         self.cache = cache
+        # reader-side format options (format.parquet.decoder etc.), applied
+        # to the format instance via FileFormat.configure per read
+        self.format_options = dict(format_options or {})
+        self.decoder_id = str(self.format_options.get("format.parquet.decoder") or "arrow")
 
     def read(
         self,
@@ -309,7 +315,10 @@ class KeyValueFileReaderFactory:
             # the read-field signature pins projection AND schema evolution:
             # the same file re-read after an ALTER maps/casts differently
             sig = tuple((f.id, f.name, repr(f.type)) for f in (self.read_schema.field(n) for n in read_names))
-            key = ("data", self.bucket_dir, meta.file_name, system_columns, sig, fields is None)
+            # decoder identity is part of the key: a batch decoded by the
+            # arrow backend must never alias one the native backend would
+            # produce (switching format.parquet.decoder stays sound)
+            key = ("data", self.bucket_dir, meta.file_name, system_columns, sig, fields is None, self.decoder_id)
             return self.cache.get_or_load(
                 key,
                 lambda: self._decode(meta, None, fields, system_columns),
@@ -349,7 +358,7 @@ class KeyValueFileReaderFactory:
         # the extension is authoritative: per-level format overrides mean a
         # table legitimately mixes formats across files
         ext = meta.file_name.rsplit(".", 1)[-1]
-        fmt = get_format(ext if "." in meta.file_name else self.format_id)
+        fmt = get_format(ext if "." in meta.file_name else self.format_id).configure(self.format_options)
         path = f"{self.bucket_dir}/{meta.file_name}"
         parts = list(fmt.read(self.file_io, path, disk_schema, projection=wanted_cols, predicate=predicate))
         if parts:

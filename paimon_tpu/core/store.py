@@ -128,14 +128,28 @@ class KeyValueFileStore:
         )
 
     def reader_factory(self, partition: tuple, bucket: int, read_schema: RowType | None = None) -> KeyValueFileReaderFactory:
+        co = self.options
+        # reader-side format options: raw format-scoped keys plus the
+        # decoder selection (format.parquet.decoder = arrow | native); this
+        # one seam routes core/read, compaction rewrites, sort_compact,
+        # lookup and table reads through the chosen decode backend
+        format_options = {
+            k: v
+            for k, v in co.options._data.items()
+            if k.startswith(("format.", "orc.", "parquet.", "avro."))
+        }
+        format_options.setdefault(
+            "format.parquet.decoder", co.options.get(CoreOptions.FORMAT_PARQUET_DECODER)
+        )
         return KeyValueFileReaderFactory(
             self.file_io,
             self.bucket_dir(partition, bucket),
             read_schema or self.value_schema,
             self.schemas_by_id(),
-            file_format=self.options.file_format,
+            file_format=co.file_format,
             keyed=self.keyed,
             cache=self.data_file_obj_cache,
+            format_options=format_options,
         )
 
     def new_scan(self) -> FileStoreScan:

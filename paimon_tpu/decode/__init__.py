@@ -1,0 +1,105 @@
+"""Native vectorized Parquet page-decode subsystem.
+
+Takes Paimon data files from raw bytes to device-ready ColumnBatches
+without pyarrow's decoder on the hot path (SURVEY §7 stage 2; round-5
+verdict: host-side decode is 66% of the pipeline and the one `partial`
+format component). The layers:
+
+  thrift.py    — compact-protocol parser (footer + page headers)
+  container.py — footer model, chunk slicing, page iteration, codecs
+  kernels.py   — vectorized decoders: bit-unpack, RLE/bit-packed hybrid,
+                 PLAIN, DELTA_BINARY_PACKED, dictionary gather, levels →
+                 validity (numpy engine + jittable JAX twins)
+  pages.py     — page → (values, validity) assembly with page skipping
+  pushdown.py  — compressed-domain predicates: chunk stats + dictionary
+                 code sets decide which pages ever expand (LSM-OPD)
+
+Entry point `read_native` mirrors `ParquetFormat.read`'s arrow semantics:
+one ColumnBatch per row group, rows in file order, fixed-width nulls filled
+with zeros, predicate used for skipping only in ways the caller's later
+dense `predicate.eval` makes exact. Files needing features outside the
+native envelope raise UnsupportedParquetFeature and the format falls back
+to the arrow decoder per file (counter decode.files_fallback).
+
+Surfaced behind the FileFormat registry as table option
+`format.parquet.decoder = arrow | native` (default arrow).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..data.batch import Column, ColumnBatch
+from ..data.predicate import Predicate
+from ..fs import FileIO
+from ..metrics import decode_metrics
+from ..types import RowType
+from .container import (
+    UnsupportedParquetFeature,
+    expected_physical_type,
+    parse_footer,
+)
+from .pages import decode_chunk
+from .pushdown import row_group_keep_mask
+
+__all__ = ["read_native", "UnsupportedParquetFeature"]
+
+
+def read_native(
+    file_io: FileIO,
+    path: str,
+    schema: RowType,
+    projection: Sequence[str] | None = None,
+    predicate: Predicate | None = None,
+) -> list[ColumnBatch]:
+    """Decode one parquet file natively: list of ColumnBatches (one per
+    surviving row group) under `schema` projected to `projection`."""
+    metrics = decode_metrics()
+    t0 = time.perf_counter()
+    cols = list(projection) if projection is not None else list(schema.field_names)
+    read_schema = schema.project(cols)
+    data = file_io.read_bytes(path)
+    footer = parse_footer(data)
+    for f in read_schema.fields:
+        if f.name not in footer.column_names:
+            raise UnsupportedParquetFeature(f"column {f.name!r} not in file")
+    # logical-type envelope check up front (nested types never decode
+    # natively); the physical-type check happens lazily in decode_chunk so
+    # all-null chunks — whose physical type arrow picks arbitrarily — pass
+    expected = {f.name: expected_physical_type(f.type) for f in read_schema.fields}
+    out: list[ColumnBatch] = []
+    for rg in footer.row_groups:
+        for f in read_schema.fields:
+            if rg.columns.get(f.name) is None:
+                raise UnsupportedParquetFeature(f"row group missing column {f.name!r}")
+        if rg.num_rows == 0:
+            continue
+        tp = time.perf_counter()
+        keep = row_group_keep_mask(data, footer, rg, predicate, schema, metrics=metrics)
+        metrics.histogram("pushdown_ms").update((time.perf_counter() - tp) * 1000)
+        if keep is False:
+            continue
+        columns: dict[str, Column] = {}
+        for f in read_schema.fields:
+            values, validity = decode_chunk(
+                data,
+                rg.columns[f.name],
+                f.type,
+                rg.num_rows,
+                keep=keep,
+                metrics=metrics,
+                expected_physical=expected[f.name],
+            )
+            if keep is not None:
+                values = values[keep]
+                validity = None if validity is None else validity[keep]
+            if validity is not None and validity.all():
+                validity = None
+            columns[f.name] = Column(values, validity)
+        out.append(ColumnBatch(read_schema, columns))
+    metrics.counter("files_native").inc()
+    metrics.histogram("file_ms").update((time.perf_counter() - t0) * 1000)
+    return out

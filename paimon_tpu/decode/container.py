@@ -1,0 +1,337 @@
+"""Parquet container walk: footer → row groups → column chunks → pages.
+
+Maps the thrift dicts from `decode.thrift` onto light typed views, slices
+raw column-chunk byte ranges out of the file, and iterates (PageHeader,
+payload) pairs. Decompression goes through pyarrow's codec objects (the
+page header carries the exact uncompressed size, so every codec — zstd,
+snappy, gzip, brotli — decompresses one-shot); the *decoding* of the
+decompressed pages is pure kernels (decode.kernels / decode.pages).
+
+Only the container features this repo's writer (and pyarrow generally)
+emits are handled natively; anything else raises UnsupportedParquetFeature
+and the caller falls back to the arrow decoder for that file:
+  * flat schemas (no REPEATED fields, no groups below the root)
+  * physical types BOOLEAN/INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY
+  * data pages v1 and v2, dictionary pages PLAIN/PLAIN_DICTIONARY
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..data.predicate import FieldStats
+from ..types import DataType, TypeRoot
+from .thrift import ThriftError, read_struct
+
+__all__ = [
+    "UnsupportedParquetFeature",
+    "ParquetFooter",
+    "RowGroupInfo",
+    "ColumnChunkInfo",
+    "PageInfo",
+    "parse_footer",
+    "iter_pages",
+    "decompress",
+    "chunk_field_stats",
+    "expected_physical_type",
+]
+
+MAGIC = b"PAR1"
+
+# parquet.thrift Type enum
+T_BOOLEAN, T_INT32, T_INT64, T_INT96, T_FLOAT, T_DOUBLE, T_BYTE_ARRAY, T_FLBA = range(8)
+
+# parquet.thrift CompressionCodec enum
+CODEC_NAMES = {
+    0: None,  # UNCOMPRESSED
+    1: "snappy",
+    2: "gzip",
+    4: "brotli",
+    6: "zstd",
+    7: "lz4_raw",
+}
+
+# parquet.thrift Encoding enum values used below
+ENC_PLAIN = 0
+ENC_PLAIN_DICTIONARY = 2
+ENC_RLE = 3
+ENC_DELTA_BINARY_PACKED = 5
+ENC_RLE_DICTIONARY = 8
+
+# parquet.thrift PageType enum
+PAGE_DATA = 0
+PAGE_INDEX = 1
+PAGE_DICTIONARY = 2
+PAGE_DATA_V2 = 3
+
+
+class UnsupportedParquetFeature(Exception):
+    """This file needs a container/encoding feature outside the native
+    decoder's envelope — the read falls back to the arrow path."""
+
+
+@dataclass(frozen=True)
+class ColumnChunkInfo:
+    name: str
+    physical_type: int
+    codec: int
+    num_values: int
+    max_def: int
+    start_offset: int  # first page (dictionary page when present)
+    total_compressed_size: int
+    has_dictionary: bool
+    encodings: tuple[int, ...]
+    stats: dict | None  # raw thrift Statistics struct ({field_id: value})
+
+
+@dataclass(frozen=True)
+class RowGroupInfo:
+    num_rows: int
+    columns: dict[str, ColumnChunkInfo]
+
+
+@dataclass(frozen=True)
+class ParquetFooter:
+    num_rows: int
+    row_groups: tuple[RowGroupInfo, ...]
+    column_names: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class PageInfo:
+    kind: int  # PAGE_DATA | PAGE_DICTIONARY | PAGE_DATA_V2
+    num_values: int  # rows incl. nulls for data pages; dict size for dict pages
+    encoding: int
+    uncompressed_size: int
+    # v2 only:
+    num_nulls: int = 0
+    def_levels_byte_length: int = 0
+    v2_compressed: bool = True
+    payload: bytes = field(default=b"", repr=False, compare=False)  # raw (compressed) page bytes
+
+
+# ---- footer --------------------------------------------------------------
+
+# FieldRepetitionType
+_REQUIRED, _OPTIONAL, _REPEATED = 0, 1, 2
+
+
+def parse_footer(data) -> ParquetFooter:
+    if len(data) < 12 or bytes(data[:4]) != MAGIC or bytes(data[-4:]) != MAGIC:
+        raise UnsupportedParquetFeature("not a parquet file (bad magic)")
+    meta_len = struct.unpack_from("<I", data, len(data) - 8)[0]
+    meta_start = len(data) - 8 - meta_len
+    if meta_start < 4:
+        raise UnsupportedParquetFeature("footer length exceeds file")
+    try:
+        fmd, _ = read_struct(data[meta_start : len(data) - 8])
+    except ThriftError as e:
+        raise UnsupportedParquetFeature(f"footer parse: {e}") from e
+
+    # SchemaElement list: [0] is the root; a flat file has exactly its
+    # children after it, none of which has children of its own
+    schema_elems = fmd.get(2) or []
+    if not schema_elems:
+        raise UnsupportedParquetFeature("no schema elements")
+    root = schema_elems[0]
+    n_children = root.get(5, 0)
+    if n_children != len(schema_elems) - 1:
+        raise UnsupportedParquetFeature("nested schema (grouped fields)")
+    col_meta: dict[str, dict] = {}
+    names = []
+    for elem in schema_elems[1:]:
+        if elem.get(5):  # num_children on a leaf => group node
+            raise UnsupportedParquetFeature("nested schema (grouped fields)")
+        rep = elem.get(3, _REQUIRED)
+        if rep == _REPEATED:
+            raise UnsupportedParquetFeature("repeated field")
+        name = elem[4].decode("utf-8")
+        names.append(name)
+        col_meta[name] = {"type": elem.get(1), "max_def": 1 if rep == _OPTIONAL else 0}
+
+    groups = []
+    for rg in fmd.get(4) or []:
+        cols: dict[str, ColumnChunkInfo] = {}
+        for cc in rg.get(1) or []:
+            md = cc.get(3)
+            if md is None:
+                raise UnsupportedParquetFeature("column chunk without inline metadata")
+            path = md.get(3) or []
+            if len(path) != 1:
+                raise UnsupportedParquetFeature("nested column path")
+            name = path[0].decode("utf-8")
+            data_off = md[9]
+            dict_off = md.get(11)
+            has_dict = dict_off is not None and 0 < dict_off < data_off
+            cols[name] = ColumnChunkInfo(
+                name=name,
+                physical_type=md[1],
+                codec=md.get(4, 0),
+                num_values=md[5],
+                max_def=col_meta[name]["max_def"],
+                start_offset=dict_off if has_dict else data_off,
+                total_compressed_size=md[7],
+                has_dictionary=has_dict,
+                encodings=tuple(md.get(2) or ()),
+                stats=md.get(12),
+            )
+        groups.append(RowGroupInfo(num_rows=rg[3], columns=cols))
+    return ParquetFooter(
+        num_rows=fmd.get(3, sum(g.num_rows for g in groups)),
+        row_groups=tuple(groups),
+        column_names=tuple(names),
+    )
+
+
+# ---- pages ---------------------------------------------------------------
+
+
+def iter_pages(data, chunk: ColumnChunkInfo):
+    """Yield PageInfo for every page of one column chunk, payloads still
+    compressed (decode.pages decompresses lazily so skipped pages never
+    even decompress)."""
+    pos = chunk.start_offset
+    end = chunk.start_offset + chunk.total_compressed_size
+    values_seen = 0
+    while pos < end and values_seen < chunk.num_values:
+        try:
+            hdr, body = read_struct(data[pos:end])
+        except ThriftError as e:
+            raise UnsupportedParquetFeature(f"page header parse: {e}") from e
+        pos += body
+        kind = hdr[1]
+        comp_size = hdr[3]
+        payload = bytes(data[pos : pos + comp_size])
+        if len(payload) < comp_size:
+            raise UnsupportedParquetFeature("truncated page payload")
+        pos += comp_size
+        if kind == PAGE_DICTIONARY:
+            dh = hdr.get(7) or {}
+            yield PageInfo(
+                kind=kind,
+                num_values=dh.get(1, 0),
+                encoding=dh.get(2, ENC_PLAIN),
+                uncompressed_size=hdr[2],
+                payload=payload,
+            )
+        elif kind == PAGE_DATA:
+            dh = hdr.get(5) or {}
+            n = dh[1]
+            values_seen += n
+            yield PageInfo(
+                kind=kind,
+                num_values=n,
+                encoding=dh[2],
+                uncompressed_size=hdr[2],
+                payload=payload,
+            )
+        elif kind == PAGE_DATA_V2:
+            dh = hdr.get(8) or {}
+            n = dh[1]
+            values_seen += n
+            if dh.get(6, 0):
+                raise UnsupportedParquetFeature("repetition levels in flat file")
+            yield PageInfo(
+                kind=kind,
+                num_values=n,
+                encoding=dh[4],
+                uncompressed_size=hdr[2],
+                num_nulls=dh.get(2, 0),
+                def_levels_byte_length=dh.get(5, 0),
+                v2_compressed=dh.get(7, True),
+                payload=payload,
+            )
+        elif kind == PAGE_INDEX:
+            continue  # offset/column index pages carry no row data
+        else:
+            raise UnsupportedParquetFeature(f"page type {kind}")
+
+
+def decompress(codec: int, data: bytes, uncompressed_size: int) -> bytes:
+    if codec == 0 or len(data) == uncompressed_size == 0:
+        return data
+    name = CODEC_NAMES.get(codec)
+    if name is None:
+        raise UnsupportedParquetFeature(f"compression codec {codec}")
+    import pyarrow as pa
+
+    try:
+        return pa.Codec(name).decompress(
+            data, decompressed_size=uncompressed_size, asbytes=True
+        )
+    except (ValueError, NotImplementedError) as e:  # codec not built into this pyarrow
+        raise UnsupportedParquetFeature(f"codec {name}: {e}") from e
+
+
+# ---- statistics ----------------------------------------------------------
+
+
+def expected_physical_type(dtype: DataType) -> int:
+    """The parquet physical type this repo's writer produces for a logical
+    type (ColumnBatch.to_arrow hands pyarrow the internal representation:
+    int64 micros for timestamps, unscaled int64 for decimals, int32 days
+    for dates)."""
+    root = dtype.root
+    if root == TypeRoot.BOOLEAN:
+        return T_BOOLEAN
+    if root in (TypeRoot.TINYINT, TypeRoot.SMALLINT, TypeRoot.INT, TypeRoot.DATE, TypeRoot.TIME):
+        return T_INT32
+    if root in (TypeRoot.BIGINT, TypeRoot.TIMESTAMP, TypeRoot.TIMESTAMP_LTZ, TypeRoot.DECIMAL):
+        return T_INT64
+    if root == TypeRoot.FLOAT:
+        return T_FLOAT
+    if root == TypeRoot.DOUBLE:
+        return T_DOUBLE
+    if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
+        return T_BYTE_ARRAY
+    raise UnsupportedParquetFeature(f"logical type {root} has no native decode")
+
+
+_STAT_UNPACK = {T_INT32: "<i", T_INT64: "<q", T_FLOAT: "<f", T_DOUBLE: "<d"}
+
+# a truncated BYTE_ARRAY max is only a valid upper bound if the writer bumped
+# it; below this length pyarrow never truncates, so the bound is exact
+_STAT_TRUST_LEN = 64
+
+
+def _stat_value(raw: bytes | None, physical: int, dtype: DataType):
+    if raw is None:
+        return None
+    if physical == T_BOOLEAN:
+        return bool(raw[0]) if raw else None
+    fmt = _STAT_UNPACK.get(physical)
+    if fmt is not None:
+        return struct.unpack(fmt, raw)[0] if len(raw) == struct.calcsize(fmt) else None
+    if physical == T_BYTE_ARRAY:
+        if len(raw) >= _STAT_TRUST_LEN:
+            return None  # possibly truncated: don't prune on it
+        if dtype.root in (TypeRoot.BINARY, TypeRoot.VARBINARY):
+            return raw
+        try:
+            # UTF-8 byte order == codepoint order, so the comparison
+            # semantics match predicate literals
+            return raw.decode("utf-8")
+        except UnicodeDecodeError:
+            return None
+    return None
+
+
+def chunk_field_stats(chunk: ColumnChunkInfo, dtype: DataType, num_rows: int) -> FieldStats | None:
+    """Thrift Statistics → FieldStats for Predicate.test_stats row-group
+    pruning (the native analog of parquet.py::_row_group_stats)."""
+    st = chunk.stats
+    if not st:
+        return None
+    # prefer min_value/max_value (6/5, well-defined order); the deprecated
+    # min/max (2/1) only for signed numeric types where old order == new
+    lo_raw = st.get(6) if 6 in st else (st.get(2) if chunk.physical_type != T_BYTE_ARRAY else None)
+    hi_raw = st.get(5) if 5 in st else (st.get(1) if chunk.physical_type != T_BYTE_ARRAY else None)
+    lo = _stat_value(lo_raw, chunk.physical_type, dtype)
+    hi = _stat_value(hi_raw, chunk.physical_type, dtype)
+    nulls = st.get(3)
+    if lo is None or hi is None:
+        if nulls is None:
+            return None
+        return FieldStats(None, None, nulls, num_rows)
+    return FieldStats(lo, hi, nulls, num_rows)

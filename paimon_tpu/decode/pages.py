@@ -1,0 +1,238 @@
+"""Page → column assembly: decompressed pages through the kernels into one
+(values, validity) pair per column chunk.
+
+The unit of skipping is the page: `decode_chunk` takes an optional per-row
+keep mask (from decode.pushdown) and any data page whose row range is fully
+dead is never decompressed, never level-decoded, never expanded — its slot
+in the output stays at the null fill and the keep mask drops those rows
+before the batch is built. That is the LSM-OPD shape: predicates ran on the
+compressed/dictionary domain, only survivors expand.
+
+Metrics (group "decode"): pages_decoded / pages_skipped counters count data
+pages; bytes_expanded accumulates the materialized value bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import DataType, TypeRoot
+from . import kernels
+from .container import (
+    ENC_DELTA_BINARY_PACKED,
+    ENC_PLAIN,
+    ENC_PLAIN_DICTIONARY,
+    ENC_RLE,
+    ENC_RLE_DICTIONARY,
+    PAGE_DATA,
+    PAGE_DATA_V2,
+    PAGE_DICTIONARY,
+    T_BOOLEAN,
+    ColumnChunkInfo,
+    PageInfo,
+    UnsupportedParquetFeature,
+    decompress,
+    iter_pages,
+)
+
+__all__ = ["decode_chunk", "chunk_code_pages", "decode_dictionary", "object_nbytes"]
+
+
+def _is_utf8(dtype: DataType) -> bool:
+    return dtype.root in (TypeRoot.CHAR, TypeRoot.VARCHAR)
+
+
+def decode_dictionary(page: PageInfo, chunk: ColumnChunkInfo, dtype: DataType) -> np.ndarray:
+    if page.encoding not in (ENC_PLAIN, ENC_PLAIN_DICTIONARY):
+        raise UnsupportedParquetFeature(f"dictionary page encoding {page.encoding}")
+    raw = decompress(chunk.codec, page.payload, page.uncompressed_size)
+    return kernels.decode_plain(
+        raw, 0, chunk.physical_type, page.num_values, utf8=_is_utf8(dtype)
+    )
+
+
+def _page_levels(
+    raw: bytes, page: PageInfo, chunk: ColumnChunkInfo
+) -> tuple[np.ndarray | None, int]:
+    """(validity, values_offset) for one decompressed v1 page / raw v2 page
+    prefix. validity None means every slot valid."""
+    n = page.num_values
+    if chunk.max_def == 0:
+        return None, 0
+    if page.kind == PAGE_DATA:
+        # v1: 4-byte length + RLE levels (bit width from max_def, here 1)
+        ln = int.from_bytes(raw[0:4], "little")
+        levels = kernels.decode_rle_hybrid(raw, 4, 4 + ln, 1, n)
+        off = 4 + ln
+    else:
+        # v2: RLE levels without length prefix, length from the header
+        ln = page.def_levels_byte_length
+        levels = kernels.decode_rle_hybrid(raw, 0, ln, 1, n)
+        off = ln
+    validity = kernels.def_levels_to_validity(levels, chunk.max_def)
+    if validity.all():
+        return None, off
+    return validity, off
+
+
+def _decode_values(
+    raw: bytes,
+    off: int,
+    page: PageInfo,
+    chunk: ColumnChunkInfo,
+    dtype: DataType,
+    dictionary: np.ndarray | None,
+    n_valid: int,
+) -> np.ndarray:
+    enc = page.encoding
+    if enc in (ENC_RLE_DICTIONARY, ENC_PLAIN_DICTIONARY):
+        if dictionary is None:
+            raise UnsupportedParquetFeature("dictionary-encoded page without dictionary")
+        width = raw[off]
+        codes = kernels.decode_rle_hybrid(raw, off + 1, len(raw), width, n_valid)
+        return kernels.gather(dictionary, codes)
+    if enc == ENC_PLAIN:
+        return kernels.decode_plain(raw, off, chunk.physical_type, n_valid, utf8=_is_utf8(dtype))
+    if enc == ENC_DELTA_BINARY_PACKED:
+        return kernels.decode_delta_binary_packed(raw, off, n_valid, chunk.physical_type)
+    if enc == ENC_RLE and chunk.physical_type == T_BOOLEAN:
+        # v2 boolean pages: RLE values behind a 4-byte length prefix
+        ln = int.from_bytes(raw[off : off + 4], "little")
+        return kernels.decode_rle_hybrid(raw, off + 4, off + 4 + ln, 1, n_valid).astype(np.bool_)
+    raise UnsupportedParquetFeature(f"data page encoding {enc}")
+
+
+def _split_v2(raw_payload: bytes, page: PageInfo, chunk: ColumnChunkInfo) -> bytes:
+    """v2 pages keep levels uncompressed ahead of the (optionally)
+    compressed values; normalize to one flat buffer like v1."""
+    ln = page.def_levels_byte_length
+    levels = raw_payload[:ln]
+    body = raw_payload[ln:]
+    if page.v2_compressed:
+        body = decompress(chunk.codec, body, page.uncompressed_size - ln)
+    return levels + body
+
+
+def object_nbytes(values: np.ndarray) -> int:
+    """Expansion weight of an object vector (bytes_expanded metric)."""
+    if values.dtype != np.dtype(object):
+        return values.nbytes
+    return int(
+        sum(len(x) if isinstance(x, (str, bytes)) else 8 for x in values if x is not None)
+    )
+
+
+def decode_chunk(
+    data,
+    chunk: ColumnChunkInfo,
+    dtype: DataType,
+    num_rows: int,
+    keep: np.ndarray | None = None,
+    metrics=None,
+    expected_physical: int | None = None,
+) -> tuple[np.ndarray, np.ndarray | None]:
+    """Decode one column chunk into (values, validity) over the row group's
+    `num_rows` rows. Pages whose row range is dead under `keep` are skipped
+    before decompression; their rows keep the null fill (the caller drops
+    them via `keep` right after).
+
+    The physical-type envelope is enforced only when values actually decode:
+    an all-null column (arrow writes those with a `null` type whose parquet
+    physical is arbitrary) never materializes a value, so its physical type
+    never matters — parity with the arrow reader."""
+    np_dtype = dtype.numpy_dtype()
+    if np_dtype == np.dtype(object):
+        values = np.empty(num_rows, dtype=object)
+    else:
+        values = np.zeros(num_rows, dtype=np_dtype)
+    validity = np.ones(num_rows, dtype=np.bool_)
+    any_null = False
+    dict_page: PageInfo | None = None
+    dictionary: np.ndarray | None = None
+    row = 0
+    for page in iter_pages(data, chunk):
+        if page.kind == PAGE_DICTIONARY:
+            dict_page = page  # decoded lazily, on first page that needs it
+            continue
+        n = page.num_values
+        sl = slice(row, row + n)
+        row += n
+        if keep is not None and not keep[sl].any():
+            validity[sl] = False  # dead rows; dropped by keep before assembly
+            any_null = True
+            if metrics is not None:
+                metrics.counter("pages_skipped").inc()
+            continue
+        if page.kind == PAGE_DATA:
+            raw = decompress(chunk.codec, page.payload, page.uncompressed_size)
+        else:
+            raw = _split_v2(page.payload, page, chunk)
+        page_validity, off = _page_levels(raw, page, chunk)
+        n_valid = n if page_validity is None else int(page_validity.sum())
+        if n_valid == 0:
+            any_null = True
+            validity[sl] = False
+            continue
+        if expected_physical is not None and chunk.physical_type != expected_physical:
+            raise UnsupportedParquetFeature(
+                f"column {chunk.name}: physical type {chunk.physical_type}, "
+                f"expected {expected_physical}"
+            )
+        if dictionary is None and dict_page is not None:
+            dictionary = decode_dictionary(dict_page, chunk, dtype)
+        compact = _decode_values(raw, off, page, chunk, dtype, dictionary, n_valid)
+        compact = _cast_physical(compact, chunk.physical_type, np_dtype)
+        if page_validity is None:
+            values[sl] = compact
+        else:
+            any_null = True
+            validity[sl] = page_validity
+            values[sl] = kernels.scatter_values(compact, page_validity, np_dtype)
+        if metrics is not None:
+            metrics.counter("pages_decoded").inc()
+            metrics.counter("bytes_expanded").inc(object_nbytes(compact))
+    if row != num_rows:
+        raise UnsupportedParquetFeature(
+            f"column {chunk.name}: pages cover {row} rows, row group has {num_rows}"
+        )
+    return values, (validity if any_null else None)
+
+
+def _cast_physical(compact: np.ndarray, physical: int, np_dtype: np.dtype) -> np.ndarray:
+    if compact.dtype == np_dtype or np_dtype == np.dtype(object):
+        return compact
+    # INT32 physical backing int8/int16/date columns etc.
+    return compact.astype(np_dtype, copy=False)
+
+
+def chunk_code_pages(
+    data, chunk: ColumnChunkInfo, dtype: DataType
+) -> tuple[np.ndarray | None, list[tuple[int, int, np.ndarray | None, np.ndarray | None]]]:
+    """The compressed-domain view of one chunk for pushdown: the decoded
+    dictionary (None when the chunk is not dictionary-encoded) and, per data
+    page, (row_start, num_rows, codes, validity) — codes None for non-dict
+    pages (a mid-chunk PLAIN fallback keeps those pages conservatively
+    alive). Values are never expanded here: only levels and index runs
+    decode, which is the cheap fraction of a page."""
+    dictionary: np.ndarray | None = None
+    pages: list[tuple[int, int, np.ndarray | None, np.ndarray | None]] = []
+    row = 0
+    for page in iter_pages(data, chunk):
+        if page.kind == PAGE_DICTIONARY:
+            dictionary = decode_dictionary(page, chunk, dtype)
+            continue
+        n = page.num_values
+        if page.encoding in (ENC_RLE_DICTIONARY, ENC_PLAIN_DICTIONARY):
+            if page.kind == PAGE_DATA:
+                raw = decompress(chunk.codec, page.payload, page.uncompressed_size)
+            else:
+                raw = _split_v2(page.payload, page, chunk)
+            page_validity, off = _page_levels(raw, page, chunk)
+            n_valid = n if page_validity is None else int(page_validity.sum())
+            width = raw[off]
+            codes = kernels.decode_rle_hybrid(raw, off + 1, len(raw), width, n_valid)
+            pages.append((row, n, codes, page_validity))
+        else:
+            pages.append((row, n, None, None))
+        row += n
+    return dictionary, pages

@@ -1,0 +1,139 @@
+"""LSM-OPD-style compressed-domain predicate pushdown (arxiv 2508.11862).
+
+Before any page expands, each AND-conjunct of the read predicate is tried
+against the compressed domain of its column:
+
+  1. row-group gate — chunk Statistics (thrift, no arrow) through the same
+     `Predicate.test_stats` the planner uses for file pruning: a group whose
+     min/max cannot match never opens a single page;
+  2. dictionary gate — for a dictionary-encoded chunk the leaf evaluates
+     ONCE over the dictionary values (a |dict|-sized vectorized eval, not a
+     |rows|-sized one) giving the surviving-code set; per page, only the
+     index runs decode and `surviving[codes]` marks live rows. A page whose
+     codes all miss is never expanded.
+
+The masks of every conjunct AND together into one per-row keep mask for the
+row group. The mask depends only on (file bytes, predicate) — never on the
+projection — so the two projection passes of the pipelined merge read stay
+row-aligned, which the datafile.read contract requires. Rows the mask kills
+are rows the caller's later `predicate.eval` would kill anyway (a code that
+fails a conjunct fails the conjunction), so dropping them early is safe on
+every path that pushes predicates down.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.predicate import FieldStats, LeafPredicate, Predicate, PredicateBuilder
+from ..types import RowType
+from .container import ParquetFooter, RowGroupInfo, chunk_field_stats
+from .pages import chunk_code_pages
+
+__all__ = ["row_group_keep_mask", "dict_surviving_codes"]
+
+# leaf functions whose data-eval on the dictionary domain transfers to rows:
+# value-determined predicates (NULL rows fail them all, matching eval()'s
+# `mask & valid`). isNull/isNotNull are row-level, not value-level — excluded.
+_VALUE_FUNCS = frozenset(
+    {
+        "equal",
+        "notEqual",
+        "lessThan",
+        "lessOrEqual",
+        "greaterThan",
+        "greaterOrEqual",
+        "in",
+        "notIn",
+        "between",
+        "startsWith",
+        "endsWith",
+        "contains",
+    }
+)
+
+
+def dict_surviving_codes(leaf: LeafPredicate, dictionary: np.ndarray) -> np.ndarray:
+    """Bool vector over dictionary codes: True where the dictionary value
+    can satisfy the leaf. One vectorized eval over the dict domain."""
+    from ..data.batch import Column, ColumnBatch
+    from ..types import DataField, STRING
+
+    # the leaf's eval only touches values + validity, so a synthetic
+    # single-column batch over the dictionary domain reuses it verbatim
+    # (the declared type is irrelevant to eval; STRING is a placeholder)
+    schema = RowType([DataField(0, leaf.field, STRING())])
+    batch = ColumnBatch(schema, {leaf.field: Column(dictionary)})
+    return leaf.eval(batch)
+
+
+def _rowgroup_stats(
+    rg: RowGroupInfo, fields: set[str], schema: RowType
+) -> dict[str, FieldStats]:
+    out: dict[str, FieldStats] = {}
+    for name in fields:
+        chunk = rg.columns.get(name)
+        if chunk is None or name not in schema:
+            continue
+        st = chunk_field_stats(chunk, schema.field(name).type, rg.num_rows)
+        if st is not None:
+            out[name] = st
+    return out
+
+
+def row_group_keep_mask(
+    data,
+    footer: ParquetFooter,
+    rg: RowGroupInfo,
+    predicate: Predicate | None,
+    schema: RowType,
+    metrics=None,
+):
+    """False → the whole row group is skipped; None → keep every row;
+    ndarray[bool] → per-row keep mask (some pages/rows pruned)."""
+    if predicate is None:
+        return None
+    # stage 1: statistics gate (native analog of the arrow path's
+    # row-group skipping — same test_stats, stats parsed from thrift)
+    stats = _rowgroup_stats(rg, predicate.referenced_fields(), schema)
+    if stats and not predicate.test_stats(stats):
+        return False
+    # stage 2: dictionary gate per AND-conjunct
+    mask: np.ndarray | None = None
+    for part in PredicateBuilder.split_and(predicate):
+        if not isinstance(part, LeafPredicate) or part.function not in _VALUE_FUNCS:
+            continue
+        chunk = rg.columns.get(part.field)
+        if chunk is None or not chunk.has_dictionary or part.field not in schema:
+            continue
+        dictionary, pages = chunk_code_pages(data, chunk, schema.field(part.field).type)
+        if dictionary is None:
+            continue
+        surviving = dict_surviving_codes(part, dictionary)
+        if surviving.all():
+            continue  # conjunct prunes nothing in this group
+        part_mask = np.zeros(rg.num_rows, dtype=np.bool_)
+        for row_start, n, codes, validity in pages:
+            if codes is None:
+                # PLAIN fallback page mid-chunk: conservatively alive
+                part_mask[row_start : row_start + n] = True
+            elif validity is None:
+                part_mask[row_start : row_start + n] = surviving[codes]
+            else:
+                # NULL rows carry no code and fail every value predicate
+                sl = part_mask[row_start : row_start + n]
+                sl[validity] = surviving[codes]
+        mask = part_mask if mask is None else (mask & part_mask)
+        if not mask.any():
+            break
+    if mask is None:
+        return None
+    if not mask.any():
+        if metrics is not None:
+            metrics.counter("rows_pruned").inc(rg.num_rows)
+        return False
+    if mask.all():
+        return None
+    if metrics is not None:
+        metrics.counter("rows_pruned").inc(int((~mask).sum()))
+    return mask

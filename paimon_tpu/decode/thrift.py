@@ -1,0 +1,156 @@
+"""Minimal Thrift compact-protocol reader for Parquet metadata.
+
+Parquet's footer (FileMetaData) and every page header are TCompactProtocol
+structs. The arrow path parses them inside C++; the native decode subsystem
+parses them here so the whole container walk — footer → row groups → column
+chunks → page headers — happens without pyarrow on the hot path.
+
+The parser is generic: `read_struct` returns {field_id: value} dicts with
+nested structs/lists parsed recursively. The parquet.thrift field-id → name
+mapping lives in container.py, which wraps these dicts in typed views. Only
+the protocol features parquet metadata actually uses are implemented (no
+maps with non-byte keys beyond the wire format, no exotic types).
+
+Wire format (thrift compact protocol spec):
+  * varint       — ULEB128
+  * i16/i32/i64  — zigzag varint
+  * field header — one byte: (id-delta << 4) | type; delta 0 = long form
+                   (type byte, then zigzag varint field id)
+  * bool         — encoded IN the field-header type nibble (1=true, 2=false);
+                   a full byte inside collections
+  * binary       — varint length + bytes
+  * list/set     — one byte (size << 4 | elem-type); size 15 = varint follows
+  * double       — 8 bytes little-endian (compact protocol, unlike binary)
+"""
+
+from __future__ import annotations
+
+import struct
+
+__all__ = ["ThriftError", "read_struct", "read_varint", "zigzag"]
+
+
+class ThriftError(ValueError):
+    """Malformed compact-protocol bytes (truncated varint, bad type nibble)."""
+
+
+# compact-protocol type nibbles
+CT_STOP = 0
+CT_TRUE = 1
+CT_FALSE = 2
+CT_BYTE = 3
+CT_I16 = 4
+CT_I32 = 5
+CT_I64 = 6
+CT_DOUBLE = 7
+CT_BINARY = 8
+CT_LIST = 9
+CT_SET = 10
+CT_MAP = 11
+CT_STRUCT = 12
+
+
+def read_varint(buf, pos: int) -> tuple[int, int]:
+    """(value, new_pos) — ULEB128."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ThriftError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ThriftError("varint too long")
+
+
+def zigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _read_value(buf, pos: int, ctype: int):
+    if ctype == CT_BYTE:
+        v = buf[pos]
+        return v - 256 if v >= 128 else v, pos + 1
+    if ctype in (CT_I16, CT_I32, CT_I64):
+        v, pos = read_varint(buf, pos)
+        return zigzag(v), pos
+    if ctype == CT_DOUBLE:
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if ctype == CT_BINARY:
+        n, pos = read_varint(buf, pos)
+        return bytes(buf[pos : pos + n]), pos + n
+    if ctype in (CT_LIST, CT_SET):
+        return _read_list(buf, pos)
+    if ctype == CT_MAP:
+        return _read_map(buf, pos)
+    if ctype == CT_STRUCT:
+        return read_struct(buf, pos)
+    raise ThriftError(f"unexpected compact type {ctype}")
+
+
+def _read_list(buf, pos: int):
+    header = buf[pos]
+    pos += 1
+    size = header >> 4
+    etype = header & 0xF
+    if size == 15:
+        size, pos = read_varint(buf, pos)
+    out = []
+    for _ in range(size):
+        if etype in (CT_TRUE, CT_FALSE):
+            # bool elements are full bytes inside collections
+            out.append(buf[pos] == CT_TRUE)
+            pos += 1
+        else:
+            v, pos = _read_value(buf, pos, etype)
+            out.append(v)
+    return out, pos
+
+
+def _read_map(buf, pos: int):
+    size, pos = read_varint(buf, pos)
+    out = {}
+    if size == 0:
+        return out, pos
+    kv = buf[pos]
+    pos += 1
+    ktype, vtype = kv >> 4, kv & 0xF
+    for _ in range(size):
+        k, pos = _read_value(buf, pos, ktype)
+        v, pos = _read_value(buf, pos, vtype)
+        out[k] = v
+    return out, pos
+
+
+def read_struct(buf, pos: int = 0) -> tuple[dict[int, object], int]:
+    """Parse one struct starting at `pos`: ({field_id: value}, end_pos).
+
+    Booleans folded into field headers come back as Python bools; nested
+    structs as dicts; lists as Python lists; binaries as bytes.
+    """
+    out: dict[int, object] = {}
+    fid = 0
+    while True:
+        if pos >= len(buf):
+            raise ThriftError("truncated struct (no STOP)")
+        header = buf[pos]
+        pos += 1
+        if header == CT_STOP:
+            return out, pos
+        delta = header >> 4
+        ctype = header & 0xF
+        if delta:
+            fid += delta
+        else:
+            v, pos = read_varint(buf, pos)
+            fid = zigzag(v)
+        if ctype == CT_TRUE:
+            out[fid] = True
+        elif ctype == CT_FALSE:
+            out[fid] = False
+        else:
+            out[fid], pos = _read_value(buf, pos, ctype)

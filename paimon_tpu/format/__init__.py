@@ -42,6 +42,11 @@ class FileFormat:
 
     identifier: str = "?"
 
+    def configure(self, format_options: dict | None) -> "FileFormat":
+        """Apply reader-side format options (e.g. format.parquet.decoder)
+        to this instance; default is a no-op. Returns self for chaining."""
+        return self
+
     def write(
         self,
         file_io: FileIO,

@@ -14,7 +14,16 @@ import time
 from collections import defaultdict
 from typing import Callable
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricGroup", "MetricRegistry", "registry", "timed"]
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricGroup",
+    "MetricRegistry",
+    "registry",
+    "timed",
+    "decode_metrics",
+]
 
 
 class Counter:
@@ -123,6 +132,17 @@ class MetricRegistry:
 
 
 registry = MetricRegistry()
+
+
+def decode_metrics() -> MetricGroup:
+    """The decode{...} group (native parquet page-decode subsystem,
+    paimon_tpu.decode). Canonical members — counters: pages_decoded,
+    pages_skipped (dead under compressed-domain pushdown, never expanded),
+    bytes_expanded (materialized value bytes), rows_pruned, files_native,
+    files_fallback (fell back to the arrow decoder); histograms: file_ms
+    (whole-file native decode wall millis), pushdown_ms (per row group).
+    Resolved per call so registry.reset() in tests swaps the group out."""
+    return registry.group("decode")
 
 
 class timed:

@@ -237,6 +237,15 @@ class CoreOptions:
     PARQUET_ENABLE_DICTIONARY = ConfigOption.bool_(
         "parquet.enable.dictionary", True, "Dictionary encoding for parquet data files."
     )
+    FORMAT_PARQUET_DECODER = ConfigOption.string(
+        "format.parquet.decoder",
+        "arrow",
+        "Parquet read decoder: 'arrow' (pyarrow C++ columnar decode) or "
+        "'native' (paimon_tpu.decode: thrift-parsed pages, vectorized "
+        "RLE/dict/delta kernels, compressed-domain predicate pushdown that "
+        "expands only surviving pages; falls back to arrow per file on "
+        "unsupported container features).",
+    )
     READ_BATCH_SIZE = ConfigOption.int_(
         "read.batch-size", None, "Rows per record batch handed to engine surfaces (unset: 1M-row chunks)."
     )

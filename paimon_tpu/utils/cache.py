@@ -14,8 +14,10 @@ two ways:
     size;
   * the **data-file cache** holds decoded KVBatch/ColumnBatch results of
     `KeyValueFileReaderFactory.read`, keyed by (file name, projection,
-    system-columns mode, read-schema signature) and weighted by
-    `KVBatch.byte_size()`.
+    system-columns mode, read-schema signature, decoder identity — the
+    `format.parquet.decoder` backend that produced the batch, so switching
+    arrow↔native can never alias a batch decoded by the other backend) and
+    weighted by `KVBatch.byte_size()`.
 
 Both caches are module-level singletons (file names embed uuid4, so keys are
 globally unique across tables and processes can share one budget), budgeted

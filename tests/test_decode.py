@@ -1,0 +1,514 @@
+"""Native vectorized parquet page-decode subsystem (paimon_tpu.decode).
+
+Covers the four layers and the wiring:
+  * kernels — bit-unpack / RLE hybrid / delta against oracles, plus
+    jax-vs-numpy kernel parity (tier-1 runs these on the cpu backend);
+  * container — thrift footer parse of real pyarrow-written files;
+  * parity — randomized arrow-vs-native fuzz over encodings
+    (plain/dict/delta), compressions (zstd/snappy/uncompressed), null
+    patterns, page versions and projections (long corpus sweep is `slow`);
+  * pushdown — compressed-domain dictionary predicates must expand strictly
+    fewer pages than full decode while the filtered result stays identical;
+  * wiring — `format.parquet.decoder = native` through table reads,
+    decoder identity in the data-file cache key, per-file arrow fallback on
+    unsupported features, and the concurrent threaded-read regression over
+    FileIO.local_path memory-mapping with the shared decode pool.
+"""
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+import paimon_tpu as pt
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.data import predicate as P
+from paimon_tpu.data.batch import ColumnBatch, concat_batches
+from paimon_tpu.decode import UnsupportedParquetFeature, read_native
+from paimon_tpu.decode import kernels
+from paimon_tpu.decode.container import parse_footer
+from paimon_tpu.format.parquet import ParquetFormat
+from paimon_tpu.fs import LocalFileIO
+from paimon_tpu.metrics import decode_metrics, registry
+from paimon_tpu.types import ArrayType
+
+IO = LocalFileIO()
+
+FULL_SCHEMA = pt.RowType.of(
+    ("i8", pt.TINYINT()),
+    ("i16", pt.SMALLINT()),
+    ("i32", pt.INT()),
+    ("i64", pt.BIGINT()),
+    ("f32", pt.FLOAT()),
+    ("f64", pt.DOUBLE()),
+    ("b", pt.BOOLEAN()),
+    ("s", pt.STRING()),
+    ("y", pt.BYTES()),
+    ("dt", pt.DATE()),
+    ("ts", pt.TIMESTAMP()),
+)
+
+
+def _random_batch(rng, n, null_rate=0.15, schema=FULL_SCHEMA, distinct=50):
+    def nullify(vals):
+        if null_rate == 0:
+            return list(vals)
+        mask = rng.random(n) < null_rate
+        return [None if m else v for v, m in zip(vals, mask)]
+
+    gens = {
+        "i8": lambda: nullify(int(x) for x in rng.integers(-128, 128, n)),
+        "i16": lambda: nullify(int(x) for x in rng.integers(-1000, 1000, n)),
+        "i32": lambda: nullify(int(x) for x in rng.integers(-(2**31), 2**31, n)),
+        "i64": lambda: nullify(int(x) for x in rng.integers(-(2**62), 2**62, n)),
+        "f32": lambda: nullify(float(x) for x in rng.integers(0, distinct, n)),
+        "f64": lambda: nullify(float(x) * 0.5 for x in rng.integers(0, 10**6, n)),
+        "b": lambda: nullify(bool(x) for x in rng.integers(0, 2, n)),
+        "s": lambda: nullify(f"val-{int(x) % distinct:04d}" for x in rng.integers(0, 10**4, n)),
+        "y": lambda: nullify(bytes([int(x) % 251]) * (int(x) % 7) for x in rng.integers(0, 255, n)),
+        "dt": lambda: nullify(int(x) for x in rng.integers(0, 20000, n)),
+        "ts": lambda: nullify(int(x) for x in rng.integers(0, 2**45, n)),
+    }
+    return ColumnBatch.from_pydict(schema, {f.name: gens[f.name]() for f in schema.fields})
+
+
+def _write(path, batch, compression="zstd", **opts):
+    fmt_opts = {"parquet.page-size": "2048"}
+    fmt_opts.update(opts)
+    ParquetFormat().write(IO, path, batch, compression=compression, format_options=fmt_opts)
+
+
+def _arrow_read(path, schema, projection=None, predicate=None):
+    parts = list(ParquetFormat().read(IO, path, schema, projection=projection, predicate=predicate))
+    return concat_batches(parts) if parts else ColumnBatch.empty(schema.project(projection or schema.field_names))
+
+
+def _native_read(path, schema, projection=None, predicate=None):
+    parts = read_native(IO, path, schema, projection=projection, predicate=predicate)
+    return concat_batches(parts) if parts else ColumnBatch.empty(schema.project(projection or schema.field_names))
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def _pack_bits_reference(values, width):
+    """Oracle LSB-first packer for unpack_bits."""
+    bits = []
+    for v in values:
+        for j in range(width):
+            bits.append((v >> j) & 1)
+    while len(bits) % 8:
+        bits.append(0)
+    out = bytearray()
+    for i in range(0, len(bits), 8):
+        out.append(sum(b << j for j, b in enumerate(bits[i : i + 8])))
+    return bytes(out)
+
+
+@pytest.mark.parametrize("width", [1, 2, 3, 5, 7, 8, 12, 17, 24, 31])
+def test_unpack_bits_against_oracle(width, rng):
+    n = 100
+    vals = [int(x) for x in rng.integers(0, 2**width, n)]
+    packed = np.frombuffer(_pack_bits_reference(vals, width), dtype=np.uint8)
+    out = kernels.unpack_bits(packed, width, n)
+    assert out.tolist() == vals
+
+
+def test_rle_hybrid_mixed_runs():
+    # RLE run of 9 sevens (width 3), then a bit-packed group of 8 values
+    stream = bytes([9 << 1, 7]) + bytes([(1 << 1) | 1]) + _pack_bits_reference(list(range(8)), 3)
+    out = kernels.decode_rle_hybrid(stream, 0, len(stream), 3, 17)
+    assert out.tolist() == [7] * 9 + list(range(8))
+
+
+def test_rle_hybrid_truncated_stream_raises():
+    with pytest.raises(UnsupportedParquetFeature):
+        kernels.decode_rle_hybrid(bytes([4 << 1, 1]), 0, 2, 1, 10)
+
+
+def test_jax_numpy_kernel_parity(rng):
+    for width in (1, 3, 8, 13, 20, 32):
+        vals = [int(x) for x in rng.integers(0, 2**width, 64)]
+        packed = np.frombuffer(_pack_bits_reference(vals, width), dtype=np.uint8)
+        np_out = kernels.unpack_bits(packed, width, 64)
+        jax_out = np.asarray(kernels.unpack_bits_jax(packed, width, 64))
+        assert np_out.astype(np.uint64).tolist() == jax_out.astype(np.uint64).tolist()
+    dictionary = rng.integers(-(2**40), 2**40, 37).astype(np.int64)
+    codes = rng.integers(0, 37, 500).astype(np.int32)
+    assert np.array_equal(
+        np.asarray(kernels.gather_jax(dictionary, codes)), dictionary.take(codes)
+    )
+
+
+def test_gather_engine_switch(rng):
+    dictionary = rng.integers(0, 1000, 16).astype(np.int64)
+    codes = rng.integers(0, 16, 100).astype(np.int32)
+    expect = dictionary.take(codes)
+    kernels.set_decode_engine("jax")
+    try:
+        assert np.array_equal(kernels.gather(dictionary, codes), expect)
+    finally:
+        kernels.set_decode_engine("numpy")
+    assert np.array_equal(kernels.gather(dictionary, codes), expect)
+
+
+def test_delta_binary_packed_parity(tmp_path, rng):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    schema = pt.RowType.of(("a", pt.BIGINT()), ("c", pt.INT()))
+    a = rng.integers(-(2**50), 2**50, 4000)
+    a[::7] = np.arange(0, 4000, 7) * 3  # mix monotone stretches into the noise
+    c = rng.integers(-(2**30), 2**30, 4000).astype(np.int32)
+    path = str(tmp_path / "delta.parquet")
+    pq.write_table(
+        pa.table({"a": a, "c": c}),
+        path,
+        use_dictionary=False,
+        column_encoding={"a": "DELTA_BINARY_PACKED", "c": "DELTA_BINARY_PACKED"},
+        data_page_size=1024,
+    )
+    got = _native_read(path, schema)
+    assert got.column("a").values.tolist() == a.tolist()
+    assert got.column("c").values.tolist() == c.tolist()
+
+
+# ---------------------------------------------------------------------------
+# container / footer
+# ---------------------------------------------------------------------------
+
+
+def test_footer_parse_matches_pyarrow(tmp_path, rng):
+    path = str(tmp_path / "f.parquet")
+    batch = _random_batch(rng, 777)
+    _write(path, batch)
+    footer = parse_footer(IO.read_bytes(path))
+    assert footer.num_rows == 777
+    assert set(footer.column_names) == set(FULL_SCHEMA.field_names)
+    assert sum(g.num_rows for g in footer.row_groups) == 777
+    chunk = footer.row_groups[0].columns["s"]
+    assert chunk.has_dictionary and chunk.num_values == footer.row_groups[0].num_rows
+
+
+def test_footer_rejects_garbage():
+    with pytest.raises(UnsupportedParquetFeature):
+        parse_footer(b"PAR1" + b"\x00" * 20 + struct.pack("<I", 999) + b"PAR1")
+    with pytest.raises(UnsupportedParquetFeature):
+        parse_footer(b"definitely not parquet")
+
+
+# ---------------------------------------------------------------------------
+# arrow-vs-native parity
+# ---------------------------------------------------------------------------
+
+
+def _assert_parity(path, schema, projection=None, predicate=None):
+    a = _arrow_read(path, schema, projection, predicate)
+    n = _native_read(path, schema, projection, predicate)
+    if predicate is not None:
+        a = a.filter(predicate.eval(a))
+        n = n.filter(predicate.eval(n))
+    assert a.num_rows == n.num_rows
+    assert a.to_pydict() == n.to_pydict()
+
+
+@pytest.mark.parametrize("compression", ["zstd", "snappy", "none"])
+@pytest.mark.parametrize("dictionary", ["true", "false"])
+def test_parity_all_types(tmp_path, rng, compression, dictionary):
+    path = str(tmp_path / f"t-{compression}-{dictionary}.parquet")
+    _write(
+        path,
+        _random_batch(rng, 3000),
+        compression=compression,
+        **{"parquet.enable.dictionary": dictionary},
+    )
+    _assert_parity(path, FULL_SCHEMA)
+
+
+def test_parity_data_page_v2(tmp_path, rng):
+    path = str(tmp_path / "v2.parquet")
+    _write(path, _random_batch(rng, 2500), **{"parquet.data-page-version": "2.0"})
+    _assert_parity(path, FULL_SCHEMA)
+
+
+def test_parity_no_nulls_and_all_nulls(tmp_path, rng):
+    p1 = str(tmp_path / "dense.parquet")
+    _write(p1, _random_batch(rng, 1000, null_rate=0.0))
+    _assert_parity(p1, FULL_SCHEMA)
+    p2 = str(tmp_path / "hollow.parquet")
+    _write(p2, _random_batch(rng, 400, null_rate=1.0))
+    _assert_parity(p2, FULL_SCHEMA)
+
+
+def test_parity_empty_file(tmp_path):
+    path = str(tmp_path / "empty.parquet")
+    _write(path, ColumnBatch.empty(FULL_SCHEMA))
+    assert _native_read(path, FULL_SCHEMA).num_rows == 0
+
+
+def test_parity_single_row(tmp_path, rng):
+    path = str(tmp_path / "one.parquet")
+    _write(path, _random_batch(rng, 1, null_rate=0.0))
+    _assert_parity(path, FULL_SCHEMA)
+
+
+def test_parity_projection_and_predicate(tmp_path, rng):
+    path = str(tmp_path / "proj.parquet")
+    _write(path, _random_batch(rng, 2000))
+    _assert_parity(path, FULL_SCHEMA, projection=["s", "i64", "f64"])
+    _assert_parity(path, FULL_SCHEMA, projection=["ts", "b"])
+    pred = P.and_(P.greater_than("i64", 0), P.equal("s", "val-0007"))
+    _assert_parity(path, FULL_SCHEMA, projection=["s", "i64"], predicate=pred)
+    _assert_parity(path, FULL_SCHEMA, predicate=P.in_("s", ["val-0001", "val-0002"]))
+    _assert_parity(path, FULL_SCHEMA, predicate=P.is_null("f32"))
+
+
+def _fuzz_once(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 4000))
+    null_rate = float(rng.choice([0.0, 0.02, 0.3, 0.9]))
+    compression = str(rng.choice(["zstd", "snappy", "none"]))
+    opts = {
+        "parquet.enable.dictionary": str(rng.choice(["true", "false"])),
+        "parquet.page-size": str(int(rng.choice([512, 2048, 65536]))),
+        "parquet.data-page-version": str(rng.choice(["1.0", "2.0"])),
+    }
+    if rng.random() < 0.5:
+        opts["parquet.row-group.rows"] = str(int(rng.integers(100, 1500)))
+    names = list(FULL_SCHEMA.field_names)
+    k = int(rng.integers(1, len(names) + 1))
+    projection = list(rng.choice(names, size=k, replace=False))
+    batch = _random_batch(rng, n, null_rate=null_rate, distinct=int(rng.integers(2, 200)))
+    path = str(tmp_path / f"fuzz-{seed}.parquet")
+    ParquetFormat().write(IO, path, batch, compression=compression, format_options=opts)
+    predicate = None
+    if rng.random() < 0.5:
+        predicate = P.between("i64", -(2**61), 2**61)
+        if rng.random() < 0.5:
+            predicate = P.and_(predicate, P.starts_with("s", "val-00"))
+        # the parity check evaluates the predicate on the projected batch
+        projection = list(dict.fromkeys(projection + sorted(predicate.referenced_fields())))
+    _assert_parity(path, FULL_SCHEMA, projection=projection, predicate=predicate)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_fuzz_quick(tmp_path, seed):
+    _fuzz_once(tmp_path, seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6, 60))
+def test_parity_fuzz_corpus(tmp_path, seed):
+    _fuzz_once(tmp_path, seed)
+
+
+# ---------------------------------------------------------------------------
+# compressed-domain pushdown
+# ---------------------------------------------------------------------------
+
+
+def _clustered_file(tmp_path, rng, n=6000, tags=12):
+    """Dictionary column clustered so most pages hold few distinct codes —
+    the shape where page skipping pays."""
+    schema = pt.RowType.of(("tag", pt.STRING()), ("v", pt.BIGINT()))
+    tag = np.sort(rng.integers(0, tags, n))
+    batch = ColumnBatch.from_pydict(
+        schema,
+        {"tag": [f"tag-{int(t):02d}" for t in tag], "v": [int(x) for x in rng.integers(0, 10**9, n)]},
+    )
+    path = str(tmp_path / "clustered.parquet")
+    _write(path, batch, **{"parquet.page-size": "512"})
+    return path, schema
+
+
+def test_pushdown_expands_strictly_fewer_pages(tmp_path, rng):
+    path, schema = _clustered_file(tmp_path, rng)
+    pred = P.equal("tag", "tag-03")
+    g = decode_metrics()
+
+    d0 = g.counter("pages_decoded").count
+    full = _native_read(path, schema)  # no predicate: every page expands
+    full_pages = g.counter("pages_decoded").count - d0
+
+    d0, s0 = g.counter("pages_decoded").count, g.counter("pages_skipped").count
+    filtered = _native_read(path, schema, predicate=pred)
+    pushed_pages = g.counter("pages_decoded").count - d0
+    skipped = g.counter("pages_skipped").count - s0
+
+    assert skipped > 0, "clustered selective predicate must skip whole pages"
+    assert pushed_pages < full_pages, "pushdown must expand strictly fewer pages than full decode"
+    # the early-dropped rows are exactly rows the dense predicate kills
+    expect = full.filter(pred.eval(full))
+    got = filtered.filter(pred.eval(filtered))
+    assert got.to_pydict() == expect.to_pydict()
+    assert filtered.num_rows < full.num_rows
+
+
+def test_pushdown_rowgroup_stats_gate(tmp_path, rng):
+    schema = pt.RowType.of(("k", pt.BIGINT()), ("v", pt.DOUBLE()))
+    batch = ColumnBatch.from_pydict(
+        schema, {"k": list(range(10000)), "v": [float(i) for i in range(10000)]}
+    )
+    path = str(tmp_path / "stats.parquet")
+    # dictionary off isolates the STATS gate (else the dictionary gate also
+    # prunes rows inside the surviving group)
+    _write(path, batch, **{"parquet.row-group.rows": "1000", "parquet.enable.dictionary": "false"})
+    got = _native_read(path, schema, predicate=P.between("k", 2500, 2600))
+    # only the one row group containing [2500, 2600] survives the stats gate
+    assert got.num_rows == 1000
+    assert got.column("k").values.min() == 2000 and got.column("k").values.max() == 2999
+    _assert_parity(path, schema, predicate=P.between("k", 2500, 2600))
+
+
+def test_pushdown_mask_is_projection_independent(tmp_path, rng):
+    """The pipelined merge read decodes keys and values in two passes with
+    the same predicate and requires identical row sets."""
+    path, schema = _clustered_file(tmp_path, rng)
+    pred = P.in_("tag", ["tag-01", "tag-07"])
+    a = _native_read(path, schema, projection=["tag"], predicate=pred)
+    b = _native_read(path, schema, projection=["v"], predicate=pred)
+    c = _native_read(path, schema, projection=["v", "tag"], predicate=pred)
+    assert a.num_rows == b.num_rows == c.num_rows
+    assert b.column("v").values.tolist() == c.column("v").values.tolist()
+
+
+def test_pushdown_all_pruned_row_group(tmp_path, rng):
+    path, schema = _clustered_file(tmp_path, rng)
+    got = _native_read(path, schema, predicate=P.equal("tag", "tag-99"))
+    assert got.num_rows == 0
+
+
+# ---------------------------------------------------------------------------
+# wiring: table option, cache key, fallback, threaded reads
+# ---------------------------------------------------------------------------
+
+TBL_SCHEMA = pt.RowType.of(("k", pt.BIGINT()), ("s", pt.STRING()), ("v", pt.DOUBLE()))
+
+
+def _write_table(table, keys, step):
+    wb = table.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(
+        {
+            "k": list(keys),
+            "s": [f"s{int(k) % 5}" for k in keys],
+            "v": [float(step) + float(k) / 1000 for k in keys],
+        }
+    )
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def _read_rows(table, predicate=None):
+    rb = table.new_read_builder()
+    if predicate is not None:
+        rb = rb.with_filter(predicate)
+    return sorted(rb.new_read().read_all(rb.new_scan().plan()).to_pylist())
+
+
+def test_native_decoder_through_table_reads(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table(
+        "db.nat",
+        TBL_SCHEMA,
+        primary_keys=["k"],
+        options={"bucket": "2", "cache.data-file.max-memory-size": "0 b"},
+    )
+    for step in range(3):  # overlapping runs: the merge path reads natively
+        _write_table(t, range(step * 20, step * 20 + 50), step)
+    arrow_view = t.copy({"format.parquet.decoder": "arrow"})
+    native_view = t.copy({"format.parquet.decoder": "native"})
+    g = decode_metrics()
+    n0 = g.counter("files_native").count
+    assert _read_rows(native_view) == _read_rows(arrow_view)
+    assert g.counter("files_native").count > n0, "table read must route through the native decoder"
+    pred = P.equal("k", 42)
+    assert _read_rows(native_view, pred) == _read_rows(arrow_view, pred)
+
+
+def test_native_decoder_survives_compaction(tmp_warehouse):
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table(
+        "db.natc",
+        TBL_SCHEMA,
+        primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "format.parquet.decoder": "native",
+            "num-sorted-run.compaction-trigger": "2",
+            "cache.data-file.max-memory-size": "0 b",
+        },
+    )
+    for step in range(4):  # trips compaction: rewrites decode natively too
+        _write_table(t, range(0, 40), step)
+    expect = {r[0]: r for r in _read_rows(t.copy({"format.parquet.decoder": "arrow"}))}
+    got = {r[0]: r for r in _read_rows(t)}
+    assert got == expect
+    assert all(r[2] == pytest.approx(3.0 + r[0] / 1000) for r in got.values())
+
+
+def test_decoder_identity_in_cache_key(tmp_warehouse):
+    from paimon_tpu.utils.cache import data_file_cache
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="c")
+    t = cat.create_table(
+        "db.ck",
+        TBL_SCHEMA,
+        primary_keys=["k"],
+        options={"bucket": "1", "cache.data-file.max-memory-size": "64 mb"},
+    )
+    _write_table(t, range(30), 0)
+    arrow_rows = _read_rows(t.copy({"format.parquet.decoder": "arrow"}))
+    before = len(data_file_cache())
+    native_rows = _read_rows(t.copy({"format.parquet.decoder": "native"}))
+    assert native_rows == arrow_rows
+    # the native read must MISS the arrow-decoded entry (fresh key), never
+    # alias it: one more entry per (file, projection) variant
+    assert len(data_file_cache()) > before, "decoder switch aliased a cached batch"
+
+
+def test_unsupported_features_fall_back_to_arrow(tmp_path, rng):
+    schema = pt.RowType.of(("k", pt.BIGINT()), ("arr", ArrayType(pt.INT())))
+    batch = ColumnBatch.from_pydict(
+        schema, {"k": [1, 2, 3], "arr": [[1, 2], None, [3]]}
+    )
+    path = str(tmp_path / "nested.parquet")
+    ParquetFormat().write(IO, path, batch)
+    g = decode_metrics()
+    f0 = g.counter("files_fallback").count
+    out = concat_batches(list(ParquetFormat(decoder="native").read(IO, path, schema)))
+    assert g.counter("files_fallback").count == f0 + 1
+    assert out.to_pydict() == batch.to_pydict()
+    with pytest.raises(UnsupportedParquetFeature):
+        read_native(IO, path, schema)
+
+
+def test_concurrent_threaded_reads_through_local_path(tmp_path, rng):
+    """Regression for the known-flaky path: concurrent threaded decode of
+    memory-mapped local files (format/parquet.py prefers FileIO.local_path
+    so pyarrow mmaps; first-use lazy init used to segfault under races).
+    Drives BOTH decoders through the shared decode pool at once."""
+    from paimon_tpu.utils import shared_executor
+
+    paths = []
+    expect = []
+    for i in range(4):
+        path = str(tmp_path / f"c{i}.parquet")
+        batch = _random_batch(np.random.default_rng(100 + i), 1500)
+        _write(path, batch)
+        paths.append(path)
+        expect.append(batch.to_pydict())
+    assert IO.local_path(paths[0]) is not None, "precondition: mmap path active"
+
+    def task(job):
+        idx, native = job
+        fmt = ParquetFormat(decoder="native" if native else "arrow")
+        out = concat_batches(list(fmt.read(IO, paths[idx], FULL_SCHEMA)))
+        return idx, out.to_pydict()
+
+    jobs = [(i % len(paths), bool(i % 2)) for i in range(32)]
+    for idx, got in shared_executor().map(task, jobs):
+        assert got == expect[idx], f"threaded decode corrupted file {idx}"
