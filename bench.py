@@ -164,6 +164,30 @@ def bench_scan_cache(table) -> float:
     return cold / warm if warm > 0 else float("inf")
 
 
+def bench_resilience() -> dict:
+    """Commit resilience spot-check (benchmarks/resilience_bench.py is the
+    dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
+    rate through the retry stack. failed_commits must stay 0; the retry/
+    giveup counters make resilience regressions visible in BENCH_* exactly
+    like perf regressions."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "resilience_bench.py")
+    spec = importlib.util.spec_from_file_location("_resilience_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    row = mod.run_config(0.05, 20, True)
+    return {
+        "metric": "commit resilience (5% injected transient faults)",
+        "commits": row["commits"],
+        "failed_commits": row["failed_commits"],
+        "io_retries": row["io_retries"],
+        "io_giveups": row["io_giveups"],
+        "commits_per_sec": row["commits_per_sec"],
+        "unit": "counters",
+    }
+
+
 def main():
     tmp = tempfile.mkdtemp(prefix="paimon_tpu_bench_")
     try:
@@ -171,6 +195,7 @@ def main():
         rows_per_sec = bench_read(table)
         scan_cache_speedup = bench_scan_cache(table)
         decode_row = bench_decode(table)
+        resilience_row = bench_resilience()
         row = {
             "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
             "value": round(rows_per_sec, 1),
@@ -203,6 +228,7 @@ def main():
             )
         )
         print(json.dumps(dict(decode_row, platform=_PLATFORM)))
+        print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -89,7 +89,9 @@ def main(argv=None) -> int:
         elif name == "rollback_to":
             p.add_argument("--to", required=True, help="snapshot id or tag name")
         elif name == "remove_orphan_files":
-            p.add_argument("--older-than-hours", type=float, default=24.0)
+            p.add_argument("--older-than-hours", type=float, default=None,
+                           help="safety threshold (default: table option "
+                                "orphan.clean.older-than, 1 day)")
             p.add_argument("--dry-run", action="store_true")
         elif name == "migrate_table":
             p.add_argument("--warehouse", required=True)
@@ -411,7 +413,11 @@ def main(argv=None) -> int:
         from .table.maintenance import remove_orphan_files
 
         removed = remove_orphan_files(
-            t, older_than_millis=int(args.older_than_hours * 3600_000), dry_run=args.dry_run
+            t,
+            older_than_millis=None
+            if args.older_than_hours is None
+            else int(args.older_than_hours * 3600_000),
+            dry_run=args.dry_run,
         )
         print(json.dumps({"orphans": removed, "dry_run": args.dry_run}))
     elif action == "query":

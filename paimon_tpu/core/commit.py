@@ -19,6 +19,7 @@ from typing import Callable, Sequence
 
 from ..fs import FileIO
 from ..options import CoreOptions
+from ..resilience.faults import crash_point
 from ..utils import dumps, loads, new_file_name, now_millis
 from .manifest import (
     CommitMessage,
@@ -38,11 +39,17 @@ from .snapshot import CommitKind, Snapshot, SnapshotManager
 # monotonic per-user streaming sequence.
 BATCH_COMMIT_IDENTIFIER = (1 << 63) - 1
 
-__all__ = ["FileStoreCommit", "CommitConflictError"]
+__all__ = ["FileStoreCommit", "CommitConflictError", "CommitGiveUpError"]
 
 
 class CommitConflictError(RuntimeError):
     pass
+
+
+class CommitGiveUpError(RuntimeError):
+    """The bounded commit retry loop (commit.max-retries) was exhausted
+    without winning the snapshot CAS. The table is untouched by this commit
+    (every round's metadata was cleaned up); the committable may be replayed."""
 
 
 class FileStoreCommit:
@@ -280,11 +287,16 @@ class FileStoreCommit:
         changelog_entries: list[ManifestEntry] | None = None,
         statistics: str | None = None,
     ) -> int:
+        import random
         import time
 
         from ..metrics import registry
 
         g = registry.group("commit")
+        opts = self.options.options
+        max_retries = opts.get(CoreOptions.COMMIT_MAX_RETRIES)
+        backoff_base = float(opts.get(CoreOptions.COMMIT_RETRY_BACKOFF))
+        prev_backoff: float | None = None
         retries = 0
         t_start = time.perf_counter()
         from contextlib import nullcontext
@@ -293,7 +305,33 @@ class FileStoreCommit:
             with self._lock.lock() if self._lock is not None else nullcontext():
                 latest = self.snapshot_manager.latest_snapshot()
                 if check_conflicts and latest is not None:
-                    self._no_conflicts_or_fail(latest, entries)
+                    conflicted = self._conflicted_buckets(latest, entries)
+                    if conflicted:
+                        g.counter("conflicts").inc()
+                        all_buckets = {(e.partition, e.bucket) for e in entries}
+                        if all_buckets <= conflicted:
+                            raise CommitConflictError(
+                                f"files of bucket(s) {sorted(conflicted)} were removed by a "
+                                f"concurrent commit; giving up this {kind.value} commit"
+                            )
+                        # retriable conflict: only SOME buckets lost their
+                        # inputs to a concurrent commit. Abandon those (their
+                        # rewritten outputs become orphans, reclaimed by
+                        # remove_orphan_files) and re-plan the untouched
+                        # buckets against the new latest — finer-grained than
+                        # the seed's whole-commit abort.
+                        g.counter("buckets_abandoned").inc(len(conflicted))
+                        entries = [e for e in entries if (e.partition, e.bucket) not in conflicted]
+                        removed_files = [
+                            e for e in (removed_files or []) if (e.partition, e.bucket) not in conflicted
+                        ]
+                        changelog_entries = [
+                            e for e in (changelog_entries or []) if (e.partition, e.bucket) not in conflicted
+                        ]
+                        index_entries = [
+                            ie for ie in (index_entries or []) if (ie.partition, ie.bucket) not in conflicted
+                        ]
+                crash_point("commit:before-manifests")
                 tmp_files: list[str] = []
                 try:
                     snapshot_id = (latest.id + 1) if latest else 1
@@ -304,24 +342,24 @@ class FileStoreCommit:
                         else []
                     )
                     base_metas = self._maybe_merge_manifests(base_metas, tmp_files)
-                    delta_meta = self.manifest_file.write(entries, self.schema_id)
-                    tmp_files.append(delta_meta.file_name)
-                    base_name = self.manifest_list.write(base_metas)
-                    tmp_files.append(base_name)
-                    delta_name = self.manifest_list.write([delta_meta])
-                    tmp_files.append(delta_name)
+                    delta_meta = self.manifest_file.write(entries, self.schema_id, track=tmp_files)
+                    base_name = self.manifest_list.write(base_metas, track=tmp_files)
+                    delta_name = self.manifest_list.write([delta_meta], track=tmp_files)
                     changelog_list = None
                     changelog_rows = None
                     if changelog_entries:
-                        cl_meta = self.manifest_file.write(changelog_entries, self.schema_id)
-                        tmp_files.append(cl_meta.file_name)
-                        changelog_list = self.manifest_list.write([cl_meta])
-                        tmp_files.append(changelog_list)
+                        cl_meta = self.manifest_file.write(changelog_entries, self.schema_id, track=tmp_files)
+                        changelog_list = self.manifest_list.write([cl_meta], track=tmp_files)
                         changelog_rows = sum(e.file.row_count for e in changelog_entries)
                     added = sum(e.file.row_count for e in entries if e.kind == FileKind.ADD)
                     deleted = sum(e.file.row_count for e in entries if e.kind == FileKind.DELETE)
                     prev_total = (latest.total_record_count or 0) if latest else 0
                     index_manifest = self._index_manifest(latest, index_entries or [], removed_files)
+                    if index_manifest and index_manifest != (latest.index_manifest if latest else None):
+                        # freshly written this round: clean it up with the
+                        # other metadata if the CAS is lost/aborted (the seed
+                        # leaked it)
+                        tmp_files.append(index_manifest)
                     snapshot = Snapshot(
                         id=snapshot_id,
                         schema_id=self.schema_id,
@@ -340,6 +378,7 @@ class FileStoreCommit:
                         watermark=committable.watermark,
                         log_offsets=dict(committable.log_offsets),
                     )
+                    crash_point("commit:manifests-written")
                     path = self.snapshot_manager.snapshot_path(snapshot_id)
                     if self.file_io.try_atomic_write(path, snapshot.to_json().encode()):
                         g.counter("commits").inc()
@@ -348,6 +387,7 @@ class FileStoreCommit:
                         # committed: the snapshot now references these manifests —
                         # they must never be cleaned up, even if hints fail
                         tmp_files.clear()
+                        crash_point("commit:snapshot-committed")
                         try:
                             self.snapshot_manager.commit_latest_hint(snapshot_id)
                             if snapshot_id == 1:
@@ -355,27 +395,73 @@ class FileStoreCommit:
                         except Exception:
                             pass  # hints are best-effort; listing is authoritative
                         return snapshot_id
-                    # lost the race: clean tmp metadata and retry against new latest
+                    # lost the CAS race. First: did OUR commit actually land?
+                    # (an IO-layer retry of a rename whose ack was lost, or a
+                    # replay racing its own earlier attempt) — adopting it
+                    # instead of re-committing prevents double-apply.
+                    own = self._find_own_commit(snapshot_id, committable, kind)
+                    if own is not None:
+                        self._cleanup(tmp_files)
+                        return own
+                    # genuinely lost to another committer: clean this round's
+                    # metadata and retry against the new latest
                     self._cleanup(tmp_files)
                     retries += 1
+                    if retries > max_retries:
+                        raise CommitGiveUpError(
+                            f"commit lost the snapshot race {retries} times "
+                            f"(commit.max-retries={max_retries}); giving up"
+                        )
                 except Exception:
                     self._cleanup(tmp_files)
                     raise
+                # a simulated CrashError (BaseException) bypasses the cleanup
+                # above on purpose: a killed process runs no cleanup either —
+                # recovery is remove_orphan_files' job
+            # backoff OUTSIDE the lock so racing committers make progress;
+            # decorrelated jitter desynchronizes the herd
+            if backoff_base > 0:
+                hi = min(backoff_base * 100.0, max(backoff_base, (prev_backoff or backoff_base) * 3.0))
+                prev_backoff = random.uniform(backoff_base, hi)
+                time.sleep(prev_backoff / 1000.0)
 
-    def _no_conflicts_or_fail(self, latest: Snapshot, entries: list[ManifestEntry]) -> None:
-        """Every file we logically delete must still be live (reference
-        noConflictsOrFail :804-808 — a concurrent compaction removing the same
-        files is a conflict; the loser abandons its compaction)."""
+    def _conflicted_buckets(self, latest: Snapshot, entries: list[ManifestEntry]) -> set[tuple]:
+        """(partition, bucket) slots whose logically-deleted files are no
+        longer live (reference noConflictsOrFail :804-808 — a concurrent
+        compaction removing the same files is a conflict; the loser abandons
+        that bucket's compaction)."""
         deletes = [e for e in entries if e.kind == FileKind.DELETE]
         if not deletes:
-            return
+            return set()
         live = {(e.partition, e.bucket, e.file.file_name) for e in self._live_entries(latest)}
-        for e in deletes:
-            if (e.partition, e.bucket, e.file.file_name) not in live:
-                raise CommitConflictError(
-                    f"file {e.file.file_name} (partition={e.partition}, bucket={e.bucket}) "
-                    f"was removed by a concurrent commit; giving up this compaction"
-                )
+        return {
+            (e.partition, e.bucket)
+            for e in deletes
+            if (e.partition, e.bucket, e.file.file_name) not in live
+        }
+
+    def _find_own_commit(self, from_id: int, committable: ManifestCommittable, kind: CommitKind) -> int | None:
+        """After a lost CAS at `from_id`: the id of an already-landed snapshot
+        carrying OUR (user, identifier, kind), or None. Sentinel identifiers
+        (batch / maintenance) are shared across logical commits and cannot
+        prove identity."""
+        ident = committable.commit_identifier
+        if ident >= BATCH_COMMIT_IDENTIFIER - 16:
+            return None
+        latest_id = self.snapshot_manager.latest_snapshot_id()
+        if latest_id is None:
+            return None
+        for sid in range(from_id, latest_id + 1):
+            if not self.snapshot_manager.snapshot_exists(sid):
+                continue
+            snap = self.snapshot_manager.snapshot(sid)
+            if (
+                snap.commit_user == self.commit_user
+                and snap.commit_identifier == ident
+                and snap.commit_kind == kind
+            ):
+                return sid
+        return None
 
     def _maybe_merge_manifests(
         self, metas: list[ManifestFileMeta], tmp_files: list[str]
@@ -418,17 +504,41 @@ class FileStoreCommit:
             while i < len(entries):
                 per_file = max(1, int(target / per_entry))
                 chunk = entries[i : i + per_file]
-                meta = self.manifest_file.write(chunk, self.schema_id)
-                tmp_files.append(meta.file_name)
+                meta = self.manifest_file.write(chunk, self.schema_id, track=tmp_files)
                 out.append(meta)
                 per_entry = max(1.0, meta.file_size / max(len(chunk), 1))
                 i += len(chunk)
         return out
 
     def _cleanup(self, names: list[str]) -> None:
+        """Best-effort removal of this round's metadata after an abort or a
+        lost CAS race: the tracked manifest names AND their torn `.tmp.*`
+        siblings (an atomic write that failed between tmp write and rename
+        leaves one — names are tracked BEFORE any byte is written, so even a
+        write that died mid-flight is covered). Failures are non-fatal (the
+        original error must win; leftovers become orphans for
+        remove_orphan_files) and are counted in io{cleanup_failures}."""
+        if not names:
+            return
+        from ..metrics import io_metrics
+
+        g = io_metrics()
+        mdir = f"{self.table_path}/manifest"
+        siblings: dict[str, list[str]] = {}
+        try:
+            for st in self.file_io.list_files(mdir):
+                base = st.path.rsplit("/", 1)[-1]
+                if base.startswith(".") and base.endswith(".tmp"):
+                    # .<name>.<hex>.tmp -> <name>; only OUR tracked names are
+                    # swept (a concurrent committer's in-flight tmp must live).
+                    # Path rebuilt from mdir: wrapper FileIOs list inner paths.
+                    siblings.setdefault(base[1:].rsplit(".", 2)[0], []).append(f"{mdir}/{base}")
+        except Exception:
+            g.counter("cleanup_failures").inc()
         for name in names:
-            try:
-                self.file_io.delete(f"{self.table_path}/manifest/{name}")
-            except Exception:
-                pass
+            for target in (f"{mdir}/{name}", *siblings.get(name, ())):
+                try:
+                    self.file_io.delete(target)
+                except Exception:
+                    g.counter("cleanup_failures").inc()
         names.clear()

@@ -44,6 +44,21 @@ class SnapshotExpire:
         self.manifest_list = ManifestList(file_io, f"{table_path}/manifest", cache=cache)
         self.protected_ids = protected_ids or (lambda: ())
 
+    def _safe_delete(self, path: str) -> bool:
+        """Physical deletion during expiry is best-effort: a transient store
+        fault on one file must not abort the run half-way (leaving SOME
+        snapshots deleted and their files still referenced-looking). A failed
+        delete leaves an unreachable file — exactly what remove_orphan_files
+        reclaims on its next sweep — and counts in io{cleanup_failures}."""
+        try:
+            self.file_io.delete(path)
+            return True
+        except Exception:
+            from ..metrics import io_metrics
+
+            io_metrics().counter("cleanup_failures").inc()
+            return False
+
     def _changelog_decoupled(self) -> bool:
         return any(
             self.options.options.get(o) is not None
@@ -141,15 +156,15 @@ class SnapshotExpire:
             # bucket dirs are resolved by the store layer convention
             pp = self._bucket_dir(partition, bucket)
             touched_dirs.add(pp)
-            self.file_io.delete(f"{pp}/{file_name}")
+            self._safe_delete(f"{pp}/{file_name}")
             invalidate_data_file(file_name)
             for x in extra:
-                self.file_io.delete(f"{pp}/{x}")
+                self._safe_delete(f"{pp}/{x}")
         for name in dead_manifests:
-            self.file_io.delete(f"{self.table_path}/manifest/{name}")
+            self._safe_delete(f"{self.table_path}/manifest/{name}")
             invalidate_manifest_path(f"{self.table_path}/manifest/{name}")
         for sid in expire_ids:
-            self.file_io.delete(sm.snapshot_path(sid))
+            self._safe_delete(sm.snapshot_path(sid))
             invalidate_snapshot(self.table_path, sid)
         # the hint must point at the smallest SURVIVING snapshot: protected
         # (tag/consumer) snapshots inside the expired range stay on disk, and
@@ -211,15 +226,15 @@ class SnapshotExpire:
                 for meta in self.manifest_list.read(snap.changelog_manifest_list):
                     for e in self.manifest_file.read(meta.file_name):
                         d = self._bucket_dir(e.partition, e.bucket)
-                        self.file_io.delete(f"{d}/{e.file.file_name}")
+                        self._safe_delete(f"{d}/{e.file.file_name}")
                         invalidate_data_file(e.file.file_name)
                         for x in e.file.extra_files:
-                            self.file_io.delete(f"{d}/{x}")
-                    self.manifest_file.delete(meta.file_name)
+                            self._safe_delete(f"{d}/{x}")
+                    self._safe_delete(f"{self.table_path}/manifest/{meta.file_name}")
                     invalidate_manifest_path(f"{self.table_path}/manifest/{meta.file_name}")
-                self.manifest_list.delete(snap.changelog_manifest_list)
+                self._safe_delete(f"{self.table_path}/manifest/{snap.changelog_manifest_list}")
                 invalidate_manifest_path(f"{self.table_path}/manifest/{snap.changelog_manifest_list}")
-            self.file_io.delete(sm.changelog_path(cid))
+            self._safe_delete(sm.changelog_path(cid))
             n += 1
         return n
 

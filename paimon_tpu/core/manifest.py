@@ -141,13 +141,26 @@ class _JsonlZst:
             self._table_cfg = (fmt, resolver, compression)
         return self._table_cfg
 
-    def _write_lines(self, name: str, dicts: Iterable[dict]) -> int:
+    def _write_payload(self, name: str, data: bytes, track: list[str] | None = None) -> int:
+        """Publish a manifest payload ATOMICALLY (tmp sibling + rename): a
+        writer dying mid-write can never leave a half-written file at the
+        final name, and a retried write stages a fresh tmp instead of
+        tripping over its own partial first attempt. `track` records `name`
+        BEFORE any byte lands, so an aborting commit can clean both the file
+        and any torn tmp sibling (FileStoreCommit._cleanup)."""
+        if track is not None:
+            track.append(name)
+        if not self.file_io.try_atomic_write(f"{self.directory}/{name}", data):
+            # uuid file names never collide; losing this CAS means the
+            # namespace is being re-written underneath us
+            raise OSError(f"manifest {name} unexpectedly already exists")
+        return len(data)
+
+    def _write_lines(self, name: str, dicts: Iterable[dict], track: list[str] | None = None) -> int:
         raw = "\n".join(dumps(d) for d in dicts).encode()
         _, _, compression = self._config()
         data = raw if compression == "none" else zstd_compress(raw, level=3)
-        path = f"{self.directory}/{name}"
-        self.file_io.write_bytes(path, data)
-        return len(data)
+        return self._write_payload(name, data, track)
 
     def _read_raw(self, name: str) -> bytes:
         return self.file_io.read_bytes(f"{self.directory}/{name}")
@@ -186,17 +199,18 @@ class _JsonlZst:
 class ManifestFile(_JsonlZst):
     """Reads/writes manifest files (lists of ManifestEntry)."""
 
-    def write(self, entries: Sequence[ManifestEntry], schema_id: int) -> ManifestFileMeta:
+    def write(
+        self, entries: Sequence[ManifestEntry], schema_id: int, track: list[str] | None = None
+    ) -> ManifestFileMeta:
         name = new_file_name("manifest")
         fmt, resolver, compression = self._config()
         if fmt == "avro" and resolver is not None:
             from ..interop.manifest_codec import write_entries_avro
 
             data = write_entries_avro(entries, resolver, codec="null" if compression == "none" else "deflate")
-            self.file_io.write_bytes(f"{self.directory}/{name}", data)
-            size = len(data)
+            size = self._write_payload(name, data, track)
         else:
-            size = self._write_lines(name, (e.to_dict() for e in entries))
+            size = self._write_lines(name, (e.to_dict() for e in entries), track)
         added = sum(1 for e in entries if e.kind == FileKind.ADD)
         return ManifestFileMeta(name, size, added, len(entries) - added, schema_id)
 
@@ -218,18 +232,19 @@ class ManifestFile(_JsonlZst):
 class ManifestList(_JsonlZst):
     """Reads/writes manifest lists (lists of ManifestFileMeta)."""
 
-    def write(self, metas: Sequence[ManifestFileMeta]) -> str:
+    def write(self, metas: Sequence[ManifestFileMeta], track: list[str] | None = None) -> str:
         name = new_file_name("manifest-list")
         fmt, resolver, compression = self._config()
         if fmt == "avro" and resolver is not None:
             from ..interop.manifest_codec import write_metas_avro
 
-            self.file_io.write_bytes(
-                f"{self.directory}/{name}",
+            self._write_payload(
+                name,
                 write_metas_avro(metas, resolver, codec="null" if compression == "none" else "deflate"),
+                track,
             )
         else:
-            self._write_lines(name, (m.to_dict() for m in metas))
+            self._write_lines(name, (m.to_dict() for m in metas), track)
         return name
 
     def read(self, name: str) -> list[ManifestFileMeta]:

@@ -49,22 +49,28 @@ def _parse_per_level(spec: str | None) -> dict[int, str]:
 
 class KeyValueFileStore:
     def __init__(self, file_io: FileIO, table_path: str, schema: TableSchema, commit_user: str = "anonymous"):
-        self.file_io = file_io
         self.table_path = table_path
         self.schema = schema
         self.commit_user = commit_user
         self.options = schema.core_options()
+        # resilience layer: every store-level path (scan / merge read /
+        # commit / compact / expire) routes its IO through the retrying
+        # wrapper, governed by fs.retry.* / fs.io.timeout; with retries
+        # disabled the original FileIO is used unwrapped (zero indirection)
+        from ..resilience import wrap_file_io
+
+        self.file_io = wrap_file_io(file_io, self.options)
         self.value_schema: RowType = RowType(schema.fields)
         self.key_names = schema.trimmed_primary_keys
         self.partition_keys = list(schema.partition_keys)
-        self.schema_manager = SchemaManager(file_io, table_path)
+        self.schema_manager = SchemaManager(self.file_io, table_path)
         # byte-budget caches (utils.cache): process-wide, shared by scan /
         # read / commit / compaction / lookup through this store's accessors;
         # None when the table opted out via a 0 budget
         from ..utils.cache import table_caches
 
         self.manifest_obj_cache, self.data_file_obj_cache = table_caches(self.options)
-        self.snapshot_manager = SnapshotManager(file_io, table_path, cache=self.manifest_obj_cache)
+        self.snapshot_manager = SnapshotManager(self.file_io, table_path, cache=self.manifest_obj_cache)
         self._schemas_cache: dict[int, RowType] = {}
 
     # ---- layout --------------------------------------------------------

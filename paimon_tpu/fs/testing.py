@@ -17,7 +17,40 @@ from . import FileIO, FileStatus, LocalFileIO, register_file_io, split_scheme
 
 
 class ArtificialException(IOError):
-    """Deliberately injected failure."""
+    """Deliberately injected failure. Subclasses IOError on purpose: the
+    resilience layer classifies it TRANSIENT, exactly like a real
+    object-store blip, so retry behavior is provable with it."""
+
+
+@dataclass
+class FaultRule:
+    """One deterministic scripted fault: fail ops whose kind matches `op`
+    ('read' | 'write' | 'rename' | 'delete' | 'atomic' | '*') and whose
+    LOGICAL path (scheme and domain stripped; for renames, the destination)
+    contains `path`. Fires on the nth..nth+count-1 matching ops (1-based);
+    count <= 0 keeps firing forever.
+
+    The two canonical shapes: FaultRule(op, path, nth=N) = "fail the Nth op
+    matching this pattern"; FaultRule(op, path) = fail-once-then-succeed.
+    A rule on op='rename' against a path written with try_atomic_write is a
+    TORN write: the tmp sibling is already on disk and stays there (crash
+    semantics — see FailingFileIO.try_atomic_write)."""
+
+    op: str = "*"
+    path: str | None = None
+    nth: int = 1
+    count: int = 1
+    _seen: int = 0
+
+    def fire(self, op: str, path: str) -> bool:
+        if self.op != "*" and self.op != op:
+            return False
+        if self.path is not None and self.path not in path:
+            return False
+        self._seen += 1
+        if self._seen < self.nth:
+            return False
+        return self.count <= 0 or self._seen < self.nth + self.count
 
 
 @dataclass
@@ -27,13 +60,22 @@ class _FailState:
     fails: int = 0
     rng: random.Random = field(default_factory=lambda: random.Random(0))
     lock: threading.Lock = field(default_factory=threading.Lock)
+    rules: list[FaultRule] = field(default_factory=list)
 
-    def maybe_fail(self) -> None:
+    def check(self, op: str, path: str, probabilistic: bool = True) -> None:
         with self.lock:
-            if self.possibility > 0 and self.fails < self.max_fails:
+            for rule in self.rules:
+                if rule.fire(op, path):
+                    self.fails += 1
+                    raise ArtificialException(f"scheduled fault: {op} {path}")
+            if probabilistic and self.possibility > 0 and self.fails < self.max_fails:
                 if self.rng.randrange(self.possibility) == 0:
                     self.fails += 1
                     raise ArtificialException("artificial failure")
+
+    # back-compat shim for callers scripted against the seed API
+    def maybe_fail(self) -> None:
+        self.check("*", "")
 
 
 class FailingFileIO(FileIO):
@@ -63,6 +105,19 @@ class FailingFileIO(FileIO):
         cls._states[name] = st
 
     @classmethod
+    def schedule(cls, name: str, *rules: FaultRule) -> None:
+        """Install a DETERMINISTIC fault schedule for `name` (replaces any
+        probabilistic state): each rule scripts exactly which ops fail."""
+        st = _FailState()
+        st.rules = list(rules)
+        cls._states[name] = st
+
+    @classmethod
+    def fails_injected(cls, name: str) -> int:
+        st = cls._states.get(name)
+        return 0 if st is None else st.fails
+
+    @classmethod
     def retry_until_success(cls, name: str, fn):
         """Disable injection for `name`, then run fn (for final verification)."""
         cls._states.pop(name, None)
@@ -75,24 +130,29 @@ class FailingFileIO(FileIO):
         local = "/" + tail
         return self._states.get(name), local
 
-    def _wrap(self, path: str) -> str:
+    def _wrap(self, path: str, op: str) -> str:
         st, local = self._strip(path)
         if st is not None:
-            st.maybe_fail()
+            st.check(op, local)
         return local
 
     def read_bytes(self, path: str) -> bytes:
-        return self._inner.read_bytes(self._wrap(path))
+        return self._inner.read_bytes(self._wrap(path, "read"))
 
     def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
-        self._inner.write_bytes(self._wrap(path), data, overwrite)
+        self._inner.write_bytes(self._wrap(path, "write"), data, overwrite)
 
     def exists(self, path: str) -> bool:
         _, local = self._strip(path)
         return self._inner.exists(local)
 
     def delete(self, path: str, recursive: bool = False) -> bool:
-        _, local = self._strip(path)
+        # deterministic rules only: the probabilistic oracle never failed
+        # deletes (seed behavior), but scheduled delete faults let tests
+        # prove cleanup failures are non-fatal
+        st, local = self._strip(path)
+        if st is not None:
+            st.check("delete", local, probabilistic=False)
         return self._inner.delete(local, recursive)
 
     def mkdirs(self, path: str) -> None:
@@ -103,7 +163,7 @@ class FailingFileIO(FileIO):
         st, s = self._strip(src)
         _, d = self._strip(dst)
         if st is not None:
-            st.maybe_fail()
+            st.check("rename", d)
         return self._inner.rename(s, d)
 
     def list_status(self, path: str) -> list[FileStatus]:
@@ -115,25 +175,44 @@ class FailingFileIO(FileIO):
         return self._inner.get_status(local)
 
     def open_input(self, path: str):
-        return self._inner.open_input(self._wrap(path))
+        return self._inner.open_input(self._wrap(path, "read"))
 
     def try_atomic_write(self, path: str, data: bytes) -> bool:
-        if isinstance(self._inner, LocalFileIO):
-            # base temp+rename path: faults injected per sub-op (write, rename)
-            return super().try_atomic_write(path, data)
-        # inner overrides the commit primitive (object store: conditional
-        # PUT, no rename) — delegate so the oracle exercises THAT protocol
         st, local = self._strip(path)
+        if not isinstance(self._inner, LocalFileIO):
+            # inner overrides the commit primitive (object store: conditional
+            # PUT, no rename) — delegate so the oracle exercises THAT protocol
+            if st is not None:
+                st.check("atomic", local)
+            return self._inner.try_atomic_write(local, data)
+        # POSIX temp+rename, decomposed with CRASH-realistic injection:
+        # - a fault on the write phase fires before any bytes land;
+        # - a fault on the rename phase fires AFTER the tmp write, and the
+        #   torn tmp sibling STAYS on disk (a crashed process runs no
+        #   cleanup) — reclaiming it is remove_orphan_files' job. The seed
+        #   harness cleaned the tmp in a finally block, which made
+        #   torn-write recovery untestable.
         if st is not None:
-            st.maybe_fail()
-        return self._inner.try_atomic_write(local, data)
+            st.check("write", local)
+        tmp = self._temp_sibling(local)
+        self._inner.write_bytes(tmp, data, overwrite=True)
+        if st is not None:
+            st.check("rename", local)
+        ok = self._inner.rename(tmp, local)
+        if not ok:
+            # graceful CAS loser (no crash): clean our own staging file
+            try:
+                self._inner.delete(tmp)
+            except Exception:
+                pass
+        return ok
 
     def try_overwrite(self, path: str, data: bytes) -> bool:
         if isinstance(self._inner, LocalFileIO):
             return super().try_overwrite(path, data)
         st, local = self._strip(path)
         if st is not None:
-            st.maybe_fail()
+            st.check("atomic", local)
         return self._inner.try_overwrite(local, data)
 
 

@@ -23,6 +23,7 @@ __all__ = [
     "registry",
     "timed",
     "decode_metrics",
+    "io_metrics",
 ]
 
 
@@ -143,6 +144,18 @@ def decode_metrics() -> MetricGroup:
     (whole-file native decode wall millis), pushdown_ms (per row group).
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("decode")
+
+
+def io_metrics() -> MetricGroup:
+    """The io{...} group (resilience subsystem). Canonical members —
+    counters: retries (transient faults absorbed by RetryingFileIO),
+    giveups (ops that exhausted fs.retry.max-attempts), timeouts (ops that
+    blew the fs.io.timeout deadline), cleanup_failures (non-fatal failures
+    while deleting tmp/abandoned files in commit cleanup / expire / orphan
+    sweep), orphans_removed; histogram: backoff_ms (individual retry
+    sleeps). Resolved per call so registry.reset() in tests swaps the group
+    out."""
+    return registry.group("io")
 
 
 class timed:

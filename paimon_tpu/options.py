@@ -312,6 +312,50 @@ class CoreOptions:
     SOURCE_SPLIT_OPEN_FILE_COST = ConfigOption.memory(
         "source.split.open-file-cost", "4 mb", "Weight floor per file when packing splits."
     )
+    FS_RETRY_MAX_ATTEMPTS = ConfigOption.int_(
+        "fs.retry.max-attempts",
+        3,
+        "Total tries per FileIO op before a transient fault becomes fatal "
+        "(resilience.RetryingFileIO, installed by the store). 1 disables "
+        "retrying entirely — the wrapper is then not even constructed.",
+    )
+    FS_RETRY_INITIAL_BACKOFF = ConfigOption.duration(
+        "fs.retry.initial-backoff",
+        "10 ms",
+        "Base backoff between IO retries; actual sleeps use decorrelated "
+        "jitter (U(base, 3*prev), capped by fs.retry.max-backoff).",
+    )
+    FS_RETRY_MAX_BACKOFF = ConfigOption.duration(
+        "fs.retry.max-backoff", "2 s", "Cap on a single IO retry backoff."
+    )
+    FS_IO_TIMEOUT = ConfigOption.duration(
+        "fs.io.timeout",
+        None,
+        "Per-op wall-clock deadline spanning all retry attempts; past it the "
+        "op fails with IODeadlineExceeded (counted in io{timeouts}). Unset = "
+        "unbounded.",
+    )
+    COMMIT_MAX_RETRIES = ConfigOption.int_(
+        "commit.max-retries",
+        10,
+        "Bounded commit retry loop: snapshot-CAS races (and conflict "
+        "re-plans) are retried this many times with commit.retry-backoff "
+        "between rounds before the commit gives up (CommitGiveUpError). The "
+        "seed looped forever — a livelock under heavy contention.",
+    )
+    COMMIT_RETRY_BACKOFF = ConfigOption.duration(
+        "commit.retry-backoff",
+        "10 ms",
+        "Base backoff between commit retry rounds (decorrelated jitter, "
+        "capped at 100x base) so racing committers desynchronize.",
+    )
+    ORPHAN_CLEAN_OLDER_THAN = ConfigOption.duration(
+        "orphan.clean.older-than",
+        "1 d",
+        "remove_orphan_files safety threshold: only files older than this "
+        "are eligible for deletion (an in-flight commit's freshly written "
+        "files must survive the sweep).",
+    )
     COMMIT_CATALOG_LOCK = ConfigOption.bool_(
         "commit.catalog-lock.enabled",
         False,

@@ -1,0 +1,39 @@
+"""Resilience layer: retrying IO, deterministic fault points, crash recovery.
+
+The durability story of the lake rests on one primitive — atomic-rename
+snapshot commit over an eventually-flaky filesystem. This package makes every
+hot path (plan -> merge read -> commit -> compact -> expire) survive transient
+object-store faults and clean up after crashes:
+
+- retry.py      transient-vs-permanent error classification + decorrelated-
+                jitter backoff with per-op deadlines (RetryPolicy)
+- fileio.py     RetryingFileIO, the FileIO wrapper installed by core/store.py
+- faults.py     named crash points (armed by tests to kill a commit at exact
+                protocol steps) — the deterministic half of the fault harness
+                (the scripted FileIO schedules live in fs/testing.py)
+- orphan.py     crash recovery: reachability walk over all live snapshots /
+                changelogs / tags / branches and deletion of unreferenced
+                files and stale .tmp.* siblings
+
+Parity: the reference wraps object-store FileIOs in retry shells
+(hadoop s3a retries / oss RetryPolicy) and ships orphan cleanup as
+RemoveOrphanFilesAction over OrphanFilesClean.
+"""
+
+from .faults import CrashError, arm_crash_point, crash_point, disarm_crash_points
+from .fileio import RetryingFileIO, wrap_file_io
+from .orphan import remove_orphan_files
+from .retry import IODeadlineExceeded, RetryPolicy, is_transient
+
+__all__ = [
+    "RetryPolicy",
+    "RetryingFileIO",
+    "wrap_file_io",
+    "is_transient",
+    "IODeadlineExceeded",
+    "CrashError",
+    "crash_point",
+    "arm_crash_point",
+    "disarm_crash_points",
+    "remove_orphan_files",
+]

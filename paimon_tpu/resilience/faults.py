@@ -1,0 +1,111 @@
+"""Named crash points: deterministic process-death simulation.
+
+Instrumented code calls `crash_point("commit:manifests-written")` at exact
+protocol steps; tests arm a point to kill the operation there (raise
+CrashError, simulating the process dying with no cleanup running beyond what
+an exception unwinds) or to run an arbitrary action at the point — the hook
+that lets a test deterministically interleave a competing commit between one
+committer's latest-snapshot read and its snapshot CAS.
+
+Crash-point map of the commit protocol (FileStoreCommit._try_commit):
+
+  commit:before-manifests    inside the (optional) catalog lock, after the
+                             latest-snapshot read + conflict check, before
+                             any manifest write. Crash leaves nothing.
+  commit:manifests-written   all manifests / manifest lists / changelog and
+                             index manifests durable, snapshot file NOT yet
+                             renamed in. Crash leaves orphan manifests (and
+                             possibly torn .tmp siblings) that no reader can
+                             reach; remove_orphan_files reclaims them.
+  commit:snapshot-committed  the snapshot CAS succeeded; hints not yet
+                             written. Crash leaves a fully-visible commit —
+                             replaying the committable must be filtered out
+                             by filter_committed (idempotence contract).
+
+Unarmed points are a dict lookup on a module-level map — zero cost in
+production paths.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+__all__ = ["CrashError", "crash_point", "arm_crash_point", "disarm_crash_points", "COMMIT_CRASH_POINTS"]
+
+# the canonical points instrumented in core/commit.py (tests iterate this)
+COMMIT_CRASH_POINTS = (
+    "commit:before-manifests",
+    "commit:manifests-written",
+    "commit:snapshot-committed",
+)
+
+
+class CrashError(BaseException):
+    """Simulated process death at a named crash point.
+
+    Deliberately NOT an Exception subclass: production code that swallows
+    broad `except Exception` (cleanup paths, best-effort hints) must not
+    accidentally survive a simulated crash — a real SIGKILL wouldn't."""
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at {point}")
+        self.point = point
+
+
+@dataclass
+class _Armed:
+    skip: int = 0  # let this many hits pass before acting
+    count: int = 1  # act on this many hits after the skip (<=0 = forever)
+    action: Callable[[], None] | None = None  # None = raise CrashError
+    hits: int = 0
+    fired: int = 0
+
+
+_armed: dict[str, _Armed] = {}
+_lock = threading.Lock()
+
+
+def arm_crash_point(
+    name: str,
+    skip: int = 0,
+    count: int = 1,
+    action: Callable[[], None] | None = None,
+) -> None:
+    """Arm `name`: after `skip` passes, the next `count` hits either raise
+    CrashError (action=None) or run `action()` at the point (the action may
+    itself raise to crash, or just mutate the world — e.g. land a competing
+    commit — and return to let the operation continue)."""
+    with _lock:
+        _armed[name] = _Armed(skip=skip, count=count, action=action)
+
+
+def disarm_crash_points(*names: str) -> None:
+    """Disarm the given points, or ALL points when called with none."""
+    with _lock:
+        if names:
+            for n in names:
+                _armed.pop(n, None)
+        else:
+            _armed.clear()
+
+
+def crash_point(name: str) -> None:
+    """Called by instrumented code. No-op unless a test armed `name`."""
+    if not _armed:  # fast path: nothing armed anywhere
+        return
+    with _lock:
+        st = _armed.get(name)
+        if st is None:
+            return
+        st.hits += 1
+        if st.hits <= st.skip:
+            return
+        if st.count > 0 and st.fired >= st.count:
+            return
+        st.fired += 1
+        action = st.action
+    if action is None:
+        raise CrashError(name)
+    action()

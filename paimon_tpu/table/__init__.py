@@ -179,6 +179,14 @@ class FileStoreTable(Table):
     def to_pandas(self, predicate=None, projection=None):
         return self.to_arrow(predicate=predicate, projection=projection).to_pandas()
 
+    def remove_orphan_files(self, older_than_millis: int | None = None, dry_run: bool = False) -> list[str]:
+        """Crash recovery: delete files unreachable from every live snapshot/
+        changelog/tag/branch plus torn .tmp.* residue (resilience/orphan.py);
+        default threshold `orphan.clean.older-than`."""
+        from .maintenance import remove_orphan_files
+
+        return remove_orphan_files(self, older_than_millis=older_than_millis, dry_run=dry_run)
+
     def expire_snapshots(self) -> int:
         from .tags import TagManager
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import datetime
 from typing import TYPE_CHECKING
 
-from ..core.manifest import CommitMessage, ManifestCommittable
+from ..core.manifest import ManifestCommittable
 from ..utils import now_millis
 
 if TYPE_CHECKING:
@@ -20,77 +20,17 @@ if TYPE_CHECKING:
 __all__ = ["remove_orphan_files", "expire_partitions", "drop_partition", "mark_partition_done"]
 
 
-def remove_orphan_files(table: "FileStoreTable", older_than_millis: int = 24 * 3600_000, dry_run: bool = False) -> list[str]:
-    """Delete data/manifest/index files referenced by NO snapshot, changelog,
-    or tag. Only files older than the TTL are touched — an in-flight commit's
-    freshly written files must survive (reference OrphanFilesClean default:
-    1 day)."""
-    from ..core.indexmanifest import read_index_manifest
-    from ..core.manifest import ManifestFile, ManifestList
-    from .tags import TagManager
+def remove_orphan_files(
+    table: "FileStoreTable", older_than_millis: int | None = None, dry_run: bool = False
+) -> list[str]:
+    """Delete files referenced by NO snapshot, changelog, tag, or branch,
+    plus torn `.tmp.*` write residue. Only files older than the threshold
+    (default `orphan.clean.older-than`, 1 day) are touched — an in-flight
+    commit's freshly written files must survive. The reachability walk and
+    sweep live in resilience/orphan.py (crash-recovery subsystem)."""
+    from ..resilience.orphan import remove_orphan_files as _impl
 
-    io = table.file_io
-    path = table.path
-    sm = table.store.snapshot_manager
-    manifest_file = ManifestFile(io, f"{path}/manifest")
-    manifest_list = ManifestList(io, f"{path}/manifest")
-
-    live_data: set[tuple] = set()  # (bucket_dir_relative, file_name)
-    live_meta: set[str] = set()  # manifest dir file names
-    live_index: set[str] = set()
-
-    snapshots = list(sm.snapshots())
-    tags = TagManager(io, path)
-    for name in tags.list_tags():
-        snapshots.append(tags.get(name))
-    for snap in snapshots:
-        lists = [snap.base_manifest_list, snap.delta_manifest_list, snap.changelog_manifest_list]
-        for lst in lists:
-            if not lst:
-                continue
-            live_meta.add(lst)
-            for meta in manifest_list.read(lst):
-                live_meta.add(meta.file_name)
-                for e in manifest_file.read(meta.file_name):
-                    bucket_dir = table.store.bucket_dir(e.partition, e.bucket)
-                    live_data.add((bucket_dir, e.file.file_name))
-                    for x in e.file.extra_files:
-                        live_data.add((bucket_dir, x))
-        if snap.index_manifest:
-            live_meta.add(snap.index_manifest)
-            from ..core.deletionvectors import DeletionVectorsIndexFile
-
-            dv_io = DeletionVectorsIndexFile(io, path)
-            for ie in read_index_manifest(io, path, snap.index_manifest):
-                if ie.kind == "DELETION_VECTORS":
-                    live_index.update(dv_io.chain_names(ie.file_name))
-                else:
-                    live_index.add(ie.file_name)
-
-    cutoff = now_millis() - older_than_millis
-    removed: list[str] = []
-
-    def sweep_dir(directory: str, keep: set[str]):
-        for st in io.list_files(directory):
-            base = st.path.rsplit("/", 1)[-1]
-            if base in keep or st.mtime_millis >= cutoff:
-                continue
-            removed.append(st.path)
-            if not dry_run:
-                io.delete(st.path)
-
-    sweep_dir(f"{path}/manifest", live_meta)
-    sweep_dir(f"{path}/index", live_index)
-    # bucket dirs: walk partitions via the live set's dirs plus table root
-    seen_dirs = {d for d, _ in live_data}
-    for st in io.list_status(path):
-        base = st.path.rsplit("/", 1)[-1]
-        if st.is_dir and base.startswith("bucket-"):
-            seen_dirs.add(st.path)
-    for d in seen_dirs:
-        keep = {f for dd, f in live_data if dd == d}
-        sweep_dir(d, keep)
-    return removed
+    return _impl(table, older_than_millis=older_than_millis, dry_run=dry_run)
 
 
 def expire_partitions(table: "FileStoreTable", expiration_millis: int, time_col: str | None = None, pattern: str = "%Y-%m-%d") -> list[tuple]:

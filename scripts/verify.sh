@@ -1,9 +1,24 @@
 #!/usr/bin/env bash
-# Tier-1 verification gate — the EXACT command from ROADMAP.md, so builders
-# and reviewers run the same check. Exits non-zero on test failure/timeout;
-# prints DOTS_PASSED=<n> (count of passing tests) for trend comparison.
+# Verification gates.
+#
+#   scripts/verify.sh          tier-1 gate — the EXACT command from ROADMAP.md,
+#                              so builders and reviewers run the same check.
+#   scripts/verify.sh faults   resilience fault-matrix stage: runs the
+#                              scheduled-fault + crash-point suite under a
+#                              FIXED seed set, so resilience regressions are
+#                              reproducible across machines.
+#
+# Exits non-zero on test failure/timeout; tier-1 prints DOTS_PASSED=<n>
+# (count of passing tests) for trend comparison.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "faults" ]; then
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" \
+    timeout -k 10 600 python -m pytest tests/test_resilience.py tests/test_commit_faults.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \

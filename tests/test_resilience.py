@@ -1,0 +1,641 @@
+"""Tier-3 resilience: retry policy, scripted fault schedules, commit crash
+points, commit auto-retry, and orphan-file crash recovery.
+
+The fault matrix (test_crash_point_matrix + test_fault_matrix_transient_rate)
+drives write -> commit -> compact -> expire under faults at every named crash
+point and a scheduled transient-error rate, asserting the three recovery
+invariants:
+  (a) readers never observe a partial snapshot,
+  (b) a follow-up / replayed commit succeeds,
+  (c) remove_orphan_files restores the on-disk file set to exactly the
+      reachable closure of live snapshots (independent oracle below).
+
+Seeds for the probabilistic matrix come from PAIMON_TPU_FAULT_SEEDS (comma or
+space separated) so scripts/verify.sh's `faults` stage pins a fixed seed set.
+"""
+
+import json
+import os
+
+import pytest
+
+from paimon_tpu.core.commit import CommitConflictError, CommitGiveUpError
+from paimon_tpu.core.manifest import ManifestCommittable, ManifestFile, ManifestList
+from paimon_tpu.core.schema import SchemaManager
+from paimon_tpu.core.snapshot import CommitKind
+from paimon_tpu.core.store import KeyValueFileStore
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.fs import LocalFileIO, get_file_io
+from paimon_tpu.fs.testing import ArtificialException, FailingFileIO, FaultRule
+from paimon_tpu.metrics import io_metrics, registry
+from paimon_tpu.resilience import (
+    CrashError,
+    IODeadlineExceeded,
+    RetryPolicy,
+    RetryingFileIO,
+    arm_crash_point,
+    disarm_crash_points,
+    is_transient,
+    wrap_file_io,
+)
+from paimon_tpu.resilience.faults import COMMIT_CRASH_POINTS
+from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
+
+FAULT_SEEDS = [
+    int(s) for s in os.environ.get("PAIMON_TPU_FAULT_SEEDS", "0,1").replace(",", " ").split()
+]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    disarm_crash_points()
+
+
+# ---------------------------------------------------------------- helpers
+def make_store(tmp_path, domain, opts=None, user="res"):
+    FailingFileIO.reset(domain, 0, 0)
+    io = get_file_io(f"fail://{domain}/x")
+    path = f"fail://{domain}{tmp_path}/table"
+    o = {"bucket": "1", **(opts or {})}
+    ts = SchemaManager(io, path).create_table(SCHEMA, primary_keys=["k"], options=o)
+    return KeyValueFileStore(io, path, ts, commit_user=user)
+
+
+def open_store(store, user):
+    """Second handle over the same table (a concurrent committer)."""
+    ts = SchemaManager(store.file_io, store.table_path).latest()
+    return KeyValueFileStore(store.file_io, store.table_path, ts, commit_user=user)
+
+
+def write_commit(store, ident, data: dict, bucket=0, compact_full=False):
+    w = store.new_writer((), bucket)
+    w.write(ColumnBatch.from_pydict(store.value_schema, {"k": list(data), "v": list(data.values())}))
+    if compact_full:
+        w.compact(full=True)
+    msg = w.prepare_commit()
+    return store.new_commit().commit(ManifestCommittable(ident, messages=[msg]))
+
+
+def read_kv(store, buckets=(0,)):
+    out = {}
+    for b in buckets:
+        batch = store.read_bucket((), b, store.restore_files((), b))
+        out.update({r[0]: r[1] for r in batch.to_pylist()})
+    return out
+
+
+def local_root(tmp_path):
+    return f"{tmp_path}/table"
+
+
+def file_set(root) -> set:
+    out = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for f in filenames:
+            out.add(os.path.join(dirpath, f))
+    return out
+
+
+def reachable_closure(root) -> set:
+    """Independent reachability oracle: parse snapshot JSON directly and walk
+    lists -> manifests -> data/index files for the main root and every
+    branch. Everything it names, PLUS table metadata (schemas, snapshot/
+    changelog/tag roots, hints, branch markers), is the expected on-disk set
+    after a clean orphan sweep."""
+    io = LocalFileIO()
+    expected = set()
+
+    def add_dir(d):
+        for st in io.list_files(d):
+            expected.add(st.path)
+
+    roots = [root]
+    for st in io.list_status(f"{root}/branch"):
+        if st.is_dir:
+            roots.append(st.path)
+    for r in roots:
+        add_dir(f"{r}/schema")
+        add_dir(f"{r}/consumer")
+        if r != root:
+            add_dir(r)  # branch markers (CREATED_FROM)
+        snaps = []
+        for d, prefix in ((f"{r}/snapshot", "snapshot-"), (f"{r}/changelog", "changelog-")):
+            for st in io.list_files(d):
+                base = st.path.rsplit("/", 1)[-1]
+                if base.startswith(prefix):
+                    expected.add(st.path)
+                    snaps.append(json.loads(io.read_bytes(st.path)))
+                elif base in ("LATEST", "EARLIEST"):
+                    expected.add(st.path)
+        for st in io.list_files(f"{r}/tag"):
+            expected.add(st.path)
+            snaps.append(json.loads(io.read_bytes(st.path)))
+        ml = ManifestList(io, f"{r}/manifest")
+        mf = ManifestFile(io, f"{r}/manifest")
+        for s in snaps:
+            for lst in (s["baseManifestList"], s["deltaManifestList"], s.get("changelogManifestList")):
+                if not lst:
+                    continue
+                expected.add(f"{r}/manifest/{lst}")
+                for meta in ml.read(lst):
+                    expected.add(f"{r}/manifest/{meta.file_name}")
+                    for e in mf.read(meta.file_name):
+                        # data files always live in the MAIN tree
+                        expected.add(f"{root}/bucket-{e.bucket}/{e.file.file_name}")
+                        for x in e.file.extra_files:
+                            expected.add(f"{root}/bucket-{e.bucket}/{x}")
+            im = s.get("indexManifest")
+            if im:
+                expected.add(f"{r}/manifest/{im}")
+                from paimon_tpu.core.indexmanifest import read_index_manifest
+
+                for ie in read_index_manifest(io, r, im):
+                    expected.add(f"{r}/index/{ie.file_name}")
+    return expected
+
+
+def assert_clean_matches_closure(table_like, root):
+    removed = _orphan(table_like)
+    assert file_set(root) == reachable_closure(root), f"removed={removed}"
+    return removed
+
+
+def _orphan(store_or_table, dry_run=False):
+    from paimon_tpu.resilience.orphan import remove_orphan_files
+
+    t = store_or_table
+    if isinstance(t, KeyValueFileStore):
+        from paimon_tpu.table import FileStoreTable
+
+        t = FileStoreTable(t.file_io, t.table_path, t.schema, t.commit_user)
+    return remove_orphan_files(t, older_than_millis=-3600_000, dry_run=dry_run)
+
+
+# ---------------------------------------------------------- retry policy
+def test_transient_classification():
+    assert is_transient(ArtificialException("blip"))
+    assert is_transient(ConnectionResetError())
+    assert is_transient(TimeoutError())
+    assert is_transient(OSError("generic store hiccup"))
+    assert not is_transient(FileNotFoundError())
+    assert not is_transient(FileExistsError())
+    assert not is_transient(PermissionError())
+    assert not is_transient(IsADirectoryError())
+    assert not is_transient(ValueError("bad arg"))
+    assert not is_transient(IODeadlineExceeded("deadline"))
+    import errno
+
+    assert not is_transient(OSError(errno.ENOSPC, "disk full"))
+    assert not is_transient(OSError(errno.ENOENT, "gone"))
+
+
+def test_decorrelated_backoff_bounds():
+    import random
+
+    p = RetryPolicy(max_attempts=10, initial_backoff_ms=10, max_backoff_ms=200, rng=random.Random(7))
+    prev = None
+    for _ in range(50):
+        b = p.next_backoff_ms(prev)
+        assert 10 <= b <= 200
+        prev = b
+
+
+def test_retry_absorbs_transient_fault(tmp_path):
+    domain = "res_retry1"
+    FailingFileIO.schedule(domain, FaultRule(op="read", count=2))
+    registry.reset()
+    io = RetryingFileIO(get_file_io(f"fail://{domain}/x"), RetryPolicy(max_attempts=3, initial_backoff_ms=0.1))
+    p = f"fail://{domain}{tmp_path}/f"
+    io.write_bytes(p, b"payload")
+    assert io.read_bytes(p) == b"payload"  # 2 scheduled faults absorbed
+    assert io_metrics().counter("retries").count == 2
+    assert io_metrics().counter("giveups").count == 0
+
+
+def test_retry_gives_up_after_max_attempts(tmp_path):
+    domain = "res_retry2"
+    FailingFileIO.schedule(domain, FaultRule(op="read", count=0))  # fail forever
+    registry.reset()
+    io = RetryingFileIO(get_file_io(f"fail://{domain}/x"), RetryPolicy(max_attempts=3, initial_backoff_ms=0.1))
+    p = f"fail://{domain}{tmp_path}/f"
+    io.write_bytes(p, b"x")
+    with pytest.raises(ArtificialException):
+        io.read_bytes(p)
+    assert io_metrics().counter("retries").count == 2  # 3 attempts = 2 retries
+    assert io_metrics().counter("giveups").count == 1
+
+
+def test_io_deadline_exceeded(tmp_path):
+    domain = "res_retry3"
+    FailingFileIO.schedule(domain, FaultRule(op="read", count=0))
+    registry.reset()
+    io = RetryingFileIO(
+        get_file_io(f"fail://{domain}/x"),
+        RetryPolicy(max_attempts=1000, initial_backoff_ms=5, max_backoff_ms=10, timeout_ms=30),
+    )
+    p = f"fail://{domain}{tmp_path}/f"
+    io.write_bytes(p, b"x")
+    with pytest.raises(IODeadlineExceeded):
+        io.read_bytes(p)
+    assert io_metrics().counter("timeouts").count == 1
+
+
+def test_permanent_error_not_retried(tmp_path):
+    registry.reset()
+    io = RetryingFileIO(LocalFileIO(), RetryPolicy(max_attempts=5, initial_backoff_ms=0.1))
+    with pytest.raises(FileNotFoundError):
+        io.read_bytes(f"{tmp_path}/does-not-exist")
+    assert io_metrics().counter("retries").count == 0
+
+
+def test_wrap_disabled_returns_inner():
+    from paimon_tpu.options import CoreOptions
+
+    inner = LocalFileIO()
+    assert wrap_file_io(inner, CoreOptions({"fs.retry.max-attempts": "1"})) is inner
+    wrapped = wrap_file_io(inner, CoreOptions({}))
+    assert isinstance(wrapped, RetryingFileIO)  # default-on
+    assert wrap_file_io(wrapped, CoreOptions({})) is wrapped  # no double wrap
+    # local fast path shines through the wrapper
+    assert wrapped.local_path("/a/b") == "/a/b"
+
+
+def test_scheduled_nth_op_fault(tmp_path):
+    domain = "res_sched"
+    FailingFileIO.schedule(domain, FaultRule(op="write", path="/data/", nth=2))
+    io = get_file_io(f"fail://{domain}/x")
+    base = f"fail://{domain}{tmp_path}/data"
+    io.write_bytes(f"{base}/a", b"1")  # 1st matching op: passes
+    with pytest.raises(ArtificialException):
+        io.write_bytes(f"{base}/b", b"2")  # 2nd: scheduled fault
+    io.write_bytes(f"{base}/c", b"3")  # 3rd: passes again
+    io.write_bytes(f"{tmp_path}/elsewhere", b"x")  # pattern miss: never faulted
+
+
+# ----------------------------------------------------- torn atomic writes
+def test_torn_write_leaves_tmp_and_orphan_reclaims(tmp_path):
+    """Satellite: a fault injected after the tmp write leaves the torn tmp on
+    disk; readers never see the partial snapshot; remove_orphan_files
+    reclaims everything down to the reachable closure."""
+    domain = "res_torn"
+    store = make_store(tmp_path, domain, opts={"fs.retry.max-attempts": "1"})
+    write_commit(store, 1, {1: 1.0, 2: 2.0})
+    FailingFileIO.schedule(domain, FaultRule(op="rename", path="/snapshot/"))
+    with pytest.raises(ArtificialException):
+        write_commit(store, 2, {3: 3.0})
+    FailingFileIO.reset(domain, 0, 0)
+    root = local_root(tmp_path)
+    torn = [f for f in file_set(f"{root}/snapshot") if f.rsplit("/", 1)[-1].startswith(".snapshot-2")]
+    assert len(torn) == 1 and torn[0].endswith(".tmp")
+    # (a) no reader observes the partial snapshot
+    assert store.snapshot_manager.latest_snapshot_id() == 1
+    assert read_kv(store) == {1: 1.0, 2: 2.0}
+    # (c) cleanup restores exactly the reachable closure (incl. the torn tmp)
+    removed = assert_clean_matches_closure(store, root)
+    assert any(p.endswith(".tmp") for p in removed)
+    # (b) a follow-up commit succeeds
+    write_commit(store, 2, {3: 3.0})
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 3: 3.0}
+
+
+def test_cleanup_removes_manifest_tmp_siblings(tmp_path):
+    """Satellite: an aborted commit cleans both its tracked manifest files
+    and their torn .tmp siblings."""
+    domain = "res_mtmp"
+    store = make_store(tmp_path, domain, opts={"fs.retry.max-attempts": "1"})
+    write_commit(store, 1, {1: 1.0})
+    FailingFileIO.schedule(domain, FaultRule(op="rename", path="/manifest/manifest-"))
+    with pytest.raises(ArtificialException):
+        write_commit(store, 2, {2: 2.0})
+    FailingFileIO.reset(domain, 0, 0)
+    root = local_root(tmp_path)
+    stray = [f for f in file_set(f"{root}/manifest") if ".tmp" in f]
+    assert stray == [], f"cleanup left torn manifest tmps: {stray}"
+    # data file of the aborted commit is an orphan until swept
+    assert_clean_matches_closure(store, root)
+    assert read_kv(store) == {1: 1.0}
+
+
+def test_cleanup_failures_are_nonfatal(tmp_path):
+    domain = "res_cfail"
+    store = make_store(tmp_path, domain, opts={"fs.retry.max-attempts": "1"})
+    write_commit(store, 1, {1: 1.0})
+    registry.reset()
+    FailingFileIO.schedule(
+        domain,
+        FaultRule(op="rename", path="/manifest/manifest-"),
+        FaultRule(op="delete", path="/manifest/", count=0),
+    )
+    # the ORIGINAL torn-write error must surface, not a cleanup error
+    with pytest.raises(ArtificialException):
+        write_commit(store, 2, {2: 2.0})
+    FailingFileIO.reset(domain, 0, 0)
+    assert io_metrics().counter("cleanup_failures").count > 0
+    # the leftovers are reclaimed by the orphan sweep
+    assert_clean_matches_closure(store, local_root(tmp_path))
+    assert read_kv(store) == {1: 1.0}
+
+
+# ------------------------------------------------------ commit crash points
+@pytest.mark.parametrize("point", COMMIT_CRASH_POINTS)
+def test_crash_point_matrix(tmp_path, point):
+    domain = f"res_cp_{point.split(':')[1].replace('-', '')}"
+    store = make_store(tmp_path, domain)
+    write_commit(store, 1, {1: 1.0, 2: 2.0})
+    w = store.new_writer((), 0)
+    w.write(ColumnBatch.from_pydict(store.value_schema, {"k": [3], "v": [3.0]}))
+    msg = w.prepare_commit()
+    committable = ManifestCommittable(2, messages=[msg])
+    arm_crash_point(point)
+    with pytest.raises(CrashError):
+        store.new_commit().commit(committable)
+    disarm_crash_points()
+    # (a) readers never observe a partial snapshot: either the old state or
+    # (past the CAS) the fully-committed new state
+    committed = point == "commit:snapshot-committed"
+    assert store.snapshot_manager.latest_snapshot_id() == (2 if committed else 1)
+    expect = {1: 1.0, 2: 2.0, 3: 3.0} if committed else {1: 1.0, 2: 2.0}
+    assert read_kv(store) == expect
+    # (b) recovery replay: filter_committed keeps the idempotence contract
+    commit = store.new_commit()
+    remaining = commit.filter_committed([ManifestCommittable(2, messages=[msg])])
+    if committed:
+        assert remaining == []  # already durable: replay is a no-op
+    else:
+        assert len(remaining) == 1
+        commit.commit(remaining[0])
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 3: 3.0}
+    # (c) whatever the crash left behind, the sweep restores the closure
+    assert_clean_matches_closure(store, local_root(tmp_path))
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 3: 3.0}
+
+
+def test_commit_auto_retry_on_cas_race(tmp_path):
+    """A rival lands a snapshot between our latest-read and our CAS: the
+    bounded retry loop re-plans against the new latest and succeeds."""
+    domain = "res_race"
+    store = make_store(tmp_path, domain)
+    write_commit(store, 1, {1: 1.0})
+    rival = open_store(store, "rival")
+
+    def rival_commits():
+        write_commit(rival, 1, {100: 100.0})
+
+    registry.reset()
+    arm_crash_point("commit:manifests-written", action=rival_commits, count=1)
+    write_commit(store, 2, {2: 2.0})
+    disarm_crash_points()
+    assert registry.group("commit").counter("retries").count >= 1
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 100: 100.0}
+    assert_clean_matches_closure(store, local_root(tmp_path))
+
+
+def test_commit_gives_up_after_max_retries(tmp_path):
+    domain = "res_giveup"
+    store = make_store(
+        tmp_path, domain, opts={"commit.max-retries": "2", "commit.retry-backoff": "1 ms"}
+    )
+    write_commit(store, 1, {1: 1.0})
+    rival = open_store(store, "rival")
+    counter = {"n": 1, "busy": False}
+
+    def rival_always_wins():
+        if counter["busy"]:
+            return  # the rival's own commit passes the same crash point
+        counter["busy"] = True
+        try:
+            counter["n"] += 1
+            write_commit(rival, counter["n"], {1000 + counter["n"]: 0.0})
+        finally:
+            counter["busy"] = False
+
+    arm_crash_point("commit:manifests-written", action=rival_always_wins, count=0)
+    with pytest.raises(CommitGiveUpError):
+        write_commit(store, 2, {2: 2.0})
+    disarm_crash_points()
+    # every aborted round's metadata was cleaned: sweep finds only the
+    # abandoned DATA file of the failed commit
+    removed = _orphan(store)
+    assert all("/bucket-0/" in p for p in removed)
+    assert file_set(local_root(tmp_path)) == reachable_closure(local_root(tmp_path))
+
+
+def test_own_commit_adopted_after_lost_rename_ack(tmp_path):
+    """If our snapshot CAS actually landed but the ack was lost (IO-layer
+    retry path), the retry loop must ADOPT the landed snapshot instead of
+    double-committing."""
+    domain = "res_ack"
+    store = make_store(tmp_path, domain)
+    write_commit(store, 1, {1: 1.0})
+    w = store.new_writer((), 0)
+    w.write(ColumnBatch.from_pydict(store.value_schema, {"k": [2], "v": [2.0]}))
+    msg = w.prepare_commit()
+    commit = store.new_commit()
+    committable = ManifestCommittable(2, messages=[msg])
+
+    def land_our_snapshot_first():
+        # simulate "rename succeeded, ack lost": the snapshot content that
+        # commit is ABOUT to CAS gets published by an earlier torn attempt
+        c2 = open_store(store, "res").new_commit()
+        c2.commit(ManifestCommittable(2, messages=[msg]))
+
+    arm_crash_point("commit:manifests-written", action=land_our_snapshot_first, count=1)
+    ids = commit.commit(committable)
+    disarm_crash_points()
+    assert ids == [2]
+    assert store.snapshot_manager.latest_snapshot_id() == 2  # no duplicate snapshot
+    assert read_kv(store) == {1: 1.0, 2: 2.0}
+
+
+def test_conflict_replan_nonoverlapping_buckets(tmp_path):
+    """A concurrent compaction stole only bucket 0: the commit abandons that
+    bucket and still lands bucket 1's rewrite (seed aborted everything)."""
+    domain = "res_replan"
+    store = make_store(tmp_path, domain, opts={"bucket": "2"})
+    w0 = store.new_writer((), 0)
+    w0.write(ColumnBatch.from_pydict(store.value_schema, {"k": [1, 2], "v": [1.0, 2.0]}))
+    w1 = store.new_writer((), 1)
+    w1.write(ColumnBatch.from_pydict(store.value_schema, {"k": [11, 12], "v": [11.0, 12.0]}))
+    store.new_commit().commit(ManifestCommittable(1, messages=[w0.prepare_commit(), w1.prepare_commit()]))
+
+    # both buckets' compactions prepared from snapshot 1
+    c0 = store.new_writer((), 0)
+    c0.compact(full=True)
+    c1 = store.new_writer((), 1)
+    c1.compact(full=True)
+    ours = ManifestCommittable(2, messages=[c0.prepare_commit(), c1.prepare_commit()])
+    # rival compacts bucket 0 first
+    rival = open_store(store, "rival")
+    r0 = rival.new_writer((), 0)
+    r0.compact(full=True)
+    rival.new_commit().commit(ManifestCommittable(1, messages=[r0.prepare_commit()]))
+
+    registry.reset()
+    ids = store.new_commit().commit(ours)  # must NOT raise
+    assert len(ids) == 1
+    assert registry.group("commit").counter("buckets_abandoned").count == 1
+    snap = store.snapshot_manager.latest_snapshot()
+    assert snap.commit_kind == CommitKind.COMPACT
+    delta = ManifestList(store.file_io, f"{store.table_path}/manifest").read(snap.delta_manifest_list)
+    mf = ManifestFile(store.file_io, f"{store.table_path}/manifest")
+    touched_buckets = {e.bucket for m in delta for e in mf.read(m.file_name)}
+    assert touched_buckets == {1}  # bucket 0 abandoned, bucket 1 landed
+    assert read_kv(store, buckets=(0, 1)) == {1: 1.0, 2: 2.0, 11: 11.0, 12: 12.0}
+    # the abandoned bucket-0 rewrite output is an orphan; sweep restores closure
+    assert_clean_matches_closure(store, local_root(tmp_path))
+    assert read_kv(store, buckets=(0, 1)) == {1: 1.0, 2: 2.0, 11: 11.0, 12: 12.0}
+
+    # all-conflict case: when EVERY bucket's inputs were stolen, the commit
+    # still raises (nothing left to re-plan). Fresh level-0 data first, so
+    # both racing compactions have genuine work.
+    write_commit(store, 3, {13: 13.0}, bucket=1)
+    c1b = store.new_writer((), 1)
+    c1b.compact(full=True)
+    stale = ManifestCommittable(4, messages=[c1b.prepare_commit()])
+    r1 = rival.new_writer((), 1)
+    r1.compact(full=True)
+    rival.new_commit().commit(ManifestCommittable(2, messages=[r1.prepare_commit()]))
+    with pytest.raises(CommitConflictError):
+        store.new_commit().commit(stale)
+    oracle = {1: 1.0, 2: 2.0, 11: 11.0, 12: 12.0, 13: 13.0}
+    assert read_kv(store, buckets=(0, 1)) == oracle
+    assert_clean_matches_closure(store, local_root(tmp_path))
+    assert read_kv(store, buckets=(0, 1)) == oracle
+
+
+# --------------------------------------------------- expire + orphan sweep
+def test_expire_delete_faults_nonfatal(tmp_path):
+    domain = "res_expfail"
+    store = make_store(
+        tmp_path,
+        domain,
+        opts={
+            "fs.retry.max-attempts": "1",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "1",
+            "snapshot.time-retained": "0 ms",
+        },
+    )
+    for i in range(1, 4):
+        write_commit(store, i, {i: float(i)})
+    write_commit(store, 4, {4: 4.0}, compact_full=True)
+    registry.reset()
+    # expired snapshots' manifest lists/manifests die during expiry; make
+    # every one of those deletes fail
+    FailingFileIO.schedule(domain, FaultRule(op="delete", path="/manifest/", count=0))
+    n = store.new_expire().expire()  # must not raise despite failing deletes
+    assert n == 4  # snapshots 1-3 plus the APPEND half of commit 4
+    assert io_metrics().counter("cleanup_failures").count > 0
+    FailingFileIO.reset(domain, 0, 0)
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 3: 3.0, 4: 4.0}
+    # the undeleted data files are unreachable -> the orphan sweep finishes the job
+    assert_clean_matches_closure(store, local_root(tmp_path))
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 3: 3.0, 4: 4.0}
+
+
+def test_orphan_preserves_branch_references(tmp_warehouse):
+    """Branch manifests live under the branch dir but reference data files in
+    the MAIN tree: the sweep must span branches before touching bucket dirs
+    (the seed walked only the main root and would delete branch-only data)."""
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.table.branch import BranchManager, branch_table
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="res")
+    t = cat.create_table("db.resbr", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1], "v": [1.0]})
+    wb.new_commit().commit(w.prepare_commit())
+    BranchManager(t.file_io, t.path).create("dev")
+    bt = branch_table(t, "dev")
+    wb2 = bt.new_batch_write_builder()
+    w2 = wb2.new_write()
+    w2.write({"k": [2], "v": [2.0]})
+    wb2.new_commit().commit(w2.prepare_commit())  # data only the BRANCH references
+    t.create_tag("keep", snapshot_id=1)
+    # plant orphans in both planes
+    t.file_io.write_bytes(f"{t.path}/bucket-0/data-orphan.parquet", b"junk")
+    t.file_io.write_bytes(f"{t.path}/manifest/manifest-orphan", b"junk")
+    t.file_io.write_bytes(f"{t.path}/snapshot/.snapshot-9.deadbeef.tmp", b"junk")
+    removed = t.remove_orphan_files(older_than_millis=-3600_000)
+    names = {p.rsplit("/", 1)[-1] for p in removed}
+    assert names == {"data-orphan.parquet", "manifest-orphan", ".snapshot-9.deadbeef.tmp"}
+    assert file_set(t.path) == reachable_closure(t.path)
+    rb = branch_table(t, "dev").new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    assert sorted(out.to_pylist()) == [(1, 1.0), (2, 2.0)]
+
+
+def test_orphan_dry_run_deletes_nothing(tmp_path):
+    domain = "res_dry"
+    store = make_store(tmp_path, domain)
+    write_commit(store, 1, {1: 1.0})
+    store.file_io.write_bytes(f"{store.table_path}/manifest/manifest-orphan", b"junk")
+    before = file_set(local_root(tmp_path))
+    would = _orphan(store, dry_run=True)
+    assert [p.rsplit("/", 1)[-1] for p in would] == ["manifest-orphan"]
+    assert file_set(local_root(tmp_path)) == before
+
+
+# --------------------------------------------------------- the fault matrix
+@pytest.mark.parametrize("seed", FAULT_SEEDS)
+def test_fault_matrix_transient_rate(tmp_path, seed):
+    """write -> commit -> compact -> expire at a 5% injected transient-error
+    rate: with retries on, every commit succeeds, readers always match the
+    oracle, and the final sweep restores exactly the reachable closure."""
+    domain = f"res_matrix{seed}"
+    store = make_store(
+        tmp_path,
+        domain,
+        opts={
+            "fs.retry.max-attempts": "5",
+            "fs.retry.initial-backoff": "1 ms",
+            "fs.retry.max-backoff": "20 ms",
+            "commit.retry-backoff": "1 ms",
+            "snapshot.num-retained.min": "2",
+            "snapshot.num-retained.max": "3",
+            "snapshot.time-retained": "0 ms",
+        },
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    oracle = {}
+    FailingFileIO.reset(domain, max_fails=10**9, possibility=20, seed=seed)
+    for round_ in range(1, 9):
+        ks = rng.integers(0, 40, 12).tolist()
+        vs = [float(x) for x in rng.random(12)]
+        w = store.new_writer((), 0)
+        w.write(ColumnBatch.from_pydict(store.value_schema, {"k": ks, "v": vs}))
+        if round_ % 3 == 0:
+            w.compact(full=True)
+        msg = w.prepare_commit()
+        ids = store.new_commit().commit(ManifestCommittable(round_, messages=[msg]))
+        assert ids, f"round {round_} produced no snapshot"
+        for k, v in zip(ks, vs):
+            oracle[k] = v
+        assert read_kv(store) == oracle  # (a) reads always see full commits
+        store.new_expire().expire()
+    faults = FailingFileIO.fails_injected(domain)
+    FailingFileIO.reset(domain, 0, 0)
+    assert faults > 0, "the matrix run injected no faults at all"
+    assert read_kv(store) == oracle
+    # (c) final file set == reachable closure of the surviving snapshots
+    assert_clean_matches_closure(store, local_root(tmp_path))
+    assert read_kv(store) == oracle
+
+
+def test_fault_matrix_seed_behavior_aborts(tmp_path):
+    """Contrast case: with retries disabled (the seed's behavior) the same
+    fault schedule aborts the commit on first fault."""
+    domain = "res_noretry"
+    store = make_store(tmp_path, domain, opts={"fs.retry.max-attempts": "1"})
+    write_commit(store, 1, {1: 1.0})
+    FailingFileIO.schedule(domain, FaultRule(op="write", path="/manifest/"))
+    with pytest.raises(ArtificialException):
+        write_commit(store, 2, {2: 2.0})
+    FailingFileIO.reset(domain, 0, 0)
+    assert store.snapshot_manager.latest_snapshot_id() == 1
