@@ -399,9 +399,9 @@ class FileStoreCommit:
                     # (an IO-layer retry of a rename whose ack was lost, or a
                     # replay racing its own earlier attempt) — adopting it
                     # instead of re-committing prevents double-apply.
-                    own = self._find_own_commit(snapshot_id, committable, kind)
+                    own = self._find_own_commit(snapshot_id, committable, kind, delta_name)
                     if own is not None:
-                        self._cleanup(tmp_files)
+                        self._cleanup_after_adopt(own, tmp_files)
                         return own
                     # genuinely lost to another committer: clean this round's
                     # metadata and retry against the new latest
@@ -413,7 +413,9 @@ class FileStoreCommit:
                             f"(commit.max-retries={max_retries}); giving up"
                         )
                 except Exception:
-                    self._cleanup(tmp_files)
+                    # an exception may have escaped mid-write, so this is the
+                    # one path where torn tmp siblings can exist
+                    self._cleanup(tmp_files, sweep_torn=True)
                     raise
                 # a simulated CrashError (BaseException) bypasses the cleanup
                 # above on purpose: a killed process runs no cleanup either —
@@ -440,11 +442,29 @@ class FileStoreCommit:
             if (e.partition, e.bucket, e.file.file_name) not in live
         }
 
-    def _find_own_commit(self, from_id: int, committable: ManifestCommittable, kind: CommitKind) -> int | None:
+    def _find_own_commit(
+        self, from_id: int, committable: ManifestCommittable, kind: CommitKind, delta_name: str
+    ) -> int | None:
         """After a lost CAS at `from_id`: the id of an already-landed snapshot
-        carrying OUR (user, identifier, kind), or None. Sentinel identifiers
-        (batch / maintenance) are shared across logical commits and cannot
-        prove identity."""
+        that is OURS, or None. Two proofs of ownership:
+
+        - content: the snapshot at `from_id` references the uuid-named delta
+          manifest list written THIS round — only our own rename (whose ack
+          was lost and whose IO-layer retry then saw `path exists` → False)
+          can have published those bytes. This also covers batch/maintenance
+          commits, whose sentinel identifier proves nothing.
+        - identity: a snapshot carrying our (user, identifier, kind) — covers
+          a crash-replay racing its own earlier attempt, which wrote its own
+          manifest copies. Sentinel identifiers are shared across logical
+          commits and are excluded from this scan.
+        """
+        if self.snapshot_manager.snapshot_exists(from_id):
+            try:
+                snap = self.snapshot_manager.snapshot(from_id)
+            except Exception:
+                snap = None  # racing expiry etc.; fall through to identity
+            if snap is not None and snap.delta_manifest_list == delta_name:
+                return from_id
         ident = committable.commit_identifier
         if ident >= BATCH_COMMIT_IDENTIFIER - 16:
             return None
@@ -462,6 +482,40 @@ class FileStoreCommit:
             ):
                 return sid
         return None
+
+    def _cleanup_after_adopt(self, own_id: int, tmp_files: list[str]) -> None:
+        """Cleanup after adopting an already-landed snapshot as our own. In
+        the lost-rename-ack case the adopted snapshot IS this round's bytes:
+        every manifest it references is live and must survive cleanup, or the
+        latest snapshot dangles and the table is unreadable. A rival replay
+        wrote its own manifest copies, so nothing intersects and this round's
+        metadata is swept as usual. If the adopted snapshot cannot be re-read
+        we leak rather than delete: the orphan sweep reclaims true orphans
+        later, while a wrong delete here is unrecoverable."""
+        try:
+            snap = self.snapshot_manager.snapshot(own_id)
+            live = {
+                n
+                for n in (
+                    snap.base_manifest_list,
+                    snap.delta_manifest_list,
+                    snap.changelog_manifest_list,
+                    snap.index_manifest,
+                )
+                if n
+            }
+            for lst in (
+                snap.base_manifest_list,
+                snap.delta_manifest_list,
+                snap.changelog_manifest_list,
+            ):
+                if lst:
+                    live.update(m.file_name for m in self.manifest_list.read(lst))
+        except Exception:
+            tmp_files.clear()
+            return
+        tmp_files[:] = [n for n in tmp_files if n not in live]
+        self._cleanup(tmp_files)
 
     def _maybe_merge_manifests(
         self, metas: list[ManifestFileMeta], tmp_files: list[str]
@@ -510,14 +564,19 @@ class FileStoreCommit:
                 i += len(chunk)
         return out
 
-    def _cleanup(self, names: list[str]) -> None:
+    def _cleanup(self, names: list[str], sweep_torn: bool = False) -> None:
         """Best-effort removal of this round's metadata after an abort or a
-        lost CAS race: the tracked manifest names AND their torn `.tmp.*`
-        siblings (an atomic write that failed between tmp write and rename
-        leaves one — names are tracked BEFORE any byte is written, so even a
-        write that died mid-flight is covered). Failures are non-fatal (the
-        original error must win; leftovers become orphans for
-        remove_orphan_files) and are counted in io{cleanup_failures}."""
+        lost CAS race: the tracked manifest names and — only when `sweep_torn`
+        — their torn `.tmp.*` siblings (an atomic write that failed between
+        tmp write and rename leaves one; names are tracked BEFORE any byte is
+        written, so even a write that died mid-flight is covered). A lost-CAS
+        round completed every write, and a completed try_atomic_write leaves
+        no torn sibling, so those rounds skip the directory LIST entirely (an
+        object-store LIST per retry round is real money). Failures are
+        non-fatal (the original error must win; leftovers become orphans for
+        remove_orphan_files) and are counted in io{cleanup_failures} — except
+        a missing manifest dir, which just means the round died before its
+        first byte landed."""
         if not names:
             return
         from ..metrics import io_metrics
@@ -525,16 +584,19 @@ class FileStoreCommit:
         g = io_metrics()
         mdir = f"{self.table_path}/manifest"
         siblings: dict[str, list[str]] = {}
-        try:
-            for st in self.file_io.list_files(mdir):
-                base = st.path.rsplit("/", 1)[-1]
-                if base.startswith(".") and base.endswith(".tmp"):
-                    # .<name>.<hex>.tmp -> <name>; only OUR tracked names are
-                    # swept (a concurrent committer's in-flight tmp must live).
-                    # Path rebuilt from mdir: wrapper FileIOs list inner paths.
-                    siblings.setdefault(base[1:].rsplit(".", 2)[0], []).append(f"{mdir}/{base}")
-        except Exception:
-            g.counter("cleanup_failures").inc()
+        if sweep_torn:
+            try:
+                for st in self.file_io.list_files(mdir):
+                    base = st.path.rsplit("/", 1)[-1]
+                    if base.startswith(".") and base.endswith(".tmp"):
+                        # .<name>.<hex>.tmp -> <name>; only OUR tracked names are
+                        # swept (a concurrent committer's in-flight tmp must live).
+                        # Path rebuilt from mdir: wrapper FileIOs list inner paths.
+                        siblings.setdefault(base[1:].rsplit(".", 2)[0], []).append(f"{mdir}/{base}")
+            except FileNotFoundError:
+                pass  # dir never created: nothing to sweep
+            except Exception:
+                g.counter("cleanup_failures").inc()
         for name in names:
             for target in (f"{mdir}/{name}", *siblings.get(name, ())):
                 try:

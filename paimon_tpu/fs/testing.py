@@ -17,9 +17,12 @@ from . import FileIO, FileStatus, LocalFileIO, register_file_io, split_scheme
 
 
 class ArtificialException(IOError):
-    """Deliberately injected failure. Subclasses IOError on purpose: the
-    resilience layer classifies it TRANSIENT, exactly like a real
-    object-store blip, so retry behavior is provable with it."""
+    """Deliberately injected failure. Carries the resilience layer's explicit
+    `transient = True` marker (see resilience.retry.is_transient), so it
+    classifies TRANSIENT exactly like a real object-store blip and retry
+    behavior is provable with it."""
+
+    transient = True
 
 
 @dataclass

@@ -1,11 +1,15 @@
 """Retry policy: error classification + decorrelated-jitter backoff.
 
-Transient faults (connection resets, throttling, generic IO hiccups — and the
-test harness's ArtificialException, which subclasses IOError precisely so it
-classifies like a real object-store blip) are retried with exponential
-backoff and decorrelated jitter; permanent faults (missing file, lost CAS,
-permission) propagate immediately — retrying them only hides bugs and burns
-the op deadline.
+Transient faults are retried with exponential backoff and decorrelated
+jitter; permanent faults (missing file, lost CAS, permission) propagate
+immediately — retrying them only hides bugs and burns the op deadline.
+Classification is an ALLOWLIST: connection/timeout exception types, OSErrors
+whose errno denotes a moment-in-time fault (EIO, EAGAIN, ETIMEDOUT, …), and
+exceptions carrying an explicit `transient = True` attribute — the marker
+store adapters (and the fault harness's ArtificialException) set on
+retryable blips that don't fit a stdlib type. Everything else, including
+OSErrors without a recognized errno (wrapper-raised namespace collisions,
+adapter bugs), is permanent and surfaces on the first attempt.
 
 Backoff follows the decorrelated-jitter scheme (sleep_n = U(base, 3*prev)
 capped at max): successive retries spread out AND desynchronize, so N writers
@@ -27,25 +31,28 @@ class IODeadlineExceeded(TimeoutError):
     """The per-op deadline (fs.io.timeout) elapsed across retries."""
 
 
-# OSError errnos that retrying cannot fix: the condition is a property of the
-# request (or the namespace), not of the moment.
-_PERMANENT_ERRNOS = frozenset(
+# OSError errnos that denote a fault of the moment (store or network), not a
+# property of the request — the only errnos worth a retry. Deliberately
+# absent: ENOENT/EEXIST/EACCES (namespace/permission facts), ENOSPC/EDQUOT
+# (a full disk does not drain on a 10ms backoff), EINVAL & friends (bugs).
+_TRANSIENT_ERRNOS = frozenset(
     x
     for x in (
-        errno.ENOENT,
-        errno.EEXIST,
-        errno.EACCES,
-        errno.EPERM,
-        errno.EISDIR,
-        errno.ENOTDIR,
-        errno.ENOTEMPTY,
-        errno.EROFS,
-        errno.ENOSYS,
-        errno.EINVAL,
-        errno.ENAMETOOLONG,
-        errno.ELOOP,
-        errno.ENOSPC,  # a full disk does not drain on a 10ms backoff
-        errno.EDQUOT,
+        errno.EIO,
+        errno.EAGAIN,
+        errno.EBUSY,
+        errno.ETIMEDOUT,
+        errno.ECONNRESET,
+        errno.ECONNREFUSED,
+        errno.ECONNABORTED,
+        errno.EPIPE,
+        errno.ENETDOWN,
+        errno.ENETUNREACH,
+        errno.ENETRESET,
+        errno.EHOSTDOWN,
+        errno.EHOSTUNREACH,
+        errno.ESTALE,
+        getattr(errno, "EREMOTEIO", None),
     )
     if x is not None
 )
@@ -70,18 +77,18 @@ _TRANSIENT_TYPES = (ConnectionError, TimeoutError, BrokenPipeError)
 
 
 def is_transient(exc: BaseException) -> bool:
-    """True if retrying the op may plausibly succeed."""
+    """True if retrying the op may plausibly succeed (see module docstring
+    for the allowlist). An explicit `transient` attribute on the exception
+    wins over every structural rule."""
+    marker = getattr(exc, "transient", None)
+    if marker is not None:
+        return bool(marker)
     if isinstance(exc, _PERMANENT_TYPES):
         return False
     if isinstance(exc, _TRANSIENT_TYPES):
         return True
     if isinstance(exc, OSError):
-        e = exc.errno
-        if e is not None and e in _PERMANENT_ERRNOS:
-            return False
-        # generic IOError/OSError without a permanent errno: object-store
-        # adapters and the fault harness raise these for throttles/blips
-        return True
+        return exc.errno in _TRANSIENT_ERRNOS
     return False
 
 

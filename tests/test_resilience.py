@@ -176,20 +176,29 @@ def _orphan(store_or_table, dry_run=False):
 
 # ---------------------------------------------------------- retry policy
 def test_transient_classification():
-    assert is_transient(ArtificialException("blip"))
+    import errno
+
+    assert is_transient(ArtificialException("blip"))  # explicit marker
     assert is_transient(ConnectionResetError())
     assert is_transient(TimeoutError())
-    assert is_transient(OSError("generic store hiccup"))
+    assert is_transient(OSError(errno.EIO, "io blip"))
+    assert is_transient(OSError(errno.ETIMEDOUT, "store timed out"))
+    assert is_transient(OSError(errno.EAGAIN, "throttled"))
+    # allowlist: an OSError without a recognized errno (wrapper-raised
+    # collision, adapter bug) must NOT burn the retry budget
+    assert not is_transient(OSError("manifest x unexpectedly already exists"))
     assert not is_transient(FileNotFoundError())
     assert not is_transient(FileExistsError())
     assert not is_transient(PermissionError())
     assert not is_transient(IsADirectoryError())
     assert not is_transient(ValueError("bad arg"))
     assert not is_transient(IODeadlineExceeded("deadline"))
-    import errno
-
     assert not is_transient(OSError(errno.ENOSPC, "disk full"))
     assert not is_transient(OSError(errno.ENOENT, "gone"))
+    # the marker wins in both directions
+    exc = OSError(errno.EIO, "looks transient")
+    exc.transient = False
+    assert not is_transient(exc)
 
 
 def test_decorrelated_backoff_bounds():
@@ -448,6 +457,125 @@ def test_own_commit_adopted_after_lost_rename_ack(tmp_path):
     assert ids == [2]
     assert store.snapshot_manager.latest_snapshot_id() == 2  # no duplicate snapshot
     assert read_kv(store) == {1: 1.0, 2: 2.0}
+
+
+def _lose_snapshot_ack_once(file_io):
+    """Simulate 'rename landed, ack lost' on the NEXT snapshot CAS: the write
+    fully lands but the caller sees False — exactly what RetryingFileIO
+    surfaces after retrying a try_atomic_write whose first rename succeeded
+    but raised before acking (the retry then finds the path taken)."""
+    real = file_io.try_atomic_write
+    state = {"fired": False}
+
+    def lossy(path, data):
+        ok = real(path, data)
+        if ok and "/snapshot/" in path and not state["fired"]:
+            state["fired"] = True
+            return False
+        return ok
+
+    file_io.try_atomic_write = lossy
+    return state
+
+
+def test_own_bytes_adoption_preserves_referenced_manifests(tmp_path):
+    """True lost-rename-ack: the CAS write LANDS but returns False, so the
+    adopted snapshot is THIS round's bytes and references this round's
+    manifests. Cleanup must spare everything the snapshot references (a
+    prior bug swept them, leaving the latest snapshot dangling)."""
+    domain = "res_ack_own"
+    store = make_store(tmp_path, domain)
+    write_commit(store, 1, {1: 1.0})
+    state = _lose_snapshot_ack_once(store.file_io)
+    try:
+        ids = write_commit(store, 2, {2: 2.0})
+    finally:
+        del store.file_io.try_atomic_write
+    assert state["fired"] and ids == [2]
+    assert store.snapshot_manager.latest_snapshot_id() == 2  # adopted, not re-committed
+    assert read_kv(store) == {1: 1.0, 2: 2.0}
+    # the independent oracle re-reads every referenced manifest from disk:
+    # a swept delta manifest / manifest list would fail right here
+    assert_clean_matches_closure(store, local_root(tmp_path))
+
+
+def test_batch_commit_adopts_own_landed_snapshot(tmp_path):
+    """Sentinel (batch) identifiers cannot prove ownership by identity; the
+    content proof — the landed snapshot references this round's uuid-named
+    delta manifest list — must adopt it instead of treating it as a rival
+    (which swept the live manifests AND double-applied the ADD entries)."""
+    from paimon_tpu.core.commit import BATCH_COMMIT_IDENTIFIER
+
+    domain = "res_ack_batch"
+    store = make_store(tmp_path, domain)
+    write_commit(store, 1, {1: 1.0})
+    state = _lose_snapshot_ack_once(store.file_io)
+    try:
+        ids = write_commit(store, BATCH_COMMIT_IDENTIFIER, {2: 2.0})
+    finally:
+        del store.file_io.try_atomic_write
+    assert state["fired"] and ids == [2]
+    assert store.snapshot_manager.latest_snapshot_id() == 2  # no duplicate snapshot
+    snap = store.snapshot_manager.snapshot(2)
+    assert snap.total_record_count == 2  # ADDs applied exactly once
+    assert read_kv(store) == {1: 1.0, 2: 2.0}
+    assert_clean_matches_closure(store, local_root(tmp_path))
+
+
+def test_lost_race_cleanup_does_not_list_manifest_dir(tmp_path):
+    """A lost-CAS round completed every write (no torn tmp possible), so its
+    cleanup must not pay a manifest-dir LIST per retry round; only rounds
+    aborted by an exception sweep torn siblings."""
+    domain = "res_nolist"
+    store = make_store(tmp_path, domain, opts={"commit.retry-backoff": "1 ms"})
+    write_commit(store, 1, {1: 1.0})
+    rival = open_store(store, "rival")
+    busy = {"on": False}
+
+    def rival_wins_once():
+        if busy["on"]:
+            return
+        busy["on"] = True
+        try:
+            write_commit(rival, 100, {50: 5.0})
+        finally:
+            busy["on"] = False
+
+    lists = {"n": 0}
+    real = store.file_io.list_status
+
+    def counting(path):
+        if path.rstrip("/").endswith("/manifest"):
+            lists["n"] += 1
+        return real(path)
+
+    arm_crash_point("commit:manifests-written", action=rival_wins_once, count=1)
+    store.file_io.list_status = counting
+    try:
+        write_commit(store, 2, {2: 2.0})
+    finally:
+        del store.file_io.list_status
+        disarm_crash_points()
+    assert lists["n"] == 0
+    assert read_kv(store) == {1: 1.0, 2: 2.0, 50: 5.0}
+
+
+def test_cleanup_tolerates_missing_manifest_dir(tmp_path):
+    """A round that dies before its first manifest byte lands may have no
+    manifest dir at all; the torn-sibling sweep must treat that as 'nothing
+    to sweep', not as a cleanup failure."""
+    from paimon_tpu.core.commit import FileStoreCommit
+
+    class NoDirIO(LocalFileIO):
+        def list_status(self, path):
+            raise FileNotFoundError(path)
+
+    registry.reset()
+    c = FileStoreCommit(NoDirIO(), f"{tmp_path}/t", "u", schema_id=0)
+    names = ["manifest-deadbeef"]
+    c._cleanup(names, sweep_torn=True)
+    assert names == []
+    assert io_metrics().counter("cleanup_failures").count == 0
 
 
 def test_conflict_replan_nonoverlapping_buckets(tmp_path):
