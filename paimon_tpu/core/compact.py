@@ -182,6 +182,64 @@ class MergeTreeCompactRewriter:
         """Returns (new files, changelog files)."""
         return self.rewrite_complete(self.rewrite_dispatch(sections, output_level), output_level, drop_delete)
 
+    def rewrite_pipelined(
+        self,
+        sections: list[list[SortedRun]],
+        output_level: int,
+        drop_delete: bool,
+        depth: int,
+        parallelism: int | None = None,
+    ) -> tuple[list[DataFileMeta], list[DataFileMeta]]:
+        """Pipelined rewrite: section i+1's file reads run on pipeline
+        workers while section i's merge executes on device, and section i's
+        output encode overlaps the dispatch of section i+1's merge (the
+        resolve-previous-after-dispatch-next stagger below). Output lists are
+        in section order — identical to rewrite() (the sequential path reads
+        EVERY section before the first merge; this one keeps at most depth+1
+        sections' inputs alive)."""
+        from ..parallel.pipeline import SplitPipeline
+        from .read import order_runs_for_merge
+
+        def read_section(section):
+            runs, seq_ascending = order_runs_for_merge(section)
+            batches = []
+            old_top: list[KVBatch] = []
+            for run in runs:
+                for f in run.files:
+                    b = self._read(f)
+                    batches.append(b)
+                    if f.level == output_level:
+                        old_top.append(b)
+            return KVBatch.concat(batches), old_top, seq_ascending
+
+        out: list[DataFileMeta] = []
+        changelog: list[DataFileMeta] = []
+        pipe = SplitPipeline(parallelism, depth, stage="compact")
+        pending = None  # previous section's (merge handle, old_top)
+        for kv, old_top, seq_ascending in pipe.map_ordered(sections, read_section):
+            handle = self.merge.merge_async(kv, seq_ascending=seq_ascending)
+            if pending is not None:
+                self._write_section(pending, output_level, drop_delete, out, changelog)
+            pending = (handle, old_top)
+        if pending is not None:
+            self._write_section(pending, output_level, drop_delete, out, changelog)
+        return out, changelog
+
+    def _write_section(self, job, output_level: int, drop_delete: bool, out, changelog) -> None:
+        """Resolve one section's merge and encode its output (the shared tail
+        of rewrite_complete and rewrite_pipelined)."""
+        handle, old_top = job
+        merged = self.merge.merge_resolve(handle)
+        if drop_delete:
+            merged = merged.drop_deletes()
+        if self.emit_full_changelog and drop_delete:
+            cl = self._section_changelog(old_top, merged)
+            if cl.num_rows:
+                changelog.extend(
+                    self.writer_factory.write(cl, level=0, file_source="compact", prefix="changelog")
+                )
+        out.extend(self.writer_factory.write(merged, output_level, file_source="compact"))
+
     def rewrite_dispatch(self, sections: list[list[SortedRun]], output_level: int):
         """Phase 1: read every section's runs and dispatch their merges.
         Under a MeshBatchContext the merges of ALL sections (and all buckets
@@ -210,17 +268,8 @@ class MergeTreeCompactRewriter:
         """Phase 2: resolve merges, emit changelog, write output files."""
         out: list[DataFileMeta] = []
         changelog: list[DataFileMeta] = []
-        for handle, old_top in jobs:
-            merged = self.merge.merge_resolve(handle)
-            if drop_delete:
-                merged = merged.drop_deletes()
-            if self.emit_full_changelog and drop_delete:
-                cl = self._section_changelog(old_top, merged)
-                if cl.num_rows:
-                    changelog.extend(
-                        self.writer_factory.write(cl, level=0, file_source="compact", prefix="changelog")
-                    )
-            out.extend(self.writer_factory.write(merged, output_level, file_source="compact"))
+        for job in jobs:
+            self._write_section(job, output_level, drop_delete, out, changelog)
         return out, changelog
 
     def _section_changelog(self, old_top: list[KVBatch], merged: KVBatch) -> KVBatch:
@@ -267,21 +316,31 @@ class MergeTreeCompactManager:
 
     def trigger_compaction(self, full: bool = False) -> CompactResult | None:
         from ..metrics import registry, timed
+        from ..parallel.executor import current_mesh_context
+        from ..parallel.pipeline import pipeline_config
 
+        depth, parallelism = pipeline_config(self.options)
         g = registry.group("compaction")
         with timed(g.histogram("duration_ms")):
-            state = self.compact_dispatch(full)
-            result = self.compact_complete(state)
+            if depth > 0 and current_mesh_context() is None:
+                # pipelined route: section reads / device merges / output
+                # encodes overlap (rewrite_pipelined) instead of reading
+                # every input before the first merge. Mesh batching keeps
+                # the dispatch/complete split (all merges in one shard_map).
+                plan = self._plan_unit(full)
+                result = self._complete_pipelined(plan, depth, parallelism)
+            else:
+                state = self.compact_dispatch(full)
+                result = self.compact_complete(state)
         if result is not None and not result.is_empty():
             g.counter("compactions").inc()
             g.counter("files_rewritten").inc(len(result.before))
         return result
 
-    def compact_dispatch(self, full: bool = False):
-        """Phase 1: pick the unit, classify upgrade-vs-rewrite (reference
-        MergeTreeCompactTask.doCompact), read inputs and dispatch the section
-        merges. Returns opaque state for compact_complete, or None when
-        nothing to compact."""
+    def _plan_unit(self, full: bool = False):
+        """Pick the unit and classify upgrade-vs-rewrite (reference
+        MergeTreeCompactTask.doCompact) WITHOUT reading any input. Returns
+        (unit, drop_delete, result, rewrite_sections) or None."""
         runs = self.levels.level_sorted_runs()
         if full:
             unit = self.strategy.force_full(self.levels.num_levels, runs)
@@ -317,6 +376,17 @@ class MergeTreeCompactManager:
                         rewrite_sections.append([SortedRun([f])])
             else:
                 rewrite_sections.append(section)
+        return (unit, drop_delete, result, rewrite_sections)
+
+    def compact_dispatch(self, full: bool = False):
+        """Phase 1: plan the unit, then read inputs and dispatch the section
+        merges (under a MeshBatchContext every bucket's merges batch into one
+        shard_map). Returns opaque state for compact_complete, or None when
+        nothing to compact."""
+        plan = self._plan_unit(full)
+        if plan is None:
+            return None
+        unit, drop_delete, result, rewrite_sections = plan
         jobs = self.rewriter.rewrite_dispatch(rewrite_sections, unit.output_level) if rewrite_sections else []
         return (unit, drop_delete, result, rewrite_sections, jobs)
 
@@ -325,9 +395,36 @@ class MergeTreeCompactManager:
         if state is None:
             return None
         unit, drop_delete, result, rewrite_sections, jobs = state
+        after, changelog = (
+            self.rewriter.rewrite_complete(jobs, unit.output_level, drop_delete)
+            if rewrite_sections
+            else ([], [])
+        )
+        return self._finish(unit, drop_delete, result, rewrite_sections, after, changelog)
+
+    def _complete_pipelined(self, plan, depth: int, parallelism: int | None) -> CompactResult | None:
+        """Pipelined phase 2: sections stream through read -> merge -> encode
+        with bounded readahead (rewrite_pipelined) — same outputs, same
+        order, without materializing every section's input first."""
+        if plan is None:
+            return None
+        unit, drop_delete, result, rewrite_sections = plan
+        after, changelog = (
+            self.rewriter.rewrite_pipelined(
+                rewrite_sections, unit.output_level, drop_delete, depth, parallelism
+            )
+            if rewrite_sections
+            else ([], [])
+        )
+        return self._finish(unit, drop_delete, result, rewrite_sections, after, changelog)
+
+    def _finish(
+        self, unit, drop_delete, result: CompactResult, rewrite_sections, after, changelog
+    ) -> CompactResult:
+        """Shared bookkeeping tail: fold rewrite outputs into the result,
+        invalidate dead cache entries, update Levels."""
         if rewrite_sections:
             flat_before = [f for sec in rewrite_sections for r in sec for f in r.files]
-            after, changelog = self.rewriter.rewrite_complete(jobs, unit.output_level, drop_delete)
             result.before.extend(flat_before)
             result.after.extend(after)
             result.changelog.extend(changelog)

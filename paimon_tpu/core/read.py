@@ -47,20 +47,22 @@ def _ensure_arrow_decode_initialized():
     _arrow_decode_warm = True
 
 
-def _parallel_map(fn, items):
+def _parallel_map(fn, items, parallelism: int | None = None):
     """Decode several files concurrently (pyarrow/zstd release the GIL, so
     threads give real parallelism on the host-side columnar decode — the
     stage that dominates once the device downloads are compact). Runs on the
     process-wide shared pool (utils.shared_executor): a pool per call paid
     thread spawn/teardown on every split, measurable on small files. Order
-    is preserved; single-item lists skip the pool."""
+    is preserved; single-item lists skip the pool. `parallelism` bounds the
+    in-flight window (the scan.parallelism option; None = pool width,
+    1 = strictly serial)."""
     items = list(items)
-    if len(items) <= 1:
+    if len(items) <= 1 or (parallelism is not None and parallelism <= 1):
         return [fn(x) for x in items]
     _ensure_arrow_decode_initialized()
-    from ..utils import shared_executor
+    from ..parallel.pipeline import bounded_map
 
-    return list(shared_executor().map(fn, items))
+    return bounded_map(fn, items, parallelism)
 
 
 def order_runs_for_merge(section) -> tuple[list, bool]:
@@ -87,10 +89,13 @@ class MergeFileSplitRead:
         reader_factory: KeyValueFileReaderFactory,
         merge_executor: MergeExecutor,
         key_names: Sequence[str],
+        parallelism: int | None = None,
     ):
         self.reader_factory = reader_factory
         self.merge = merge_executor
         self.key_names = set(key_names)
+        # scan.parallelism: in-flight bound of the per-file decode fan-out
+        self.parallelism = parallelism
 
     def read_split(
         self,
@@ -203,6 +208,7 @@ class MergeFileSplitRead:
         heads = _parallel_map(
             lambda f: self.reader_factory.read(f, predicate=key_filter, fields=key_names, system_columns=sys_cols),
             ordered_files,
+            parallelism=self.parallelism,
         )
         kv_keys = KVBatch.concat(heads)
         if kv_keys.num_rows == 0:
@@ -223,6 +229,7 @@ class MergeFileSplitRead:
                     f, predicate=key_filter, fields=rest_names, system_columns=False
                 ),
                 ordered_files,
+                parallelism=self.parallelism,
             )
             full_schema = self.reader_factory.read_schema
             cols = {}

@@ -118,13 +118,16 @@ class FileStoreScan:
 
     def _read_manifests(self, metas) -> list:
         """Manifest files decode independently: scan.manifest.parallelism
-        threads them over the process-wide shared pool (reference
+        (falling back to scan.parallelism — store.new_scan resolves the
+        knobs) threads them over the process-wide shared pool (reference
         ScanParallelExecutor; a pool per plan() would pay thread spawn/join
-        on every small scan), order preserved."""
+        on every small scan), order preserved and in-flight bounded."""
         if self.manifest_parallelism and self.manifest_parallelism > 1 and len(metas) > 1:
-            from ..utils import shared_executor
+            from ..parallel.pipeline import bounded_map
 
-            return list(shared_executor().map(lambda m: self.manifest_file.read(m.file_name), metas))
+            return bounded_map(
+                lambda m: self.manifest_file.read(m.file_name), metas, self.manifest_parallelism
+            )
         return [self.manifest_file.read(m.file_name) for m in metas]
 
     def _plan(self) -> ScanPlan:

@@ -158,12 +158,25 @@ class KeyValueFileStore:
             format_options=format_options,
         )
 
+    def pipeline_config(self) -> tuple[int, int | None]:
+        """(scan.prefetch-splits, scan.parallelism) — the pipelined split
+        scheduler's knobs (parallel/pipeline.py), resolved once here so
+        read/compact/flush consumers all agree."""
+        from ..parallel.pipeline import pipeline_config
+
+        return pipeline_config(self.options)
+
     def new_scan(self) -> FileStoreScan:
+        manifest_par = self.options.options.get(CoreOptions.SCAN_MANIFEST_PARALLELISM)
+        if manifest_par is None:
+            # scan.parallelism is the general pipeline knob; the manifest-
+            # specific option stays the override
+            manifest_par = self.options.options.get(CoreOptions.SCAN_PARALLELISM)
         return FileStoreScan(
             self.file_io,
             self.table_path,
             self.key_names,
-            manifest_parallelism=self.options.options.get(CoreOptions.SCAN_MANIFEST_PARALLELISM),
+            manifest_parallelism=manifest_par,
             cache=self.manifest_obj_cache,
         )
 
@@ -309,7 +322,12 @@ class KeyValueFileStore:
             from ..data.predicate import and_
 
             predicate = expire if predicate is None else and_(predicate, expire)
-        read = MergeFileSplitRead(self.reader_factory(partition, bucket), self.merge_executor(), self.key_names)
+        read = MergeFileSplitRead(
+            self.reader_factory(partition, bucket),
+            self.merge_executor(),
+            self.key_names,
+            parallelism=self.options.options.get(CoreOptions.SCAN_PARALLELISM),
+        )
         return read.read_split_dispatch(files, predicate, projection, drop_delete, deletion_vectors)
 
 

@@ -54,6 +54,18 @@ class MergeTreeWriter:
         self._compact_after: list[DataFileMeta] = []
         self._changelog: list[DataFileMeta] = []
         self._compact_changelog: list[DataFileMeta] = []
+        # pipelined flush (parallel/pipeline.py consumer 3): auto-flushes
+        # triggered by write() offload the merge-resolve + file encode (+
+        # any resulting compaction) to a single background worker, so the
+        # next memtable fills while the previous one encodes. One worker +
+        # FIFO keeps the levels/compaction state transitions in exactly the
+        # sequential order — output is bit-identical. prepare_commit (and the
+        # public flush()) is the barrier; worker errors surface there.
+        from ..parallel.pipeline import pipeline_config
+
+        self._async_flush = pipeline_config(options)[0] > 0
+        self._flush_pool = None
+        self._flush_pending: list = []
 
     # ---- ingest --------------------------------------------------------
     def write(self, data: ColumnBatch, kinds: np.ndarray | None = None) -> None:
@@ -68,7 +80,7 @@ class MergeTreeWriter:
         self._buffered_rows += n
         self._buffered_bytes += kv.byte_size()
         if self._should_flush():
-            self.flush()
+            self._flush_async()
 
     def write_kv(self, kv: KVBatch) -> None:
         if kv.num_rows == 0:
@@ -81,7 +93,7 @@ class MergeTreeWriter:
         self._buffered_rows += kv.num_rows
         self._buffered_bytes += kv.byte_size()
         if self._should_flush():
-            self.flush()
+            self._flush_async()
 
     def _should_flush(self) -> bool:
         """Byte budget first (reference MemorySegmentPool accounts bytes —
@@ -94,15 +106,98 @@ class MergeTreeWriter:
 
     # ---- flush ---------------------------------------------------------
     def flush(self) -> None:
+        """Synchronous barrier: drain the memtable AND wait for every
+        offloaded flush to finish (errors from background encodes re-raise
+        here). Same post-conditions as the sequential path."""
+        self._flush_async()
+        self._drain_flushes()
+
+    def _flush_async(self) -> None:
+        """Drain the memtable; run the complete phase on the flush worker
+        when pipelining is on (so the caller returns to filling the next
+        memtable), inline otherwise. FIFO on one worker = sequential order."""
+        from ..parallel.executor import current_mesh_context
+
         state = self.flush_dispatch()
-        if state is not None:
+        if state is None:
+            return
+        if not self._async_flush or current_mesh_context() is not None:
             self.flush_complete(state)
+            return
+        if self._flush_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ..parallel.pipeline import FLUSH_THREAD_PREFIX
+
+            self._flush_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=FLUSH_THREAD_PREFIX
+            )
+        from ..metrics import pipeline_metrics
+
+        import time as _time
+
+        g = pipeline_metrics()
+        busy = g.histogram("flush_busy_ms")
+        g.counter("splits_prefetched").inc()
+
+        def run():
+            t0 = _time.perf_counter()
+            try:
+                self.flush_complete(state)
+            finally:
+                busy.update((_time.perf_counter() - t0) * 1000)
+
+        self._flush_pending.append(self._flush_pool.submit(run))
+
+    def _drain_flushes(self) -> None:
+        """Wait for offloaded flushes; the FIRST failure re-raises after the
+        rest were cancelled/awaited (a failed flush must not silently let a
+        later one keep mutating levels)."""
+        pending, self._flush_pending = self._flush_pending, []
+        error = None
+        for f in pending:
+            if error is not None:
+                f.cancel()
+                continue
+            try:
+                f.result()
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                error = exc
+        if error is not None:
+            self._shutdown_flush_pool()
+            raise error
+
+    def _shutdown_flush_pool(self) -> None:
+        if self._flush_pool is not None:
+            self._flush_pool.shutdown(wait=True, cancel_futures=True)
+            self._flush_pool = None
+
+    def close(self) -> None:
+        """Release the flush worker without committing. Pending background
+        errors are swallowed (close is the abandon path; prepare_commit is
+        where failures must surface)."""
+        for f in self._flush_pending:
+            f.cancel()
+        try:
+            for f in self._flush_pending:
+                if not f.cancelled():
+                    f.exception()
+        finally:
+            self._flush_pending = []
+            self._shutdown_flush_pool()
 
     def flush_dispatch(self):
         """Phase 1 of a (possibly mesh-batched) flush: drain the memtable,
         persist the input changelog, and dispatch the merge. Under an active
         MeshBatchContext the merge job is only enqueued — every bucket's job
-        runs in one batched mesh call when the first flush_complete resolves."""
+        runs in one batched mesh call when the first flush_complete resolves.
+
+        Any offloaded flush_complete still in flight lands first (and its
+        error surfaces here): at most one flush is ever pending, so every
+        caller — including the mesh path's direct dispatch/complete — sees
+        levels/compaction state in strict flush order. The overlap window is
+        the memtable fill between two flushes, which is the point."""
+        self._drain_flushes()
         if not self._buffer:
             return None
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
@@ -218,6 +313,7 @@ class MergeTreeWriter:
 
     def compact_dispatch(self, full: bool = False):
         """Phase 1 of an explicit compaction (caller must have flushed)."""
+        self._drain_flushes()  # levels must be settled before planning
         if self.compact_manager is None:
             return None
         return self.compact_manager.compact_dispatch(full)
@@ -242,7 +338,8 @@ class MergeTreeWriter:
 
     # ---- commit --------------------------------------------------------
     def prepare_commit(self) -> CommitMessage:
-        self.flush()
+        self.flush()  # barrier: offloaded encodes land before the message builds
+        self._shutdown_flush_pool()  # no idle worker between commits
         # a file produced by one compaction round and consumed by a later
         # round within the same commit cancels out of the message
         before_names = {f.file_name for f in self._compact_before}

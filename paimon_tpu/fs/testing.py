@@ -219,6 +219,66 @@ class FailingFileIO(FileIO):
         return self._inner.try_overwrite(local, data)
 
 
+class LatencyFileIO(FileIO):
+    """Injects a fixed per-op sleep over LocalFileIO — object-store
+    first-byte latency as a local, deterministic effect, so benchmarks and
+    tests can measure how much of it the pipelined split scheduler hides
+    (overlapped fetches pay the RTT concurrently; a serial scan pays it once
+    per file). Paths: ``latency://<abs-path>``. Inherits the base
+    local_path=None, so format readers take the stream path where the
+    latency is injected — exactly the code path a remote store would use."""
+
+    read_ms: float = 0.0
+    write_ms: float = 0.0
+
+    @classmethod
+    def configure(cls, read_ms: float = 0.0, write_ms: float = 0.0) -> None:
+        cls.read_ms = read_ms
+        cls.write_ms = write_ms
+
+    def __init__(self):
+        self._inner = LocalFileIO()
+
+    def _p(self, path: str) -> str:
+        return split_scheme(path)[1]
+
+    def _nap(self, ms: float) -> None:
+        if ms > 0:
+            import time
+
+            time.sleep(ms / 1000.0)
+
+    def read_bytes(self, path: str) -> bytes:
+        self._nap(LatencyFileIO.read_ms)
+        return self._inner.read_bytes(self._p(path))
+
+    def open_input(self, path: str):
+        self._nap(LatencyFileIO.read_ms)
+        return self._inner.open_input(self._p(path))
+
+    def write_bytes(self, path: str, data: bytes, overwrite: bool = False) -> None:
+        self._nap(LatencyFileIO.write_ms)
+        self._inner.write_bytes(self._p(path), data, overwrite)
+
+    def exists(self, path: str) -> bool:
+        return self._inner.exists(self._p(path))
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        return self._inner.delete(self._p(path), recursive)
+
+    def mkdirs(self, path: str) -> None:
+        self._inner.mkdirs(self._p(path))
+
+    def rename(self, src: str, dst: str) -> bool:
+        return self._inner.rename(self._p(src), self._p(dst))
+
+    def list_status(self, path: str) -> list[FileStatus]:
+        return self._inner.list_status(self._p(path))
+
+    def get_status(self, path: str) -> FileStatus:
+        return self._inner.get_status(self._p(path))
+
+
 class TraceableFileIO(FileIO):
     """Tracks open streams so tests can assert no reader/writer leaks."""
 
@@ -290,6 +350,7 @@ def _fail_s3_legacy() -> FailingFileIO:
 
 
 register_file_io("fail", FailingFileIO)
+register_file_io("latency", LatencyFileIO)
 register_file_io("fail-s3", _fail_s3)
 register_file_io("fail-s3-legacy", _fail_s3_legacy)
 register_file_io("traceable", TraceableFileIO)

@@ -24,6 +24,7 @@ __all__ = [
     "timed",
     "decode_metrics",
     "io_metrics",
+    "pipeline_metrics",
 ]
 
 
@@ -144,6 +145,19 @@ def decode_metrics() -> MetricGroup:
     (whole-file native decode wall millis), pushdown_ms (per row group).
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("decode")
+
+
+def pipeline_metrics() -> MetricGroup:
+    """The pipeline{...} group (pipelined split scheduler,
+    paimon_tpu.parallel.pipeline). Canonical members — counter:
+    splits_prefetched (items submitted ahead of the consumer); gauge:
+    queue_depth_high_water (max items in flight — bounded by
+    scan.prefetch-splits + 1, the memory high-water guard); histograms per
+    stage: {stage}_busy_ms (worker wall time per item) and {stage}_wait_ms
+    (consumer blocked waiting for the head-of-line item), stage in
+    {scan, compact, flush}. Resolved per call so registry.reset() in tests
+    swaps the group out."""
+    return registry.group("pipeline")
 
 
 def io_metrics() -> MetricGroup:

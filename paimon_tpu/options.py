@@ -475,7 +475,22 @@ class CoreOptions:
         "scan.max-splits-per-task", 10, "Split-assignment batch cap per reader task in the enumerator."
     )
     SCAN_MANIFEST_PARALLELISM = ConfigOption.int_(
-        "scan.manifest.parallelism", None, "Threads for reading manifests during scan planning."
+        "scan.manifest.parallelism", None, "Threads for reading manifests during scan planning (default: scan.parallelism)."
+    )
+    SCAN_PREFETCH_SPLITS = ConfigOption.int_(
+        "scan.prefetch-splits",
+        2,
+        "Readahead depth of the pipelined split scheduler: how many splits/"
+        "compaction sections/flush encodes may run ahead of the consumer. "
+        "0 disables pipelining everywhere (strictly sequential execution; "
+        "output is bit-identical either way).",
+    )
+    SCAN_PARALLELISM = ConfigOption.int_(
+        "scan.parallelism",
+        None,
+        "Worker threads per pipeline stage, and the in-flight bound of the "
+        "per-file/manifest decode fan-out (default: min(prefetch+1, 4) for "
+        "stages, shared-pool width for decode fan-out).",
     )
     INCREMENTAL_BETWEEN_TIMESTAMP = ConfigOption.string(
         "incremental-between-timestamp",

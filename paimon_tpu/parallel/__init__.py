@@ -17,10 +17,15 @@ stack (SURVEY §2.9). The TPU-native mapping:
 Multi-host: the same mesh spans hosts via jax.distributed; the all_to_all
 rides ICI within a slice and DCN across slices — no NCCL/MPI analog needed,
 XLA owns the collectives.
+
+Within one host, pipeline.py supplies the orthogonal axis: staged overlap of
+IO / decode / device merge across splits, compaction sections, and writer
+flushes (scan.prefetch-splits / scan.parallelism).
 """
 
 from .distributed import global_mesh, init_multi_host, is_commit_coordinator
 from .mesh import make_mesh
+from .pipeline import SplitPipeline, bounded_map, pipeline_config
 from .merge import (
     bucket_parallel_dedup,
     distributed_aggregate_step,
@@ -32,6 +37,9 @@ from .merge import (
 
 __all__ = [
     "make_mesh",
+    "SplitPipeline",
+    "bounded_map",
+    "pipeline_config",
     "bucket_parallel_dedup",
     "distributed_merge_step",
     "distributed_partial_update_step",

@@ -471,18 +471,38 @@ class TableRead:
             deletion_vectors=dvs,
         )
 
-    def read_all(self, splits: Sequence[DataSplit]):
-        from ..data.batch import concat_batches
+    def batches(self, splits: Sequence[DataSplit]):
+        """Ordered generator of per-split batches (the ConcatRecordReader
+        analog): each split's output is yielded as soon as its merge stage
+        completes, in deterministic split order, instead of materializing
+        every split before the first row is visible. Three execution modes,
+        picked per call:
+
+        * mesh batching (parallel.mesh.enabled, >1 device): dispatch every
+          split first so all merges run in one shard_map, then complete;
+        * pipelined (scan.prefetch-splits > 0, the default): split i+1
+          fetches bytes through RetryingFileIO and decodes on a pipeline
+          worker while split i merges on device — output is bit-identical
+          to the sequential path (parallel/pipeline.py contract);
+        * sequential (scan.prefetch-splits = 0, or a limit wanting
+          split-by-split early exit)."""
         from ..parallel.executor import maybe_mesh_batch
 
-        schema = self.table.row_type if self.projection is None else self.table.row_type.project(self.projection)
-        batches = []
+        splits = list(splits)
         remaining = self.limit
         # a limit wants early-exit split by split — dispatching every split
         # up front would turn a point query into a full scan, so limited
         # reads stay on the sequential path
         use_mesh = remaining is None
         with maybe_mesh_batch(self.table.store) if use_mesh else _null_ctx() as ctx:
+            if ctx is None and remaining is None and len(splits) > 1:
+                depth, parallelism = self.table.store.pipeline_config()
+                if depth > 0:
+                    from ..parallel.pipeline import SplitPipeline
+
+                    pipe = SplitPipeline(parallelism, depth, stage="scan")
+                    yield from pipe.map_ordered(splits, self.read)
+                    return
             if ctx is not None:
                 # mesh mode: dispatch every split first — their merges run as
                 # one batched shard_map over the bucket axis — then complete
@@ -499,7 +519,13 @@ class TableRead:
                     if b.num_rows > remaining:
                         b = b.slice(0, remaining)
                     remaining -= b.num_rows
-                batches.append(b)
+                yield b
+
+    def read_all(self, splits: Sequence[DataSplit]):
+        from ..data.batch import concat_batches
+
+        schema = self.table.row_type if self.projection is None else self.table.row_type.project(self.projection)
+        batches = list(self.batches(splits))
         if not batches:
             from ..data.batch import ColumnBatch
 

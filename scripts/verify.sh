@@ -7,11 +7,26 @@
 #                              scheduled-fault + crash-point suite under a
 #                              FIXED seed set, so resilience regressions are
 #                              reproducible across machines.
+#   scripts/verify.sh pipeline pipelined-scheduler determinism stage: the
+#                              randomized-oracle parity tests with
+#                              scan.parallelism forced to 1 and then to 8 —
+#                              pipelined output must be bit-identical to the
+#                              sequential path at both extremes.
 #
 # Exits non-zero on test failure/timeout; tier-1 prints DOTS_PASSED=<n>
 # (count of passing tests) for trend comparison.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "pipeline" ]; then
+  for par in 1 8; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_SCAN_PARALLELISM=$par \
+      timeout -k 10 600 python -m pytest tests/test_pipeline.py -q \
+      -k 'parity or fault or flush' \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
+fi
 
 if [ "${1:-}" = "faults" ]; then
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" \

@@ -33,6 +33,36 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _no_worker_thread_leaks():
+    """Fail any test that leaves the pipelined scheduler's non-daemon worker
+    threads alive (paimon-pipeline-* stage pools, paimon-flush writer
+    offload). The process-wide shared decode pool (paimon-decode) is exempt:
+    it is never torn down by design. Abandoned executors tear down via
+    ThreadPoolExecutor's weakref callback, so collect + briefly wait before
+    declaring a leak."""
+    yield
+    import gc
+    import threading
+    import time
+
+    def leaked():
+        return [
+            t
+            for t in threading.enumerate()
+            if t.is_alive()
+            and not t.daemon
+            and t.name.startswith(("paimon-pipeline", "paimon-flush"))
+        ]
+
+    if leaked():
+        gc.collect()
+        deadline = time.time() + 3.0
+        while leaked() and time.time() < deadline:
+            time.sleep(0.05)
+    assert not leaked(), f"leaked non-daemon worker threads: {[t.name for t in leaked()]}"
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

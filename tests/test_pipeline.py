@@ -1,0 +1,342 @@
+"""Pipelined split scheduler (parallel/pipeline.py): scheduler unit tests,
+randomized-oracle parity (pipelined == sequential, bit-for-bit), fault
+interaction with the PR 3 retry stack, async writer flush, and pipelined
+compaction.
+
+scripts/verify.sh pipeline runs the parity tests twice with
+PAIMON_TPU_SCAN_PARALLELISM forced to 1 and to 8 — the env var folds into
+every pipelined table's scan.parallelism below."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.fs.testing import ArtificialException, FailingFileIO, FaultRule
+from paimon_tpu.metrics import registry
+from paimon_tpu.parallel.pipeline import SplitPipeline, bounded_map
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+SCHEMA = RowType.of(("k", BIGINT()), ("s", STRING()), ("v", DOUBLE()))
+
+
+def _pipeline_opts(extra=None):
+    """Pipelined-table options, honoring the verify.sh parallelism forcing."""
+    opts = dict(extra or {})
+    forced = os.environ.get("PAIMON_TPU_SCAN_PARALLELISM")
+    if forced:
+        opts.setdefault("scan.parallelism", forced)
+    return opts
+
+
+def _no_pipeline_threads():
+    return not [
+        t
+        for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(("paimon-pipeline", "paimon-flush"))
+    ]
+
+
+def _wait_pipeline_threads_gone(timeout=3.0):
+    import gc
+
+    gc.collect()
+    deadline = time.time() + timeout
+    while not _no_pipeline_threads() and time.time() < deadline:
+        time.sleep(0.05)
+    return _no_pipeline_threads()
+
+
+def _write_random(table, seed, steps=6, keyspace=200):
+    """Randomized upsert/delete churn; returns the dict oracle."""
+    rng = np.random.default_rng(seed)
+    oracle = {}
+    for step in range(steps):
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        n = int(rng.integers(20, 80))
+        ks = rng.integers(0, keyspace, n)
+        rows = {}
+        for k in ks:
+            rows[int(k)] = (int(k), f"s{int(k)}-{step}", float(step) + float(k) / 1000)
+        deletes = (
+            [int(k) for k in rng.choice(list(oracle), size=min(len(oracle), 5), replace=False)]
+            if oracle and rng.random() < 0.5
+            else []
+        )
+        rows = {k: v for k, v in rows.items() if k not in deletes}
+        if rows:
+            w.write(
+                {
+                    "k": [r[0] for r in rows.values()],
+                    "s": [r[1] for r in rows.values()],
+                    "v": [r[2] for r in rows.values()],
+                }
+            )
+            oracle.update(rows)
+        if deletes:
+            w.write(
+                {"k": deletes, "s": [None] * len(deletes), "v": [None] * len(deletes)},
+                kinds=["-D"] * len(deletes),
+            )
+            for k in deletes:
+                oracle.pop(k, None)
+        if rng.random() < 0.3:
+            w.compact(full=rng.random() < 0.5)
+        wb.new_commit().commit(w.prepare_commit())
+    return oracle
+
+
+def _read_exact(table):
+    rb = table.new_read_builder()
+    return rb.new_read().read_all(rb.new_scan().plan())
+
+
+def _assert_bit_identical(a, b):
+    assert a.num_rows == b.num_rows
+    assert a.schema.field_names == b.schema.field_names
+    for name in a.schema.field_names:
+        ca, cb = a.column(name), b.column(name)
+        assert ca.values.dtype == cb.values.dtype, name
+        assert np.array_equal(ca.values, cb.values), name
+        assert np.array_equal(ca.validity, cb.validity), name
+
+
+# ---------------------------------------------------------------- scheduler
+
+
+def test_map_ordered_preserves_order_and_bounds_inflight():
+    running = []
+    high_water = []
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            running.append(i)
+            high_water.append(len(running))
+        time.sleep(0.002 * (7 - i % 7))  # completion order != input order
+        with lock:
+            running.remove(i)
+        return i * i
+
+    pipe = SplitPipeline(parallelism=3, depth=4, stage="scan")
+    out = list(pipe.map_ordered(range(20), fn))
+    assert out == [i * i for i in range(20)]
+    assert max(high_water) <= 3  # workers bound concurrency
+    assert _wait_pipeline_threads_gone()
+
+
+def test_map_ordered_depth_bounds_readahead():
+    registry.reset()
+    pipe = SplitPipeline(parallelism=8, depth=2, stage="scan")
+    out = list(pipe.map_ordered(range(12), lambda i: i))
+    assert out == list(range(12))
+    from paimon_tpu.metrics import pipeline_metrics
+
+    g = pipeline_metrics()
+    # memory high-water guard: never more than depth+1 items in flight
+    assert 0 < g.gauge("queue_depth_high_water").value <= 3
+    assert g.counter("splits_prefetched").count > 0
+
+
+def test_map_ordered_propagates_error_at_position_and_shuts_down():
+    def fn(i):
+        if i == 3:
+            raise ValueError("boom at 3")
+        return i
+
+    pipe = SplitPipeline(parallelism=2, depth=2, stage="scan")
+    got = []
+    with pytest.raises(ValueError, match="boom at 3"):
+        for x in pipe.map_ordered(range(8), fn):
+            got.append(x)
+    assert got == [0, 1, 2]  # everything before the failing item emitted
+    assert _wait_pipeline_threads_gone()
+
+
+def test_map_ordered_early_close_tears_down_pool():
+    pipe = SplitPipeline(parallelism=2, depth=3, stage="scan")
+    gen = pipe.map_ordered(range(50), lambda i: i)
+    assert next(gen) == 0
+    gen.close()  # consumer abandons mid-stream
+    assert _wait_pipeline_threads_gone()
+
+
+def test_map_ordered_depth_zero_is_strictly_sequential():
+    seen = []
+    pipe = SplitPipeline(parallelism=4, depth=0, stage="scan")
+    out = list(pipe.map_ordered(range(5), lambda i: (seen.append(i), i)[1]))
+    assert out == list(range(5)) == seen
+    assert _no_pipeline_threads()  # no pool was ever built
+
+
+def test_bounded_map_matches_serial():
+    items = list(range(17))
+    fn = lambda x: x * 3 + 1  # noqa: E731
+    assert bounded_map(fn, items, None) == [fn(x) for x in items]
+    assert bounded_map(fn, items, 1) == [fn(x) for x in items]  # serial path
+    assert bounded_map(fn, items, 4) == [fn(x) for x in items]  # windowed
+
+
+# ---------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("seed,buckets", [(11, 2), (12, 4), (13, 8)])
+def test_pipelined_scan_parity_randomized(tmp_warehouse, seed, buckets):
+    """Acceptance: pipelined and sequential scans produce bit-identical
+    output across seeds x bucket counts (and the async-flush write path
+    produces the same table state as the sequential one)."""
+    cat = FileSystemCatalog(f"{tmp_warehouse}/{seed}", commit_user="pipe")
+    base = {
+        "bucket": str(buckets),
+        "target-file-size": "4 kb",
+        "num-sorted-run.compaction-trigger": "3",
+        "write-buffer-rows": "64",  # many auto-flushes exercise the offload
+    }
+    t_pipe = cat.create_table("db.p", SCHEMA, primary_keys=["k"], options=_pipeline_opts(base))
+    t_seq = cat.create_table(
+        "db.s", SCHEMA, primary_keys=["k"], options={**base, "scan.prefetch-splits": "0"}
+    )
+    oracle_p = _write_random(t_pipe, seed)
+    oracle_s = _write_random(t_seq, seed)
+    assert oracle_p == oracle_s
+    out_pipe = _read_exact(t_pipe)
+    out_seq = _read_exact(t_pipe.copy({"scan.prefetch-splits": "0", "scan.parallelism": None}))
+    _assert_bit_identical(out_pipe, out_seq)
+    # the two independently written tables agree row-for-row too
+    got = {r[0]: r for r in out_pipe.to_pylist()}
+    want = {r[0]: r for r in _read_exact(t_seq).to_pylist()}
+    assert got == want == {k: v for k, v in oracle_p.items()}
+    # cross-parallelism parity: 1 worker == 8 workers, bit for bit
+    out_p1 = _read_exact(t_pipe.copy({"scan.parallelism": "1"}))
+    out_p8 = _read_exact(t_pipe.copy({"scan.parallelism": "8"}))
+    _assert_bit_identical(out_p1, out_p8)
+    _assert_bit_identical(out_p1, out_pipe)
+
+
+def test_batches_streams_in_split_order(tmp_warehouse):
+    cat = FileSystemCatalog(f"{tmp_warehouse}/stream", commit_user="pipe")
+    t = cat.create_table(
+        "db.b", SCHEMA, primary_keys=["k"], options=_pipeline_opts({"bucket": "4"})
+    )
+    _write_random(t, 5, steps=3)
+    rb = t.new_read_builder()
+    splits = rb.new_scan().plan()
+    assert len(splits) > 1
+    read = rb.new_read()
+    streamed = list(read.batches(splits))
+    assert len(streamed) == len(splits)
+    # per-split batches in split order concat to exactly read_all
+    from paimon_tpu.data.batch import concat_batches
+
+    _assert_bit_identical(concat_batches(streamed), read.read_all(splits))
+
+
+# ---------------------------------------------------------------- faults
+
+
+def _fault_table(tmp_path, domain, opts=None):
+    FailingFileIO.reset(domain, 0, 0)
+    from paimon_tpu.core.schema import SchemaManager
+    from paimon_tpu.fs import get_file_io
+    from paimon_tpu.table import FileStoreTable
+
+    io = get_file_io(f"fail://{domain}/x")
+    path = f"fail://{domain}{tmp_path}/table"
+    base = {"bucket": "4", "fs.retry.initial-backoff": "1 ms", **_pipeline_opts(opts or {})}
+    ts = SchemaManager(io, path).create_table(SCHEMA, primary_keys=["k"], options=base)
+    return FileStoreTable(io, path, ts, commit_user="pipe")
+
+
+def test_prefetch_worker_transient_fault_retries(tmp_path):
+    """A transient fault inside a PREFETCHING worker is absorbed by the PR 3
+    retry policy (fail-once rule -> one retry, scan succeeds)."""
+    domain = "pipe-transient"
+    t = _fault_table(tmp_path, domain)
+    oracle = _write_random(t, 21, steps=3)
+    registry.reset()
+    FailingFileIO.schedule(domain, FaultRule(op="read", path="/bucket-"))  # fail once
+    out = _read_exact(t)
+    assert {r[0]: r for r in out.to_pylist()} == oracle
+    assert registry.group("io").counter("retries").count >= 1
+    assert registry.group("io").counter("giveups").count == 0
+    FailingFileIO.reset(domain, 0, 0)
+
+
+def test_prefetch_worker_permanent_fault_propagates_no_leaks(tmp_path):
+    """A permanent fault (retry budget exhausted by a fail-forever rule)
+    propagates from the worker to the caller, and neither threads nor tmp
+    files leak afterward."""
+    domain = "pipe-permanent"
+    t = _fault_table(tmp_path, domain, {"fs.retry.max-attempts": "2"})
+    _write_random(t, 22, steps=3)
+    registry.reset()
+    FailingFileIO.schedule(domain, FaultRule(op="read", path="/bucket-", count=0))  # forever
+    with pytest.raises(ArtificialException):
+        _read_exact(t)
+    FailingFileIO.reset(domain, 0, 0)
+    assert registry.group("io").counter("giveups").count >= 1
+    assert _wait_pipeline_threads_gone()
+    # a read-side failure must leave no tmp residue anywhere in the table
+    leftovers = [
+        os.path.join(dirpath, f)
+        for dirpath, _, files in os.walk(f"{tmp_path}/table")
+        for f in files
+        if ".tmp" in f
+    ]
+    assert not leftovers, leftovers
+    # the table stays fully readable once the fault clears
+    assert _read_exact(t).num_rows > 0
+
+
+def test_async_flush_error_surfaces_at_barrier(tmp_path):
+    """An encode failure on the flush worker re-raises at the prepare_commit
+    barrier (not silently dropped), and close() releases the worker."""
+    domain = "pipe-flusherr"
+    t = _fault_table(tmp_path, domain, {"fs.retry.max-attempts": "1"})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    FailingFileIO.schedule(domain, FaultRule(op="write", path="/bucket-", count=0))
+    with pytest.raises(ArtificialException):
+        rng = np.random.default_rng(0)
+        for step in range(50):  # enough rows to roll several auto-flushes
+            ks = rng.integers(0, 100, 64)
+            w.write(
+                {
+                    "k": ks.astype(np.int64),
+                    "s": [f"x{int(x)}" for x in ks],
+                    "v": ks.astype(np.float64),
+                }
+            )
+        w.prepare_commit()
+    FailingFileIO.reset(domain, 0, 0)
+    w.close()
+    assert _wait_pipeline_threads_gone()
+
+
+# ---------------------------------------------------------------- compaction
+
+
+def test_pipelined_compaction_parity(tmp_warehouse):
+    """A forced full compaction through the pipelined rewrite produces the
+    same logical table as the sequential rewrite."""
+    results = {}
+    for mode, extra in (("pipe", _pipeline_opts()), ("seq", {"scan.prefetch-splits": "0"})):
+        cat = FileSystemCatalog(f"{tmp_warehouse}/{mode}", commit_user="pipe")
+        t = cat.create_table(
+            "db.c",
+            SCHEMA,
+            primary_keys=["k"],
+            options={"bucket": "2", "target-file-size": "2 kb", **extra},
+        )
+        _write_random(t, 31, steps=4)
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.compact(full=True)
+        wb.new_commit().commit(w.prepare_commit())
+        results[mode] = {r[0]: r for r in _read_exact(t).to_pylist()}
+    assert results["pipe"] == results["seq"]
+    assert _wait_pipeline_threads_gone()
