@@ -183,6 +183,21 @@ def bench_pipeline() -> list:
     return mod.run(iters=2)
 
 
+def bench_encode() -> list:
+    """Write-path headline (benchmarks/encode_bench.py is the dedicated
+    benchmark): ingest throughput for a 1M-row PK write+flush, arrow vs
+    native encoder, plus the native encode counter breakdown — the write
+    mirror of the decode rows. The guard inside run_headline asserts
+    pyarrow reads every natively-written file bit-identically."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "encode_bench.py")
+    spec = importlib.util.spec_from_file_location("_encode_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_resilience() -> dict:
     """Commit resilience spot-check (benchmarks/resilience_bench.py is the
     dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
@@ -215,6 +230,7 @@ def main():
         scan_cache_speedup = bench_scan_cache(table)
         decode_row = bench_decode(table)
         pipeline_rows = bench_pipeline()
+        encode_rows = bench_encode()
         resilience_row = bench_resilience()
         row = {
             "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
@@ -250,6 +266,8 @@ def main():
         print(json.dumps(dict(decode_row, platform=_PLATFORM)))
         for prow in pipeline_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
+        for erow in encode_rows:
+            print(json.dumps(dict(erow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -103,7 +103,7 @@ class KeyValueFileStore:
         format_options = {
             k: v
             for k, v in co.options._data.items()
-            if k.startswith(("orc.", "parquet.", "avro."))
+            if k.startswith(("format.", "orc.", "parquet.", "avro."))
         }
         # generic writer knobs the format backends understand
         block = co.options.get(CoreOptions.FILE_BLOCK_SIZE)
@@ -111,6 +111,12 @@ class KeyValueFileStore:
             format_options.setdefault("file.block-size", int(block))
         format_options.setdefault(
             "file.compression.zstd-level", co.options.get(CoreOptions.FILE_COMPRESSION_ZSTD_LEVEL)
+        )
+        # encoder selection (format.parquet.encoder = arrow | native); this
+        # one seam routes memtable flush, compaction rewrite, changelog and
+        # sort-compact writes through the chosen encode backend
+        format_options.setdefault(
+            "format.parquet.encoder", co.options.get(CoreOptions.FORMAT_PARQUET_ENCODER)
         )
         return KeyValueFileWriterFactory(
             self.file_io,

@@ -35,14 +35,22 @@ class Column:
     String/bytes columns may additionally be backed by a pyarrow array
     (`arrow`): structural ops (take/slice/filter/concat) then run in arrow's
     C++ and the object ndarray materializes lazily only when `.values` is
-    actually touched (predicates, key pools, python access)."""
+    actually touched (predicates, key pools, python access).
 
-    __slots__ = ("_values", "validity", "arrow", "_len")
+    `dict_cache` is an optional (sorted pool, uint32 ranks) pair attached by
+    the key-lane encoder (data/keys.py): the ranks ARE exact dictionary
+    codes against the pool, so the native parquet encoder emits dictionary
+    pages without ever touching a string object. Structural ops transform
+    the ranks alongside the values; concat drops the cache (pools differ
+    per input)."""
+
+    __slots__ = ("_values", "validity", "arrow", "_len", "dict_cache")
 
     def __init__(self, values: np.ndarray | None = None, validity: np.ndarray | None = None, arrow=None):
         assert values is not None or arrow is not None
         self._values = values
         self.arrow = arrow
+        self.dict_cache = None
         self._len = len(values) if values is not None else len(arrow)
         if validity is not None:
             assert validity.dtype == np.bool_
@@ -50,6 +58,12 @@ class Column:
             if bool(validity.all()):
                 validity = None
         self.validity = validity
+
+    def _with_cache(self, out: "Column", transform) -> "Column":
+        if self.dict_cache is not None:
+            pool, codes = self.dict_cache
+            out.dict_cache = (pool, transform(codes))
+        return out
 
     @property
     def values(self) -> np.ndarray:
@@ -100,22 +114,28 @@ class Column:
         if self._values is None:
             import pyarrow.compute as pc
 
-            return Column(validity=m, arrow=pc.take(self.arrow, indices))
-        return Column(self.values.take(indices), m)
+            out = Column(validity=m, arrow=pc.take(self.arrow, indices))
+        else:
+            out = Column(self.values.take(indices), m)
+        return self._with_cache(out, lambda c: c.take(indices))
 
     def slice(self, start: int, stop: int) -> "Column":
         m = None if self.validity is None else self.validity[start:stop]
         if self._values is None:
-            return Column(validity=m, arrow=self.arrow.slice(start, stop - start))
-        return Column(self.values[start:stop], m)
+            out = Column(validity=m, arrow=self.arrow.slice(start, stop - start))
+        else:
+            out = Column(self.values[start:stop], m)
+        return self._with_cache(out, lambda c: c[start:stop])
 
     def filter(self, mask: np.ndarray) -> "Column":
         m = None if self.validity is None else self.validity[mask]
         if self._values is None:
             import pyarrow.compute as pc
 
-            return Column(validity=m, arrow=pc.filter(self.arrow, mask))
-        return Column(self.values[mask], m)
+            out = Column(validity=m, arrow=pc.filter(self.arrow, mask))
+        else:
+            out = Column(self.values[mask], m)
+        return self._with_cache(out, lambda c: c[mask])
 
     def to_pylist(self) -> list:
         if self._values is None and self.validity is None:
@@ -127,6 +147,14 @@ class Column:
     @staticmethod
     def from_pylist(data: Sequence[Any], dtype: DataType) -> "Column":
         np_dtype = dtype.numpy_dtype()
+        if isinstance(data, np.ndarray):
+            # vectorized ingest fast paths: callers handing numpy arrays
+            # (bench/engine surfaces) must not pay a per-element loop
+            if np_dtype != np.dtype(object) and data.dtype.kind in "biuf":
+                return Column(np.ascontiguousarray(data, dtype=np_dtype))
+            if np_dtype == data.dtype == np.dtype(object):
+                validity = np.asarray(data != None, dtype=np.bool_)  # noqa: E711 — elementwise
+                return Column(data, None if validity.all() else validity)
         validity = np.array([x is not None for x in data], dtype=np.bool_)
         if np_dtype == np.dtype(object):
             values = np.empty(len(data), dtype=object)
@@ -262,8 +290,15 @@ class ColumnBatch:
             mask = None if c.validity is None else ~c.validity
             if f.type.root in (TypeRoot.ARRAY, TypeRoot.MAP, TypeRoot.ROW):
                 # nested columns need the declared type: inference cannot see
-                # struct shapes through object ndarrays
-                vals = [None if (mask is not None and mask[i]) else c.values[i] for i in range(len(c.values))]
+                # struct shapes through object ndarrays. The null-free fast
+                # path hands the object vector over in one C pass; nulls take
+                # one vectorized mask-assign on a copy — no per-row loop
+                if mask is None:
+                    vals = list(c.values)
+                else:
+                    masked = c.values.copy()
+                    masked[mask] = None
+                    vals = list(masked)
                 arrays.append(pa.array(vals, type=_pa_nested_type(f.type)))
             else:
                 arrays.append(pa.array(c.values, from_pandas=True, mask=mask))

@@ -106,22 +106,66 @@ def _encode_column(values: np.ndarray, root: TypeRoot, pool: np.ndarray | None) 
     if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
         if pool is None:
             raise ValueError("string key column requires a pool (build_string_pool)")
+        if len(pool) == 0:
+            raise ValueError("string key value(s) missing from pool; pool must cover all merge inputs")
+        if len(values) >= 65_536:
+            ranks = _hash_ranks(values, pool)
+            if ranks is not None:
+                return [ranks]
         ranks = np.searchsorted(pool, values)
         # a value missing from the pool would silently collide with its
         # successor's rank — turn that data corruption into an error
-        clipped = np.minimum(ranks, len(pool) - 1) if len(pool) else ranks
-        if len(pool) == 0 or not bool(np.all(pool[clipped] == values)):
+        clipped = np.minimum(ranks, len(pool) - 1)
+        if not bool(np.all(pool[clipped] == values)):
             raise ValueError("string key value(s) missing from pool; pool must cover all merge inputs")
         return [ranks.astype(np.uint32)]
     raise ValueError(f"type {root} not supported as key column")
 
 
+def _hash_ranks(values: np.ndarray, pool: np.ndarray) -> np.ndarray | None:
+    """Rank lookup through arrow's C hash table — replaces a |values| × log
+    |pool| object-compare searchsorted for large merges. index_in against
+    the sorted pool returns the rank directly; a null (value outside the
+    pool) is the same data-corruption case the searchsorted path raises
+    for. Returns None when the values cannot take the arrow path (mixed
+    types) so the caller falls back."""
+    try:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        idx = pc.index_in(pa.array(values, from_pandas=True), value_set=pa.array(pool))
+    except (TypeError, ValueError, OverflowError, pa.lib.ArrowInvalid):
+        return None
+    if idx.null_count:
+        raise ValueError("string key value(s) missing from pool; pool must cover all merge inputs")
+    return idx.to_numpy(zero_copy_only=False).astype(np.uint32)
+
+
 def build_string_pool(column_values: Sequence[np.ndarray]) -> np.ndarray:
     """Sorted unique values across every input of one merge. Ranks against this
-    pool are exact order-preserving surrogates for the strings themselves."""
+    pool are exact order-preserving surrogates for the strings themselves.
+
+    Large inputs dedupe through arrow's C hash table first (object-compare
+    sorting then touches only the distinct set — for dictionary-shaped key
+    columns that is orders of magnitude smaller); the output contract is
+    identical to np.unique: a sorted object ndarray."""
     non_empty = [v for v in column_values if len(v)]
     if not non_empty:
         return np.empty(0, dtype=object)
+    total = sum(len(v) for v in non_empty)
+    if total >= 65_536:
+        try:
+            import pyarrow as pa
+            import pyarrow.compute as pc
+
+            chunked = pa.chunked_array([pa.array(v, from_pandas=True) for v in non_empty])
+            uniq = pc.drop_null(pc.unique(chunked)).to_numpy(zero_copy_only=False)
+            if uniq.dtype != np.dtype(object):
+                uniq = uniq.astype(object)
+            uniq.sort()
+            return uniq
+        except (TypeError, ValueError, OverflowError, pa.lib.ArrowInvalid):
+            pass  # mixed/unhashable values: the numpy sort path below
     return np.unique(np.concatenate(non_empty))
 
 
@@ -131,15 +175,25 @@ def encode_key_lanes(
     string_pools: Mapping[str, np.ndarray] | None = None,
 ) -> np.ndarray:
     """(N, L) uint32 lanes for the given key columns. Key columns must be
-    non-null (primary keys are NOT NULL by schema validation)."""
+    non-null (primary keys are NOT NULL by schema validation).
+
+    Side effect: string/bytes key columns get the (pool, ranks) pair cached
+    on the Column (`dict_cache`) — the ranks double as exact dictionary
+    codes, which the native parquet encoder consumes directly so flushed
+    merge output never rematerializes key strings (any consistent pair is
+    correct, so concurrent merges over a shared cached column are safe)."""
     lanes: list[np.ndarray] = []
+    string_roots = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
     for name in key_names:
         col = batch.column(name)
         if col.null_count:
             raise ValueError(f"key column {name!r} contains nulls")
         root = batch.schema.field(name).type.root
         pool = None if string_pools is None else string_pools.get(name)
-        lanes.extend(_encode_column(col.values, root, pool))
+        col_lanes = _encode_column(col.values, root, pool)
+        if pool is not None and root in string_roots:
+            col.dict_cache = (pool, col_lanes[0].astype(np.uint32, copy=False))
+        lanes.extend(col_lanes)
     if not lanes:
         return np.zeros((batch.num_rows, 0), dtype=np.uint32)
     return np.stack(lanes, axis=1)

@@ -1,9 +1,14 @@
-"""Minimal Thrift compact-protocol reader for Parquet metadata.
+"""Minimal Thrift compact-protocol reader AND writer for Parquet metadata.
 
 Parquet's footer (FileMetaData) and every page header are TCompactProtocol
 structs. The arrow path parses them inside C++; the native decode subsystem
 parses them here so the whole container walk — footer → row groups → column
 chunks → page headers — happens without pyarrow on the hot path.
+
+The writer dual lives here too: `build_struct` takes (field_id, type,
+value) triples and emits the exact wire bytes `read_struct` parses — the
+native encode subsystem (paimon_tpu.encode) uses it for page headers and
+the footer, so encoder and decoder share one protocol implementation.
 
 The parser is generic: `read_struct` returns {field_id: value} dicts with
 nested structs/lists parsed recursively. The parquet.thrift field-id → name
@@ -27,7 +32,15 @@ from __future__ import annotations
 
 import struct
 
-__all__ = ["ThriftError", "read_struct", "read_varint", "zigzag"]
+__all__ = [
+    "ThriftError",
+    "read_struct",
+    "read_varint",
+    "zigzag",
+    "zigzag_encode",
+    "append_uvarint",
+    "build_struct",
+]
 
 
 class ThriftError(ValueError):
@@ -154,3 +167,84 @@ def read_struct(buf, pos: int = 0) -> tuple[dict[int, object], int]:
             out[fid] = False
         else:
             out[fid], pos = _read_value(buf, pos, ctype)
+
+
+# ---- writer (the encode dual) --------------------------------------------
+
+
+def zigzag_encode(n: int) -> int:
+    """Signed int → zigzag unsigned (inverse of `zigzag`)."""
+    return (n << 1) ^ (n >> 63)
+
+
+def append_uvarint(out: bytearray, v: int) -> None:
+    while v > 0x7F:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def _append_value(out: bytearray, ctype: int, value) -> None:
+    if ctype in (CT_I16, CT_I32, CT_I64):
+        append_uvarint(out, zigzag_encode(int(value)))
+    elif ctype == CT_BYTE:
+        out.append(int(value) & 0xFF)
+    elif ctype == CT_DOUBLE:
+        out += struct.pack("<d", float(value))
+    elif ctype == CT_BINARY:
+        raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        append_uvarint(out, len(raw))
+        out += raw
+    elif ctype == CT_STRUCT:
+        # nested structs are pre-built bytes (build_struct output) or
+        # field-triple lists, appended in place
+        out += value if isinstance(value, (bytes, bytearray)) else build_struct(value)
+    elif ctype in (CT_LIST, CT_SET):
+        etype, elems = value
+        if len(elems) < 15:
+            out.append((len(elems) << 4) | etype)
+        else:
+            out.append((15 << 4) | etype)
+            append_uvarint(out, len(elems))
+        for e in elems:
+            if etype in (CT_TRUE, CT_FALSE):
+                out.append(CT_TRUE if e else CT_FALSE)
+            else:
+                _append_value(out, etype, e)
+    else:
+        raise ThriftError(f"cannot write compact type {ctype}")
+
+
+def build_struct(fields) -> bytes:
+    """(field_id, ctype, value) triples → compact-protocol struct bytes.
+
+    None values are skipped (optional thrift fields). Bools use CT_TRUE with
+    a bool value — the writer folds them into the field header exactly like
+    the spec. Nested structs pass pre-built bytes (or a triple list); lists
+    pass (elem_ctype, [values]). Fields are sorted by id so the short-form
+    delta header applies wherever it can."""
+    out = bytearray()
+    prev = 0
+    for fid, ctype, value in sorted(fields, key=lambda f: f[0]):
+        if value is None:
+            continue
+        if ctype in (CT_TRUE, CT_FALSE):
+            ctype = CT_TRUE if value else CT_FALSE
+            delta = fid - prev
+            if 0 < delta <= 15:
+                out.append((delta << 4) | ctype)
+            else:
+                out.append(ctype)
+                append_uvarint(out, zigzag_encode(fid))
+            prev = fid
+            continue
+        delta = fid - prev
+        if 0 < delta <= 15:
+            out.append((delta << 4) | ctype)
+        else:
+            out.append(ctype)
+            append_uvarint(out, zigzag_encode(fid))
+        prev = fid
+        _append_value(out, ctype, value)
+    out.append(CT_STOP)
+    return bytes(out)

@@ -1,0 +1,472 @@
+"""Column → page assembly: one ColumnBatch column into a dictionary page +
+data pages + chunk-level statistics (the write-side dual of decode/pages.py).
+
+Encoding selection per chunk:
+  * BYTE_ARRAY with a merge-path dict cache (data/keys.py attached the
+    sorted string pool + rank vector while encoding key lanes) — dictionary
+    page straight from the pool, RLE_DICTIONARY codes straight from the
+    ranks: no string object is touched between the merge and the file bytes;
+  * other BYTE_ARRAY — one arrow conversion (C, the same first step the
+    arrow writer pays) yields the offsets/data buffers; dictionary-encode
+    when the domain is small enough, PLAIN from the buffers otherwise —
+    either way the page bytes build through the vectorized kernels;
+  * INT32/INT64 — DELTA_BINARY_PACKED when the valid values are
+    non-decreasing (merge output key columns are), PLAIN otherwise;
+  * BOOLEAN / FLOAT / DOUBLE — PLAIN.
+
+Definition levels always write (columns are OPTIONAL, matching the arrow
+writer); an all-valid page collapses to a single RLE run. Chunk min/max
+stats compute vectorized and feed both `_row_group_stats` (arrow read path)
+and the decode subsystem's chunk-stats pushdown gate.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..data.batch import Column
+from ..decode.container import (
+    ENC_PLAIN,
+    ENC_RLE,
+    ENC_RLE_DICTIONARY,
+    ENC_DELTA_BINARY_PACKED,
+    PAGE_DATA,
+    PAGE_DATA_V2,
+    PAGE_DICTIONARY,
+    T_BOOLEAN,
+    T_BYTE_ARRAY,
+    T_DOUBLE,
+    T_FLOAT,
+    T_INT32,
+    T_INT64,
+    UnsupportedParquetFeature,
+)
+from ..decode.thrift import build_struct
+from ..types import DataType, TypeRoot
+from . import kernels
+
+__all__ = ["EncodedChunk", "encode_chunk"]
+
+# thrift compact type nibbles used for header building
+_I32, _I64, _BOOL, _STRUCT = 5, 6, 1, 12
+
+# dictionary domains above this fraction of the valid rows fall back to
+# PLAIN — the page would carry the whole domain anyway (unique PK strings
+# with a merge pool are exempt: their codes are already free)
+_DICT_RATIO_NUM, _DICT_RATIO_DEN = 2, 3
+
+_STAT_PACK = {T_INT32: "<i", T_INT64: "<q", T_FLOAT: "<f", T_DOUBLE: "<d"}
+# decode.container._STAT_TRUST_LEN: byte-array stats at or past this length
+# are treated as possibly-truncated by readers — omit instead of writing
+_STAT_MAX_LEN = 64
+
+
+@dataclass
+class EncodedChunk:
+    """One column chunk, ready for file assembly."""
+
+    pages: list[bytes] = field(default_factory=list)  # header+body, dict page first
+    physical_type: int = 0
+    encodings: tuple[int, ...] = ()
+    num_values: int = 0  # incl. nulls
+    total_uncompressed: int = 0
+    total_compressed: int = 0
+    dict_page_len: int = 0  # 0 = no dictionary page
+    stats: bytes | None = None  # pre-built thrift Statistics struct
+    num_pages: int = 0  # data pages (metrics)
+
+
+def _is_utf8(dtype: DataType) -> bool:
+    return dtype.root in (TypeRoot.CHAR, TypeRoot.VARCHAR)
+
+
+def _compressor(codec_id: int, codec_name: str | None, zstd_level: int | None):
+    if codec_id == 0:
+        return lambda b: b
+    import pyarrow as pa
+
+    try:
+        if codec_name == "zstd" and zstd_level is not None:
+            codec = pa.Codec("zstd", compression_level=zstd_level)
+        else:
+            codec = pa.Codec(codec_name)
+    except (ValueError, NotImplementedError) as e:
+        raise UnsupportedParquetFeature(f"codec {codec_name}: {e}") from e
+    return lambda b: codec.compress(b, asbytes=True)
+
+
+def _stats_struct(min_raw: bytes | None, max_raw: bytes | None, null_count: int) -> bytes:
+    return build_struct(
+        [
+            (3, _I64, null_count),
+            (5, 8, max_raw),  # 8 = CT_BINARY
+            (6, 8, min_raw),
+        ]
+    )
+
+
+def _fixed_stat_bytes(compact: np.ndarray, physical: int) -> tuple[bytes | None, bytes | None]:
+    if len(compact) == 0:
+        return None, None
+    if physical == T_BOOLEAN:
+        b = compact.astype(np.bool_)
+        return (b"\x01" if bool(b.min()) else b"\x00"), (b"\x01" if bool(b.max()) else b"\x00")
+    fmt = _STAT_PACK[physical]
+    if physical in (T_FLOAT, T_DOUBLE):
+        with np.errstate(invalid="ignore"):
+            lo, hi = np.nanmin(compact), np.nanmax(compact)
+        if np.isnan(lo) or np.isnan(hi):
+            return None, None
+    else:
+        lo, hi = compact.min(), compact.max()
+    return struct.pack(fmt, lo), struct.pack(fmt, hi)
+
+
+def _byte_stat(value, utf8: bool) -> bytes | None:
+    raw = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    return raw if len(raw) < _STAT_MAX_LEN else None
+
+
+class _IdentityIndex:
+    """cidx stand-in for all-valid columns: row index == compact index,
+    without materializing an arange."""
+
+    def __getitem__(self, i):
+        return i
+
+
+def _compact_index(validity: np.ndarray | None, n: int):
+    """Prefix-sum mapping row index → index into the nulls-stripped value
+    vector (page slicing of compact arrays)."""
+    if validity is None:
+        return _IdentityIndex()
+    out = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(validity, out=out[1:])
+    return out
+
+
+class _PageSink:
+    """Accumulates pages of one chunk and assembles v1/v2 page bytes."""
+
+    def __init__(self, chunk: EncodedChunk, compress, page_v2: bool, codec_id: int):
+        self.chunk = chunk
+        self.compress = compress
+        self.page_v2 = page_v2
+        self.codec_id = codec_id
+
+    def add_dict_page(self, payload: bytes, num_values: int, is_sorted: bool) -> None:
+        body = self.compress(payload)
+        header = build_struct(
+            [
+                (1, _I32, PAGE_DICTIONARY),
+                (2, _I32, len(payload)),
+                (3, _I32, len(body)),
+                (
+                    7,
+                    _STRUCT,
+                    build_struct(
+                        [(1, _I32, num_values), (2, _I32, ENC_PLAIN), (3, _BOOL, is_sorted)]
+                    ),
+                ),
+            ]
+        )
+        self.chunk.pages.append(header + body)
+        self.chunk.dict_page_len = len(header) + len(body)
+        self.chunk.total_uncompressed += len(header) + len(payload)
+        self.chunk.total_compressed += len(header) + len(body)
+
+    def add_data_page(self, levels: bytes, values: bytes, n: int, n_valid: int, enc: int) -> None:
+        if self.page_v2:
+            body = self.compress(values) if self.codec_id else values
+            header = build_struct(
+                [
+                    (1, _I32, PAGE_DATA_V2),
+                    (2, _I32, len(levels) + len(values)),
+                    (3, _I32, len(levels) + len(body)),
+                    (
+                        8,
+                        _STRUCT,
+                        build_struct(
+                            [
+                                (1, _I32, n),
+                                (2, _I32, n - n_valid),
+                                (3, _I32, n),
+                                (4, _I32, enc),
+                                (5, _I32, len(levels)),
+                                (6, _I32, 0),
+                                (7, _BOOL, bool(self.codec_id)),
+                            ]
+                        ),
+                    ),
+                ]
+            )
+            page = header + levels + body
+            self.chunk.total_uncompressed += len(header) + len(levels) + len(values)
+            self.chunk.total_compressed += len(page)
+        else:
+            raw = struct.pack("<I", len(levels)) + levels + values
+            body = self.compress(raw)
+            header = build_struct(
+                [
+                    (1, _I32, PAGE_DATA),
+                    (2, _I32, len(raw)),
+                    (3, _I32, len(body)),
+                    (
+                        5,
+                        _STRUCT,
+                        build_struct(
+                            [(1, _I32, n), (2, _I32, enc), (3, _I32, ENC_RLE), (4, _I32, ENC_RLE)]
+                        ),
+                    ),
+                ]
+            )
+            page = header + body
+            self.chunk.total_uncompressed += len(header) + len(raw)
+            self.chunk.total_compressed += len(page)
+        self.chunk.pages.append(page)
+        self.chunk.num_pages += 1
+
+
+def _page_bounds(n: int, bytes_per_value: float, page_size: int) -> range:
+    rows = max(1, int(page_size / max(bytes_per_value, 1e-9)))
+    return range(0, n, rows)
+
+
+def _valid_arrow_array(col: Column, validity: np.ndarray | None):
+    """Nulls-stripped pyarrow array for a byte-array column — reuses the
+    column's arrow backing when present, else pays the one object→arrow
+    conversion (the same cost the arrow writer's to_arrow pays)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if col.arrow is not None and col._values is None:
+        arr = col.arrow
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if arr.null_count:
+            arr = pc.drop_null(arr)
+        return arr
+    vals = col.values if validity is None else col.values[validity]
+    return pa.array(vals, from_pandas=True)
+
+
+def _arrow_parts(arr) -> tuple[np.ndarray, bytes]:
+    """(lengths, payload) straight from a string/binary array's buffers."""
+    import pyarrow as pa
+
+    if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+        off_dt = np.dtype(np.int64)
+    elif pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
+        off_dt = np.dtype(np.int32)
+    else:
+        raise UnsupportedParquetFeature(f"arrow type {arr.type} is not string-like")
+    bufs = arr.buffers()
+    offsets = np.frombuffer(
+        bufs[1], dtype=off_dt, count=len(arr) + 1, offset=arr.offset * off_dt.itemsize
+    ).astype(np.int64)
+    data = np.frombuffer(bufs[2] or b"", dtype=np.uint8)
+    lengths = np.diff(offsets)
+    payload = data[offsets[0] : offsets[-1]].tobytes()
+    return lengths, payload
+
+
+def encode_chunk(
+    col: Column,
+    dtype: DataType,
+    physical: int,
+    *,
+    page_size: int,
+    page_v2: bool,
+    enable_dict: bool,
+    codec_id: int,
+    codec_name: str | None,
+    zstd_level: int | None,
+    metrics=None,
+) -> EncodedChunk:
+    """Encode one column (one row group's worth) into an EncodedChunk."""
+    n = len(col)
+    validity = col.validity
+    n_valid = n if validity is None else int(validity.sum())
+    levels = None if validity is None else kernels.validity_to_def_levels(validity, n)
+    cidx = _compact_index(validity, n)
+    chunk = EncodedChunk(physical_type=physical, num_values=n)
+    sink = _PageSink(chunk, _compressor(codec_id, codec_name, zstd_level), page_v2, codec_id)
+    utf8 = _is_utf8(dtype)
+
+    stats_min: bytes | None = None
+    stats_max: bytes | None = None
+    encodings = {ENC_RLE}
+    t_stats = 0.0
+
+    if physical == T_BYTE_ARRAY:
+        dict_route = _byte_array_route(col, validity, n_valid, enable_dict)
+        if dict_route is not None:
+            codes, pool_lens, pool_payload, is_sorted, lo, hi = dict_route
+            if lo is not None:
+                t0 = time.perf_counter()
+                stats_min, stats_max = _byte_stat(lo, utf8), _byte_stat(hi, utf8)
+                t_stats += time.perf_counter() - t0
+            dict_size = len(pool_lens)
+            sink.add_dict_page(
+                kernels.encode_plain_byte_array(pool_lens, pool_payload), dict_size, is_sorted
+            )
+            if metrics is not None:
+                metrics.counter("dict_pages").inc()
+            width = kernels.bit_width_for(max(dict_size - 1, 0))
+            if n_valid > 50_000 and 0 < width < 32 and width % 8:
+                # byte-aligned widths pack as a cast+reshape instead of a
+                # bit-matrix expansion; the compression codec absorbs the
+                # few padding bits per value (any width >= needed is legal)
+                width = (width + 7) & ~7
+            encodings |= {ENC_PLAIN, ENC_RLE_DICTIONARY}
+            bounds = _page_bounds(n, max(width, 1) / 8 + 0.125, page_size)
+            for start in bounds:
+                stop = min(start + bounds.step, n)
+                page_codes = codes[cidx[start] : cidx[stop]]
+                body = bytes([width]) + kernels.encode_rle_hybrid(page_codes, width)
+                sink.add_data_page(
+                    _level_bytes(levels, start, stop),
+                    body,
+                    stop - start,
+                    len(page_codes),
+                    ENC_RLE_DICTIONARY,
+                )
+        else:
+            lengths, payload, lo, hi = _byte_array_plain(col, validity, n_valid)
+            if lo is not None:
+                t0 = time.perf_counter()
+                stats_min, stats_max = _byte_stat(lo, utf8), _byte_stat(hi, utf8)
+                t_stats += time.perf_counter() - t0
+            encodings.add(ENC_PLAIN)
+            pay_off = np.zeros(len(lengths) + 1, dtype=np.int64)
+            np.cumsum(lengths, out=pay_off[1:])
+            bpv = 4 + (float(lengths.mean()) if len(lengths) else 0.0)
+            bounds = _page_bounds(n, bpv, page_size)
+            for start in bounds:
+                stop = min(start + bounds.step, n)
+                vs, ve = cidx[start], cidx[stop]
+                body = kernels.encode_plain_byte_array(
+                    lengths[vs:ve], payload[pay_off[vs] : pay_off[ve]]
+                )
+                sink.add_data_page(
+                    _level_bytes(levels, start, stop), body, stop - start, int(ve - vs), ENC_PLAIN
+                )
+    else:
+        compact, enc = _fixed_values(col, dtype, physical, validity, n_valid)
+        if stats_min is None and n_valid:
+            t0 = time.perf_counter()
+            stats_min, stats_max = _fixed_stat_bytes(compact, physical)
+            t_stats += time.perf_counter() - t0
+        encodings.add(enc)
+        bpv = 0.125 if physical == T_BOOLEAN else _STAT_ITEMSIZE[physical]
+        bounds = _page_bounds(n, bpv, page_size)
+        for start in bounds:
+            stop = min(start + bounds.step, n)
+            vs, ve = cidx[start], cidx[stop]
+            page_vals = compact[vs:ve]
+            if enc == ENC_DELTA_BINARY_PACKED and len(page_vals):
+                body = kernels.encode_delta_binary_packed(page_vals, physical)
+            elif physical == T_BOOLEAN:
+                body = kernels.encode_plain_boolean(page_vals)
+            else:
+                body = kernels.encode_plain(page_vals, physical)
+            sink.add_data_page(
+                _level_bytes(levels, start, stop), body, stop - start, int(ve - vs), enc
+            )
+    null_count = n - n_valid
+    chunk.stats = _stats_struct(stats_min, stats_max, null_count)
+    chunk.encodings = tuple(sorted(encodings))
+    if metrics is not None:
+        metrics.counter("pages_written").inc(chunk.num_pages)
+        metrics.histogram("stats_ms").update(t_stats * 1000)
+    return chunk
+
+
+_STAT_ITEMSIZE = {T_INT32: 4, T_INT64: 8, T_FLOAT: 4, T_DOUBLE: 8, T_BOOLEAN: 1}
+
+
+def _level_bytes(levels: np.ndarray | None, start: int, stop: int) -> bytes:
+    if levels is None:  # all valid: one RLE run of level 1, no vectors at all
+        from ..decode.thrift import append_uvarint
+
+        out = bytearray()
+        append_uvarint(out, (stop - start) << 1)
+        out += b"\x01"
+        return bytes(out)
+    return kernels.encode_rle_hybrid(levels[start:stop], 1)
+
+
+def _fixed_values(col: Column, dtype: DataType, physical: int, validity, n_valid: int):
+    """Nulls-stripped fixed-width values + the encoding to use."""
+    values = col.values
+    if validity is not None:
+        values = values[validity]
+    if physical == T_BOOLEAN:
+        return np.ascontiguousarray(values, dtype=np.bool_), ENC_PLAIN
+    np_dt = kernels._PLAIN_DTYPES[physical]
+    compact = np.ascontiguousarray(values, dtype=np_dt)
+    if (
+        physical in (T_INT32, T_INT64)
+        and n_valid >= 64
+        and bool(np.all(np.diff(compact) >= 0))
+    ):
+        # sorted int columns (merge output keys, sequence runs): the delta
+        # stream compresses far below PLAIN and packs vectorized
+        return compact, ENC_DELTA_BINARY_PACKED
+    return compact, ENC_PLAIN
+
+
+def _byte_array_route(col: Column, validity, n_valid: int, enable_dict: bool):
+    """Dictionary route for a BYTE_ARRAY column, or None for PLAIN.
+
+    Returns (codes, pool_lengths, pool_payload, is_sorted, min, max)."""
+    if not enable_dict or n_valid == 0:
+        return None
+    cache = getattr(col, "dict_cache", None)
+    if cache is not None and len(cache[1]) == len(col):
+        pool, codes = cache
+        if validity is not None:
+            codes = codes[validity]
+        codes = np.ascontiguousarray(codes, dtype=np.int64)
+        pool_lens, pool_payload = kernels.byte_array_parts(pool)
+        lo = pool[int(codes.min())] if len(codes) else None
+        hi = pool[int(codes.max())] if len(codes) else None
+        return codes, pool_lens, pool_payload, True, lo, hi
+    import pyarrow.compute as pc
+
+    arr = _valid_arrow_array(col, validity)
+    denc = arr.dictionary_encode()
+    dict_size = len(denc.dictionary)
+    if dict_size * _DICT_RATIO_DEN > n_valid * _DICT_RATIO_NUM:
+        return None  # domain ~as large as the data: PLAIN wins
+    codes = denc.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+    pool_lens, pool_payload = _arrow_parts(denc.dictionary)
+    mm = pc.min_max(arr).as_py() if n_valid else {"min": None, "max": None}
+    return codes, pool_lens, pool_payload, False, mm["min"], mm["max"]
+
+
+def _byte_array_plain(col: Column, validity, n_valid: int):
+    """PLAIN route: (lengths, payload, min, max) for the valid values."""
+    if n_valid == 0:
+        return np.zeros(0, dtype=np.int64), b"", None, None
+    cache = getattr(col, "dict_cache", None)
+    if cache is not None and len(cache[1]) == len(col):
+        # dictionary disabled but the merge pool still pays for stats: the
+        # pool is sorted, so min/max come from the code range without any
+        # object comparison; the values stream packs via the np.char path
+        pool, codes = cache
+        if validity is not None:
+            codes = codes[validity]
+        values = col.values if validity is None else col.values[validity]
+        lens, payload = kernels.byte_array_parts(values)
+        return lens, payload, pool[int(codes.min())], pool[int(codes.max())]
+    import pyarrow.compute as pc
+
+    arr = _valid_arrow_array(col, validity)
+    lengths, payload = _arrow_parts(arr)
+    mm = pc.min_max(arr).as_py()
+    return lengths, payload, mm["min"], mm["max"]

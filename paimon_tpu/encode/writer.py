@@ -1,0 +1,197 @@
+"""Parquet container assembly: column chunks → row groups → footer bytes
+(the write-side dual of decode/container.py).
+
+Everything thrift-shaped goes through decode.thrift.build_struct; offsets
+are tracked as pages append so ColumnMetaData carries exact
+dictionary/data-page offsets, and the footer writes ColumnOrder
+TYPE_DEFINED_ORDER for every leaf so readers (pyarrow included) trust the
+min_value/max_value statistics for row-group pruning.
+
+Envelope (mirrors the decoder's): flat schemas only, physical types
+BOOLEAN/INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY, codecs the repo uses
+(uncompressed/snappy/gzip/brotli/zstd/lz4). Anything else raises
+UnsupportedParquetFeature before a single byte is written, so the caller
+falls back to the arrow writer for that file only.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..data.batch import ColumnBatch
+from ..decode.container import (
+    CODEC_NAMES,
+    MAGIC,
+    T_INT32,
+    UnsupportedParquetFeature,
+    expected_physical_type,
+)
+from ..decode.thrift import build_struct
+from ..types import TypeRoot
+from .pages import encode_chunk
+
+__all__ = ["encode_parquet_bytes"]
+
+# thrift compact type nibbles
+_BOOL, _I32, _I64, _BINARY, _LIST, _STRUCT = 1, 5, 6, 8, 9, 12
+
+# parquet.thrift ConvertedType values the arrow writer emits for this
+# repo's logical types (everything else stays unannotated, matching
+# ColumnBatch.to_arrow's physical-representation columns)
+_CONVERTED_UTF8 = 0
+_CONVERTED_INT8 = 15
+_CONVERTED_INT16 = 16
+
+_CODEC_IDS = {name: cid for cid, name in CODEC_NAMES.items() if name}
+_CODEC_IDS.update({"lz4": 7, "uncompressed": 0, "none": 0})
+
+_CREATED_BY = b"paimon_tpu version 1.0.0 (build native-encode)"
+
+_DEFAULT_PAGE_SIZE = 1 << 20  # pyarrow's data_page_size default
+_DEFAULT_ROW_GROUP_ROWS = 1 << 20  # pyarrow's row_group_size default
+
+
+def _codec_for(compression: str | None) -> tuple[int, str | None]:
+    if compression is None:
+        return 0, None
+    name = str(compression).lower()
+    if name not in _CODEC_IDS:
+        raise UnsupportedParquetFeature(f"compression codec {compression!r}")
+    cid = _CODEC_IDS[name]
+    return cid, CODEC_NAMES.get(cid)
+
+
+def _converted_type(root: TypeRoot) -> int | None:
+    if root in (TypeRoot.CHAR, TypeRoot.VARCHAR):
+        return _CONVERTED_UTF8
+    if root == TypeRoot.TINYINT:
+        return _CONVERTED_INT8
+    if root == TypeRoot.SMALLINT:
+        return _CONVERTED_INT16
+    return None
+
+
+def _schema_elements(schema) -> list[bytes]:
+    elems = [build_struct([(4, _BINARY, b"schema"), (5, _I32, len(schema.fields))])]
+    for f in schema.fields:
+        root = f.type.root
+        if root in (TypeRoot.ARRAY, TypeRoot.MAP, TypeRoot.ROW):
+            raise UnsupportedParquetFeature(f"nested column {f.name!r}")
+        physical = expected_physical_type(f.type)
+        elems.append(
+            build_struct(
+                [
+                    (1, _I32, physical),
+                    (3, _I32, 1),  # OPTIONAL, like every arrow-written leaf
+                    (4, _BINARY, f.name),
+                    (6, _I32, _converted_type(root)),
+                ]
+            )
+        )
+    return elems
+
+
+def _row_group_rows(batch: ColumnBatch, format_options: dict) -> int:
+    if "parquet.row-group.rows" in format_options:
+        return max(1, int(format_options["parquet.row-group.rows"]))
+    if "file.block-size" in format_options and batch.num_rows:
+        per_row = max(1, batch.byte_size() // batch.num_rows)
+        return max(1024, int(format_options["file.block-size"]) // per_row)
+    return _DEFAULT_ROW_GROUP_ROWS
+
+
+def encode_parquet_bytes(
+    batch: ColumnBatch,
+    compression: str | None = "zstd",
+    format_options: dict | None = None,
+    metrics=None,
+) -> bytes:
+    """One ColumnBatch → complete parquet file bytes, or raise
+    UnsupportedParquetFeature (before any output) when the batch needs a
+    feature outside the native envelope."""
+    opts = format_options or {}
+    codec_id, codec_name = _codec_for(compression)
+    page_size = int(opts.get("parquet.page-size", _DEFAULT_PAGE_SIZE))
+    page_v2 = str(opts.get("parquet.data-page-version", "1.0")).strip() in ("2.0", "2")
+    enable_dict = str(opts.get("parquet.enable.dictionary", "true")).lower() != "false"
+    zstd_level = (
+        int(opts["file.compression.zstd-level"])
+        if codec_name == "zstd" and "file.compression.zstd-level" in opts
+        else None
+    )
+
+    schema_elems = _schema_elements(batch.schema)  # validates the envelope up front
+    physicals = {f.name: expected_physical_type(f.type) for f in batch.schema.fields}
+
+    body = bytearray(MAGIC)
+    row_groups: list[bytes] = []
+    n = batch.num_rows
+    rg_rows = _row_group_rows(batch, opts)
+    for rg_start in range(0, n, rg_rows):
+        # whole-batch shortcut: Column.slice materializes lazy values, which
+        # would defeat the dict-cache pool-reuse path for the (default)
+        # single-row-group file
+        rg = batch if rg_rows >= n else batch.slice(rg_start, min(rg_start + rg_rows, n))
+        chunk_structs: list[bytes] = []
+        rg_total_bytes = 0
+        for f in rg.schema.fields:
+            chunk = encode_chunk(
+                rg.column(f.name),
+                f.type,
+                physicals[f.name],
+                page_size=page_size,
+                page_v2=page_v2,
+                enable_dict=enable_dict,
+                codec_id=codec_id,
+                codec_name=codec_name,
+                zstd_level=zstd_level,
+                metrics=metrics,
+            )
+            chunk_start = len(body)
+            for page in chunk.pages:
+                body += page
+            dict_off = chunk_start if chunk.dict_page_len else None
+            data_off = chunk_start + chunk.dict_page_len
+            meta = build_struct(
+                [
+                    (1, _I32, chunk.physical_type),
+                    (2, _LIST, (_I32, list(chunk.encodings))),
+                    (3, _LIST, (_BINARY, [f.name])),
+                    (4, _I32, codec_id),
+                    (5, _I64, chunk.num_values),
+                    (6, _I64, chunk.total_uncompressed),
+                    (7, _I64, chunk.total_compressed),
+                    (9, _I64, data_off),
+                    (11, _I64, dict_off),
+                    (12, _STRUCT, chunk.stats),
+                ]
+            )
+            chunk_structs.append(
+                build_struct([(2, _I64, chunk_start), (3, _STRUCT, meta)])
+            )
+            rg_total_bytes += chunk.total_uncompressed
+        row_groups.append(
+            build_struct(
+                [
+                    (1, _LIST, (_STRUCT, chunk_structs)),
+                    (2, _I64, rg_total_bytes),
+                    (3, _I64, rg.num_rows),
+                ]
+            )
+        )
+
+    type_order = build_struct([(1, _STRUCT, build_struct([]))])
+    footer = build_struct(
+        [
+            (1, _I32, 2 if page_v2 else 1),
+            (2, _LIST, (_STRUCT, schema_elems)),
+            (3, _I64, n),
+            (4, _LIST, (_STRUCT, row_groups)),
+            (6, _BINARY, _CREATED_BY),
+            (7, _LIST, (_STRUCT, [type_order] * len(batch.schema.fields))),
+        ]
+    )
+    body += footer
+    body += struct.pack("<I", len(footer))
+    body += MAGIC
+    return bytes(body)

@@ -107,12 +107,21 @@ def collect_stats(batch: ColumnBatch, truncate: int = _TRUNCATE_LEN) -> dict[str
             # nested values have no total order: null-count-only stats
             out[f.name] = FieldStats(None, None, nulls, n)
             continue
-        valid = col.valid_mask()
-        v = col.values[valid] if nulls else col.values
         if f.type.numpy_dtype() == np.dtype(object):
-            lo, hi = min(v), max(v)
+            cache = getattr(col, "dict_cache", None)
+            if cache is not None and len(cache[1]) == n and not nulls:
+                # key-lane pool reuse: the pool is sorted, so min/max are a
+                # uint32 reduction over the ranks — no object comparisons
+                pool, codes = cache
+                lo, hi = pool[int(codes.min())], pool[int(codes.max())]
+            else:
+                v = col.values[col.valid_mask()] if nulls else col.values
+                lo, hi = min(v), max(v)
             lo, hi = _truncate_min(lo, truncate), _truncate_max(hi, truncate)
-        elif v.dtype.kind == "f":
+            out[f.name] = FieldStats(lo, hi, nulls, n)
+            continue
+        v = col.values[col.valid_mask()] if nulls else col.values
+        if v.dtype.kind == "f":
             # NaN-ignoring reductions: a NaN min/max would defeat every
             # stats comparison and prune files that contain matches
             with np.errstate(invalid="ignore"):

@@ -14,6 +14,17 @@ ParquetRowDataWriter. Two read decoders sit behind one `read()`:
     `format.parquet.decoder = native`; files needing features outside the
     native envelope (nested schemas, exotic encodings) fall back to arrow
     per file (counter decode.files_fallback).
+
+Two write encoders sit behind one `write()` the same way:
+
+  * arrow (default)  — ColumnBatch.to_arrow (per-column pa.array object
+    conversion) into pq.write_table;
+  * native           — paimon_tpu.encode: vectorized PLAIN/RLE/DELTA/
+    dictionary kernels writing pages straight from columnar arrays, with
+    dictionary pages consuming the merge path's string pools directly.
+    Selected per table via `format.parquet.encoder = native`; unsupported
+    shapes fall back to arrow per file (counter encode.files_fallback),
+    never per table.
 """
 
 from __future__ import annotations
@@ -30,14 +41,29 @@ from . import FileFormat, register_format
 class ParquetFormat(FileFormat):
     identifier = "parquet"
 
-    def __init__(self, decoder: str = "arrow"):
+    def __init__(self, decoder: str = "arrow", encoder: str = "arrow"):
         self.decoder = decoder
+        self.encoder = encoder
 
     def configure(self, format_options: dict | None) -> "ParquetFormat":
         d = (format_options or {}).get("format.parquet.decoder")
         if d:
             self.decoder = str(d)
+        e = (format_options or {}).get("format.parquet.encoder")
+        if e:
+            self.encoder = str(e)
         return self
+
+    def _effective_encoder(self, format_options: dict | None) -> str:
+        # PAIMON_TPU_PARQUET_ENCODER lets scripts/verify.sh force the whole
+        # suite through one encoder (same pattern as the pipeline stage's
+        # PAIMON_TPU_SCAN_PARALLELISM)
+        import os
+
+        env = os.environ.get("PAIMON_TPU_PARQUET_ENCODER")
+        if env:
+            return env
+        return str((format_options or {}).get("format.parquet.encoder") or self.encoder)
 
     def write(
         self,
@@ -50,6 +76,21 @@ class ParquetFormat(FileFormat):
         import io as _io
 
         import pyarrow.parquet as pq
+
+        if self._effective_encoder(format_options) == "native":
+            from ..decode.container import UnsupportedParquetFeature
+            from ..encode import write_native
+
+            try:
+                write_native(file_io, path, batch, compression, format_options)
+                return
+            except UnsupportedParquetFeature:
+                # per-FILE fallback: this batch needs a feature outside the
+                # native envelope (nested columns, exotic codec); later
+                # files still try the native path
+                from ..metrics import encode_metrics
+
+                encode_metrics().counter("files_fallback").inc()
 
         table = batch.to_arrow()
         buf = _io.BytesIO()

@@ -23,6 +23,7 @@ __all__ = [
     "registry",
     "timed",
     "decode_metrics",
+    "encode_metrics",
     "io_metrics",
     "pipeline_metrics",
 ]
@@ -145,6 +146,18 @@ def decode_metrics() -> MetricGroup:
     (whole-file native decode wall millis), pushdown_ms (per row group).
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("decode")
+
+
+def encode_metrics() -> MetricGroup:
+    """The encode{...} group (native parquet page-encode subsystem,
+    paimon_tpu.encode — the write-side mirror of decode{...}). Canonical
+    members — counters: pages_written (data pages), bytes_written (file
+    bytes produced natively), dict_pages (dictionary pages emitted),
+    files_native, files_fallback (fell back to the arrow writer on an
+    unsupported shape); histograms: encode_ms (whole-file native encode
+    wall millis), stats_ms (chunk min/max statistics portion). Resolved per
+    call so registry.reset() in tests swaps the group out."""
+    return registry.group("encode")
 
 
 def pipeline_metrics() -> MetricGroup:

@@ -246,6 +246,16 @@ class CoreOptions:
         "expands only surviving pages; falls back to arrow per file on "
         "unsupported container features).",
     )
+    FORMAT_PARQUET_ENCODER = ConfigOption.string(
+        "format.parquet.encoder",
+        "arrow",
+        "Parquet write encoder: 'arrow' (ColumnBatch.to_arrow + pyarrow "
+        "pq.write_table) or 'native' (paimon_tpu.encode: vectorized "
+        "PLAIN/RLE/DELTA/dictionary kernels writing pages straight from "
+        "columnar arrays, reusing the merge path's string pools for "
+        "dictionary pages; falls back to arrow per file on unsupported "
+        "shapes such as nested columns).",
+    )
     READ_BATCH_SIZE = ConfigOption.int_(
         "read.batch-size", None, "Rows per record batch handed to engine surfaces (unset: 1M-row chunks)."
     )

@@ -11,7 +11,17 @@
 #                              randomized-oracle parity tests with
 #                              scan.parallelism forced to 1 and then to 8 —
 #                              pipelined output must be bit-identical to the
-#                              sequential path at both extremes.
+#                              sequential path at both extremes. Runs with
+#                              the native parquet encoder forced, so the
+#                              pipelined flush/compaction encode stages are
+#                              exercised through paimon_tpu.encode
+#                              (conftest asserts encode{files_native} > 0).
+#   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
+#                              full test_encode suite (incl. the slow
+#                              corpus sweep) with the encoder forced
+#                              native — every natively-written file must
+#                              read back bit-identically through BOTH the
+#                              native decoder and pyarrow.
 #
 # Exits non-zero on test failure/timeout; tier-1 prints DOTS_PASSED=<n>
 # (count of passing tests) for trend comparison.
@@ -20,17 +30,24 @@ cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "pipeline" ]; then
   for par in 1 8; do
-    env JAX_PLATFORMS=cpu PAIMON_TPU_SCAN_PARALLELISM=$par \
-      timeout -k 10 600 python -m pytest tests/test_pipeline.py -q \
-      -k 'parity or fault or flush' \
+    env JAX_PLATFORMS=cpu PAIMON_TPU_SCAN_PARALLELISM=$par PAIMON_TPU_PARQUET_ENCODER=native \
+      timeout -k 10 600 python -m pytest tests/test_pipeline.py tests/test_encode.py -q \
+      -k 'parity or fault or flush or pipelined' \
       -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
   done
   exit 0
 fi
 
 if [ "${1:-}" = "faults" ]; then
-  exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" \
-    timeout -k 10 600 python -m pytest tests/test_resilience.py tests/test_commit_faults.py -q \
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" PAIMON_TPU_PARQUET_ENCODER=native \
+    timeout -k 10 600 python -m pytest tests/test_resilience.py tests/test_commit_faults.py \
+    tests/test_encode.py::test_native_encoder_under_transient_faults -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "encode" ]; then
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_PARQUET_ENCODER=native \
+    timeout -k 10 600 python -m pytest tests/test_encode.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 

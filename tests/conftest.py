@@ -63,6 +63,22 @@ def _no_worker_thread_leaks():
     assert not leaked(), f"leaked non-daemon worker threads: {[t.name for t in leaked()]}"
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _forced_encoder_coverage():
+    """When a verify stage forces PAIMON_TPU_PARQUET_ENCODER=native, the run
+    must actually have routed parquet writes through the native encoder —
+    a stage that silently fell back everywhere would prove nothing. Uses the
+    encode subsystem's process-lifetime counter (registry.reset()-proof)."""
+    yield
+    if os.environ.get("PAIMON_TPU_PARQUET_ENCODER") == "native":
+        from paimon_tpu.encode import files_native_total
+
+        assert files_native_total() > 0, (
+            "PAIMON_TPU_PARQUET_ENCODER=native was forced but no file was "
+            "natively encoded in this session"
+        )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
