@@ -198,6 +198,61 @@ def bench_encode() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_lanes(table) -> list:
+    """Key-lane compression breakdown (benchmarks/lanes_bench.py is the
+    dedicated 3-schema x 3-workload sweep): the standard merge-read table
+    read twice through table.copy — merge.lane-compression off vs on (same
+    files, same cache state) — plus the planner counter deltas from the
+    lanes{} metric group. Outputs are asserted identical row-for-row."""
+    from paimon_tpu.metrics import lanes_metrics
+
+    g = lanes_metrics()
+
+    def counters():
+        return {k: g.counter(k).count for k in ("plans", "lanes_in", "lanes_out", "ovc_merges", "bytes_saved")}
+
+    results = {}
+    deltas = None
+    for comp in (False, True):
+        t = table.copy({"merge.lane-compression": "true" if comp else "false"})
+        rb = t.new_read_builder()
+        best = float("inf")
+        c0 = counters()
+        out = None
+        for it in range(4):
+            t0 = time.perf_counter()
+            out = rb.new_read().read_all(rb.new_scan().plan())
+            dt = time.perf_counter() - t0
+            assert out.num_rows == N_ROWS, out.num_rows
+            if it > 0:
+                best = min(best, dt)
+        if comp:
+            c1 = counters()
+            deltas = {k: c1[k] - c0[k] for k in c0}
+        results[comp] = (N_ROWS / best, out)
+    assert results[True][1].to_pylist() == results[False][1].to_pylist()
+    on, off = results[True][0], results[False][0]
+    plans = max(deltas["plans"], 1)
+    return [
+        {
+            "metric": "merge-read compressed vs uncompressed key lanes (same table)",
+            "rows_per_sec_uncompressed": round(off, 1),
+            "rows_per_sec_compressed": round(on, 1),
+            "speedup": round(on / off, 3),
+            "unit": "rows/s",
+        },
+        {
+            "metric": "key-lane compression breakdown",
+            "plans": deltas["plans"],
+            "lanes_in_per_plan": round(deltas["lanes_in"] / plans, 2),
+            "lanes_out_per_plan": round(deltas["lanes_out"] / plans, 2),
+            "ovc_merges": deltas["ovc_merges"],
+            "bytes_saved": deltas["bytes_saved"],
+            "unit": "counters",
+        },
+    ]
+
+
 def bench_resilience() -> dict:
     """Commit resilience spot-check (benchmarks/resilience_bench.py is the
     dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
@@ -229,6 +284,7 @@ def main():
         rows_per_sec = bench_read(table)
         scan_cache_speedup = bench_scan_cache(table)
         decode_row = bench_decode(table)
+        lanes_rows = bench_lanes(table)
         pipeline_rows = bench_pipeline()
         encode_rows = bench_encode()
         resilience_row = bench_resilience()
@@ -264,6 +320,8 @@ def main():
             )
         )
         print(json.dumps(dict(decode_row, platform=_PLATFORM)))
+        for lrow in lanes_rows:
+            print(json.dumps(dict(lrow, platform=_PLATFORM)))
         for prow in pipeline_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         for erow in encode_rows:

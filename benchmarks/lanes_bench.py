@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Benchmark: key-lane compression (ISSUE 6) under merge read, compaction
+rewrite, and sort-compact.
+
+Three schemas spanning the planner's decision space:
+
+  int_pk     — single BIGINT primary key (2 lanes; the constant hi word
+               truncates away, the lo word min-shifts)
+  composite  — 4-column composite STRING key with shared prefixes (4 dict-
+               rank lanes; truncation + bit-packing fuse them into 1-2
+               operands, wide batches carry the OVC lane)
+  dict_pk    — dictionary-heavy STRING + INT key (low-cardinality ranks:
+               tiny bit widths, maximal packing)
+
+Per schema x workload the bench measures rows/s with merge.lane-compression
+ON vs OFF (bit-identical outputs asserted on every pass) plus the planner's
+lanes_in -> lanes_out width from the lanes{} metric group.
+
+Acceptance (ISSUE 6): >= 1.25x merge-read rows/s on the composite schema and
+lanes_out < lanes_in on every multi-lane schema. Results land in
+benchmarks/results/lanes_bench.json.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_ROWS = 400_000
+N_RUNS = 4
+ITERS = 5
+RESULTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results", "lanes_bench.json")
+
+
+def _schemas():
+    import paimon_tpu as pt
+
+    return {
+        "int_pk": dict(
+            schema=pt.RowType.of(("id", pt.BIGINT(False)), ("v", pt.BIGINT()), ("w", pt.DOUBLE())),
+            keys=["id"],
+        ),
+        "composite": dict(
+            schema=pt.RowType.of(
+                ("region", pt.STRING(False)),
+                ("dept", pt.STRING(False)),
+                ("user", pt.STRING(False)),
+                ("item", pt.STRING(False)),
+                ("v", pt.BIGINT()),
+            ),
+            keys=["region", "dept", "user", "item"],
+        ),
+        "dict_pk": dict(
+            schema=pt.RowType.of(("cat", pt.STRING(False)), ("slot", pt.INT(False)), ("v", pt.BIGINT())),
+            keys=["cat", "slot"],
+        ),
+    }
+
+
+def _rows(kind, n, rng):
+    if kind == "int_pk":
+        ids = rng.integers(0, n * 2, n).astype(np.int64)
+        return {"id": ids, "v": ids * 3, "w": ids.astype(np.float64) * 0.5}
+    if kind == "composite":
+        # shared prefixes everywhere: the OVC/prefix-truncation stress shape
+        region = np.array([f"acct-region-{int(x):02d}" for x in rng.integers(0, 8, n)], dtype=object)
+        dept = np.array([f"acct-dept-{int(x):03d}" for x in rng.integers(0, 64, n)], dtype=object)
+        user = np.array([f"user-{int(x):05d}" for x in rng.integers(0, 2000, n)], dtype=object)
+        item = np.array([f"item-{int(x):04d}" for x in rng.integers(0, 500, n)], dtype=object)
+        return {"region": region, "dept": dept, "user": user, "item": item,
+                "v": rng.integers(0, 1 << 40, n).astype(np.int64)}
+    if kind == "dict_pk":
+        cat = np.array([f"category-{int(x):03d}" for x in rng.integers(0, 100, n)], dtype=object)
+        return {"cat": cat, "slot": rng.integers(0, 1000, n).astype(np.int32),
+                "v": rng.integers(0, 1 << 40, n).astype(np.int64)}
+    raise AssertionError(kind)
+
+
+def _make_table(cat, name, kind, spec, compression, extra=None):
+    opts = {
+        "bucket": "1",
+        "file.format": "parquet",
+        "write-only": "true",
+        "merge.lane-compression": "true" if compression else "false",
+    }
+    opts.update(extra or {})
+    return cat.create_table(name, spec["schema"], primary_keys=spec["keys"], options=opts)
+
+
+def _write_runs(table, kind, n, runs, seed=7):
+    rng = np.random.default_rng(seed)
+    per = n // runs
+    for r in range(runs):
+        data = _rows(kind, per, rng)
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(data)
+        wb.new_commit().commit(w.prepare_commit())
+
+
+def _lane_counters():
+    from paimon_tpu.metrics import lanes_metrics
+
+    g = lanes_metrics()
+    return {k: g.counter(k).count for k in ("plans", "lanes_in", "lanes_out", "ovc_merges", "bytes_saved")}
+
+
+def _timed_read(table, iters):
+    rb = table.new_read_builder()
+    best = float("inf")
+    out = None
+    for it in range(iters + 1):  # first pass warms jit caches
+        t0 = time.perf_counter()
+        out = rb.new_read().read_all(rb.new_scan().plan())
+        dt = time.perf_counter() - t0
+        if it > 0:
+            best = min(best, dt)
+    return out, best
+
+
+def bench_merge_read(cat_path, kind, spec, extra=None):
+    """Both option values read the SAME physical table (table.copy swaps only
+    merge.lane-compression), so file layout, page boundaries, and OS cache
+    state are identical — the delta is the merge kernel's lane width."""
+    from paimon_tpu.catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(cat_path, commit_user="lanes-bench")
+    row = {"schema": kind, "workload": "merge_read", "rows": N_ROWS}
+    base = _make_table(cat, f"b.mr_{kind}", kind, spec, True, extra=extra)
+    _write_runs(base, kind, N_ROWS, N_RUNS)
+    outs = {}
+    for comp in (False, True):
+        t = base.copy({"merge.lane-compression": "true" if comp else "false"})
+        c0 = _lane_counters()
+        out, best = _timed_read(t, ITERS)
+        c1 = _lane_counters()
+        outs[comp] = out
+        tag = "on" if comp else "off"
+        row[f"rows_per_sec_{tag}"] = round(out.num_rows / best, 1)
+        if comp:
+            delta = {k: c1[k] - c0[k] for k in c0}
+            row["lanes_in"] = delta["lanes_in"] // max(delta["plans"], 1)
+            row["lanes_out"] = delta["lanes_out"] // max(delta["plans"], 1)
+            row["ovc_merges"] = delta["ovc_merges"]
+    assert outs[True].to_pylist() == outs[False].to_pylist(), f"{kind}: compressed read differs"
+    row["speedup"] = round(row["rows_per_sec_on"] / row["rows_per_sec_off"], 3)
+    return row
+
+
+def bench_compaction(cat_path, kind, spec):
+    from paimon_tpu.catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(cat_path, commit_user="lanes-bench")
+    n = N_ROWS // 2
+    row = {"schema": kind, "workload": "compaction_rewrite", "rows": n}
+    merged = {}
+    # single-shot workload: best of 2 fresh-table runs per option damps
+    # filesystem/allocator noise (outputs still asserted identical)
+    for comp in (False, True):
+        best = float("inf")
+        for attempt in range(2):
+            t = _make_table(
+                cat, f"b.cp_{kind}_{int(comp)}_{attempt}", kind, spec, comp,
+                extra={"write-only": "false"},
+            )
+            _write_runs(t, kind, n, N_RUNS)
+            wb = t.new_batch_write_builder()
+            w = wb.new_write()
+            t0 = time.perf_counter()
+            w.compact(full=True)
+            best = min(best, time.perf_counter() - t0)
+            wb.new_commit().commit(w.prepare_commit())
+            rb = t.new_read_builder()
+            merged[comp] = rb.new_read().read_all(rb.new_scan().plan())
+        row[f"rows_per_sec_{'on' if comp else 'off'}"] = round(n / best, 1)
+    assert merged[True].to_pylist() == merged[False].to_pylist(), f"{kind}: compacted view differs"
+    row["speedup"] = round(row["rows_per_sec_on"] / row["rows_per_sec_off"], 3)
+    return row
+
+
+def bench_sort_compact(cat_path, kind, spec):
+    import paimon_tpu as pt
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.table.sort_compact import sort_compact
+
+    cat = FileSystemCatalog(cat_path, commit_user="lanes-bench")
+    n = N_ROWS // 2
+    row = {"schema": kind, "workload": "sort_compact", "rows": n}
+    views = {}
+    for comp in (False, True):
+        best = float("inf")
+        for attempt in range(2):
+            # append-only variant of the same schema (sort-compact precondition)
+            t = cat.create_table(
+                f"b.sc_{kind}_{int(comp)}_{attempt}",
+                spec["schema"],
+                options={
+                    "bucket": "1",
+                    "file.format": "parquet",
+                    "merge.lane-compression": "true" if comp else "false",
+                },
+            )
+            _write_runs(t, kind, n, 2)
+            t0 = time.perf_counter()
+            total = sort_compact(t, spec["keys"], order="order")
+            best = min(best, time.perf_counter() - t0)
+            rb = t.new_read_builder()
+            views[comp] = rb.new_read().read_all(rb.new_scan().plan())
+        row[f"rows_per_sec_{'on' if comp else 'off'}"] = round(total / best, 1)
+    assert views[True].to_pylist() == views[False].to_pylist(), f"{kind}: clustered view differs"
+    row["speedup"] = round(row["rows_per_sec_on"] / row["rows_per_sec_off"], 3)
+    return row
+
+
+def bench_ovc_wide(cat_path):
+    """Extra headline: a key too wide to pack into one operand, driven
+    through the DEVICE kernel (sort-engine pinned so the adaptive CPU
+    fallback doesn't bypass it) — the batch genuinely carries the
+    offset-value code lane through lax.sort (ovc_merges > 0)."""
+    import paimon_tpu as pt
+
+    spec = dict(
+        schema=pt.RowType.of(
+            ("hi", pt.BIGINT(False)), ("lo", pt.BIGINT(False)), ("tag", pt.STRING(False)),
+            ("v", pt.BIGINT()),
+        ),
+        keys=["hi", "lo", "tag"],
+    )
+
+    def rows_fn(n, rng):
+        # 20+20+4 varying bits: two fused operands (20 | 20+4) -> the
+        # planner attaches the OVC lane (vbits 24 + 2 offset bits <= 32)
+        return {
+            "hi": rng.integers(0, 1 << 20, n).astype(np.int64),
+            "lo": rng.integers(0, 1 << 20, n).astype(np.int64),
+            "tag": np.array([f"t-{int(x):02d}" for x in rng.integers(0, 16, n)], dtype=object),
+            "v": rng.integers(0, 1 << 40, n).astype(np.int64),
+        }
+
+    from paimon_tpu.catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(cat_path, commit_user="lanes-bench")
+    n = N_ROWS // 2
+    row = {"schema": "wide_ovc", "workload": "merge_read_device", "rows": n}
+    base = cat.create_table(
+        "b.ovc", spec["schema"], primary_keys=spec["keys"],
+        options={"bucket": "1", "file.format": "parquet", "write-only": "true",
+                 "sort-engine": "xla-segmented"},
+    )
+    rng = np.random.default_rng(5)
+    per = n // N_RUNS
+    for _ in range(N_RUNS):
+        wb = base.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(rows_fn(per, rng))
+        wb.new_commit().commit(w.prepare_commit())
+    outs = {}
+    for comp in (False, True):
+        t = base.copy({"merge.lane-compression": "true" if comp else "false"})
+        c0 = _lane_counters()
+        out, best = _timed_read(t, ITERS)
+        c1 = _lane_counters()
+        outs[comp] = out
+        row[f"rows_per_sec_{'on' if comp else 'off'}"] = round(out.num_rows / best, 1)
+        if comp:
+            delta = {k: c1[k] - c0[k] for k in c0}
+            row["lanes_in"] = delta["lanes_in"] // max(delta["plans"], 1)
+            row["lanes_out"] = delta["lanes_out"] // max(delta["plans"], 1)
+            row["ovc_merges"] = delta["ovc_merges"]
+    assert outs[True].to_pylist() == outs[False].to_pylist(), "wide_ovc: compressed read differs"
+    row["speedup"] = round(row["rows_per_sec_on"] / row["rows_per_sec_off"], 3)
+    return row
+
+
+def run():
+    from paimon_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    rows = []
+    specs = _schemas()
+    for kind, spec in specs.items():
+        for bench in (bench_merge_read, bench_compaction, bench_sort_compact):
+            tmp = tempfile.mkdtemp(prefix="paimon_lanes_bench_")
+            try:
+                rows.append(bench(tmp, kind, spec))
+                print(json.dumps(rows[-1]))
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+    tmp = tempfile.mkdtemp(prefix="paimon_lanes_bench_")
+    try:
+        rows.append(bench_ovc_wide(tmp))
+        print(json.dumps(rows[-1]))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return rows
+
+
+def main():
+    rows = run()
+    headline = next(r for r in rows if r["schema"] == "composite" and r["workload"] == "merge_read")
+    summary = {
+        "metric": "key-lane compression (merge read, composite string key)",
+        "speedup": headline["speedup"],
+        "lanes_in": headline["lanes_in"],
+        "lanes_out": headline["lanes_out"],
+        "acceptance_1_25x": headline["speedup"] >= 1.25,
+    }
+    print(json.dumps(summary))
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump({"rows": rows, "summary": summary, "n_rows": N_ROWS}, f, indent=2)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

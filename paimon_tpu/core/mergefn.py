@@ -34,12 +34,19 @@ from .kv import KVBatch
 __all__ = ["MergeExecutor"]
 
 
-def _numpy_dedup_select(lanes: np.ndarray, seq_lanes: np.ndarray | None) -> np.ndarray:
+def _numpy_dedup_select(lanes: np.ndarray, seq_lanes: np.ndarray | None, compress: bool | None = None) -> np.ndarray:
     """sort-engine=numpy: the pure-host oracle path (useful when no
     accelerator is attached, and as the reference implementation the device
-    kernels are tested against)."""
+    kernels are tested against). Lane compression applies here too — fewer
+    lexsort key arrays and fewer boundary compares, same selection — with an
+    all-constant key short-circuiting to the scalar winner."""
     from ..data.keys import lexsort_rows
+    from ..ops.lanes import compress_key_lanes, scalar_dedup_winner
 
+    n = lanes.shape[0]
+    lanes, plan = compress_key_lanes(lanes, compress, enable_ovc=False)
+    if plan is not None and lanes.shape[1] == 0:
+        return scalar_dedup_winner(seq_lanes, n)
     tiebreakers = [] if seq_lanes is None else [seq_lanes[:, i] for i in range(seq_lanes.shape[1])]
     order = lexsort_rows(lanes, *tiebreakers)
     sorted_lanes = lanes[order]
@@ -66,6 +73,12 @@ class MergeExecutor:
             if value_schema.field(k).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
         ]
         self._user_seq = self.options.sequence_field
+
+    @property
+    def _compress(self) -> bool:
+        """merge.lane-compression: the key-lane compression layer (the
+        PAIMON_TPU_LANE_COMPRESSION env var overrides at the ops seam)."""
+        return self.options.lane_compression
 
     def effective_sort_engine(self):
         """The merge backend actually used. sort-engine set on the table wins
@@ -133,7 +146,7 @@ class MergeExecutor:
 
     def _plan(self, kv: KVBatch, seq_ascending: bool = False):
         lanes, seq_lanes = self._lanes(kv, seq_ascending)
-        return merge_plan(lanes, seq_lanes)
+        return merge_plan(lanes, seq_lanes, compress=self._compress)
 
     def merge(self, kv: KVBatch, seq_ascending: bool = False) -> KVBatch:
         """One output row per key, key-sorted. Dedup keeps the winning row's
@@ -177,17 +190,34 @@ class MergeExecutor:
             seq_lanes = self._seq_lanes(kv, seq_ascending)
             engine = self.effective_sort_engine()
             if engine == SortEngine.NUMPY:
-                return ("sync", kv.take(_numpy_dedup_select(lanes, seq_lanes)))
+                return ("sync", kv.take(_numpy_dedup_select(lanes, seq_lanes, self._compress)))
             if ctx is not None:
-                return ("dedup", ctx, ctx.submit_dedup(lanes, seq_lanes), kv)
+                # compress before submit: mesh jobs upload fewer lanes and
+                # the batch pads to a smaller common arity (no OVC — the
+                # mesh kernels take plain lanes, and the plan can't ride a
+                # job queue; packing alone keeps the metric honest)
+                from ..ops.lanes import compress_key_lanes
+
+                cl, _ = compress_key_lanes(lanes, self._compress, enable_ovc=False)
+                return ("dedup", ctx, ctx.submit_dedup(cl, seq_lanes), kv)
             backend = "pallas" if engine == SortEngine.PALLAS else "xla"
             from ..ops.merge import deduplicate_resolve, deduplicate_select_async
 
-            return ("sync", kv.take(deduplicate_resolve(deduplicate_select_async(lanes, seq_lanes, backend=backend))))
+            return (
+                "sync",
+                kv.take(
+                    deduplicate_resolve(
+                        deduplicate_select_async(lanes, seq_lanes, backend=backend, compress=self._compress)
+                    )
+                ),
+            )
         lanes, seq_lanes = self._lanes(kv, seq_ascending)
         engine = self.effective_sort_engine()
         if ctx is not None and engine != SortEngine.NUMPY:
-            return ("plan", ctx, ctx.submit_plan(lanes, seq_lanes), kv)
+            from ..ops.lanes import compress_key_lanes
+
+            cl, _ = compress_key_lanes(lanes, self._compress, enable_ovc=False)
+            return ("plan", ctx, ctx.submit_plan(cl, seq_lanes), kv)
         if engine != SortEngine.NUMPY:
             # single-device fast paths: sort + segment + engine selection in
             # ONE kernel call (no plan download, no per-field round trips)
@@ -201,7 +231,7 @@ class MergeExecutor:
                 cols = [kv.data.column(f.name) for f in fields]
                 if fused_routable(specs, cols):
                     return ("sync", self._aggregate_fused(kv, lanes, seq_lanes, fields, specs, cols))
-        return ("sync", self._merge_with_plan(kv, merge_plan(lanes, seq_lanes)))
+        return ("sync", self._merge_with_plan(kv, merge_plan(lanes, seq_lanes, compress=self._compress)))
 
     def merge_resolve(self, handle) -> KVBatch:
         tag = handle[0]
@@ -228,17 +258,21 @@ class MergeExecutor:
 
         engine = self.effective_sort_engine()
         if engine == SortEngine.NUMPY:
-            return ("numpy", _numpy_dedup_select(lanes, seq_lanes))
-        from ..ops.merge import deduplicate_select_async, deduplicate_tiled_dispatch, drop_constant_lanes
+            return ("numpy", _numpy_dedup_select(lanes, seq_lanes, self._compress))
+        from ..ops.merge import deduplicate_select_async, deduplicate_tiled_dispatch
 
         backend = "pallas" if engine == SortEngine.PALLAS else "xla"
         if seq_lanes is None and run_offsets is not None:
             tile_rows = self.options.options.get(CoreOptions.MERGE_READ_BATCH_ROWS)
-            kl = drop_constant_lanes(lanes)
-            if kl.shape[1] == 0 and lanes.shape[1]:
-                kl = lanes[:, :1]
-            return ("tiled", deduplicate_tiled_dispatch(kl, run_offsets, tile_rows, backend=backend))
-        return ("single", deduplicate_select_async(lanes, seq_lanes, backend=backend))
+            # the tiled dispatcher owns the compression seam (one plan per
+            # merge, shared by every tile) and the all-constant fast path
+            return (
+                "tiled",
+                deduplicate_tiled_dispatch(
+                    lanes, run_offsets, tile_rows, backend=backend, compress=self._compress
+                ),
+            )
+        return ("single", deduplicate_select_async(lanes, seq_lanes, backend=backend, compress=self._compress))
 
     @staticmethod
     def dedup_resolve(handle) -> np.ndarray:
@@ -297,7 +331,12 @@ class MergeExecutor:
             else np.zeros((0, kv.num_rows), np.bool_)
         )
         src, exists, last_take = fused_partial_update(
-            lanes, seq_lanes, field_valid, kv.kind, remove_record_on_delete=remove_on_delete
+            lanes,
+            seq_lanes,
+            field_valid,
+            kv.kind,
+            remove_record_on_delete=remove_on_delete,
+            compress=self._compress,
         )
         cols: dict[str, Column] = {}
         for k in self.key_names:
@@ -315,7 +354,7 @@ class MergeExecutor:
         the same kernel as the sort."""
         from ..ops.aggregates import fused_aggregate
 
-        agg_cols, last_take = fused_aggregate(lanes, seq_lanes, cols_in, specs, kv.kind)
+        agg_cols, last_take = fused_aggregate(lanes, seq_lanes, cols_in, specs, kv.kind, compress=self._compress)
         cols: dict[str, Column] = {}
         for k in self.key_names:
             cols[k] = kv.data.column(k).take(last_take)
@@ -375,7 +414,7 @@ class MergeExecutor:
         g_lanes = self._lanes_nullsafe(gcol, root, gpool, seq_col)
         hi, lo = split_int64_lanes(kv.seq)
         seq_lanes = np.concatenate([g_lanes, np.stack([hi, lo], axis=1)], axis=1)
-        gplan = merge_plan(key_lanes, seq_lanes)
+        gplan = merge_plan(key_lanes, seq_lanes, compress=self._compress)
         candidate = g_valid & np.isin(kv.kind, (int(RowKind.INSERT), int(RowKind.UPDATE_AFTER)))
         src = _pick_fn(True)(
             jnp.asarray(gplan.perm), jnp.asarray(gplan.seg_id), jnp.asarray(pad_to(candidate, gplan.m, False))

@@ -25,6 +25,7 @@ __all__ = [
     "decode_metrics",
     "encode_metrics",
     "io_metrics",
+    "lanes_metrics",
     "pipeline_metrics",
 ]
 
@@ -171,6 +172,18 @@ def pipeline_metrics() -> MetricGroup:
     {scan, compact, flush}. Resolved per call so registry.reset() in tests
     swaps the group out."""
     return registry.group("pipeline")
+
+
+def lanes_metrics() -> MetricGroup:
+    """The lanes{...} group (key-lane compression layer, paimon_tpu.ops.lanes).
+    Canonical members — counters: plans (merges planned), lanes_in (logical
+    uint32 key lanes entering the planner), lanes_out (physical sort operands
+    after truncation + packing, incl. the OVC lane when present), bytes_saved
+    (host->device key-lane bytes elided vs the uncompressed upload),
+    ovc_merges (merges that carried an offset-value code lane through the
+    sort). Resolved per call so registry.reset() in tests swaps the group
+    out."""
+    return registry.group("lanes")
 
 
 def io_metrics() -> MetricGroup:

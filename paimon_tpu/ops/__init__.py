@@ -18,6 +18,7 @@ once per (lane-count, size-bucket) and caches.
 """
 
 from .aggregates import AGGREGATORS, AggregateSpec, aggregate_merge
+from .lanes import LanePlan, apply_plan, compress_key_lanes, plan_lanes
 from .merge import (
     MergePlan,
     deduplicate_select,
@@ -39,4 +40,8 @@ __all__ = [
     "aggregate_merge",
     "AggregateSpec",
     "AGGREGATORS",
+    "LanePlan",
+    "plan_lanes",
+    "apply_plan",
+    "compress_key_lanes",
 ]

@@ -358,12 +358,15 @@ def fused_aggregate(
     columns: list[Column],
     specs: list[AggregateSpec],
     row_kind: np.ndarray,
+    compress: bool | None = None,
 ) -> tuple[list[Column], np.ndarray]:
     """Single-call aggregation merge over every value column. Returns
-    (aggregated columns in key order, last_take winning-row indices)."""
-    from .merge import prepare_lanes
+    (aggregated columns in key order, last_take winning-row indices). Key
+    lanes run through the compression seam (ops/lanes.py) — identical
+    segmentation, fewer sort operands."""
+    from .merge import prepare_lanes_planned
 
-    klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, seq_lanes)
+    klp, slp, pad, n, k, s, m, _plan = prepare_lanes_planned(key_lanes, seq_lanes, compress=compress)
     col_fns = []
     values = []
     valids = []

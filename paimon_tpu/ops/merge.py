@@ -56,7 +56,7 @@ def pad_to(arr: np.ndarray, m: int, fill=0) -> np.ndarray:
     return out
 
 
-def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes, pad_flag):
+def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes, pad_flag, extra_keys=()):
     """The shared in-kernel preamble (traced inside each jitted kernel): one
     stable lexicographic sort on (pad, key lanes, seq lanes, iota), then
     segment detection over (pad, key lanes) only — sequence lanes do NOT
@@ -65,19 +65,30 @@ def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes
 
     Lane containers may be a (L, m) array OR a list of (m,) arrays of MIXED
     uint dtypes (the range-narrowed upload path) — per-lane indexing and
-    per-lane compares avoid any cross-dtype stack."""
+    per-lane compares avoid any cross-dtype stack.
+
+    extra_keys: order-consistent leading key lanes (the offset-value code
+    lane of ops/lanes.py) sorted between the pad flag and the key lanes and
+    tested FIRST in boundary detection. An extra key must satisfy the OVC
+    contract — where it differs it agrees with full-key order, where it ties
+    the key lanes decide — so both the permutation and the segmentation stay
+    bit-identical to the plain path."""
     m = pad_flag.shape[0]
     iota = jnp.arange(m, dtype=jnp.int32)
+    extra = list(extra_keys)
     operands = (
         [pad_flag]
+        + extra
         + [key_lanes[i] for i in range(num_key_lanes)]
         + [seq_lanes[i] for i in range(num_seq_lanes)]
         + [iota]
     )
-    out = jax.lax.sort(operands, num_keys=1 + num_key_lanes + num_seq_lanes, is_stable=True)
+    out = jax.lax.sort(
+        operands, num_keys=1 + len(extra) + num_key_lanes + num_seq_lanes, is_stable=True
+    )
     perm = out[-1]
     neq = jnp.zeros(m - 1, dtype=jnp.bool_)
-    for lane in out[: 1 + num_key_lanes]:
+    for lane in out[: 1 + len(extra) + num_key_lanes]:
         neq = neq | (lane[1:] != lane[:-1])
     seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
     keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
@@ -218,8 +229,6 @@ def prepare_lanes(key_lanes: np.ndarray, seq_lanes: np.ndarray | None, narrow: b
     independently) and pad is (m,) u8."""
     key_lanes = np.ascontiguousarray(key_lanes)
     kl = drop_constant_lanes(key_lanes)
-    if kl.shape[1] == 0 and key_lanes.shape[1]:
-        kl = key_lanes[:, :1]
     sl = drop_constant_lanes(np.ascontiguousarray(seq_lanes)) if seq_lanes is not None else None
     n, k = kl.shape
     s = 0 if sl is None else sl.shape[1]
@@ -237,9 +246,58 @@ def prepare_lanes(key_lanes: np.ndarray, seq_lanes: np.ndarray | None, narrow: b
     return klp, slp, pad, n, k, s, m
 
 
+def prepare_lanes_planned(
+    key_lanes: np.ndarray,
+    seq_lanes: np.ndarray | None,
+    narrow: bool = True,
+    compress: bool | None = None,
+):
+    """prepare_lanes behind the key-lane compression seam (ops/lanes.py):
+    the key matrix is truncated/packed per a LanePlan before the usual
+    narrowing + padding. Returns (klp, slp, pad, n, k, s, m, plan); plan is
+    None when the layer is off (k then counts post-drop_constant_lanes key
+    lanes, exactly the legacy path). Either way an all-constant key yields
+    k == 0 — callers take the zero-width scalar fast path instead of the old
+    dummy-lane sort."""
+    import dataclasses
+
+    from .lanes import compress_key_lanes
+
+    kl, plan = compress_key_lanes(np.ascontiguousarray(key_lanes), compress)
+    klp, slp, pad, n, k, s, m = prepare_lanes(kl, seq_lanes, narrow=narrow)
+    if plan is not None and plan.use_ovc and kl.shape[0]:
+        # narrow_lane min-shifts every uploaded column; the OVC base must
+        # shift identically so the in-kernel lane==base compares match the
+        # packed-space comparison exactly (a shared constant shift per column
+        # preserves ==, <, and the code's value-field bound)
+        if narrow:
+            mins = kl.min(axis=0)
+            plan = dataclasses.replace(
+                plan, base=tuple(int(b) - int(mn) for b, mn in zip(plan.base, mins))
+            )
+    return klp, slp, pad, n, k, s, m, plan
+
+
 @functools.lru_cache(maxsize=None)
-def _plan_fn(num_key_lanes: int, num_seq_lanes: int):
-    """Builds the jitted sort+segment kernel for a lane arity."""
+def _plan_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0):
+    """Builds the jitted sort+segment kernel for a lane arity. ovc_vbits > 0
+    adds the device-computed offset-value code as the leading key (and the
+    base values as a traced (G,) operand)."""
+    if ovc_vbits:
+        from .lanes import ovc_codes_jax
+
+        @jax.jit
+        def f_ovc(key_lanes, seq_lanes, pad_flag, base):
+            code = ovc_codes_jax(
+                [key_lanes[i] for i in range(num_key_lanes)], base, ovc_vbits
+            )
+            _, perm, seg_start, keep_last, seg_id = sorted_segments(
+                num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag,
+                extra_keys=(code,),
+            )
+            return perm, seg_start, keep_last, seg_id
+
+        return f_ovc
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
@@ -288,7 +346,9 @@ def drop_constant_lanes(lanes: np.ndarray) -> np.ndarray:
     return lanes[:, keep] if keep else lanes[:, :0]
 
 
-def merge_plan(key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None) -> MergePlan:
+def merge_plan(
+    key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None, compress: bool | None = None
+) -> MergePlan:
     """key_lanes: (n, K) uint32. seq_lanes: (n, S) uint32 ordering within a
     key group (user-defined sequence lanes first, then sequence-number lanes —
     the reference's (udsSeq, seqNumber) tie-break). Stable: remaining ties
@@ -298,16 +358,46 @@ def merge_plan(key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None) -> Me
     Callers whose input rows are already seq-ascending within equal keys
     (runs with disjoint seq ranges concatenated in seq order) may pass
     seq_lanes=None: stability makes explicit sequence lanes redundant.
-    """
+
+    compress routes the key matrix through the lane-compression layer
+    (ops/lanes.py: truncation + packing + OVC) — bit-identical plan, fewer
+    sort operands; None resolves to the merge.lane-compression default."""
+    from .lanes import compress_key_lanes, resolve_compress
+
     key_lanes = np.ascontiguousarray(key_lanes)
     seq_keep = drop_constant_lanes(np.ascontiguousarray(seq_lanes)) if seq_lanes is not None else None
-    kl_kept = drop_constant_lanes(key_lanes)
-    if kl_kept.shape[1] == 0 and key_lanes.shape[1]:
-        kl_kept = key_lanes[:, :1]  # all keys equal: keep one lane for shape sanity
-    return _merge_plan_padded(kl_kept, seq_keep)
+    if resolve_compress(compress):
+        kl_kept, plan = compress_key_lanes(key_lanes, True)
+    else:
+        kl_kept, plan = drop_constant_lanes(key_lanes), None
+    if kl_kept.shape[1] == 0 and (seq_keep is None or seq_keep.shape[1] == 0):
+        # all keys equal (or no rows) and nothing to order by: the zero-width
+        # scalar fast path — one segment of valid rows in input order, no
+        # sort dispatched at all (the old path kept a dummy constant lane
+        # "for shape sanity" and sorted it anyway)
+        return _scalar_plan(key_lanes.shape[0])
+    return _merge_plan_padded(kl_kept, seq_keep, plan)
 
 
-def _merge_plan_padded(key_lanes: np.ndarray, seq_lanes: np.ndarray | None) -> MergePlan:
+def _scalar_plan(n: int) -> MergePlan:
+    """Host-built MergePlan for the zero-width key, zero seq-lane case: the
+    stable sort of (pad, iota) is the identity, valid rows form one segment
+    and pads another — exactly what the k=0 kernel would return, without the
+    device trip."""
+    m = pad_size(n)
+    perm = np.arange(m, dtype=np.int32)
+    seg_start = np.zeros(m, dtype=np.bool_)
+    seg_start[0] = True
+    keep_last = np.zeros(m, dtype=np.bool_)
+    keep_last[m - 1] = True
+    if 0 < n < m:
+        seg_start[n] = True
+        keep_last[n - 1] = True
+    seg_id = (np.cumsum(seg_start) - 1).astype(np.int32)
+    return MergePlan(perm=perm, seg_start=seg_start, keep_last=keep_last, seg_id=seg_id, n=n, m=m)
+
+
+def _merge_plan_padded(key_lanes: np.ndarray, seq_lanes: np.ndarray | None, plan=None) -> MergePlan:
     n, k = key_lanes.shape
     if seq_lanes is None:
         seq_lanes = np.zeros((n, 0), dtype=np.uint32)
@@ -319,7 +409,14 @@ def _merge_plan_padded(key_lanes: np.ndarray, seq_lanes: np.ndarray | None) -> M
     sl[:, :n] = seq_lanes.T
     pad = np.zeros(m, dtype=np.uint32)
     pad[n:] = 1
-    perm, seg_start, keep_last, seg_id = _plan_fn(k, s)(kl, sl, pad)
+    if plan is not None and plan.use_ovc:
+        # this path uploads unshifted u32 lanes, so the packed-space base
+        # passes through unshifted too
+        perm, seg_start, keep_last, seg_id = _plan_fn(k, s, plan.ovc_vbits)(
+            kl, sl, pad, np.asarray(plan.base, dtype=np.uint32)
+        )
+    else:
+        perm, seg_start, keep_last, seg_id = _plan_fn(k, s)(kl, sl, pad)
     return MergePlan(
         perm=np.asarray(perm),
         seg_start=np.asarray(seg_start),
@@ -360,12 +457,29 @@ def _pallas_keep_last_select(pad_flag, key_lanes, seq_lanes=()):
 
 
 @functools.lru_cache(maxsize=None)
-def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla"):
+def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla", ovc_vbits: int = 0):
     """Sort + keep-last + device-side compaction: returns ONLY the selected
     input indices (packed to the front) and their count — the minimal
     device->host transfer for the dominant dedup path. backend="pallas"
     computes the boundary mask with the fused pallas sweep
-    (ops/pallas_kernels.keep_last_mask)."""
+    (ops/pallas_kernels.keep_last_mask). ovc_vbits > 0 computes the
+    offset-value code lane on device and leads the sort + boundary detection
+    with it (ops/lanes.py)."""
+    if ovc_vbits:
+        from .lanes import ovc_codes_jax
+
+        @jax.jit
+        def f_ovc(key_lanes, seq_lanes, pad_flag, base):
+            code = ovc_codes_jax(
+                [key_lanes[i] for i in range(num_key_lanes)], base, ovc_vbits
+            )
+            pad_sorted, perm, _, keep_last, _ = sorted_segments(
+                num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag,
+                extra_keys=(code,),
+            )
+            return pack_selected(keep_last & (pad_sorted == 0), perm)
+
+        return f_ovc
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
@@ -385,11 +499,29 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla
     return f
 
 
-def deduplicate_select_async(key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None, backend: str = "xla"):
+def deduplicate_select_async(
+    key_lanes: np.ndarray,
+    seq_lanes: np.ndarray | None = None,
+    backend: str = "xla",
+    compress: bool | None = None,
+):
     """Dispatch the dedup kernel without blocking: returns (packed_device,
     count_device). jax's async dispatch lets the host keep decoding value
-    columns while the device sorts — resolve with deduplicate_resolve()."""
-    klp, slp, pad, _, k, s, _ = prepare_lanes(key_lanes, seq_lanes)
+    columns while the device sorts — resolve with deduplicate_resolve().
+    The key matrix goes through the lane-compression seam first; an
+    all-constant key short-circuits to the scalar winner without any device
+    dispatch."""
+    klp, slp, pad, n, k, s, _, plan = prepare_lanes_planned(key_lanes, seq_lanes, compress=compress)
+    if k == 0:
+        # all keys equal: one winner — the last row in (seq, input) order;
+        # no key sort, no device trip (host lexsort of the seq lanes only)
+        from .lanes import scalar_dedup_winner
+
+        return ("scalar", scalar_dedup_winner(seq_lanes, n))
+    if plan is not None and plan.use_ovc and backend != "pallas":
+        return _dedup_select_fn(k, s, backend, plan.ovc_vbits)(
+            klp, slp, pad, np.asarray(plan.base, dtype=np.uint32)
+        )
     return _dedup_select_fn(k, s, backend)(klp, slp, pad)
 
 
@@ -453,10 +585,26 @@ def _pad_starts(starts_real: Sequence[int], m: int) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int):
+def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0):
     """Sort + keep-last + compact-encoded selection: the downlink-minimal
     dedup kernel (bit-packed keep-mask + run-id interleave instead of int32
-    indices)."""
+    indices). ovc_vbits > 0 leads sort + boundary detection with the
+    device-computed offset-value code lane."""
+    if ovc_vbits:
+        from .lanes import ovc_codes_jax
+
+        @jax.jit
+        def f_ovc(key_lanes, seq_lanes, pad_flag, starts, base):
+            code = ovc_codes_jax(
+                [key_lanes[i] for i in range(num_key_lanes)], base, ovc_vbits
+            )
+            pad_sorted, perm, _, keep_last, _ = sorted_segments(
+                num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag,
+                extra_keys=(code,),
+            )
+            return pack_selection_compact(keep_last & (pad_sorted == 0), perm, starts)
+
+        return f_ovc
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag, starts):
@@ -469,7 +617,9 @@ def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int):
     return f
 
 
-def deduplicate_select_compact_async(key_lanes: np.ndarray, run_offsets: Sequence[int]):
+def deduplicate_select_compact_async(
+    key_lanes: np.ndarray, run_offsets: Sequence[int], compress: bool | None = None
+):
     """Compact-download dispatch for run-structured inputs (each run
     key-sorted ascending). Returns an opaque handle for
     deduplicate_resolve(), or None above 256 runs (run-ids are u8 on
@@ -479,9 +629,18 @@ def deduplicate_select_compact_async(key_lanes: np.ndarray, run_offsets: Sequenc
     starts_real = _real_starts(run_offsets)
     if len(starts_real) > 256:
         return None  # run-ids are u8 on device
-    klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, None)
+    klp, slp, pad, n, k, s, m, plan = prepare_lanes_planned(key_lanes, None, compress=compress)
+    if k == 0:
+        from .lanes import scalar_dedup_winner
+
+        return ("scalar", scalar_dedup_winner(None, n))
     starts_p = _pad_starts(starts_real, m)
-    outs = _dedup_select_compact_fn(k, s)(klp, slp, pad, starts_p)
+    if plan is not None and plan.use_ovc:
+        outs = _dedup_select_compact_fn(k, s, plan.ovc_vbits)(
+            klp, slp, pad, starts_p, np.asarray(plan.base, dtype=np.uint32)
+        )
+    else:
+        outs = _dedup_select_compact_fn(k, s)(klp, slp, pad, starts_p)
     return ("compact", outs, n, len(starts_real), _runid_bits(len(starts_p)))
 
 
@@ -591,21 +750,26 @@ def _dedup_dispatch(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: 
     """One dispatch-policy site: delta-packed upload when it qualifies,
     compact (bit-packed) download when the run count allows, wide
     index-download otherwise. On the CPU backend every encoding is skipped
-    (_link_encodings_pay_off): there are no link bytes to save."""
+    (_link_encodings_pay_off): there are no link bytes to save. Callers
+    (the tiled dispatcher) have already run the lane-compression seam, so
+    every path here suppresses it (compress=False) — plans are made once
+    per merge, not once per tile."""
     if not _link_encodings_pay_off():
-        return deduplicate_select_async(key_lanes, None, backend=backend)
+        return deduplicate_select_async(key_lanes, None, backend=backend, compress=False)
     handle = deduplicate_select_delta_async(key_lanes, run_offsets, backend=backend)
     if handle is not None:
         return handle
     if backend == "pallas":
-        return deduplicate_select_async(key_lanes, None, backend=backend)
-    handle = deduplicate_select_compact_async(key_lanes, run_offsets)
+        return deduplicate_select_async(key_lanes, None, backend=backend, compress=False)
+    handle = deduplicate_select_compact_async(key_lanes, run_offsets, compress=False)
     if handle is None:  # >256 runs: index-download fallback
-        handle = deduplicate_select_async(key_lanes, None, backend=backend)
+        handle = deduplicate_select_async(key_lanes, None, backend=backend, compress=False)
     return handle
 
 
 def deduplicate_resolve(handle) -> np.ndarray:
+    if isinstance(handle, tuple) and handle[0] == "scalar":
+        return handle[1]  # zero-width fast path: host-computed winner(s)
     if isinstance(handle, tuple) and handle[0] == "compact":
         _, (mask_bytes, runs_packed, count), n, num_runs, rbits = handle
         return unpack_selection_compact(mask_bytes, runs_packed, count, n, num_runs, rbits)
@@ -614,10 +778,12 @@ def deduplicate_resolve(handle) -> np.ndarray:
     return np.asarray(packed[:c])
 
 
-def deduplicate_select(key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None) -> np.ndarray:
+def deduplicate_select(
+    key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None, compress: bool | None = None
+) -> np.ndarray:
     """Fused dedup: input lanes -> selected input-row indices (key order).
     Equivalent to deduplicate_take(merge_plan(...)) with ~3x less transfer."""
-    return deduplicate_resolve(deduplicate_select_async(key_lanes, seq_lanes))
+    return deduplicate_resolve(deduplicate_select_async(key_lanes, seq_lanes, compress=compress))
 
 
 def deduplicate_select_tiled(
@@ -625,6 +791,7 @@ def deduplicate_select_tiled(
     run_offsets: Sequence[int],
     tile_rows: int = 256 * 1024,
     backend: str = "xla",
+    compress: bool | None = None,
 ) -> np.ndarray:
     """Key-range tiled dedup for runs concatenated in ascending-seq order
     (stability replaces seq lanes; see merge_plan docstring).
@@ -637,7 +804,9 @@ def deduplicate_select_tiled(
     blockwise path for sections larger than device memory (the reference
     spills via MergeSorter :110-116; we tile by key range instead).
     Returns selected input-row indices in global key order."""
-    return deduplicate_resolve_tiled(deduplicate_tiled_dispatch(key_lanes, run_offsets, tile_rows, backend))
+    return deduplicate_resolve_tiled(
+        deduplicate_tiled_dispatch(key_lanes, run_offsets, tile_rows, backend, compress=compress)
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -714,6 +883,7 @@ def deduplicate_tiled_dispatch(
     run_offsets: Sequence[int],
     tile_rows: int = 256 * 1024,
     backend: str = "xla",
+    compress: bool | None = None,
 ):
     """Async dispatch of the key-range tiled dedup; resolve with
     deduplicate_resolve_tiled.
@@ -731,6 +901,19 @@ def deduplicate_tiled_dispatch(
     offsets = list(run_offsets)
     if n == 0:
         return []
+    from .lanes import compress_key_lanes, resolve_compress, scalar_dedup_winner
+
+    # one compression plan for the whole merge; tiles inherit the packed
+    # lanes (row order is untouched, so run offsets and the per-run key
+    # ascent the tiler depends on both survive the transform)
+    if resolve_compress(compress):
+        key_lanes, _plan = compress_key_lanes(key_lanes, True)
+    else:
+        key_lanes = drop_constant_lanes(key_lanes)
+    if key_lanes.shape[1] == 0:
+        # all keys equal: one winner (no seq lanes on this path — run order
+        # + stability carries the tie-break, so the winner is the last row)
+        return [(("scalar", scalar_dedup_winner(None, n)), np.arange(n, dtype=np.int32))]
     if n <= tile_rows or len(offsets) < 3:
         return [(_dedup_dispatch(key_lanes, offsets, backend), np.arange(n, dtype=np.int32))]
     lane0_runs = [key_lanes[offsets[r] : offsets[r + 1], 0] for r in range(len(offsets) - 1)]
@@ -957,15 +1140,18 @@ def fused_partial_update(
     field_valid: np.ndarray,  # (F, n) bool
     row_kind: np.ndarray,  # (n,) uint8
     remove_record_on_delete: bool = False,
+    compress: bool | None = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Single-call partial-update merge: returns (src (F, k), exists (k,),
     last_take (k,)) in key order — the same contract as
     merge_plan + partial_update_takes + keep-last takes, one device trip.
     When the input decomposes into <=256 ascending-key blocks (always true
-    for real sections), downloads use the compact bit-packed encoding."""
+    for real sections), downloads use the compact bit-packed encoding.
+    Key lanes run through the compression seam (truncate + pack); an
+    all-constant key sorts on sequence lanes alone (k=0 kernel)."""
     from ..types import RowKind
 
-    klp, slp, pad, n, k, s, m = prepare_lanes(key_lanes, seq_lanes)
+    klp, slp, pad, n, k, s, m, _plan = prepare_lanes_planned(key_lanes, seq_lanes, compress=compress)
     is_add = np.isin(row_kind, (int(RowKind.INSERT), int(RowKind.UPDATE_AFTER)))
     if remove_record_on_delete:
         is_delete = row_kind == int(RowKind.DELETE)

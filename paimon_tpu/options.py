@@ -301,6 +301,15 @@ class CoreOptions:
         ),
     )
     SORT_ENGINE = ConfigOption.enum("sort-engine", SortEngine, SortEngine.XLA_SEGMENTED, "Merge kernel backend.")
+    MERGE_LANE_COMPRESSION = ConfigOption.bool_(
+        "merge.lane-compression",
+        True,
+        "Compress uint32 key lanes before every merge, compaction rewrite, "
+        "and sort-compact sort: drop batch-constant lanes, bit-pack adjacent "
+        "narrowed lanes into fused uint32 operands, and lead wide keys with "
+        "a device-computed offset-value code lane (OVC). Output is "
+        "bit-identical to the uncompressed path; off restores it.",
+    )
     PARALLEL_MESH_ENABLED = ConfigOption.bool_(
         "parallel.mesh.enabled",
         False,
@@ -793,6 +802,10 @@ class CoreOptions:
     @property
     def sort_engine(self) -> SortEngine:
         return self.options.get(CoreOptions.SORT_ENGINE)
+
+    @property
+    def lane_compression(self) -> bool:
+        return self.options.get(CoreOptions.MERGE_LANE_COMPRESSION)
 
     @property
     def changelog_producer(self) -> ChangelogProducer:

@@ -80,12 +80,18 @@ def sort_compact(
                 lanes = z_order_lanes(lanes)
             elif order == "hilbert":
                 lanes = hilbert_lanes(lanes)
+            # key-lane compression (ops/lanes.py): curve code lanes truncate
+            # and pack like any key — identical clustering permutation
+            # (order- and stability-preserving), fewer sort operands
+            compress = store.options.lane_compression
             if use_host_sort:
                 from ..data.keys import lexsort_rows
+                from ..ops.lanes import compress_key_lanes
 
-                perm = lexsort_rows(lanes)
+                sort_lanes, _plan = compress_key_lanes(lanes, compress, enable_ovc=False)
+                perm = lexsort_rows(sort_lanes)
             else:
-                p = merge_plan(lanes)  # device sort; stability keeps arrival order on ties
+                p = merge_plan(lanes, compress=compress)  # device sort; stability keeps arrival order on ties
                 perm = p.perm[p.valid_sorted]
             sorted_kv = kv.take(perm)
             wf = store.writer_factory(partition, bucket)

@@ -16,6 +16,11 @@
 #                              pipelined flush/compaction encode stages are
 #                              exercised through paimon_tpu.encode
 #                              (conftest asserts encode{files_native} > 0).
+#   scripts/verify.sh lanes    key-lane compression parity stage: the
+#                              tests/test_lanes.py + merge-kernel suites run
+#                              TWICE — PAIMON_TPU_LANE_COMPRESSION forced on,
+#                              then forced off — so compressed and legacy
+#                              paths both prove bit-identical merge output.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -29,8 +34,11 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 
 if [ "${1:-}" = "pipeline" ]; then
+  # lane compression forced ON: retry/prefetch interactions run through the
+  # compressed merge kernels (ISSUE 6)
   for par in 1 8; do
     env JAX_PLATFORMS=cpu PAIMON_TPU_SCAN_PARALLELISM=$par PAIMON_TPU_PARQUET_ENCODER=native \
+      PAIMON_TPU_LANE_COMPRESSION=1 \
       timeout -k 10 600 python -m pytest tests/test_pipeline.py tests/test_encode.py -q \
       -k 'parity or fault or flush or pipelined' \
       -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
@@ -40,9 +48,22 @@ fi
 
 if [ "${1:-}" = "faults" ]; then
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" PAIMON_TPU_PARQUET_ENCODER=native \
+    PAIMON_TPU_LANE_COMPRESSION=1 \
     timeout -k 10 600 python -m pytest tests/test_resilience.py tests/test_commit_faults.py \
     tests/test_encode.py::test_native_encoder_under_transient_faults -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "lanes" ]; then
+  # parity suite with compression forced on, then forced off: both sides of
+  # the merge.lane-compression switch must produce bit-identical output
+  for comp in 1 0; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_LANE_COMPRESSION=$comp \
+      timeout -k 10 600 python -m pytest tests/test_lanes.py tests/test_merge_kernel.py \
+      tests/test_randomized_oracle.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
 fi
 
 if [ "${1:-}" = "encode" ]; then

@@ -1,0 +1,445 @@
+"""Key-lane compression layer (ops/lanes.py): planner decisions, transform
+invariants, OVC kernel numpy/JAX parity, and the compressed==uncompressed
+bit-for-bit guarantee across every merge consumer.
+
+The hard contract under test: with merge.lane-compression on, every sort
+permutation, segmentation, and merge output is BIT-IDENTICAL to the
+uncompressed path (which itself matches the pre-PR oracle: plain lexsort +
+all-lane boundary compares)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paimon_tpu.data.keys import lexsort_rows
+from paimon_tpu.ops import lanes as L
+from paimon_tpu.ops import merge as M
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def _oracle_dedup(lanes, seq_lanes=None):
+    """The pre-PR oracle: stable lexsort over ALL raw lanes + all-lane
+    boundary compares; last row per key wins."""
+    tiebreakers = [] if seq_lanes is None else [seq_lanes[:, i] for i in range(seq_lanes.shape[1])]
+    order = lexsort_rows(lanes, *tiebreakers)
+    s = lanes[order]
+    if len(s) == 0:
+        return np.empty(0, dtype=np.int64)
+    neq = (s[1:] != s[:-1]).any(axis=1) if lanes.shape[1] else np.zeros(len(s) - 1, bool)
+    keep_last = np.concatenate([neq, np.ones(1, dtype=np.bool_)])
+    return order[keep_last]
+
+
+# ---------------------------------------------------------------------------
+# planner unit tests: packing decisions pinned per lane-stat input
+# ---------------------------------------------------------------------------
+
+def test_planner_drops_constant_lanes_and_packs():
+    n = 1000
+    rng = np.random.default_rng(0)
+    lanes = np.stack(
+        [
+            np.full(n, 0xDEAD, np.uint32),  # constant: dropped
+            rng.integers(100, 108, n).astype(np.uint32),  # 3 bits
+            rng.integers(0, 2000, n).astype(np.uint32),  # 11 bits
+            rng.integers(5, 37, n).astype(np.uint32),  # 5 bits
+        ],
+        axis=1,
+    )
+    plan = L.plan_lanes(lanes)
+    assert plan.lanes_in == 4
+    assert plan.keep == (1, 2, 3)
+    assert plan.bits == (3, 11, 5)
+    assert plan.groups == ((0, 1, 2),)  # 19 bits fuse into ONE operand
+    assert plan.lanes_out == 1 and plan.lanes_out < plan.lanes_in
+    assert not plan.use_ovc  # single-operand key IS its own complete code
+
+
+def test_planner_group_split_at_32_bits():
+    n = 500
+    rng = np.random.default_rng(0)
+    lanes = np.stack(
+        [
+            rng.integers(0, 1 << 20, n).astype(np.uint32),  # 20 bits
+            rng.integers(0, 1 << 20, n).astype(np.uint32),  # 20 bits: won't fit with prev
+            rng.integers(0, 50, n).astype(np.uint32),  # 6 bits: joins group 2
+        ],
+        axis=1,
+    )
+    plan = L.plan_lanes(lanes)
+    assert plan.groups == ((0,), (1, 2))
+    assert plan.use_ovc  # >= 2 fused operands: the OVC lane leads the sort
+    assert plan.ovc_vbits == 26  # max group width (20 + 6)
+    assert plan.sort_width == 3
+
+
+def test_planner_min_shift_is_bit_exact():
+    # two lanes spanning [1_000_000, +4) and [500, +8): 2 + 3 bits, packed
+    # into one operand with both minimums subtracted first
+    a = np.array([1_000_000, 1_000_001, 1_000_003], dtype=np.uint32)
+    b = np.array([507, 500, 503], dtype=np.uint32)
+    lanes = np.stack([a, b], axis=1)
+    plan = L.plan_lanes(lanes)
+    assert plan.bits == (2, 3)
+    assert plan.los == (1_000_000, 500)
+    assert plan.groups == ((0, 1),)
+    packed = L.apply_plan(plan, lanes)
+    assert packed[:, 0].tolist() == [(0 << 3) | 7, (1 << 3) | 0, (3 << 3) | 3]
+
+
+def test_planner_singleton_groups_skip_the_shift():
+    """When nothing fuses and no OVC value field needs bounding, the shift
+    is a pure copy — the planner zeroes it and apply_plan returns a column
+    selection (or the input itself) with no per-row arithmetic."""
+    col = np.array([1_000_000, 1_000_001, 1_000_003], dtype=np.uint32)
+    plan = L.plan_lanes(col.reshape(-1, 1))
+    assert plan.bits == (2,) and plan.los == (0,)
+    src = np.ascontiguousarray(col.reshape(-1, 1))
+    out = L.apply_plan(plan, src)
+    assert out is src  # zero-copy identity
+    # constant lane + wide lane: selection without arithmetic
+    lanes = np.stack([np.full(3, 9, np.uint32), col], axis=1)
+    plan2 = L.plan_lanes(lanes)
+    out2 = L.apply_plan(plan2, lanes)
+    assert out2[:, 0].tolist() == col.tolist()
+
+
+def test_planner_zero_width_for_trivial_inputs():
+    assert L.plan_lanes(np.zeros((0, 3), np.uint32)).lanes_out == 0
+    assert L.plan_lanes(np.full((1, 3), 9, np.uint32)).lanes_out == 0
+    assert L.plan_lanes(np.full((64, 2), 7, np.uint32)).lanes_out == 0
+
+
+def test_planner_base_is_lexicographic_minimum():
+    rng = np.random.default_rng(3)
+    n = 2000
+    lanes = np.stack(
+        [rng.integers(0, 1 << 20, n), rng.integers(0, 1 << 20, n)], axis=1
+    ).astype(np.uint32)
+    plan = L.plan_lanes(lanes)
+    assert plan.use_ovc
+    packed = L.apply_plan(plan, lanes)
+    min_row = packed[lexsort_rows(packed)[0]]
+    assert tuple(int(v) for v in min_row) == plan.base
+
+
+# ---------------------------------------------------------------------------
+# transform invariants: order, equality, stability
+# ---------------------------------------------------------------------------
+
+def _random_lanes(rng, n, shape_kind):
+    if shape_kind == "single_small":
+        return rng.integers(0, 300, (n, 1)).astype(np.uint32)
+    if shape_kind == "single_wide":
+        return rng.integers(0, 1 << 31, (n, 1)).astype(np.uint32)
+    if shape_kind == "composite_dict":
+        return np.stack(
+            [
+                rng.integers(0, 4, n),
+                rng.integers(0, 100, n),
+                rng.integers(0, 5000, n),
+                rng.integers(0, 12, n),
+            ],
+            axis=1,
+        ).astype(np.uint32)
+    if shape_kind == "wide_multi":
+        return np.stack(
+            [rng.integers(0, 1 << 24, n), rng.integers(0, 1 << 24, n), rng.integers(0, 64, n)],
+            axis=1,
+        ).astype(np.uint32)
+    if shape_kind == "const_prefix":
+        return np.stack(
+            [np.full(n, 42), np.full(n, 7), rng.integers(0, 900, n), rng.integers(0, 33, n)],
+            axis=1,
+        ).astype(np.uint32)
+    raise AssertionError(shape_kind)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+@pytest.mark.parametrize(
+    "shape_kind", ["single_small", "single_wide", "composite_dict", "wide_multi", "const_prefix"]
+)
+def test_transform_preserves_order_and_equality(seed, shape_kind):
+    rng = np.random.default_rng(seed)
+    n = 3000
+    lanes = _random_lanes(rng, n, shape_kind)
+    dup = rng.integers(0, n, n // 3)
+    lanes = np.concatenate([lanes, lanes[dup]])  # guarantee duplicate keys
+    plan = L.plan_lanes(lanes)
+    packed = L.apply_plan(plan, lanes)
+    o1, o2 = lexsort_rows(lanes), lexsort_rows(packed)
+    assert np.array_equal(o1, o2)  # identical permutation incl. tie order
+    s1, s2 = lanes[o1], packed[o1]
+    b1 = (s1[1:] != s1[:-1]).any(axis=1)
+    b2 = (s2[1:] != s2[:-1]).any(axis=1) if packed.shape[1] else np.zeros(len(s2) - 1, bool)
+    assert np.array_equal(b1, b2)  # identical segmentation
+
+
+# ---------------------------------------------------------------------------
+# OVC kernel: numpy/JAX parity + the order-consistency property
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 5])
+def test_ovc_numpy_jax_parity(seed):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = 4096
+    lanes = np.stack(
+        [rng.integers(0, 1 << 20, n), rng.integers(0, 1 << 18, n), rng.integers(0, 40, n)],
+        axis=1,
+    ).astype(np.uint32)
+    plan = L.plan_lanes(lanes)
+    assert plan.use_ovc
+    packed = L.apply_plan(plan, lanes)
+    base = np.asarray(plan.base, np.uint32)
+    c_np = L.ovc_codes_np(packed, base, plan.ovc_vbits)
+    c_jax = np.asarray(
+        L.ovc_codes_jax(
+            [jnp.asarray(packed[:, i]) for i in range(packed.shape[1])],
+            jnp.asarray(base),
+            plan.ovc_vbits,
+        )
+    )
+    assert np.array_equal(c_np, c_jax)
+
+
+def test_ovc_codes_are_order_consistent(rng):
+    """The OVC contract: where codes differ, unsigned code order == full key
+    order; equal keys always produce equal codes."""
+    n = 5000
+    lanes = np.stack([rng.integers(0, 1 << 20, n), rng.integers(0, 1 << 20, n)], axis=1).astype(
+        np.uint32
+    )
+    lanes = np.concatenate([lanes, lanes[rng.integers(0, n, n // 2)]])
+    plan = L.plan_lanes(lanes)
+    packed = L.apply_plan(plan, lanes)
+    codes = L.ovc_codes_np(packed, np.asarray(plan.base, np.uint32), plan.ovc_vbits)
+    order = lexsort_rows(packed)
+    sc = codes[order].astype(np.uint64)
+    assert (sc[1:] >= sc[:-1]).all()  # codes non-decreasing in key order
+    sp = packed[order]
+    key_eq = (sp[1:] == sp[:-1]).all(axis=1)
+    assert (sc[1:][key_eq] == sc[:-1][key_eq]).all()  # equal keys -> equal codes
+    # a base row equal to the batch minimum codes 0
+    assert codes[order[0]] == 0
+
+
+def test_ovc_base_row_codes_zero():
+    lanes = np.array([[5, 9], [5, 9], [6, 0]], dtype=np.uint32)
+    codes = L.ovc_codes_np(lanes, np.array([5, 9], np.uint32), 8)
+    assert codes[0] == 0 and codes[1] == 0 and codes[2] != 0
+
+
+# ---------------------------------------------------------------------------
+# compressed == uncompressed, bit-for-bit, across every consumer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+@pytest.mark.parametrize(
+    "shape_kind", ["single_small", "composite_dict", "wide_multi", "const_prefix"]
+)
+def test_dedup_parity_with_oracle(seed, shape_kind):
+    rng = np.random.default_rng(seed)
+    n = 2500
+    lanes = _random_lanes(rng, n, shape_kind)
+    lanes = np.concatenate([lanes, lanes[rng.integers(0, n, n // 4)]])
+    seq = rng.permutation(len(lanes)).astype(np.uint32).reshape(-1, 1)
+    on = M.deduplicate_select(lanes, seq, compress=True)
+    off = M.deduplicate_select(lanes, seq, compress=False)
+    oracle = _oracle_dedup(lanes, seq)
+    assert np.array_equal(np.sort(on), np.sort(off))
+    assert np.array_equal(np.sort(on), np.sort(oracle))
+
+
+@pytest.mark.parametrize("seed", [0, 4])
+def test_merge_plan_parity(seed):
+    rng = np.random.default_rng(seed)
+    n = 2000
+    lanes = _random_lanes(rng, n, "wide_multi")
+    lanes = np.concatenate([lanes, lanes[rng.integers(0, n, n // 2)]])
+    seq = np.arange(len(lanes), dtype=np.uint32).reshape(-1, 1)
+    p_on = M.merge_plan(lanes, seq, compress=True)
+    p_off = M.merge_plan(lanes, seq, compress=False)
+    assert np.array_equal(p_on.perm, p_off.perm)
+    assert np.array_equal(p_on.seg_start, p_off.seg_start)
+    assert np.array_equal(p_on.keep_last, p_off.keep_last)
+    assert np.array_equal(p_on.seg_id, p_off.seg_id)
+
+
+def _sorted_runs(rng, lanes, runs):
+    per = len(lanes) // runs
+    parts, offsets = [], [0]
+    for r in range(runs):
+        chunk = lanes[r * per : (r + 1) * per if r < runs - 1 else len(lanes)]
+        parts.append(chunk[lexsort_rows(chunk)])
+        offsets.append(offsets[-1] + len(chunk))
+    return np.concatenate(parts), offsets
+
+
+@pytest.mark.parametrize("tile_rows", [1024, 1 << 20])
+def test_tiled_dedup_parity(rng, tile_rows):
+    n = 12000
+    lanes = _random_lanes(rng, n, "wide_multi")
+    lanes = np.concatenate([lanes, lanes[rng.integers(0, n, n // 3)]])
+    l2, offsets = _sorted_runs(rng, lanes, 4)
+    on = M.deduplicate_select_tiled(l2, offsets, tile_rows=tile_rows, compress=True)
+    off = M.deduplicate_select_tiled(l2, offsets, tile_rows=tile_rows, compress=False)
+    assert np.array_equal(on, off)
+
+
+def test_compact_download_parity_forced(rng, monkeypatch):
+    monkeypatch.setenv("PAIMON_TPU_FORCE_COMPACT", "1")
+    n = 8000
+    lanes = _random_lanes(rng, n, "wide_multi")
+    l2, offsets = _sorted_runs(rng, lanes, 3)
+    a = M.deduplicate_resolve(M.deduplicate_select_compact_async(l2, offsets, compress=True))
+    b = M.deduplicate_resolve(M.deduplicate_select_compact_async(l2, offsets, compress=False))
+    assert np.array_equal(a, b)
+
+
+# ---- collation edge cases --------------------------------------------------
+
+def test_parity_0xffff_lane_boundary():
+    """Lanes straddling the u16 narrowing boundary: ptp of exactly 0xFFFF-1,
+    0xFFFF, 0xFFFF+1 — the planner's bit widths and the narrowing tiers must
+    agree on segmentation either way."""
+    for span in (0xFFFE, 0xFFFF, 0x10000, 0x10001):
+        base = 1 << 20
+        col = np.array([base, base + span, base, base + span // 2, base + span], dtype=np.uint32)
+        lanes = np.stack([col, np.array([1, 2, 1, 2, 1], np.uint32)], axis=1)
+        on = M.deduplicate_select(lanes, None, compress=True)
+        off = M.deduplicate_select(lanes, None, compress=False)
+        assert np.array_equal(on, off), span
+        assert np.array_equal(np.sort(on), np.sort(_oracle_dedup(lanes))), span
+
+
+def test_parity_prefix_equal_strings():
+    """Dictionary ranks of prefix-equal strings ('a', 'aa', 'aaa', ...):
+    adjacent ranks, heavy duplication — the classic OVC stress shape."""
+    from paimon_tpu.data.keys import build_string_pool
+
+    rng = np.random.default_rng(9)
+    vocab = np.array(["a" * k for k in range(1, 40)] + ["a" * 20 + "b", "a" * 20 + "c"], dtype=object)
+    vals = vocab[rng.integers(0, len(vocab), 4000)]
+    pool = build_string_pool([vals])
+    ranks = np.searchsorted(pool, vals).astype(np.uint32)
+    salt = rng.integers(0, 3, len(vals)).astype(np.uint32)
+    lanes = np.stack([ranks, salt], axis=1)
+    on = M.deduplicate_select(lanes, None, compress=True)
+    off = M.deduplicate_select(lanes, None, compress=False)
+    assert np.array_equal(on, off)
+    assert np.array_equal(np.sort(on), np.sort(_oracle_dedup(lanes)))
+
+
+def test_parity_all_equal_keys_and_single_row_runs(rng):
+    # all-equal: the zero-width scalar fast path must pick the same winner
+    eq = np.full((257, 2), 12345, np.uint32)
+    seq = rng.permutation(257).astype(np.uint32).reshape(-1, 1)
+    on = M.deduplicate_select(eq, seq, compress=True)
+    off = M.deduplicate_select(eq, seq, compress=False)
+    assert np.array_equal(on, off) and len(on) == 1
+    assert np.array_equal(on, _oracle_dedup(eq, seq))
+    # single-row runs: n=1 per run — planner sees a 1-row batch per tile edge
+    one = np.array([[7, 9]], dtype=np.uint32)
+    assert M.deduplicate_select(one, None, compress=True).tolist() == [0]
+    assert M.merge_plan(one, compress=True).num_segments == 1
+    # empty input
+    empty = np.zeros((0, 2), np.uint32)
+    assert M.deduplicate_select(empty, None, compress=True).size == 0
+    assert M.merge_plan(empty, compress=True).num_segments == 0
+
+
+def test_scalar_fast_path_skips_key_sort(rng, monkeypatch):
+    """All-equal keys: no device kernel runs at all — the handle is the
+    host-computed scalar winner (the ISSUE 6 satellite replacing the old
+    dummy-lane sort)."""
+    eq = np.full((100, 3), 5, np.uint32)
+    h = M.deduplicate_select_async(eq, None, compress=True)
+    assert isinstance(h, tuple) and h[0] == "scalar"
+    assert M.deduplicate_resolve(h).tolist() == [99]
+    # with seq lanes the winner is ordered by the seq lanes alone
+    seq = rng.permutation(100).astype(np.uint32).reshape(-1, 1)
+    h2 = M.deduplicate_select_async(eq, seq, compress=True)
+    assert h2[0] == "scalar"
+    assert M.deduplicate_resolve(h2).tolist() == [int(np.argmax(seq[:, 0]))]
+    # the fast path also applies with compression off (it replaces the old
+    # ops/merge.py "shape sanity" dummy lane in both modes)
+    h3 = M.deduplicate_select_async(eq, None, compress=False)
+    assert h3[0] == "scalar"
+
+
+# ---------------------------------------------------------------------------
+# executor-level parity: full merges through MergeExecutor, option on vs off
+# ---------------------------------------------------------------------------
+
+def _mk_exec(schema, keys, engine, opts):
+    from paimon_tpu.core.mergefn import MergeExecutor
+    from paimon_tpu.options import CoreOptions, MergeEngine
+
+    return MergeExecutor(schema, keys, MergeEngine(engine), CoreOptions(opts))
+
+
+def _mk_kv(rng, n, null_rate=0.0, seed_vals=None):
+    from paimon_tpu.core.kv import KVBatch
+    from paimon_tpu.data.batch import Column, ColumnBatch
+    from paimon_tpu.types import BIGINT, INT, STRING, RowKind, RowType
+
+    schema = RowType.of(("k1", STRING(False)), ("k2", BIGINT(False)), ("v", INT()))
+    k1 = np.array([f"acct-{int(x):03d}" for x in rng.integers(0, 50, n)], dtype=object)
+    k2 = rng.integers(0, 200, n).astype(np.int64)
+    v = rng.integers(-100, 100, n).astype(np.int32)
+    valid = rng.random(n) >= null_rate
+    cols = {"k1": Column(k1), "k2": Column(k2), "v": Column(v, valid)}
+    data = ColumnBatch(schema, cols)
+    seq = np.arange(n, dtype=np.int64)
+    kind = np.full(n, int(RowKind.INSERT), np.uint8)
+    return schema, KVBatch(data, seq, kind)
+
+
+@pytest.mark.parametrize("engine", ["deduplicate", "partial-update", "aggregation"])
+@pytest.mark.parametrize("null_rate", [0.0, 0.35])
+def test_executor_merge_parity_on_vs_off(rng, engine, null_rate):
+    n = 3000
+    schema, kv = _mk_kv(rng, n, null_rate=null_rate)
+    opts = {} if engine != "aggregation" else {"fields.v.aggregate-function": "sum"}
+    ex_on = _mk_exec(schema, ["k1", "k2"], engine, dict(opts, **{"merge.lane-compression": "true"}))
+    ex_off = _mk_exec(schema, ["k1", "k2"], engine, dict(opts, **{"merge.lane-compression": "false"}))
+    out_on = ex_on.merge(kv, seq_ascending=True)
+    out_off = ex_off.merge(kv, seq_ascending=True)
+    assert out_on.num_rows == out_off.num_rows
+    assert out_on.data.to_pylist() == out_off.data.to_pylist()
+    assert np.array_equal(out_on.seq, out_off.seq)
+    assert np.array_equal(out_on.kind, out_off.kind)
+
+
+@pytest.mark.skipif(
+    os.environ.get("PAIMON_TPU_LANE_COMPRESSION", "").strip().lower() in ("0", "off", "false"),
+    reason="lane compression forced off by env (verify.sh lanes stage, off pass)",
+)
+def test_executor_records_lanes_metrics(rng):
+    from paimon_tpu.metrics import lanes_metrics, registry
+
+    registry.reset()
+    n = 2000
+    schema, kv = _mk_kv(rng, n)
+    ex = _mk_exec(schema, ["k1", "k2"], "deduplicate", {"merge.lane-compression": "true"})
+    ex.merge(kv, seq_ascending=True)
+    g = lanes_metrics()
+    assert g.counter("plans").count >= 1
+    assert g.counter("lanes_in").count > g.counter("lanes_out").count
+
+
+def test_env_var_forces_compression_both_ways(monkeypatch):
+    monkeypatch.setenv("PAIMON_TPU_LANE_COMPRESSION", "0")
+    assert L.resolve_compress(True) is False
+    monkeypatch.setenv("PAIMON_TPU_LANE_COMPRESSION", "1")
+    assert L.resolve_compress(False) is True
+    monkeypatch.delenv("PAIMON_TPU_LANE_COMPRESSION")
+    assert L.resolve_compress(None) is True
+    assert L.resolve_compress(False) is False
