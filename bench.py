@@ -253,6 +253,24 @@ def bench_lanes(table) -> list:
     ]
 
 
+def bench_mesh() -> list:
+    """Mesh-sharded execution headline (benchmarks/multichip_bench.py is the
+    dedicated 1/2/4/8-device sweep): 8-bucket merge-read behind simulated
+    store RTT at 8 simulated devices vs 1, each device count in its own
+    subprocess with a forced host device count — every pass asserts the mesh
+    output bit-identical to the single-device engine before timing counts —
+    plus the mesh{} counter breakdown. Subprocess children pin
+    JAX_PLATFORMS=cpu, so this row is rig-independent (a wedged tunnel
+    cannot hang it)."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "multichip_bench.py")
+    spec = importlib.util.spec_from_file_location("_multichip_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_resilience() -> dict:
     """Commit resilience spot-check (benchmarks/resilience_bench.py is the
     dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
@@ -287,6 +305,7 @@ def main():
         lanes_rows = bench_lanes(table)
         pipeline_rows = bench_pipeline()
         encode_rows = bench_encode()
+        mesh_rows = bench_mesh()
         resilience_row = bench_resilience()
         row = {
             "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
@@ -326,6 +345,8 @@ def main():
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         for erow in encode_rows:
             print(json.dumps(dict(erow, platform=_PLATFORM)))
+        for mrow in mesh_rows:
+            print(json.dumps(dict(mrow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -198,23 +198,11 @@ class MergeTreeCompactRewriter:
         EVERY section before the first merge; this one keeps at most depth+1
         sections' inputs alive)."""
         from ..parallel.pipeline import SplitPipeline
-        from .read import order_runs_for_merge
-
-        def read_section(section):
-            runs, seq_ascending = order_runs_for_merge(section)
-            batches = []
-            old_top: list[KVBatch] = []
-            for run in runs:
-                for f in run.files:
-                    b = self._read(f)
-                    batches.append(b)
-                    if f.level == output_level:
-                        old_top.append(b)
-            return KVBatch.concat(batches), old_top, seq_ascending
 
         out: list[DataFileMeta] = []
         changelog: list[DataFileMeta] = []
         pipe = SplitPipeline(parallelism, depth, stage="compact")
+        read_section = lambda section: self._read_section(section, output_level)
         pending = None  # previous section's (merge handle, old_top)
         for kv, old_top, seq_ascending in pipe.map_ordered(sections, read_section):
             handle = self.merge.merge_async(kv, seq_ascending=seq_ascending)
@@ -240,25 +228,58 @@ class MergeTreeCompactRewriter:
                 )
         out.extend(self.writer_factory.write(merged, output_level, file_source="compact"))
 
-    def rewrite_dispatch(self, sections: list[list[SortedRun]], output_level: int):
-        """Phase 1: read every section's runs and dispatch their merges.
-        Under a MeshBatchContext the merges of ALL sections (and all buckets
-        whose compactions dispatched in the same batch window) execute in one
-        shard_map over the mesh."""
-        jobs = []
+    def _read_section(self, section: list[SortedRun], output_level: int):
+        """Read one section's runs in merge order: (concatenated KVBatch,
+        old top-level batches for the changelog diff, seq_ascending) — the
+        shared read head of every rewrite mode."""
+        from ..parallel.pipeline import bounded_map
         from .read import order_runs_for_merge
 
+        runs, seq_ascending = order_runs_for_merge(section)
+        files = [f for run in runs for f in run.files]
+        # per-file reads fan out over the shared pool (order preserved, so
+        # the concatenated runs — and the merge — are bit-identical to the
+        # old serial loop); this is leaf work per the pool contract
+        batches = bounded_map(self._read, files)
+        old_top = [b for f, b in zip(files, batches) if f.level == output_level]
+        return KVBatch.concat(batches), old_top, seq_ascending
+
+    def rewrite_dispatch(self, sections: list[list[SortedRun]], output_level: int):
+        """Phase 1: read every section's runs and dispatch their merges.
+        Under a mesh context the merges of ALL sections (and all buckets
+        whose compactions dispatched in the same batch window) execute in
+        batched shard_map calls over the mesh; with the MeshExecutor active
+        the section reads additionally stream through the SplitPipeline
+        feeder (one prefetch lane per device) instead of running serially."""
+        import threading
+
+        from ..parallel.executor import current_mesh_context
+        from ..parallel.pipeline import PIPELINE_THREAD_PREFIX
+
+        ctx = current_mesh_context()
+        # no feeder-in-feeder: when this dispatch already runs on a pipeline
+        # worker (table/write.compact fans buckets out), the serial loop below
+        # still fans its file reads over the shared pool
+        in_worker = threading.current_thread().name.startswith(PIPELINE_THREAD_PREFIX)
+        if (
+            ctx is not None
+            and getattr(ctx, "plans_globally", False)
+            and len(sections) > 1
+            and not in_worker
+        ):
+            from ..parallel.pipeline import SplitPipeline
+
+            lanes = ctx.feeder_lanes
+            pipe = SplitPipeline(parallelism=lanes, depth=lanes, stage="compact")
+            return [
+                (self.merge.merge_async(kv, seq_ascending=sa), old_top)
+                for kv, old_top, sa in pipe.map_ordered(
+                    sections, lambda s: self._read_section(s, output_level)
+                )
+            ]
+        jobs = []
         for section in sections:
-            runs, seq_ascending = order_runs_for_merge(section)
-            batches = []
-            old_top: list[KVBatch] = []
-            for run in runs:
-                for f in run.files:
-                    b = self._read(f)
-                    batches.append(b)
-                    if f.level == output_level:
-                        old_top.append(b)
-            kv = KVBatch.concat(batches)
+            kv, old_top, seq_ascending = self._read_section(section, output_level)
             jobs.append((self.merge.merge_async(kv, seq_ascending=seq_ascending), old_top))
         return jobs
 
@@ -317,21 +338,27 @@ class MergeTreeCompactManager:
     def trigger_compaction(self, full: bool = False) -> CompactResult | None:
         from ..metrics import registry, timed
         from ..parallel.executor import current_mesh_context
+        from ..parallel.mesh_exec import maybe_mesh_exec
         from ..parallel.pipeline import pipeline_config
 
         depth, parallelism = pipeline_config(self.options)
         g = registry.group("compaction")
         with timed(g.histogram("duration_ms")):
-            if depth > 0 and current_mesh_context() is None:
-                # pipelined route: section reads / device merges / output
-                # encodes overlap (rewrite_pipelined) instead of reading
-                # every input before the first merge. Mesh batching keeps
-                # the dispatch/complete split (all merges in one shard_map).
-                plan = self._plan_unit(full)
-                result = self._complete_pipelined(plan, depth, parallelism)
-            else:
-                state = self.compact_dispatch(full)
-                result = self.compact_complete(state)
+            # merge.engine = mesh and no context installed yet (standalone
+            # compaction, not under a table-write batch window): install the
+            # MeshExecutor so this bucket's section merges run as batched
+            # shard_maps; no-op (yields None) on 1 device — cpu fallback
+            with maybe_mesh_exec(self.options) as mex:
+                if mex is None and depth > 0 and current_mesh_context() is None:
+                    # pipelined route: section reads / device merges / output
+                    # encodes overlap (rewrite_pipelined) instead of reading
+                    # every input before the first merge. Mesh execution keeps
+                    # the dispatch/complete split (all merges in shard_maps).
+                    plan = self._plan_unit(full)
+                    result = self._complete_pipelined(plan, depth, parallelism)
+                else:
+                    state = self.compact_dispatch(full)
+                    result = self.compact_complete(state)
         if result is not None and not result.is_empty():
             g.counter("compactions").inc()
             g.counter("files_rewritten").inc(len(result.before))

@@ -192,10 +192,21 @@ class MergeExecutor:
             if engine == SortEngine.NUMPY:
                 return ("sync", kv.take(_numpy_dedup_select(lanes, seq_lanes, self._compress)))
             if ctx is not None:
-                # compress before submit: mesh jobs upload fewer lanes and
-                # the batch pads to a smaller common arity (no OVC — the
-                # mesh kernels take plain lanes, and the plan can't ride a
-                # job queue; packing alone keeps the metric honest)
+                if getattr(ctx, "plans_globally", False):
+                    # MeshExecutor: submit RAW lanes — compression is decided
+                    # ONCE per family batch from stats reduced over every
+                    # shard (ops.lanes.plan_lanes_global), so all shards of
+                    # one shard_map agree on packed widths (ISSUE 7 fix)
+                    from ..ops.lanes import resolve_compress
+
+                    return (
+                        "dedup",
+                        ctx,
+                        ctx.submit_dedup(lanes, seq_lanes, compress=resolve_compress(self._compress)),
+                        kv,
+                    )
+                # legacy MeshBatchContext: compress before submit (per-job
+                # plans are safe there — jobs never share a comparator)
                 from ..ops.lanes import compress_key_lanes
 
                 cl, _ = compress_key_lanes(lanes, self._compress, enable_ovc=False)
@@ -214,6 +225,15 @@ class MergeExecutor:
         lanes, seq_lanes = self._lanes(kv, seq_ascending)
         engine = self.effective_sort_engine()
         if ctx is not None and engine != SortEngine.NUMPY:
+            if getattr(ctx, "plans_globally", False):
+                from ..ops.lanes import resolve_compress
+
+                return (
+                    "plan",
+                    ctx,
+                    ctx.submit_plan(lanes, seq_lanes, compress=resolve_compress(self._compress)),
+                    kv,
+                )
             from ..ops.lanes import compress_key_lanes
 
             cl, _ = compress_key_lanes(lanes, self._compress, enable_ovc=False)

@@ -139,7 +139,11 @@ class MergeFileSplitRead:
             if len(section) == 1:
                 # single sorted run: keys are unique — no merge needed; full
                 # predicate pushdown is safe (reference RawFileSplitRead)
-                kv_parts = [self._read_file(f, predicate, dvs) for f in section[0].files]
+                kv_parts = _parallel_map(
+                    lambda f: self._read_file(f, predicate, dvs),
+                    section[0].files,
+                    parallelism=self.parallelism,
+                )
                 kv = KVBatch.concat(kv_parts)
                 section_conts.append(lambda kv=kv: kv)
             else:
@@ -155,7 +159,14 @@ class MergeFileSplitRead:
                     kv = self._pipelined_dedup(ordered_files, key_filter, seq_ascending)
                     section_conts.append(lambda kv=kv: kv)
                 else:
-                    batches = [self._read_file(f, key_filter, dvs) for f in ordered_files]
+                    # mesh/DV/engine path: the per-file reads fan out over the
+                    # shared pool (order preserved, so the concatenated runs
+                    # and the merge output are bit-identical to serial)
+                    batches = _parallel_map(
+                        lambda f: self._read_file(f, key_filter, dvs),
+                        ordered_files,
+                        parallelism=self.parallelism,
+                    )
                     kv = KVBatch.concat(batches)
                     handle = self.merge.merge_async(kv, seq_ascending=seq_ascending)
                     section_conts.append(lambda h=handle: self.merge.merge_resolve(h))

@@ -26,6 +26,7 @@ __all__ = [
     "encode_metrics",
     "io_metrics",
     "lanes_metrics",
+    "mesh_metrics",
     "pipeline_metrics",
 ]
 
@@ -184,6 +185,19 @@ def lanes_metrics() -> MetricGroup:
     sort). Resolved per call so registry.reset() in tests swaps the group
     out."""
     return registry.group("lanes")
+
+
+def mesh_metrics() -> MetricGroup:
+    """The mesh{...} group (mesh-sharded execution layer,
+    paimon_tpu.parallel.mesh_exec). Canonical members — counters:
+    buckets_sharded (per-bucket merge jobs executed through the mesh),
+    shards (shard_map / key-axis collective invocations), pad_rows (padding
+    overhead: allocated minus valid rows across batched calls),
+    exchange_rows (rows moved through key-axis range-shuffle collectives);
+    histograms: device_busy_ms (wall millis per batched device call),
+    feeder_wait_ms (consumer blocked on the host-side split feeder).
+    Resolved per call so registry.reset() in tests swaps the group out."""
+    return registry.group("mesh")
 
 
 def io_metrics() -> MetricGroup:

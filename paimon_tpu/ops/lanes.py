@@ -58,6 +58,9 @@ import numpy as np
 __all__ = [
     "LanePlan",
     "plan_lanes",
+    "plan_lanes_from_stats",
+    "plan_lanes_global",
+    "lane_stats",
     "apply_plan",
     "compress_key_lanes",
     "resolve_compress",
@@ -132,16 +135,25 @@ def resolve_compress(compress: bool | None) -> bool:
     return True
 
 
-def plan_lanes(key_lanes: np.ndarray, enable_ovc: bool = True) -> LanePlan:
-    """Decide truncation, packing, and OVC from one pass of lane stats.
-    O(K * n) host work — the same order as the boundary compares it saves."""
+def lane_stats(key_lanes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-lane (min, max) over one shard's rows — the commutative piece a
+    mesh merge reduces across shards before planning (plan_lanes_global).
+    Zero-row shards contribute the neutral element (max sentinel mins, zero
+    maxes), so reducing over them never widens a lane."""
     key_lanes = np.ascontiguousarray(key_lanes)
     n, k = key_lanes.shape
-    if n <= 1 or k == 0:
-        # 0/1 rows: every lane is batch-constant — a zero-width key
-        return LanePlan(k, (), (), (), ())
-    los = key_lanes.min(axis=0)
-    his = key_lanes.max(axis=0)
+    if n == 0:
+        return (
+            np.full(k, 0xFFFFFFFF, dtype=np.uint32),
+            np.zeros(k, dtype=np.uint32),
+        )
+    return key_lanes.min(axis=0), key_lanes.max(axis=0)
+
+
+def _truncate_and_group(k: int, los, his):
+    """The shared stats -> (keep, bits, lo_kept, groups, vbits) decision of
+    every planner entry point: drop constant lanes, width each survivor to
+    its exact ptp bit length, fuse adjacent widths into <=32-bit operands."""
     keep: list[int] = []
     bits: list[int] = []
     lo_kept: list[int] = []
@@ -162,8 +174,55 @@ def plan_lanes(key_lanes: np.ndarray, enable_ovc: bool = True) -> LanePlan:
         cur_bits += b
     if cur:
         groups.append(tuple(cur))
-    g = len(groups)
     vbits = max((sum(bits[p] for p in grp) for grp in groups), default=0)
+    return keep, bits, lo_kept, groups, vbits
+
+
+def plan_lanes_from_stats(lanes_in: int, los, his) -> LanePlan:
+    """Truncation + packing decided from per-lane (min, max) ALONE — the
+    stats may have been reduced over many shards (plan_lanes_global), so
+    every shard of one mesh merge derives identical packed widths and the
+    packed operands stay comparable across devices (range-shuffle splitters,
+    stacked shard_map lanes). Never emits an OVC lane: the code needs the
+    batch-min row, and the mesh kernels carry plain packed lanes."""
+    keep, bits, lo_kept, groups, _vbits = _truncate_and_group(lanes_in, los, his)
+    if all(len(grp) == 1 for grp in groups):
+        # same zero-shift rule as the local planner: a pure column selection
+        lo_kept = [0] * len(lo_kept)
+    return LanePlan(lanes_in, tuple(keep), tuple(lo_kept), tuple(bits), tuple(groups))
+
+
+def plan_lanes_global(parts) -> LanePlan:
+    """ONE LanePlan for a whole mesh merge: reduce per-shard lane stats and
+    plan from the reduction (ISSUE 7 satellite: per-shard plans can disagree
+    on packed widths across devices — a lane spanning 8 bits on shard A and
+    20 on shard B packs differently, and the stacked shard_map lanes or the
+    range-shuffle splitters would then compare apples to oranges). Every
+    shard applies THIS plan via apply_plan."""
+    parts = [np.ascontiguousarray(p) for p in parts]
+    k = parts[0].shape[1] if parts else 0
+    if not parts or all(p.shape[0] == 0 for p in parts):
+        return LanePlan(k, (), (), (), ())
+    los = None
+    his = None
+    for p in parts:
+        lo, hi = lane_stats(p)
+        los = lo if los is None else np.minimum(los, lo)
+        his = hi if his is None else np.maximum(his, hi)
+    return plan_lanes_from_stats(k, los, his)
+
+
+def plan_lanes(key_lanes: np.ndarray, enable_ovc: bool = True) -> LanePlan:
+    """Decide truncation, packing, and OVC from one pass of lane stats.
+    O(K * n) host work — the same order as the boundary compares it saves."""
+    key_lanes = np.ascontiguousarray(key_lanes)
+    n, k = key_lanes.shape
+    if n <= 1 or k == 0:
+        # 0/1 rows: every lane is batch-constant — a zero-width key
+        return LanePlan(k, (), (), (), ())
+    los, his = lane_stats(key_lanes)
+    keep, bits, lo_kept, groups, vbits = _truncate_and_group(k, los, his)
+    g = len(groups)
     use_ovc = enable_ovc and g >= _OVC_MIN_GROUPS and g.bit_length() + vbits <= 32
     if not use_ovc and all(len(grp) == 1 for grp in groups):
         # nothing fuses and no code lane needs a bounded value field: the

@@ -310,6 +310,21 @@ class CoreOptions:
         "a device-computed offset-value code lane (OVC). Output is "
         "bit-identical to the uncompressed path; off restores it.",
     )
+    MERGE_EXEC_ENGINE = ConfigOption.string(
+        "merge.engine",
+        "single",
+        "Merge EXECUTION engine (orthogonal to merge-engine, which picks the "
+        "per-key semantics): 'single' runs each bucket's sort-merge as its "
+        "own device call; 'mesh' routes scans, compaction rewrites and "
+        "writer flushes through the mesh-sharded execution layer "
+        "(parallel.mesh_exec.MeshExecutor) — per-bucket merges batch into "
+        "one shard_map per merge-function family over the mesh's bucket "
+        "axis with globally-agreed lane plans, oversized buckets "
+        "range-shuffle over the key axis, and the split pipeline feeds one "
+        "prefetch lane per device. Output is bit-identical to 'single'; a "
+        "1-device or shard_map-less environment degrades to 'single' "
+        "automatically (cpu fallback). PAIMON_TPU_MERGE_ENGINE overrides.",
+    )
     PARALLEL_MESH_ENABLED = ConfigOption.bool_(
         "parallel.mesh.enabled",
         False,

@@ -25,6 +25,7 @@ flushes (scan.prefetch-splits / scan.parallelism).
 
 from .distributed import global_mesh, init_multi_host, is_commit_coordinator
 from .mesh import make_mesh
+from .mesh_exec import MeshExecutor, maybe_mesh_exec, mesh_available, resolve_merge_engine
 from .pipeline import SplitPipeline, bounded_map, pipeline_config
 from .merge import (
     bucket_parallel_dedup,
@@ -33,10 +34,15 @@ from .merge import (
     distributed_merge_step,
     distributed_partial_update_step,
     range_partition_lanes,
+    range_partition_rows,
 )
 
 __all__ = [
     "make_mesh",
+    "MeshExecutor",
+    "maybe_mesh_exec",
+    "mesh_available",
+    "resolve_merge_engine",
     "SplitPipeline",
     "bounded_map",
     "pipeline_config",
@@ -46,6 +52,7 @@ __all__ = [
     "distributed_aggregate_step",
     "distributed_changelog_step",
     "range_partition_lanes",
+    "range_partition_rows",
     "init_multi_host",
     "is_commit_coordinator",
     "global_mesh",

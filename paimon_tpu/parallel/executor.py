@@ -63,10 +63,19 @@ def mesh_batch(mesh=None, key_axis_rows: int = 1 << 22):
 
 @contextmanager
 def maybe_mesh_batch(store):
-    """mesh_batch() iff the table enables parallel execution
-    (parallel.mesh.enabled) and >1 device is visible; no-op otherwise."""
+    """The one mesh-entry seam for table operations. `merge.engine = mesh`
+    (the ISSUE 7 executor: family-batched shard_maps, global lane plans,
+    per-device feeder — parallel.mesh_exec) takes precedence; otherwise the
+    legacy parallel.mesh.enabled batching context; no-op when neither is on,
+    a context is already active, or <2 devices are visible (cpu fallback)."""
     from ..options import CoreOptions
 
+    from .mesh_exec import maybe_mesh_exec, resolve_merge_engine
+
+    if resolve_merge_engine(store.options) == "mesh" and current_mesh_context() is None:
+        with maybe_mesh_exec(store.options) as ctx:
+            yield ctx
+        return
     enabled = store.options.options.get(CoreOptions.PARALLEL_MESH_ENABLED)
     if not enabled or current_mesh_context() is not None:
         yield None

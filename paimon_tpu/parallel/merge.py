@@ -35,7 +35,10 @@ from ..ops.merge import _plan_fn
 
 __all__ = [
     "bucket_parallel_dedup",
+    "bucket_parallel_dedup_fn",
+    "bucket_parallel_plan_fn",
     "range_partition_lanes",
+    "range_partition_rows",
     "distributed_merge_step",
     "distributed_partial_update_step",
     "distributed_aggregate_step",
@@ -74,6 +77,56 @@ def bucket_parallel_dedup(mesh: Mesh, key_lanes: np.ndarray, seq_lanes: np.ndarr
         out_specs=(P("bucket", None), P("bucket", None)),
     )
     return jax.jit(fn)(key_lanes, seq_lanes, pad)
+
+
+@functools.lru_cache(maxsize=None)
+def bucket_parallel_dedup_fn(mesh: Mesh, k: int, s: int):
+    """Cached jit+shard_map of the DEDUP family over the mesh's bucket axis:
+    (B, m, K) key lanes, (B, m, S) seq lanes, (B, m) pad -> per-bucket packed
+    selected input indices + counts (the minimal download — pack_selected on
+    device). The kernel body is ops.merge.sorted_segments/pack_selected, so
+    mesh and single-device selection share one copy of the semantics. The
+    cache key includes the Mesh (hashable, one per process via the executor's
+    mesh factory), so each (mesh, lane arity) compiles exactly once."""
+    from ..ops.merge import pack_selected, sorted_segments
+
+    def per_bucket(kl, sl, pf):  # (m, K), (m, S), (m,)
+        pad_sorted, perm, _, keep_last, _ = sorted_segments(k, s, kl.T, sl.T, pf)
+        return pack_selected(keep_last & (pad_sorted == 0), perm)
+
+    fn = shard_map(
+        lambda kl, sl, pf: jax.vmap(per_bucket)(kl, sl, pf),
+        mesh=mesh,
+        in_specs=(P("bucket", None, None), P("bucket", None, None), P("bucket", None)),
+        out_specs=(P("bucket", None), P("bucket")),
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def bucket_parallel_plan_fn(mesh: Mesh, k: int, s: int):
+    """Cached jit+shard_map of the PLAN families (partial-update, aggregate,
+    changelog rewrite — engines whose segment reductions finish host-side
+    with arbitrary per-field aggregators) over the bucket axis: the full
+    merge plan arrays (perm, seg_start, keep_last, seg_id) per bucket."""
+    from ..ops.merge import sorted_segments
+
+    def per_bucket(kl, sl, pf):
+        _, perm, seg_start, keep_last, seg_id = sorted_segments(k, s, kl.T, sl.T, pf)
+        return perm, seg_start, keep_last, seg_id
+
+    fn = shard_map(
+        lambda kl, sl, pf: jax.vmap(per_bucket)(kl, sl, pf),
+        mesh=mesh,
+        in_specs=(P("bucket", None, None), P("bucket", None, None), P("bucket", None)),
+        out_specs=(
+            P("bucket", None),
+            P("bucket", None),
+            P("bucket", None),
+            P("bucket", None),
+        ),
+    )
+    return jax.jit(fn)
 
 
 # ---------------------------------------------------------------------------
@@ -175,6 +228,50 @@ def range_partition_lanes(
         out_specs=(P("key", None), P("key"), P("key"), P("key")),
     )
     return jax.jit(fn)(key_lanes, seq_lanes, pad)
+
+
+@functools.lru_cache(maxsize=None)
+def _range_partition_rows_fn(mesh: Mesh, k: int, sample: int):
+    """Cached kernel behind range_partition_rows: one row-id lane rides the
+    all_to_all as the sole sequence lane, so after the exchange + local sort
+    each device can name the GLOBAL input row at every sorted position."""
+    p = mesh.shape["key"]
+
+    def shard_fn(kl, rid, pf):
+        rk, rs, rp = _range_exchange(kl.T, rid[None, :], pf, "key", p, k, 1, sample=sample)
+        perm, _, _, _ = _local_plan(k, 1, rk, rs, rp)
+        return rs[0][perm], rp[perm]
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P("key", None), P("key"), P("key")),
+        out_specs=(P("key"), P("key")),
+    )
+    return jax.jit(fn)
+
+
+def range_partition_rows(
+    mesh: Mesh,
+    key_lanes: np.ndarray,
+    row_ids: np.ndarray,
+    pad: np.ndarray,
+    sample_per_device: int = 64,
+):
+    """Globally-stable distributed sort of row ids by key: rows sharded over
+    the "key" axis are range-shuffled to their owner (all_gather splitter
+    sample + all_to_all — the RangeShuffle.java analog), locally sorted with
+    the row id as the tie-break lane, and returned as (row_ids_sorted,
+    pad_sorted) concatenated in ascending device-range order. Because routing
+    is a pure function of the leading lane, device ranges are disjoint; and
+    because the row id orders ties, the concatenation equals the SINGLE-device
+    stable sort permutation bit-for-bit — the property sort-compact and
+    dynamic-bucket rescale rely on (paimon_tpu.parallel.mesh_exec)."""
+    n, k = key_lanes.shape
+    out_rows, out_pad = _range_partition_rows_fn(mesh, k, sample_per_device)(
+        key_lanes, row_ids, pad
+    )
+    return np.asarray(out_rows), np.asarray(out_pad)
 
 
 # ---------------------------------------------------------------------------

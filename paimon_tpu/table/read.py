@@ -478,6 +478,10 @@ class TableRead:
         every split before the first row is visible. Three execution modes,
         picked per call:
 
+        * mesh execution (merge.engine = mesh, >1 device): the SplitPipeline
+          becomes the host-side feeder — one prefetch lane per device — so
+          IO/decode of split i+1 overlaps the batched shard_map merges of
+          split i (parallel/mesh_exec.py);
         * mesh batching (parallel.mesh.enabled, >1 device): dispatch every
           split first so all merges run in one shard_map, then complete;
         * pipelined (scan.prefetch-splits > 0, the default): split i+1
@@ -503,6 +507,11 @@ class TableRead:
                     pipe = SplitPipeline(parallelism, depth, stage="scan")
                     yield from pipe.map_ordered(splits, self.read)
                     return
+            if ctx is not None and getattr(ctx, "plans_globally", False) and len(splits) > 1:
+                # merge.engine = mesh: feeder-driven dispatch (one prefetch
+                # lane per device) instead of reading every split up front
+                yield from self._mesh_batches(ctx, splits)
+                return
             if ctx is not None:
                 # mesh mode: dispatch every split first — their merges run as
                 # one batched shard_map over the bucket axis — then complete
@@ -520,6 +529,49 @@ class TableRead:
                         b = b.slice(0, remaining)
                     remaining -= b.num_rows
                 yield b
+
+    def _mesh_batches(self, mex, splits: Sequence[DataSplit]):
+        """merge.engine = mesh scan: the PR 4 SplitPipeline is the host-side
+        feeder with one prefetch lane per device, so the IO + decode of
+        shard i+1 overlap the batched device merges of shard i. Each
+        continuation's first resolve executes every merge job dispatched so
+        far in family-batched shard_map calls over the mesh's bucket axis;
+        emission stays in strict split order, so output is bit-identical to
+        the single-device path."""
+        import time
+
+        from ..metrics import mesh_metrics
+        from ..parallel.pipeline import SplitPipeline
+
+        from ..parallel.executor import _ACTIVE
+
+        lanes = mex.feeder_lanes
+        pipe = SplitPipeline(parallelism=lanes, depth=lanes, stage="scan")
+        wait = mesh_metrics().histogram("feeder_wait_ms")
+
+        def dispatch(s: DataSplit):
+            # changelog splits have no merge to batch: read on the consumer
+            if s.is_changelog:
+                return None
+            # the mesh context is a ContextVar — invisible inside pipeline
+            # worker threads unless re-installed, and without it the dispatch
+            # would silently merge eagerly on the worker instead of enqueuing
+            # the job for the batched shard_map
+            token = _ACTIVE.set(mex)
+            try:
+                return self._dispatch(s)
+            finally:
+                _ACTIVE.reset(token)
+
+        it = pipe.map_ordered(splits, dispatch)
+        try:
+            for s in splits:
+                t0 = time.perf_counter()
+                cont = next(it)
+                wait.update((time.perf_counter() - t0) * 1000)
+                yield self.read(s) if cont is None else cont()
+        finally:
+            it.close()
 
     def read_all(self, splits: Sequence[DataSplit]):
         from ..data.batch import concat_batches

@@ -219,7 +219,29 @@ class TableWrite:
                     w.compact(full=full)
                 return
             self._batched_flush()
-            states = [(w, w.compact_dispatch(full)) for w in self._writers.values()]
+            writers = list(self._writers.values())
+            if getattr(ctx, "plans_globally", False) and len(writers) > 1:
+                # merge.engine = mesh: bucket dispatches (input reads + merge
+                # enqueue) stream through the feeder, one lane per device, so
+                # bucket i+1's IO overlaps while bucket i's merges batch
+                from ..parallel.executor import _ACTIVE
+                from ..parallel.pipeline import SplitPipeline
+
+                lanes = ctx.feeder_lanes
+                pipe = SplitPipeline(parallelism=lanes, depth=lanes, stage="compact")
+
+                def dispatch(w):
+                    # re-install the mesh context: ContextVars don't cross
+                    # into pipeline worker threads by themselves
+                    token = _ACTIVE.set(ctx)
+                    try:
+                        return w.compact_dispatch(full)
+                    finally:
+                        _ACTIVE.reset(token)
+
+                states = list(zip(writers, pipe.map_ordered(writers, dispatch)))
+            else:
+                states = [(w, w.compact_dispatch(full)) for w in writers]
             for w, st in states:
                 w.compact_complete(st)
 

@@ -21,6 +21,13 @@
 #                              TWICE — PAIMON_TPU_LANE_COMPRESSION forced on,
 #                              then forced off — so compressed and legacy
 #                              paths both prove bit-identical merge output.
+#   scripts/verify.sh mesh     mesh-execution parity stage: the mesh-executor
+#                              suite + mesh table ops + the randomized oracle
+#                              run TWICE on the forced 8-device virtual CPU
+#                              mesh — PAIMON_TPU_MERGE_ENGINE forced mesh,
+#                              then forced single — so the mesh-sharded and
+#                              single-device execution engines both prove
+#                              bit-identical merge output.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -47,11 +54,27 @@ if [ "${1:-}" = "pipeline" ]; then
 fi
 
 if [ "${1:-}" = "faults" ]; then
+  # mesh engine forced ON: the fault matrix (transient retries, crash
+  # points, torn writes) must stay green through the mesh-sharded executor
+  # and its feeder workers (ISSUE 7)
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" PAIMON_TPU_PARQUET_ENCODER=native \
-    PAIMON_TPU_LANE_COMPRESSION=1 \
+    PAIMON_TPU_LANE_COMPRESSION=1 PAIMON_TPU_MERGE_ENGINE=mesh \
     timeout -k 10 600 python -m pytest tests/test_resilience.py tests/test_commit_faults.py \
     tests/test_encode.py::test_native_encoder_under_transient_faults -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "mesh" ]; then
+  # parity suites with the merge execution engine forced mesh, then single:
+  # both sides of the merge.engine switch must produce bit-identical output
+  # (the conftest forces the 8-device virtual CPU mesh)
+  for eng in mesh single; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_MERGE_ENGINE=$eng \
+      timeout -k 10 600 python -m pytest tests/test_mesh_exec.py tests/test_mesh_execution.py \
+      tests/test_randomized_oracle.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
 fi
 
 if [ "${1:-}" = "lanes" ]; then
