@@ -295,6 +295,33 @@ def bench_resilience() -> dict:
     }
 
 
+def bench_soak() -> dict:
+    """Traffic-soak spot-check (benchmarks/soak_bench.py is the dedicated
+    >=60 s run): a short multi-writer/multi-reader soak at 5% injected
+    faults with admission control on. consistent must stay true and
+    failed/lost/leaked must stay 0 — the composed-system invariants live in
+    BENCH_* next to the perf rows."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "soak_bench.py")
+    spec = importlib.util.spec_from_file_location("_soak_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    row = mod.run_mode("full", duration=8.0, possibility=20, seed=0)
+    return {
+        "metric": "traffic soak spot-check (8 s, 3 writers / 2 readers, 5% faults)",
+        "consistent": row["consistent"],
+        "commits_ok": row["commits_ok"],
+        "failed_commits": row["commits_failed"],
+        "commits_per_sec": row["commits_per_sec"],
+        "read_p99_ms": row["read_p99_ms"],
+        "writes_throttled": row["writes_throttled"],
+        "lost_rows": row["lost_rows"],
+        "leaked_files": row["leaked_file_count"],
+        "unit": "counters",
+    }
+
+
 def main():
     tmp = tempfile.mkdtemp(prefix="paimon_tpu_bench_")
     try:
@@ -307,6 +334,7 @@ def main():
         encode_rows = bench_encode()
         mesh_rows = bench_mesh()
         resilience_row = bench_resilience()
+        soak_row = bench_soak()
         row = {
             "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
             "value": round(rows_per_sec, 1),
@@ -348,6 +376,7 @@ def main():
         for mrow in mesh_rows:
             print(json.dumps(dict(mrow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
+        print(json.dumps(dict(soak_row, platform=_PLATFORM)))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

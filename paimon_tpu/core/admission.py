@@ -1,0 +1,206 @@
+"""Writer admission control: a byte budget over buffered memtables.
+
+The delta/main architecture ("Fast Updates on Read-Optimized Databases",
+PAPERS.md) assumes the delta never outruns the merge that drains it. Under
+sustained concurrent ingest that assumption needs enforcement: every
+memtable byte a writer buffers — and every byte still being encoded by the
+PR-4 offloaded flush worker — is host memory that only the flush/encode
+pipeline can return. `WriteBufferController` is that enforcement point, a
+process-level (or per-`TableWrite`) accountant shared by every merge-tree
+writer of an ingest job:
+
+  reserve(n)     admission for n incoming bytes. Below the stop trigger
+                 (`write.buffer.stop-trigger` x `write.buffer.max-memory`)
+                 writes are admitted immediately. Above it the caller is
+                 THROTTLED: a bounded block (deadline
+                 `write.buffer.block-timeout`) waiting for in-flight flushes
+                 to release budget. On deadline the write is REJECTED with a
+                 typed `WriterBackpressureError` — load shedding the caller
+                 can catch, back off, and replay, instead of an OOM nobody
+                 can catch.
+  release(n)     budget returned: an offloaded flush finished encoding, or
+                 a writer was closed/abandoned (commit-conflict teardown)
+                 with bytes still reserved. Releasing is idempotent at the
+                 writer layer (MergeTreeWriter tracks its accounted bytes
+                 exactly once), so a conflict-replanned bucket can never
+                 double-count.
+  flush_begin()  pending-flush depth cap: at most
+                 `write.buffer.max-pending-flushes` memtables may sit behind
+                 the flush workers at once. When the cap is hit the writer
+                 encodes INLINE (the caller pays — natural backpressure)
+                 rather than queueing unbounded memtables behind a slow
+                 encoder.
+
+Backpressure state machine (see ARCHITECTURE.md "Traffic soak & flow
+control"): OK -> THROTTLING (in_use >= stop trigger; writers block and
+drain their own memtables) -> REJECTING (deadline exceeded; typed error)
+-> back to OK as flush workers release. Metrics land in the soak{...}
+group: writes_throttled, writes_rejected, backpressure_ms.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["WriterBackpressureError", "WriteBufferController"]
+
+
+class WriterBackpressureError(RuntimeError):
+    """Write rejected: the write buffer stayed at/above the stop trigger for
+    the full `write.buffer.block-timeout`. The write was NOT buffered — the
+    caller may shed it, back off and replay it, or surface the pressure to
+    its own upstream. Typed (rather than a bare RuntimeError) so ingest
+    frontends can distinguish load shedding from data errors."""
+
+
+class WriteBufferController:
+    """Byte/flush-depth accountant shared by the merge-tree writers of one
+    ingest job (or, when passed explicitly, by many concurrent jobs — the
+    soak harness shares one across every writer thread to model a global
+    host-memory budget)."""
+
+    def __init__(
+        self,
+        max_memory: int,
+        stop_trigger: float = 0.9,
+        block_timeout_ms: int = 10_000,
+        max_pending_flushes: int = 4,
+    ):
+        self.max_memory = int(max_memory)
+        self.stop_trigger = float(stop_trigger)
+        self.block_timeout_ms = int(block_timeout_ms)
+        self.max_pending_flushes = int(max_pending_flushes)
+        self._soft = int(self.max_memory * self.stop_trigger) if self.max_memory > 0 else 0
+        self._cond = threading.Condition()
+        self._in_use = 0
+        self._pending_flushes = 0
+        self._throttled = 0
+        self._rejected = 0
+
+    # ---- construction ---------------------------------------------------
+    @classmethod
+    def from_options(cls, options) -> "WriteBufferController | None":
+        """None when admission control is off (write.buffer.max-memory=0,
+        the default — existing write paths are untouched)."""
+        from ..options import CoreOptions
+
+        max_memory = options.write_buffer_max_memory
+        if max_memory <= 0:
+            return None
+        return cls(
+            max_memory,
+            stop_trigger=options.options.get(CoreOptions.WRITE_BUFFER_STOP_TRIGGER),
+            block_timeout_ms=options.write_buffer_block_timeout_ms,
+            max_pending_flushes=options.options.get(CoreOptions.WRITE_BUFFER_MAX_PENDING_FLUSHES),
+        )
+
+    # ---- byte budget ----------------------------------------------------
+    def _admissible(self, nbytes: int) -> bool:
+        # an empty buffer always admits, even an oversized single batch:
+        # rejecting it forever would deadlock the caller against itself
+        return self._in_use == 0 or self._in_use + nbytes <= self._soft
+
+    def try_reserve(self, nbytes: int) -> bool:
+        """Non-blocking admission. False = over the stop trigger; the caller
+        should drain its own memtable (freeing its share) before falling
+        back to the blocking reserve()."""
+        with self._cond:
+            if not self._admissible(nbytes):
+                return False
+            self._in_use += nbytes
+            return True
+
+    def reserve(self, nbytes: int) -> None:
+        """Blocking admission: throttle (bounded block) then reject."""
+        from ..metrics import soak_metrics
+
+        with self._cond:
+            if self._admissible(nbytes):
+                self._in_use += nbytes
+                return
+            g = soak_metrics()
+            g.counter("writes_throttled").inc()
+            self._throttled += 1
+            t0 = time.perf_counter()
+            deadline = t0 + self.block_timeout_ms / 1000.0
+            try:
+                while not self._admissible(nbytes):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        g.counter("writes_rejected").inc()
+                        self._rejected += 1
+                        raise WriterBackpressureError(
+                            f"write buffer full: {self._in_use}/{self.max_memory} bytes in "
+                            f"use (stop trigger {self._soft}), {self._pending_flushes} "
+                            f"flushes pending; blocked {self.block_timeout_ms} ms "
+                            f"(write.buffer.block-timeout) without drain"
+                        )
+                    self._cond.wait(remaining)
+                self._in_use += nbytes
+            finally:
+                g.histogram("backpressure_ms").update((time.perf_counter() - t0) * 1000)
+
+    def release(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._cond:
+            self._in_use = max(0, self._in_use - nbytes)
+            self._cond.notify_all()
+
+    # ---- pending-flush depth cap ---------------------------------------
+    def flush_begin(self) -> bool:
+        """Claim a pending-flush slot. False = cap held for the full block
+        timeout; the caller must encode inline instead of queueing."""
+        from ..metrics import soak_metrics
+
+        with self._cond:
+            if self.max_pending_flushes <= 0 or self._pending_flushes < self.max_pending_flushes:
+                self._pending_flushes += 1
+                return True
+            g = soak_metrics()
+            g.counter("writes_throttled").inc()
+            self._throttled += 1
+            t0 = time.perf_counter()
+            deadline = t0 + self.block_timeout_ms / 1000.0
+            try:
+                while self._pending_flushes >= self.max_pending_flushes:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        return False
+                    self._cond.wait(remaining)
+                self._pending_flushes += 1
+                return True
+            finally:
+                g.histogram("backpressure_ms").update((time.perf_counter() - t0) * 1000)
+
+    def flush_end(self) -> None:
+        with self._cond:
+            self._pending_flushes = max(0, self._pending_flushes - 1)
+            self._cond.notify_all()
+
+    # ---- introspection --------------------------------------------------
+    @property
+    def in_use(self) -> int:
+        return self._in_use
+
+    @property
+    def pending_flushes(self) -> int:
+        return self._pending_flushes
+
+    def health(self) -> dict:
+        """Point-in-time flow-control surface (TableWrite.health embeds it)."""
+        with self._cond:
+            state = "ok"
+            if self._in_use >= self._soft > 0:
+                state = "throttling"
+            return {
+                "state": state,
+                "buffered_bytes": self._in_use,
+                "max_memory": self.max_memory,
+                "stop_trigger_bytes": self._soft,
+                "pending_flushes": self._pending_flushes,
+                "max_pending_flushes": self.max_pending_flushes,
+                "writes_throttled": self._throttled,
+                "writes_rejected": self._rejected,
+            }

@@ -218,7 +218,14 @@ class KeyValueFileStore:
             dvs = DeletionVectorsIndexFile(self.file_io, self.table_path).read_all(dv_index)
         return files, dvs
 
-    def new_writer(self, partition: tuple, bucket: int, total_buckets: int | None = None, restore: bool = True) -> MergeTreeWriter:
+    def new_writer(
+        self,
+        partition: tuple,
+        bucket: int,
+        total_buckets: int | None = None,
+        restore: bool = True,
+        admission=None,
+    ) -> MergeTreeWriter:
         from ..options import ChangelogProducer
 
         if self.options.write_only and self.options.changelog_producer == ChangelogProducer.LOOKUP:
@@ -271,6 +278,7 @@ class KeyValueFileStore:
             compact_manager,
             self.options,
             restored_max_seq=max_seq,
+            admission=admission,
         )
 
     # ---- read ----------------------------------------------------------
@@ -343,7 +351,15 @@ class AppendOnlyFileStore(KeyValueFileStore):
 
     keyed = False
 
-    def new_writer(self, partition: tuple, bucket: int, total_buckets: int | None = None, restore: bool = True):
+    def new_writer(
+        self,
+        partition: tuple,
+        bucket: int,
+        total_buckets: int | None = None,
+        restore: bool = True,
+        admission=None,  # accepted for signature parity; the append writer
+        # buffers through its own spillable path and takes no byte admission
+    ):
         from .append import AppendOnlyCompactManager, AppendOnlyWriter
 
         existing = self.restore_files(partition, bucket) if restore else []

@@ -36,6 +36,7 @@ class MergeTreeWriter:
         compact_manager: MergeTreeCompactManager | None,
         options: CoreOptions,
         restored_max_seq: int = -1,
+        admission=None,
     ):
         self.partition = partition
         self.bucket = bucket
@@ -45,6 +46,19 @@ class MergeTreeWriter:
         self.compact_manager = compact_manager
         self.options = options
         self.seq = restored_max_seq + 1
+        # admission control (core/admission.py): every buffered byte is
+        # reserved against the shared WriteBufferController and released
+        # exactly once — when the flush that drains it finishes encoding, or
+        # when this writer is closed/abandoned (commit-conflict teardown).
+        # _accounted tracks this writer's outstanding reservation so teardown
+        # can release the remainder without double-counting what in-flight
+        # flush workers already returned.
+        self.admission = admission
+        self._accounted = 0
+        self._slots_held = 0
+        import threading
+
+        self._acct_lock = threading.Lock()
         self._buffer: list[KVBatch] = []
         self._buffered_rows = 0
         self._buffered_bytes = 0
@@ -75,6 +89,7 @@ class MergeTreeWriter:
         if n == 0:
             return
         kv = KVBatch.from_rows(data, self.seq, kinds)
+        self._reserve(kv.byte_size())  # may raise: seq/buffer untouched
         self.seq += n
         self._buffer.append(kv)
         self._buffered_rows += n
@@ -85,6 +100,7 @@ class MergeTreeWriter:
     def write_kv(self, kv: KVBatch) -> None:
         if kv.num_rows == 0:
             return
+        self._reserve(kv.byte_size())  # may raise: buffer untouched
         # externally assigned seqs may interleave: disable the stability
         # shortcut for this memtable generation
         self._buffer_seq_ordered = False
@@ -94,6 +110,37 @@ class MergeTreeWriter:
         self._buffered_bytes += kv.byte_size()
         if self._should_flush():
             self._flush_async()
+
+    # ---- admission accounting ------------------------------------------
+    def _reserve(self, nbytes: int) -> None:
+        """Admission for nbytes of memtable. Over the stop trigger, first
+        drain OUR OWN memtable through the (offloaded) flush — freeing the
+        share this writer itself holds — then fall back to the bounded
+        blocking reserve (which raises WriterBackpressureError on deadline,
+        with nothing buffered and self.seq untouched)."""
+        if self.admission is None:
+            return
+        if not self.admission.try_reserve(nbytes):
+            if self._buffered_bytes > 0:
+                self._flush_async()
+            self.admission.reserve(nbytes)
+        with self._acct_lock:
+            self._accounted += nbytes
+
+    def _acct_release(self, nbytes: int) -> None:
+        if self.admission is None or nbytes <= 0:
+            return
+        with self._acct_lock:
+            nbytes = min(nbytes, self._accounted)
+            self._accounted -= nbytes
+        self.admission.release(nbytes)
+
+    def _acct_release_all(self) -> None:
+        if self.admission is None:
+            return
+        with self._acct_lock:
+            n, self._accounted = self._accounted, 0
+        self.admission.release(n)
 
     def _should_flush(self) -> bool:
         """Byte budget first (reference MemorySegmentPool accounts bytes —
@@ -124,6 +171,15 @@ class MergeTreeWriter:
         if not self._async_flush or current_mesh_context() is not None:
             self.flush_complete(state)
             return
+        if self.admission is not None and not self.admission.flush_begin():
+            # pending-flush depth cap held for the full block timeout: a slow
+            # encoder must not queue unbounded memtables — encode inline, the
+            # caller pays (that IS the backpressure)
+            self.flush_complete(state)
+            return
+        if self.admission is not None:
+            with self._acct_lock:
+                self._slots_held += 1
         if self._flush_pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -145,6 +201,10 @@ class MergeTreeWriter:
             try:
                 self.flush_complete(state)
             finally:
+                if self.admission is not None:
+                    with self._acct_lock:
+                        self._slots_held -= 1
+                    self.admission.flush_end()
                 busy.update((_time.perf_counter() - t0) * 1000)
 
         self._flush_pending.append(self._flush_pool.submit(run))
@@ -171,11 +231,23 @@ class MergeTreeWriter:
         if self._flush_pool is not None:
             self._flush_pool.shutdown(wait=True, cancel_futures=True)
             self._flush_pool = None
+        if self.admission is not None:
+            # with the pool down, any slot still held belongs to a flush
+            # that was cancelled before running (its run() never reached
+            # flush_end) — return those so the depth cap cannot wedge
+            with self._acct_lock:
+                slots, self._slots_held = self._slots_held, 0
+            for _ in range(slots):
+                self.admission.flush_end()
 
     def close(self) -> None:
         """Release the flush worker without committing. Pending background
         errors are swallowed (close is the abandon path; prepare_commit is
-        where failures must surface)."""
+        where failures must surface). Every byte this writer still holds
+        reserved — undrained memtable, a cancelled flush's batch, a failed
+        dispatch — returns to the admission controller EXACTLY once here, so
+        abandoning a bucket after a commit conflict re-admits blocked rivals
+        instead of leaking budget."""
         for f in self._flush_pending:
             f.cancel()
         try:
@@ -184,7 +256,8 @@ class MergeTreeWriter:
                     f.exception()
         finally:
             self._flush_pending = []
-            self._shutdown_flush_pool()
+            self._shutdown_flush_pool()  # also returns cancelled flushes' depth slots
+            self._acct_release_all()
 
     def flush_dispatch(self):
         """Phase 1 of a (possibly mesh-batched) flush: drain the memtable,
@@ -201,6 +274,7 @@ class MergeTreeWriter:
         if not self._buffer:
             return None
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
+        drained_bytes = self._buffered_bytes
         self._buffer.clear()
         self._buffered_rows = 0
         self._buffered_bytes = 0
@@ -219,12 +293,20 @@ class MergeTreeWriter:
         buffer_seq_ordered = self._buffer_seq_ordered
         handle = self.merge.merge_async(kv, seq_ascending=buffer_seq_ordered)
         self._buffer_seq_ordered = True
-        return (handle, buffer_seq_ordered)
+        return (handle, buffer_seq_ordered, drained_bytes)
 
     def flush_complete(self, state) -> None:
         """Phase 2: resolve the merge and write level-0 files + changelog,
-        then trigger compaction."""
-        handle, buffer_seq_ordered = state
+        then trigger compaction. The batch's buffer reservation returns to
+        the admission controller when the encode lands (or fails) — that is
+        the moment the bytes stop being host-memory the flush pipeline owes."""
+        handle, buffer_seq_ordered, drained_bytes = state
+        try:
+            self._flush_complete_inner(handle, buffer_seq_ordered)
+        finally:
+            self._acct_release(drained_bytes)
+
+    def _flush_complete_inner(self, handle, buffer_seq_ordered) -> None:
         merged = self.merge.merge_resolve(handle)
         from ..options import ChangelogProducer
 
@@ -338,8 +420,14 @@ class MergeTreeWriter:
 
     # ---- commit --------------------------------------------------------
     def prepare_commit(self) -> CommitMessage:
-        self.flush()  # barrier: offloaded encodes land before the message builds
-        self._shutdown_flush_pool()  # no idle worker between commits
+        try:
+            self.flush()  # barrier: offloaded encodes land before the message builds
+        finally:
+            # torn down on the ERROR path too: a flush-worker failure
+            # re-raised here must not leak the 1-worker paimon-flush
+            # executor (the happy path shut it down; a dispatch-phase
+            # failure — e.g. the input-changelog write — left it alive)
+            self._shutdown_flush_pool()
         # a file produced by one compaction round and consumed by a later
         # round within the same commit cancels out of the message
         before_names = {f.file_name for f in self._compact_before}
@@ -365,3 +453,12 @@ class MergeTreeWriter:
     @property
     def max_sequence_number(self) -> int:
         return self.seq - 1
+
+    def health(self) -> dict:
+        """Point-in-time writer state for TableWrite.health()."""
+        return {
+            "buffered_bytes": self._buffered_bytes,
+            "buffered_rows": self._buffered_rows,
+            "pending_flushes": len(self._flush_pending),
+            "reserved_bytes": self._accounted,
+        }

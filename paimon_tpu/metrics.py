@@ -28,6 +28,7 @@ __all__ = [
     "lanes_metrics",
     "mesh_metrics",
     "pipeline_metrics",
+    "soak_metrics",
 ]
 
 
@@ -198,6 +199,22 @@ def mesh_metrics() -> MetricGroup:
     feeder_wait_ms (consumer blocked on the host-side split feeder).
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("mesh")
+
+
+def soak_metrics() -> MetricGroup:
+    """The soak{...} group (writer flow control, core.admission, and the
+    traffic-soak harness, service.soak). Canonical members — counters:
+    commits_ok (committer rounds fully landed), commits_retried (CAS retry
+    rounds absorbed across commits), commits_conflict_replanned (conflict
+    events survived by abandoning stolen buckets or adopting the landed
+    APPEND phase), writes_throttled (admissions that blocked at the
+    stop trigger or the pending-flush cap), writes_rejected (throttled
+    writes that hit write.buffer.block-timeout and raised
+    WriterBackpressureError); gauges: read_p50_ms, read_p99_ms (snapshot
+    read latency percentiles, set by the soak harness); histogram:
+    backpressure_ms (time writers spent blocked in admission). Resolved per
+    call so registry.reset() in tests swaps the group out."""
+    return registry.group("soak")
 
 
 def io_metrics() -> MetricGroup:

@@ -274,6 +274,36 @@ class CoreOptions:
         "Skip compaction (dedicated compact job mode).",
         fallback=("write.compaction-skip",),
     )
+    WRITE_BUFFER_MAX_MEMORY = ConfigOption.memory(
+        "write.buffer.max-memory",
+        "0 b",
+        "Admission-control byte budget over ALL buffered memtables and "
+        "in-flight offloaded flushes of a write job (0 = off). Above "
+        "write.buffer.stop-trigger of this budget new writes first throttle "
+        "(bounded block while flushes drain, deadline "
+        "write.buffer.block-timeout) and then reject with "
+        "WriterBackpressureError.",
+    )
+    WRITE_BUFFER_STOP_TRIGGER = ConfigOption.float_(
+        "write.buffer.stop-trigger",
+        0.9,
+        "Fraction of write.buffer.max-memory at which incoming writes stop "
+        "being admitted immediately and start throttling.",
+    )
+    WRITE_BUFFER_BLOCK_TIMEOUT = ConfigOption.duration(
+        "write.buffer.block-timeout",
+        "10 s",
+        "How long a throttled write blocks waiting for flushes to release "
+        "buffer budget before it is rejected with WriterBackpressureError.",
+    )
+    WRITE_BUFFER_MAX_PENDING_FLUSHES = ConfigOption.int_(
+        "write.buffer.max-pending-flushes",
+        4,
+        "Cap on memtables queued behind the offloaded flush workers across "
+        "a write job (0 = unlimited). At the cap the writer encodes inline — "
+        "the caller pays — so a slow encoder can never queue unbounded "
+        "memtables.",
+    )
     WRITE_BUFFER_SPILLABLE = ConfigOption.bool_(
         "write-buffer-spillable", False, "Spill the write buffer to local disk under memory pressure."
     )
@@ -382,6 +412,38 @@ class CoreOptions:
         "10 ms",
         "Base backoff between commit retry rounds (decorrelated jitter, "
         "capped at 100x base) so racing committers desynchronize.",
+    )
+    SOAK_DURATION = ConfigOption.duration(
+        "soak.duration",
+        "45 s",
+        "Traffic-soak harness (service.soak): how long the concurrent "
+        "writer/reader/churn threads run before the final drain and orphan "
+        "sweep.",
+    )
+    SOAK_WRITERS = ConfigOption.int_(
+        "soak.writers", 3, "Traffic-soak harness: number of concurrent committer threads."
+    )
+    SOAK_READERS = ConfigOption.int_(
+        "soak.readers",
+        2,
+        "Traffic-soak harness: number of concurrent snapshot-reader threads "
+        "(each read is verified against the serialized oracle log).",
+    )
+    SOAK_FAULT_POSSIBILITY = ConfigOption.int_(
+        "soak.fault.possibility",
+        0,
+        "Traffic-soak harness: inject a transient IO fault on 1/N of "
+        "filesystem ops (0 = no faults; 20 = the 5% headline rate).",
+    )
+    SOAK_ROWS_PER_COMMIT = ConfigOption.int_(
+        "soak.rows-per-commit", 400, "Traffic-soak harness: rows each writer commits per round."
+    )
+    SOAK_COMPACT_EVERY = ConfigOption.int_(
+        "soak.compact-every",
+        4,
+        "Traffic-soak harness: every Nth commit of a writer forces a full "
+        "compaction, driving the commit-conflict re-plan path on shared "
+        "buckets.",
     )
     ORPHAN_CLEAN_OLDER_THAN = ConfigOption.duration(
         "orphan.clean.older-than",
@@ -837,6 +899,14 @@ class CoreOptions:
     @property
     def write_buffer_size(self) -> int:
         return int(self.options.get(CoreOptions.WRITE_BUFFER_SIZE))
+
+    @property
+    def write_buffer_max_memory(self) -> int:
+        return int(self.options.get(CoreOptions.WRITE_BUFFER_MAX_MEMORY))
+
+    @property
+    def write_buffer_block_timeout_ms(self) -> int:
+        return self.options.get(CoreOptions.WRITE_BUFFER_BLOCK_TIMEOUT)
 
     @property
     def write_only(self) -> bool:

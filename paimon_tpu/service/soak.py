@@ -1,0 +1,718 @@
+"""Production traffic soak: concurrent writers, churn, verified reads.
+
+Every resilience piece of this system exists in isolation — RetryingFileIO +
+commit auto-retry + orphan sweep, streaming reads, offloaded flushes, the
+mesh engine, and (this PR) writer admission control. The soak harness is
+where they prove they compose: N committer threads on disjoint AND
+overlapping buckets, M reader threads asserting snapshot-consistent scans
+against a serialized oracle log, a dedicated full-compactor and a snapshot
+expirer churning underneath, all over a fault-injecting filesystem at a
+sustained op rate, with one shared `WriteBufferController` modelling the
+host-memory budget ("Fast Updates on Read-Optimized Databases" assumes the
+delta never outruns the merge; this is the machinery that makes it true).
+
+Consistency protocol. Writers commit through the real snapshot-CAS path and
+record every LANDED commit in the `OracleLog` under one lock:
+(append-snapshot-id -> {key: value}). Keyspaces are disjoint per writer
+(key = writer_id * KEYSPACE + n) so cross-writer merge order is irrelevant,
+while updates WITHIN a writer are ordered by its monotone sequence numbers —
+the expected row set at snapshot S is therefore exactly the fold of all
+recorded events with id <= S, in id order. A reader pins snapshot S
+(scan.snapshot-id), scans, waits for the oracle to cover every soak APPEND
+snapshot <= S (the record happens microseconds after commit() returns), and
+asserts the scanned row set EQUALS the fold. A commit that raises may still
+have landed its APPEND phase (conflict on the COMPACT half, a lost rename
+ack, a crash-replay) — `find_landed_append` resolves the truth from the
+snapshot chain, so the oracle counts exactly what the table counts: no lost
+rows, no duplicated rows.
+
+End of soak: drain writers, disable faults, full-compact once, assert the
+final scan equals the oracle fold and the physical row count matches, then
+run the orphan sweep with threshold 0 and assert the on-disk file set is
+exactly the reachable closure (zero leaked files) — and that the sweep
+removed nothing a reader can still see.
+
+Run directly:  python -m paimon_tpu.service.soak [base_dir]
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..types import BIGINT, DOUBLE, RowType
+
+__all__ = ["SoakConfig", "OracleLog", "SoakHarness", "run_soak", "find_landed_append"]
+
+SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
+KEYSPACE = 10_000_000  # per-writer key stride: keyspaces never collide
+
+
+@dataclass
+class SoakConfig:
+    """Knobs for one soak run. `from_table_options` maps the soak.* table
+    options onto the same fields so a run is reproducible from table config
+    alone; the CLI/bench/tests override programmatically."""
+
+    duration_s: float = 45.0
+    writers: int = 3
+    readers: int = 2
+    buckets: int = 4
+    fault_possibility: int = 0  # 1/N ops fail (20 = 5%); 0 = off
+    seed: int = 0
+    rows_per_commit: int = 400
+    write_chunk_rows: int = 100  # rows per TableWrite.write call
+    update_fraction: float = 0.3  # fraction of a round re-writing own keys
+    compact_every: int = 4  # full-compact every Nth commit per writer
+    compactor_pause_s: float = 0.4
+    expire_every_s: float = 1.5
+    mesh: bool = False
+    # flow control (the shared WriteBufferController)
+    backpressure: bool = True
+    max_memory: int = 512 * 1024
+    stop_trigger: float = 0.6
+    block_timeout_ms: int = 30_000
+    max_pending_flushes: int = 2
+    # resilience (False = seed-like config: first fault aborts, no CAS retry)
+    resilient: bool = True
+    table_options: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_table_options(cls, options) -> "SoakConfig":
+        from ..options import CoreOptions
+
+        o = options.options
+        return cls(
+            duration_s=o.get(CoreOptions.SOAK_DURATION) / 1000.0,
+            writers=o.get(CoreOptions.SOAK_WRITERS),
+            readers=o.get(CoreOptions.SOAK_READERS),
+            fault_possibility=o.get(CoreOptions.SOAK_FAULT_POSSIBILITY),
+            rows_per_commit=o.get(CoreOptions.SOAK_ROWS_PER_COMMIT),
+            compact_every=o.get(CoreOptions.SOAK_COMPACT_EVERY),
+        )
+
+
+class OracleLog:
+    """Serialized log of landed commits: (append snapshot id -> rows).
+    The single source of truth every concurrent read is verified against."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._events: dict[int, dict] = {}  # snapshot id -> {key: value}
+
+    def record(self, snapshot_id: int, rows: dict) -> None:
+        with self._cond:
+            self._events[snapshot_id] = dict(rows)
+            self._cond.notify_all()
+
+    def covers(self, needed: set[int]) -> bool:
+        with self._cond:
+            return needed <= self._events.keys()
+
+    def wait_covers(self, needed: set[int], timeout_s: float) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: needed <= self._events.keys(), timeout_s)
+
+    def expected_at(self, snapshot_id: int) -> dict:
+        """Fold of all recorded events with id <= snapshot_id, in id order —
+        the exact row set a consistent read of that snapshot must return."""
+        with self._cond:
+            items = sorted((sid, rows) for sid, rows in self._events.items() if sid <= snapshot_id)
+        out: dict = {}
+        for _, rows in items:
+            out.update(rows)
+        return out
+
+    def expected_final(self) -> dict:
+        return self.expected_at(1 << 62)
+
+    @property
+    def commits(self) -> int:
+        with self._cond:
+            return len(self._events)
+
+    @property
+    def accepted_rows(self) -> int:
+        with self._cond:
+            return sum(len(r) for r in self._events.values())
+
+
+def find_landed_append(store, user: str, identifier: int) -> int | None:
+    """Did this (user, identifier) round's APPEND phase land? A commit that
+    raised (conflict on its COMPACT half, retry exhaustion, an injected
+    fault mid-protocol) may still have published rows — the snapshot chain,
+    not the exception, is the truth the oracle must record."""
+    from ..core.snapshot import CommitKind
+
+    try:
+        for snap in store.snapshot_manager.snapshots_of_user_with_identifier(user, identifier):
+            if snap.commit_kind == CommitKind.APPEND:
+                return snap.id
+    except Exception:
+        return None
+    return None
+
+
+class SoakHarness:
+    def __init__(self, base_dir: str, cfg: SoakConfig | None = None, domain: str | None = None):
+        self.cfg = cfg or SoakConfig()
+        self.base_dir = str(base_dir)
+        self.domain = domain or f"soak{os.getpid()}_{self.cfg.seed}"
+        self.local_root = os.path.join(self.base_dir, "soak_table")
+        self.path = f"fail://{self.domain}{self.local_root}"
+        self.stop = threading.Event()
+        self.oracle = OracleLog()
+        self.errors: list[str] = []  # unexpected thread crashes
+        self.inconsistencies: list[dict] = []
+        self.read_latencies_ms: list[float] = []
+        self._lock = threading.Lock()
+        self.counts = {
+            "commits_ok": 0,
+            "commits_failed": 0,
+            "commits_conflict_survived": 0,  # raised, but APPEND landed
+            "commits_conflict_aborted": 0,  # raised, nothing landed
+            "writes_rejected_rounds": 0,
+            "compactor_commits": 0,
+            "compactor_conflicts": 0,
+            "expire_runs": 0,
+            "reads_ok": 0,
+            "reads_expired_race": 0,
+            "read_errors": 0,
+        }
+        self._table = None
+        self._controller = None
+
+    # ---- setup ---------------------------------------------------------
+    def _table_options(self) -> dict:
+        cfg = self.cfg
+        opts = {
+            "bucket": str(cfg.buckets),
+            "merge.engine": "mesh" if cfg.mesh else "single",
+            # small memtables force the offloaded-flush path under load
+            "write-buffer-rows": str(max(cfg.write_chunk_rows * 2, 64)),
+            # enough history that a pinned read never races expiry
+            "snapshot.num-retained.min": "16",
+            "snapshot.num-retained.max": "30",
+            "commit.retry-backoff": "2 ms",
+        }
+        if cfg.resilient:
+            opts.update(
+                {
+                    "commit.max-retries": "30",
+                    "fs.retry.max-attempts": "6",
+                    "fs.retry.initial-backoff": "2 ms",
+                    "fs.retry.max-backoff": "40 ms",
+                }
+            )
+        else:
+            # the seed contrast: first IO fault aborts, no CAS retry budget
+            opts.update({"commit.max-retries": "0", "fs.retry.max-attempts": "1"})
+        opts.update(cfg.table_options)
+        return opts
+
+    def setup(self):
+        from ..core.schema import SchemaManager
+        from ..fs import get_file_io
+        from ..fs.testing import FailingFileIO
+        from ..table import FileStoreTable
+
+        FailingFileIO.reset(self.domain, 0, 0)
+        io = get_file_io(self.path)
+        ts = SchemaManager(io, self.path).create_table(
+            SCHEMA, primary_keys=["k"], options=self._table_options()
+        )
+        self._table = FileStoreTable(io, self.path, ts, commit_user="soak-setup")
+        if self.cfg.backpressure:
+            from ..core.admission import WriteBufferController
+
+            self._controller = WriteBufferController(
+                self.cfg.max_memory,
+                stop_trigger=self.cfg.stop_trigger,
+                block_timeout_ms=self.cfg.block_timeout_ms,
+                max_pending_flushes=self.cfg.max_pending_flushes,
+            )
+        return self._table
+
+    def _handle(self, user: str):
+        """A fresh table handle (own store, own commit user) — one per
+        thread, exactly how independent jobs would mount the table."""
+        return self._table.with_user(user)
+
+    # ---- writer --------------------------------------------------------
+    def _writer_loop(self, wid: int, deadline: float) -> None:
+        from ..core.admission import WriterBackpressureError
+        from ..core.commit import CommitConflictError, CommitGiveUpError
+        from ..core.manifest import ManifestCommittable
+        from ..fs.testing import ArtificialException
+        from ..metrics import soak_metrics
+        from ..table.write import TableWrite
+
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed * 7919 + wid)
+        user = f"soak-w{wid}"
+        table = self._handle(user)
+        store = table.store
+        g = soak_metrics()
+        ident = 0
+        next_key = 0
+        written: list[int] = []
+        while not self.stop.is_set() and time.monotonic() < deadline:
+            ident += 1
+            n_upd = int(cfg.rows_per_commit * cfg.update_fraction) if written else 0
+            n_new = cfg.rows_per_commit - n_upd
+            fresh = [wid * KEYSPACE + next_key + i for i in range(n_new)]
+            upd = (
+                [written[i] for i in rng.integers(0, len(written), n_upd)] if n_upd else []
+            )
+            keys = fresh + upd
+            vals = (ident * 1_000.0 + wid) + rng.random(len(keys))
+            rows = dict(zip(keys, [float(v) for v in vals]))  # unique keys per round
+            try:
+                tw = TableWrite(table, buffer_controller=self._controller)
+                try:
+                    data_keys = list(rows)
+                    data_vals = [rows[k] for k in data_keys]
+                    from ..data.batch import ColumnBatch
+
+                    for i in range(0, len(data_keys), cfg.write_chunk_rows):
+                        tw.write(
+                            ColumnBatch.from_pydict(
+                                SCHEMA,
+                                {
+                                    "k": data_keys[i : i + cfg.write_chunk_rows],
+                                    "v": data_vals[i : i + cfg.write_chunk_rows],
+                                },
+                            )
+                        )
+                    if cfg.compact_every and ident % cfg.compact_every == 0:
+                        tw.compact(full=True)
+                    msgs = tw.prepare_commit()
+                finally:
+                    tw.close()  # releases any reservation this round still holds
+                sids = store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+                if sids:
+                    self.oracle.record(sids[0], rows)
+                    next_key += n_new
+                    written.extend(fresh)
+                    with self._lock:
+                        self.counts["commits_ok"] += 1
+                    g.counter("commits_ok").inc()
+            except WriterBackpressureError:
+                # load shed: the round was REJECTED before any byte buffered —
+                # not lost, not accepted. Back off and continue.
+                with self._lock:
+                    self.counts["writes_rejected_rounds"] += 1
+                time.sleep(0.02)
+            except (CommitConflictError, CommitGiveUpError, ArtificialException):
+                sid = find_landed_append(store, user, ident)
+                if sid is not None:
+                    # COMPACT half lost the race/faulted, APPEND landed: the
+                    # rows ARE committed and the oracle must count them
+                    self.oracle.record(sid, rows)
+                    next_key += n_new
+                    written.extend(fresh)
+                    with self._lock:
+                        self.counts["commits_conflict_survived"] += 1
+                    g.counter("commits_conflict_replanned").inc()
+                else:
+                    with self._lock:
+                        if self.cfg.resilient:
+                            self.counts["commits_conflict_aborted"] += 1
+                        else:
+                            self.counts["commits_failed"] += 1
+
+    # ---- reader --------------------------------------------------------
+    def _append_sids_up_to(self, sm, sid: int) -> set[int]:
+        """The soak-writer APPEND snapshots <= sid the oracle must cover
+        before the read at sid can be judged. A snapshot that vanishes
+        mid-walk was just expired — expiry only reaches OLD snapshots, whose
+        commits were recorded long ago, so skipping it never weakens the
+        coverage requirement (sm.snapshots() itself is list-then-read and
+        would throw on exactly that race)."""
+        from ..core.snapshot import CommitKind
+
+        out: set[int] = set()
+        earliest = sm.earliest_snapshot_id()
+        if earliest is None:
+            return out
+        for i in range(earliest, sid + 1):
+            try:
+                if not sm.snapshot_exists(i):
+                    continue
+                snap = sm.snapshot(i)
+            except FileNotFoundError:
+                continue  # expired between the exists check and the read
+            if snap.commit_kind == CommitKind.APPEND and snap.commit_user.startswith("soak-w"):
+                out.add(snap.id)
+        return out
+
+    def _read_at(self, table, sid: int):
+        t = table.copy({"scan.snapshot-id": str(sid)})
+        rb = t.new_read_builder()
+        splits = rb.new_scan().plan()
+        return rb.new_read().read_all(splits)
+
+    def _reader_loop(self, rid: int, deadline: float) -> None:
+        user = f"soak-r{rid}"
+        table = self._handle(user)
+        sm = table.store.snapshot_manager
+        while not self.stop.is_set() and time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            try:
+                sid = sm.latest_snapshot_id()
+            except Exception:
+                sid = None
+            if sid is None:
+                time.sleep(0.05)
+                continue
+            try:
+                from ..fs.testing import ArtificialException
+
+                try:
+                    batch = self._read_at(table, sid)
+                except ArtificialException:
+                    # the IO layer already burned fs.retry.max-attempts; one
+                    # fresh pass covers the (rare) full-budget exhaustion
+                    batch = self._read_at(table, sid)
+                needed = self._append_sids_up_to(sm, sid)
+            except Exception as exc:
+                earliest = None
+                try:
+                    earliest = sm.earliest_snapshot_id()
+                except Exception:
+                    pass
+                with self._lock:
+                    if earliest is not None and sid < earliest:
+                        # pinned snapshot expired mid-read: a retriable race,
+                        # not an inconsistency (retention bounds its rate)
+                        self.counts["reads_expired_race"] += 1
+                    else:
+                        self.counts["read_errors"] += 1
+                        self.errors.append(f"reader {rid} @ snapshot {sid}: {exc!r}")
+                continue
+            self.read_latencies_ms.append((time.perf_counter() - t0) * 1000)
+            ks = batch.column("k").values.tolist()
+            got = dict(zip(ks, batch.column("v").values.tolist()))
+            if len(ks) != len(got):
+                self.inconsistencies.append(
+                    {"snapshot": sid, "kind": "duplicate-keys", "rows": len(ks), "unique": len(got)}
+                )
+                continue
+            if not self.oracle.wait_covers(needed, timeout_s=10.0):
+                self.inconsistencies.append(
+                    {"snapshot": sid, "kind": "oracle-lag", "needed": sorted(needed)[-3:]}
+                )
+                continue
+            expected = self.oracle.expected_at(sid)
+            if got != expected:
+                missing = [k for k in expected if k not in got]
+                extra = [k for k in got if k not in expected]
+                wrong = [k for k in expected if k in got and got[k] != expected[k]]
+                self.inconsistencies.append(
+                    {
+                        "snapshot": sid,
+                        "kind": "row-set-mismatch",
+                        "missing": len(missing),
+                        "extra": len(extra),
+                        "wrong_value": len(wrong),
+                        "sample": (missing[:3], extra[:3], wrong[:3]),
+                    }
+                )
+            else:
+                with self._lock:
+                    self.counts["reads_ok"] += 1
+
+    # ---- churn ---------------------------------------------------------
+    def _compactor_loop(self, deadline: float) -> None:
+        from ..core.commit import BATCH_COMMIT_IDENTIFIER, CommitConflictError, CommitGiveUpError
+        from ..core.manifest import ManifestCommittable
+        from ..fs.testing import ArtificialException
+        from ..table.write import TableWrite
+
+        table = self._handle("soak-compactor")
+        store = table.store
+        while not self.stop.is_set() and time.monotonic() < deadline:
+            time.sleep(self.cfg.compactor_pause_s)
+            try:
+                tw = TableWrite(table)
+                try:
+                    tw.compact(full=True)
+                    msgs = tw.prepare_commit()
+                finally:
+                    tw.close()
+                if not msgs:
+                    continue
+                store.new_commit().commit(ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs))
+                with self._lock:
+                    self.counts["compactor_commits"] += 1
+            except (CommitConflictError, CommitGiveUpError, ArtificialException):
+                # losing a compaction race (or a fault aborting one) is the
+                # expected storm; rows are untouched — writers own them
+                with self._lock:
+                    self.counts["compactor_conflicts"] += 1
+
+    def _expirer_loop(self, deadline: float) -> None:
+        table = self._handle("soak-expirer")
+        while not self.stop.is_set() and time.monotonic() < deadline:
+            time.sleep(self.cfg.expire_every_s)
+            try:
+                table.expire_snapshots()
+                with self._lock:
+                    self.counts["expire_runs"] += 1
+            except Exception:
+                pass  # expiry is maintenance: faults here must never matter
+
+    # ---- orchestration -------------------------------------------------
+    def _spawn(self, name: str, fn, *args) -> threading.Thread:
+        def guarded():
+            try:
+                fn(*args)
+            except BaseException:
+                self.errors.append(f"{name} crashed:\n{traceback.format_exc()}")
+
+        t = threading.Thread(target=guarded, name=name, daemon=False)
+        t.start()
+        return t
+
+    def run(self) -> dict:
+        from ..fs.testing import FailingFileIO
+        from ..metrics import registry, soak_metrics
+
+        cfg = self.cfg
+        if self._table is None:
+            self.setup()
+        # drop ONLY the soak{...} group so back-to-back runs in one process
+        # (the bench's full-vs-seed contrast) report their own counters;
+        # other groups keep accumulating and are reported as deltas
+        with registry._lock:
+            registry.groups.pop(("soak", ()), None)
+        commit_group = registry.group("commit")
+        base_retries = commit_group.counter("retries").count
+        base_abandoned = commit_group.counter("buckets_abandoned").count
+        base_conflicts = commit_group.counter("conflicts").count
+        if cfg.fault_possibility > 0:
+            FailingFileIO.reset(
+                self.domain, max_fails=10**9, possibility=cfg.fault_possibility, seed=cfg.seed
+            )
+        t_start = time.monotonic()
+        deadline = t_start + cfg.duration_s
+        threads = [
+            self._spawn(f"soak-writer-{w}", self._writer_loop, w, deadline)
+            for w in range(cfg.writers)
+        ]
+        threads += [
+            self._spawn(f"soak-reader-{r}", self._reader_loop, r, deadline)
+            for r in range(cfg.readers)
+        ]
+        threads.append(self._spawn("soak-compactor", self._compactor_loop, deadline))
+        threads.append(self._spawn("soak-expirer", self._expirer_loop, deadline))
+        for t in threads:
+            t.join(timeout=cfg.duration_s + max(120.0, cfg.block_timeout_ms / 1000.0 * 3))
+        alive = [t.name for t in threads if t.is_alive()]
+        if alive:
+            self.stop.set()
+            for t in threads:
+                t.join(timeout=60.0)
+            self.errors.append(f"threads failed to drain in time: {alive}")
+        wall_s = time.monotonic() - t_start
+        FailingFileIO.reset(self.domain, 0, 0)  # faults off for verification
+        report = self._verify(wall_s)
+        g = soak_metrics()
+        g.counter("commits_retried").inc(commit_group.counter("retries").count - base_retries)
+        report["commit_cas_retries"] = commit_group.counter("retries").count - base_retries
+        report["commit_conflicts_detected"] = commit_group.counter("conflicts").count - base_conflicts
+        report["commit_buckets_replanned"] = (
+            commit_group.counter("buckets_abandoned").count - base_abandoned
+        )
+        if self.read_latencies_ms:
+            p50 = float(np.percentile(self.read_latencies_ms, 50))
+            p99 = float(np.percentile(self.read_latencies_ms, 99))
+            g.gauge("read_p50_ms").set(p50)
+            g.gauge("read_p99_ms").set(p99)
+            report["read_p50_ms"] = round(p50, 2)
+            report["read_p99_ms"] = round(p99, 2)
+        else:
+            report["read_p50_ms"] = report["read_p99_ms"] = None
+        return report
+
+    # ---- post-soak verification ----------------------------------------
+    def _final_compact(self) -> None:
+        from ..core.commit import BATCH_COMMIT_IDENTIFIER
+        from ..core.manifest import ManifestCommittable
+        from ..table.write import TableWrite
+
+        table = self._handle("soak-final")
+        for _ in range(3):  # nothing else is running; retries cover stragglers
+            tw = TableWrite(table)
+            try:
+                tw.compact(full=True)
+                msgs = tw.prepare_commit()
+                if not msgs:
+                    return
+                table.store.new_commit().commit(
+                    ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs)
+                )
+                return
+            except Exception:
+                continue
+            finally:
+                tw.close()
+
+    def _sweep_and_audit(self) -> dict:
+        """Orphan sweep at threshold 0, then an independent disk walk: the
+        surviving file set must be EXACTLY the reachable closure plus table
+        metadata (snapshots/schemas/hints/markers)."""
+        from ..resilience.orphan import reachable_files, remove_orphan_files
+
+        removed = remove_orphan_files(self._table, older_than_millis=0)
+        closure = reachable_files(self._table)
+        meta_names = set().union(*closure["meta"].values()) if closure["meta"] else set()
+        index_names = set().union(*closure["index"].values()) if closure["index"] else set()
+        data_names = {name for (_, name) in closure["data"]}
+        leaked = []
+        for dirpath, _dirs, files in os.walk(self.local_root):
+            rel = os.path.relpath(dirpath, self.local_root)
+            parts = [] if rel == "." else rel.split(os.sep)
+            top = parts[0] if parts else ""
+            for f in files:
+                if top == "manifest":
+                    ok = f in meta_names
+                elif top == "index":
+                    ok = f in index_names
+                elif top in (
+                    "snapshot",
+                    "schema",
+                    "branch",
+                    "tag",
+                    "consumer",
+                    "service",
+                    "statistics",
+                    "changelog",
+                ):
+                    ok = True  # metadata planes: hints, schema history, markers
+                elif any(p.startswith("bucket-") for p in parts):
+                    ok = f in data_names
+                else:
+                    ok = False
+                if not ok:
+                    leaked.append(os.path.join(rel, f))
+        return {"orphans_removed": len(removed), "leaked_files": leaked}
+
+    def _verify(self, wall_s: float) -> dict:
+        lost = dup = wrong = 0
+        final_rows = None
+        total_record_count = None
+        try:
+            self._final_compact()
+            table = self._handle("soak-verify")
+            latest = table.store.snapshot_manager.latest_snapshot()
+            sid = latest.id if latest else None
+            expected = self.oracle.expected_final()
+            if sid is not None:
+                batch = self._read_at(table, sid)
+                ks = batch.column("k").values.tolist()
+                got = dict(zip(ks, batch.column("v").values.tolist()))
+                final_rows = len(ks)
+                dup = len(ks) - len(got)
+                lost = sum(1 for k in expected if k not in got)
+                wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
+                dup += sum(1 for k in got if k not in expected)
+                total_record_count = latest.total_record_count
+            elif expected:
+                lost = len(expected)
+        except Exception:
+            self.errors.append(f"final verification crashed:\n{traceback.format_exc()}")
+        audit = {"orphans_removed": None, "leaked_files": ["<sweep crashed>"]}
+        try:
+            audit = self._sweep_and_audit()
+            # the sweep must not have removed anything a reader can see
+            if final_rows is not None:
+                table = self._handle("soak-post-sweep")
+                latest = table.store.snapshot_manager.latest_snapshot()
+                batch = self._read_at(table, latest.id)
+                if batch.num_rows != final_rows:
+                    self.inconsistencies.append(
+                        {"kind": "sweep-removed-live-rows", "before": final_rows, "after": batch.num_rows}
+                    )
+        except Exception:
+            self.errors.append(f"orphan audit crashed:\n{traceback.format_exc()}")
+        from ..metrics import soak_metrics
+
+        g = soak_metrics()
+        consistent = (
+            not self.inconsistencies
+            and not self.errors
+            and lost == 0
+            and dup == 0
+            and wrong == 0
+            and (total_record_count is None or total_record_count == len(self.oracle.expected_final()))
+        )
+        report = {
+            "wall_s": round(wall_s, 2),
+            "consistent": consistent,
+            "accepted_commits": self.oracle.commits,
+            "accepted_rows": self.oracle.accepted_rows,
+            "expected_unique_keys": len(self.oracle.expected_final()),
+            "final_rows": final_rows,
+            "total_record_count": total_record_count,
+            "lost_rows": lost,
+            "duplicated_rows": dup,
+            "wrong_values": wrong,
+            "commits_per_sec": round(self.oracle.commits / wall_s, 2) if wall_s > 0 else None,
+            "writes_throttled": g.counter("writes_throttled").count,
+            "writes_rejected": g.counter("writes_rejected").count,
+            "backpressure_ms_mean": round(g.histogram("backpressure_ms").mean, 2),
+            "inconsistencies": self.inconsistencies[:10],
+            "errors": self.errors[:5],
+            **self.counts,
+            **{"orphans_removed": audit["orphans_removed"], "leaked_files": audit["leaked_files"][:10]},
+            "leaked_file_count": len(audit["leaked_files"]),
+        }
+        return report
+
+
+def run_soak(base_dir: str, cfg: SoakConfig | None = None, domain: str | None = None) -> dict:
+    """Create a fresh soak table under base_dir, run the harness, return the
+    report dict (see SoakHarness._verify for fields)."""
+    return SoakHarness(base_dir, cfg, domain=domain).run()
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+    import tempfile
+
+    ap = argparse.ArgumentParser(description="paimon-tpu production traffic soak")
+    ap.add_argument("base_dir", nargs="?", default=None)
+    ap.add_argument("--duration", type=float, default=45.0)
+    ap.add_argument("--writers", type=int, default=3)
+    ap.add_argument("--readers", type=int, default=2)
+    ap.add_argument("--fault-possibility", type=int, default=20, help="1/N ops fail (20 = 5%%)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mesh", action="store_true")
+    ap.add_argument("--no-backpressure", action="store_true")
+    ap.add_argument("--seed-mode", action="store_true", help="seed-like resilience: no IO/CAS retries")
+    args = ap.parse_args(argv)
+    base = args.base_dir or tempfile.mkdtemp(prefix="paimon_soak_")
+    cfg = SoakConfig(
+        duration_s=args.duration,
+        writers=args.writers,
+        readers=args.readers,
+        fault_possibility=args.fault_possibility,
+        seed=args.seed,
+        mesh=args.mesh,
+        backpressure=not args.no_backpressure,
+        resilient=not args.seed_mode,
+    )
+    report = run_soak(base, cfg)
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["consistent"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,9 +28,18 @@ __all__ = ["BatchWriteBuilder", "StreamWriteBuilder", "TableWrite", "TableCommit
 
 
 class TableWrite:
-    def __init__(self, table: "FileStoreTable"):
+    def __init__(self, table: "FileStoreTable", buffer_controller=None):
         self.table = table
         store = table.store
+        # admission control / memtable backpressure (core/admission.py):
+        # built from write.buffer.max-memory when set, or injected — the
+        # soak harness shares ONE controller across all writer threads to
+        # model a global host-memory budget
+        if buffer_controller is None:
+            from ..core.admission import WriteBufferController
+
+            buffer_controller = WriteBufferController.from_options(store.options)
+        self.admission = buffer_controller
         self.partition_keys = store.partition_keys
         self.bucket_keys = table.schema.bucket_keys
         self.dynamic = table.is_primary_key_table and store.options.bucket == -1
@@ -197,7 +206,9 @@ class TableWrite:
         key = (partition, bucket)
         if key not in self._writers:
             total = -1 if self.dynamic else self.num_buckets
-            self._writers[key] = self.table.store.new_writer(partition, bucket, total)
+            self._writers[key] = self.table.store.new_writer(
+                partition, bucket, total, admission=self.admission
+            )
         return self._writers[key]
 
     def compact(self, full: bool = False) -> None:
@@ -281,11 +292,31 @@ class TableWrite:
         return msgs
 
     def close(self) -> None:
+        """Tear down every per-bucket writer. Each close releases that
+        writer's outstanding buffer reservation back to the (possibly
+        shared) admission controller — abandoning a conflicted commit must
+        re-admit blocked rivals, never leak budget."""
         for w in self._writers.values():
             close = getattr(w, "close", None)
             if close is not None:
                 close()
         self._writers.clear()
+
+    def health(self) -> dict:
+        """Writer-side flow-control snapshot: the admission controller's
+        backpressure state plus per-bucket buffer/flush depths (the health
+        surface a serving layer polls to decide shedding vs routing)."""
+        writers = {}
+        for (partition, bucket), w in self._writers.items():
+            h = getattr(w, "health", None)
+            if h is not None:
+                writers[f"{partition}/{bucket}"] = h()
+        out = {"state": "ok", "writers": writers}
+        if self.admission is not None:
+            out.update(self.admission.health())
+        out["buffered_rows"] = sum(w.get("buffered_rows", 0) for w in writers.values())
+        out["pending_flushes_writers"] = sum(w.get("pending_flushes", 0) for w in writers.values())
+        return out
 
 
 def load_callbacks(table, option) -> list:

@@ -28,6 +28,17 @@
 #                              then forced single — so the mesh-sharded and
 #                              single-device execution engines both prove
 #                              bit-identical merge output.
+#   scripts/verify.sh soak     traffic-soak stage: the writer flow-control /
+#                              conflict-storm suite plus a bounded (~60 s
+#                              total) DETERMINISTIC mini-soak — fixed seed,
+#                              3 writers / 2 readers / 5% injected faults —
+#                              asserting snapshot-consistent reads (oracle
+#                              log), zero failed commits, zero lost or
+#                              duplicated rows, zero leaked worker threads
+#                              (conftest), and a post-soak orphan sweep
+#                              leaving the file set exactly equal to the
+#                              reachable closure. Nightly-scale knobs live
+#                              in benchmarks/soak_bench.py.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -87,6 +98,13 @@ if [ "${1:-}" = "lanes" ]; then
       -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
   done
   exit 0
+fi
+
+if [ "${1:-}" = "soak" ]; then
+  # no -m filter: this stage INCLUDES the slow-marked ~45 s stage soak
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_SOAK_DURATION=45 PAIMON_TPU_SOAK_SEED=0 \
+    timeout -k 10 600 python -m pytest tests/test_soak.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
 if [ "${1:-}" = "encode" ]; then

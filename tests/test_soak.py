@@ -1,0 +1,447 @@
+"""Traffic soak & writer flow control: admission-control units, the
+flush-offload teardown error path, conflict-teardown buffer accounting,
+overlapping-bucket conflict storms, and the end-to-end mini-soak.
+
+The verify stage (`scripts/verify.sh soak`) runs this whole module INCLUDING
+the slow-marked deterministic ~45 s stage soak (fixed seed, 3 writers /
+2 readers / 5% faults); the tier-1 gate runs everything but that.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paimon_tpu.core.admission import WriteBufferController, WriterBackpressureError
+from paimon_tpu.core.commit import CommitConflictError
+from paimon_tpu.core.manifest import ManifestCommittable
+from paimon_tpu.core.schema import SchemaManager
+from paimon_tpu.data import ColumnBatch
+from paimon_tpu.fs import get_file_io
+from paimon_tpu.fs.testing import FailingFileIO, FaultRule, LatencyFileIO
+from paimon_tpu.metrics import registry, soak_metrics
+from paimon_tpu.service.soak import (
+    KEYSPACE,
+    SCHEMA,
+    SoakConfig,
+    find_landed_append,
+    run_soak,
+)
+from paimon_tpu.table import FileStoreTable
+from paimon_tpu.table.write import TableWrite
+
+
+def make_table(tmp_path, domain, opts=None, scheme="fail", user="soak-test"):
+    if scheme == "fail":
+        FailingFileIO.reset(domain, 0, 0)
+        path = f"fail://{domain}{tmp_path}/t"
+    else:
+        path = f"{scheme}://{tmp_path}/t"
+    io = get_file_io(path)
+    o = {"bucket": "1", **(opts or {})}
+    ts = SchemaManager(io, path).create_table(SCHEMA, primary_keys=["k"], options=o)
+    return FileStoreTable(io, path, ts, commit_user=user)
+
+
+def batch(keys, base=0.0):
+    return ColumnBatch.from_pydict(SCHEMA, {"k": list(keys), "v": [base + k for k in keys]})
+
+
+def commit_all(table, tw, ident=None):
+    from paimon_tpu.core.commit import BATCH_COMMIT_IDENTIFIER
+
+    msgs = tw.prepare_commit()
+    return table.store.new_commit().commit(
+        ManifestCommittable(BATCH_COMMIT_IDENTIFIER if ident is None else ident, messages=msgs)
+    )
+
+
+# ------------------------------------------------------------------ admission
+def test_controller_admits_below_trigger_and_throttles_above():
+    c = WriteBufferController(1000, stop_trigger=0.5, block_timeout_ms=50)
+    assert c.try_reserve(400)  # below the 500-byte trigger
+    assert not c.try_reserve(200)  # 600 > 500: throttle territory
+    t0 = time.perf_counter()
+    with pytest.raises(WriterBackpressureError):
+        c.reserve(200)
+    assert (time.perf_counter() - t0) >= 0.045  # blocked for the deadline
+    c.release(400)
+    c.reserve(200)  # budget freed: admitted immediately
+    assert c.in_use == 200
+
+
+def test_controller_oversized_batch_admitted_when_empty():
+    # a single batch larger than the whole budget must not deadlock forever
+    c = WriteBufferController(100, stop_trigger=0.5, block_timeout_ms=10)
+    c.reserve(5000)
+    assert c.in_use == 5000
+    with pytest.raises(WriterBackpressureError):
+        c.reserve(1)
+    c.release(5000)
+    c.reserve(1)
+
+
+def test_controller_blocked_reserve_wakes_on_release():
+    c = WriteBufferController(1000, stop_trigger=0.5, block_timeout_ms=5000)
+    c.reserve(500)
+    got = []
+
+    def blocked():
+        c.reserve(300)
+        got.append(c.in_use)
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.05)
+    assert not got  # still throttled
+    c.release(500)
+    t.join(timeout=5)
+    assert got == [300]
+
+
+def test_controller_flush_depth_cap_and_metrics():
+    registry.reset()
+    c = WriteBufferController(1000, block_timeout_ms=30, max_pending_flushes=2)
+    assert c.flush_begin() and c.flush_begin()
+    assert not c.flush_begin()  # cap held for the timeout -> inline signal
+    c.flush_end()
+    assert c.flush_begin()
+    g = soak_metrics()
+    assert g.counter("writes_throttled").count == 1
+    assert c.health()["pending_flushes"] == 2
+
+
+def test_controller_from_options_off_by_default(tmp_path):
+    t = make_table(tmp_path, "adm_off")
+    tw = TableWrite(t)
+    assert tw.admission is None  # write.buffer.max-memory=0: untouched path
+    t2 = make_table(tmp_path / "on", "adm_on", opts={"write.buffer.max-memory": "64 kb"})
+    tw2 = TableWrite(t2)
+    assert tw2.admission is not None and tw2.admission.max_memory == 64 * 1024
+    h = tw2.health()
+    assert h["state"] == "ok" and h["max_memory"] == 64 * 1024
+
+
+def test_writer_throttles_through_offloaded_drain(tmp_path):
+    """End-to-end throttle: a big first batch puts the shared budget over the
+    stop trigger while its offloaded flush encodes on a slow store; the next
+    write blocks in admission until the worker releases, then lands. Data is
+    intact and the throttle is visible in soak{writes_throttled}."""
+    registry.reset()
+    LatencyFileIO.configure(write_ms=30)
+    try:
+        t = make_table(
+            tmp_path,
+            "",
+            scheme="latency",
+            opts={
+                "write.buffer.max-memory": "20 kb",
+                "write.buffer.stop-trigger": "0.3",
+                "write.buffer.block-timeout": "5 s",
+                "write-buffer-rows": "512",
+            },
+        )
+        tw = TableWrite(t)
+        tw.write(batch(range(512)))  # ~13 kb: over the 6 kb trigger, flushing
+        tw.write(batch(range(512, 700)))  # must throttle until the drain
+        commit_all(t, tw)
+        tw.close()
+        g = soak_metrics()
+        assert g.counter("writes_throttled").count > 0
+        assert g.histogram("backpressure_ms").count > 0
+        rb = t.new_read_builder()
+        got = rb.new_read().read_all(rb.new_scan().plan())
+        assert sorted(got.column("k").values.tolist()) == list(range(700))
+        assert tw.admission.in_use == 0
+    finally:
+        LatencyFileIO.configure()
+
+
+def test_writer_rejects_on_deadline_then_recovers(tmp_path):
+    """End-to-end reject: with the budget pinned over the trigger and nothing
+    draining, a write blocks for write.buffer.block-timeout then raises the
+    typed WriterBackpressureError — nothing buffered, sequence untouched —
+    and is admitted again once the pressure lifts."""
+    registry.reset()
+    ctrl = WriteBufferController(10_000, stop_trigger=0.5, block_timeout_ms=80)
+    t = make_table(tmp_path, "reject", opts={"write-buffer-rows": "100000"})
+    tw = TableWrite(t, buffer_controller=ctrl)
+    pin = 6_000  # over the 5 kb trigger, held by "someone else"
+    ctrl.reserve(pin)
+    with pytest.raises(WriterBackpressureError):
+        tw.write(batch(range(200)))
+    g = soak_metrics()
+    assert g.counter("writes_rejected").count == 1
+    ctrl.release(pin)  # pressure lifts
+    tw.write(batch(range(200)))  # same rows admitted now
+    commit_all(t, tw)
+    tw.close()
+    rb = t.new_read_builder()
+    got = rb.new_read().read_all(rb.new_scan().plan())
+    assert got.num_rows == 200  # the rejected attempt buffered nothing
+    assert ctrl.in_use == 0
+
+
+# ----------------------------------------------- satellite 1: flush pool leak
+def flush_threads():
+    return [
+        t
+        for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith("paimon-flush")
+    ]
+
+
+def test_flush_pool_torn_down_when_worker_fails(tmp_path):
+    """A flush-WORKER error re-raised at the prepare_commit barrier must not
+    leak the 1-worker paimon-flush executor."""
+    domain = "flushleak_worker"
+    t = make_table(tmp_path, domain, opts={"write-buffer-rows": "32"})
+    w = t.store.new_writer((), 0)
+    # every data-file write fails permanently: the offloaded flush_complete
+    # errors on the worker thread
+    FailingFileIO.schedule(domain, FaultRule(op="write", path="bucket-0", count=0))
+    w.write(batch(range(64)))  # auto-flush offloads and fails in background
+    with pytest.raises(Exception):
+        w.prepare_commit()
+    FailingFileIO.reset(domain, 0, 0)
+    assert not flush_threads()
+    w.close()
+
+
+def test_flush_pool_torn_down_when_dispatch_fails(tmp_path):
+    """The FAILING-path case the conftest leak assertion used to see only in
+    the happy path: a dispatch-phase error (the input-changelog write runs on
+    the CALLER thread, before the worker is involved) leaves an already-warm
+    flush pool alive. prepare_commit must still tear it down. Verified to
+    leak before the try/finally fix."""
+    domain = "flushleak_dispatch"
+    t = make_table(
+        tmp_path,
+        domain,
+        opts={"write-buffer-rows": "100000", "changelog-producer": "input"},
+    )
+    w = t.store.new_writer((), 0)
+    w.write(batch(range(64)))
+    w.flush()  # healthy offloaded flush: warms the paimon-flush pool
+    assert w._flush_pool is not None  # pool alive between flushes
+    # now fail the NEXT changelog write (flush_dispatch, caller thread);
+    # the buffered rows sit below the auto-flush bound so the error fires
+    # inside prepare_commit's flush barrier, with the warm pool at stake
+    FailingFileIO.schedule(domain, FaultRule(op="write", path="changelog", count=0))
+    w.write(batch(range(100, 164)))
+    with pytest.raises(Exception):
+        w.prepare_commit()
+    FailingFileIO.reset(domain, 0, 0)
+    assert w._flush_pool is None
+    assert not flush_threads()
+    w.close()
+
+
+# ------------------------------------- satellite 2: conflict-teardown release
+def test_conflict_teardown_releases_stolen_bucket_bytes(tmp_path):
+    """A writer holding buffer budget that loses its bucket to a rival must
+    return the stolen bucket's bytes on teardown — exactly once — so a rival
+    writer blocked at the high-water mark is re-admitted."""
+    domain = "steal"
+    ctrl = WriteBufferController(12_000, stop_trigger=0.5, block_timeout_ms=20_000)
+    t = make_table(tmp_path, domain, opts={"write-buffer-rows": "100000"})
+    # seed data so there is a compaction input to steal
+    tw0 = TableWrite(t)
+    tw0.write(batch(range(100)))
+    commit_all(t, tw0, ident=1)
+    tw0.close()
+
+    # our writer: plans a full compaction of the current files, then buffers
+    # the NEXT round's rows — reserved memtable bytes it still holds when the
+    # commit conflicts
+    tw = TableWrite(t.with_user("victim"), buffer_controller=ctrl)
+    tw.write(batch(range(200, 300)))
+    tw.compact(full=True)  # flush + rewrite planned against current levels
+    msgs = tw.prepare_commit()
+    assert ctrl.in_use == 0  # everything flushed: budget returned
+    tw.write(batch(range(300, 700)))  # next round's memtable, ~10 kb reserved
+    held = ctrl.in_use
+    assert held > int(12_000 * 0.5)  # victim alone is over the stop trigger
+
+    # rival steals the bucket: full-compacts and commits FIRST
+    rival = TableWrite(t.with_user("rival"))
+    rival.write(batch(range(500, 520)))
+    rival.compact(full=True)
+    commit_all(t, rival, ident=2)
+    rival.close()
+
+    # a second writer blocked at the high-water mark on the SHARED controller
+    blocked_done = []
+
+    def blocked_write():
+        tw2 = TableWrite(t.with_user("waiter"), buffer_controller=ctrl)
+        tw2.write(batch(range(900, 1200)))
+        blocked_done.append(ctrl.in_use)
+        tw2.close()
+
+    waiter = threading.Thread(target=blocked_write)
+    waiter.start()
+    time.sleep(0.1)
+    assert not blocked_done  # genuinely throttled behind the victim's bytes
+
+    # victim's commit loses every bucket -> typed conflict
+    with pytest.raises(CommitConflictError):
+        t.store.new_commit().commit(ManifestCommittable(3, messages=msgs))
+    tw.close()  # teardown: the stolen bucket's buffered bytes must come back
+    waiter.join(timeout=20)
+    assert blocked_done, "rival writer was never re-admitted after the teardown"
+    tw.close()  # idempotent: double-close must not double-release
+    assert ctrl.in_use == 0
+
+
+def test_close_releases_inflight_offloaded_flush_exactly_once(tmp_path):
+    """Bytes travelling through the offloaded flush worker are released by
+    the worker OR by close() — never both (no double-count, no leak)."""
+    LatencyFileIO.configure(write_ms=80)
+    try:
+        ctrl = WriteBufferController(1 << 20, block_timeout_ms=5000, max_pending_flushes=4)
+        t = make_table(
+            tmp_path, "", scheme="latency", opts={"write-buffer-rows": "64"}
+        )
+        tw = TableWrite(t, buffer_controller=ctrl)
+        tw.write(batch(range(64)))  # offloads a flush (slow encode in flight)
+        tw.write(batch(range(64, 100)))  # partially filled memtable
+        assert ctrl.in_use > 0
+        tw.close()  # worker drains during shutdown; remainder released here
+        assert ctrl.in_use == 0
+        assert ctrl.pending_flushes == 0
+    finally:
+        LatencyFileIO.configure()
+
+
+# --------------------------------------- satellite 3: conflict-storm coverage
+@pytest.mark.parametrize("engine", ["single", "mesh"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_overlapping_bucket_conflict_storm(tmp_path, engine, seed):
+    """N writers, every one targeting the SAME bucket set, compacting
+    aggressively: total committed rows must equal the sum of accepted
+    writes — no loss, no duplication — with the mesh engine on and off."""
+    domain = f"storm{engine}{seed}"
+    t = make_table(
+        tmp_path,
+        domain,
+        opts={
+            "bucket": "2",
+            "merge.engine": engine,
+            "commit.max-retries": "30",
+            "commit.retry-backoff": "1 ms",
+        },
+    )
+    n_writers, rounds, rows = 3, 4, 60
+    accepted: dict[int, list[int]] = {w: [] for w in range(n_writers)}
+    errors = []
+
+    def writer(wid):
+        rng = np.random.default_rng(seed * 101 + wid)
+        table = t.with_user(f"w{wid}")
+        store = table.store
+        try:
+            for ident in range(1, rounds + 1):
+                ks = [wid * KEYSPACE + int(k) for k in rng.choice(rows * 50, size=rows, replace=False)]
+                tw = TableWrite(table)
+                try:
+                    tw.write(batch(ks, base=wid))
+                    if ident % 2 == 0:
+                        tw.compact(full=True)
+                    msgs = tw.prepare_commit()
+                finally:
+                    tw.close()
+                try:
+                    sids = store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+                    if sids:
+                        accepted[wid].extend(ks)
+                except CommitConflictError:
+                    if find_landed_append(store, f"w{wid}", ident) is not None:
+                        accepted[wid].extend(ks)
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append(f"w{wid}: {exc!r}")
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(n_writers)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+    assert not errors, errors
+    # settle with one quiescent full compaction, then audit totals
+    fin = TableWrite(t.with_user("final"))
+    fin.compact(full=True)
+    commit_all(t, fin)
+    fin.close()
+    expected_keys = set().union(*(set(v) for v in accepted.values()))
+    rb = t.new_read_builder()
+    got = rb.new_read().read_all(rb.new_scan().plan())
+    ks = got.column("k").values.tolist()
+    assert len(ks) == len(set(ks)), "duplicated primary keys in final scan"
+    assert set(ks) == expected_keys, (
+        f"lost={len(expected_keys - set(ks))} extra={len(set(ks) - expected_keys)}"
+    )
+    latest = t.store.snapshot_manager.latest_snapshot()
+    assert latest.total_record_count == len(expected_keys)
+
+
+# ------------------------------------------------------------------ the soak
+def _assert_healthy(report):
+    assert report["consistent"], report
+    assert report["commits_failed"] == 0, report
+    assert report["lost_rows"] == 0 and report["duplicated_rows"] == 0, report
+    assert report["leaked_file_count"] == 0, report
+    assert report["commits_ok"] > 0 and report["reads_ok"] > 0, report
+    assert report["read_p99_ms"] is not None
+
+
+def test_mini_soak_faulted(tmp_path):
+    """A quick end-to-end soak at a high fault rate: every subsystem wired
+    together, consistency oracle green, zero leaks."""
+    cfg = SoakConfig(
+        duration_s=4.0,
+        writers=2,
+        readers=1,
+        fault_possibility=25,
+        rows_per_commit=100,
+        seed=11,
+        max_memory=256 * 1024,
+    )
+    report = run_soak(str(tmp_path), cfg, domain="minisoak")
+    _assert_healthy(report)
+
+
+def test_soak_health_surface(tmp_path):
+    t = make_table(tmp_path, "health", opts={"write.buffer.max-memory": "1 mb"})
+    tw = TableWrite(t)
+    tw.write(batch(range(32)))
+    h = tw.health()
+    assert h["state"] in ("ok", "throttling")
+    assert h["buffered_rows"] == 32
+    assert "writers" in h and len(h["writers"]) == 1
+    commit_all(t, tw)
+    tw.close()
+    assert tw.health()["buffered_rows"] == 0
+
+
+@pytest.mark.slow
+def test_soak_stage(tmp_path):
+    """The `scripts/verify.sh soak` stage: a bounded deterministic soak —
+    fixed seed, 3 writers / 2 readers / 5% faults — asserting consistency,
+    zero failed commits, zero leaked files (and, via the conftest autouse
+    fixture, zero leaked worker threads)."""
+    duration = float(os.environ.get("PAIMON_TPU_SOAK_DURATION", "45"))
+    seed = int(os.environ.get("PAIMON_TPU_SOAK_SEED", "0"))
+    cfg = SoakConfig(
+        duration_s=duration,
+        writers=3,
+        readers=2,
+        fault_possibility=20,  # the 5% headline rate
+        seed=seed,
+    )
+    report = run_soak(str(tmp_path), cfg, domain=f"stagesoak{seed}")
+    _assert_healthy(report)
+    assert report["commits_conflict_survived"] + report["commit_buckets_replanned"] > 0, (
+        "the soak never drove the conflict re-plan path"
+    )
