@@ -18,6 +18,21 @@ shared WriteBufferController) in two configurations:
               rounds strew orphans — recorded in the results JSON so the
               delta is auditable.
 
+and (unless --no-process) the PROCESS-GRAIN crash soak (service.proc_soak):
+
+  proc-full   >= 60 s with 2 writer + 1 reader OS processes sharing only
+              the warehouse filesystem, scripted kill -9 deaths at every
+              commit/flush crash point plus seeded random SIGKILLs, respawn
+              with journal recovery and periodic orphan sweeps. Headline:
+              accepted commits/s and kills survived with 0 lost/duplicated
+              rows (journal-oracle fold == final scan), 0 read errors, and
+              0 leaked files after the final sweep.
+  proc-seed   the contrast WITHOUT CAS retries, recovery probes, or orphan
+              sweeps: the same kill schedule loses commits outright
+              (rounds_failed), strands landed-but-unaccounted commits
+              (rounds_ack_lost with zero crash_recoveries), and leaks the
+              kills' torn files (leaked_file_count > 0).
+
 Prints one JSON line per configuration and writes
 benchmarks/results/soak_bench.json.
 
@@ -93,6 +108,73 @@ def run_mode(mode: str, duration: float, possibility: int, seed: int) -> dict:
     return row
 
 
+def run_proc_mode(mode: str, duration: float, seed: int) -> dict:
+    from paimon_tpu.service.proc_soak import DEFAULT_SCRIPTED_KILLS, ProcSoakConfig, run_proc_soak
+
+    full = mode == "proc-full"
+    cfg = ProcSoakConfig(
+        duration_s=duration,
+        writers=2,
+        readers=1,
+        seed=seed,
+        scripted_kills=DEFAULT_SCRIPTED_KILLS,
+        kill_period_s=8.0,
+        sweep_period_s=12.0,
+        resilient=full,
+    )
+    tmp = tempfile.mkdtemp(prefix=f"paimon_proc_soak_bench_{mode}_")
+    try:
+        report = run_proc_soak(tmp, cfg)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    keep = [
+        "wall_s",
+        "consistent",
+        "accepted_commits",
+        "commits_per_sec",
+        "rounds_intended",
+        "rounds_landed",
+        "rounds_failed",
+        "rounds_ack_lost",
+        "crash_recoveries",
+        "procs_spawned",
+        "procs_killed",
+        "procs_respawned",
+        "sweeps_during_soak",
+        "reads_ok",
+        "read_errors",
+        "lost_rows",
+        "duplicated_rows",
+        "expected_unique_keys",
+        "total_record_count",
+        "orphans_removed",
+        "leaked_file_count",
+    ]
+    row = {
+        "metric": "process-grain crash soak (2 writer + 1 reader OS processes, kill -9 at crash points + random)",
+        "mode": (
+            "full (journal recovery + CAS retries + orphan sweep)"
+            if full
+            else "seed (no retries, no recovery probe, no sweep)"
+        ),
+        **{k: report.get(k) for k in keep},
+    }
+    if full:
+        # the acceptance gate: >= 5 process kills survived with nothing lost
+        assert report["consistent"], report
+        assert report["procs_killed"] >= 5, report
+        assert report["lost_rows"] == 0 and report["duplicated_rows"] == 0, report
+        assert report["read_errors"] == 0, report
+        assert report["leaked_file_count"] == 0, report
+        assert report["total_record_count"] == report["expected_unique_keys"], report
+    else:
+        # the contrast gate: the same kill schedule demonstrably loses
+        # commits and/or leaks files without the recovery machinery
+        assert report["leaked_file_count"] > 0 or report["rounds_failed"] > 0, report
+        assert report["crash_recoveries"] == 0, report
+    return row
+
+
 def main():
     import jax
 
@@ -102,10 +184,20 @@ def main():
     ap.add_argument("--seed-duration", type=float, default=20.0, help="contrast run length")
     ap.add_argument("--fault-possibility", type=int, default=20, help="1/N ops fail (20 = 5%%)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-process", action="store_true", help="skip the process-grain rows")
+    ap.add_argument("--no-thread", action="store_true", help="skip the thread-soak rows")
     args = ap.parse_args()
     rows = []
-    for mode, dur in (("full", args.duration), ("seed", args.seed_duration)):
-        row = run_mode(mode, dur, args.fault_possibility, args.seed)
+    modes = []
+    if not args.no_thread:
+        modes += [("full", args.duration), ("seed", args.seed_duration)]
+    if not args.no_process:
+        modes += [("proc-full", args.duration), ("proc-seed", args.seed_duration)]
+    for mode, dur in modes:
+        if mode.startswith("proc"):
+            row = run_proc_mode(mode, dur, args.seed)
+        else:
+            row = run_mode(mode, dur, args.fault_possibility, args.seed)
         rows.append(row)
         print(json.dumps(row))
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results", "soak_bench.json")

@@ -77,6 +77,14 @@ class WriteBufferController:
         self._pending_flushes = 0
         self._throttled = 0
         self._rejected = 0
+        # total millis writers spent blocked in THIS controller's admission
+        # (the network servers report it per ingest surface — the global
+        # soak{backpressure_ms} histogram mixes every controller together)
+        self._backpressure_ms = 0.0
+        # REJECTING latch: a deadline reject happened and the buffer has not
+        # dropped below the stop trigger since — remote frontends should shed
+        # immediately instead of paying the block timeout themselves
+        self._rejecting = False
 
     # ---- construction ---------------------------------------------------
     @classmethod
@@ -130,6 +138,7 @@ class WriteBufferController:
                     if remaining <= 0:
                         g.counter("writes_rejected").inc()
                         self._rejected += 1
+                        self._rejecting = True
                         raise WriterBackpressureError(
                             f"write buffer full: {self._in_use}/{self.max_memory} bytes in "
                             f"use (stop trigger {self._soft}), {self._pending_flushes} "
@@ -139,13 +148,17 @@ class WriteBufferController:
                     self._cond.wait(remaining)
                 self._in_use += nbytes
             finally:
-                g.histogram("backpressure_ms").update((time.perf_counter() - t0) * 1000)
+                blocked_ms = (time.perf_counter() - t0) * 1000
+                self._backpressure_ms += blocked_ms
+                g.histogram("backpressure_ms").update(blocked_ms)
 
     def release(self, nbytes: int) -> None:
         if nbytes <= 0:
             return
         with self._cond:
             self._in_use = max(0, self._in_use - nbytes)
+            if self._in_use < self._soft or self._soft <= 0:
+                self._rejecting = False
             self._cond.notify_all()
 
     # ---- pending-flush depth cap ---------------------------------------
@@ -172,7 +185,9 @@ class WriteBufferController:
                 self._pending_flushes += 1
                 return True
             finally:
-                g.histogram("backpressure_ms").update((time.perf_counter() - t0) * 1000)
+                blocked_ms = (time.perf_counter() - t0) * 1000
+                self._backpressure_ms += blocked_ms
+                g.histogram("backpressure_ms").update(blocked_ms)
 
     def flush_end(self) -> None:
         with self._cond:
@@ -188,12 +203,27 @@ class WriteBufferController:
     def pending_flushes(self) -> int:
         return self._pending_flushes
 
-    def health(self) -> dict:
-        """Point-in-time flow-control surface (TableWrite.health embeds it)."""
+    def health_dict(self) -> dict:
+        """Point-in-time flow-control surface, JSON-serializable with a
+        STABLE schema: both network servers (KV + Flight), the soak
+        supervisors, and TableWrite.health() all report this exact shape, so
+        a remote ingest frontend can shed on `state` without caring which
+        surface answered. States: ok → throttling (at/over the stop
+        trigger — writers block bounded) → rejecting (a block deadline
+        expired and pressure has not released — shed immediately).
+        retry_after_ms is the server's backoff hint for a BUSY response,
+        derived from the admission state."""
         with self._cond:
             state = "ok"
             if self._in_use >= self._soft > 0:
-                state = "throttling"
+                state = "rejecting" if self._rejecting else "throttling"
+            retry_after = 0
+            if state == "throttling":
+                # half the block budget: pressure is draining, come back soon
+                retry_after = max(1, self.block_timeout_ms // 2)
+            elif state == "rejecting":
+                # a full block budget already failed once — back off hard
+                retry_after = self.block_timeout_ms
             return {
                 "state": state,
                 "buffered_bytes": self._in_use,
@@ -203,4 +233,10 @@ class WriteBufferController:
                 "max_pending_flushes": self.max_pending_flushes,
                 "writes_throttled": self._throttled,
                 "writes_rejected": self._rejected,
+                "backpressure_ms": round(self._backpressure_ms, 3),
+                "retry_after_ms": retry_after,
             }
+
+    # kept as an alias: PR-8 callers (TableWrite.health, the thread soak)
+    # predate the stable-schema rename
+    health = health_dict

@@ -273,6 +273,11 @@ class MergeTreeWriter:
         self._drain_flushes()
         if not self._buffer:
             return None
+        from ..resilience.faults import crash_point
+
+        # memtable full, nothing drained: a kill here loses only rows no
+        # commit ever acknowledged
+        crash_point("flush:before-dispatch")
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
         drained_bytes = self._buffered_bytes
         self._buffer.clear()
@@ -332,6 +337,11 @@ class MergeTreeWriter:
                     )
                 )
         files = self.writer_factory.write(merged, level=0, file_source="append")
+        from ..resilience.faults import crash_point
+
+        # level-0 files durable but referenced by no snapshot yet: a kill
+        # here strews orphan data files for remove_orphan_files to reclaim
+        crash_point("flush:files-written")
         self._new_files.extend(files)
         if self.compact_manager is not None and not self.options.write_only:
             for f in files:

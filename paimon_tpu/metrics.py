@@ -210,10 +210,17 @@ def soak_metrics() -> MetricGroup:
     APPEND phase), writes_throttled (admissions that blocked at the
     stop trigger or the pending-flush cap), writes_rejected (throttled
     writes that hit write.buffer.block-timeout and raised
-    WriterBackpressureError); gauges: read_p50_ms, read_p99_ms (snapshot
-    read latency percentiles, set by the soak harness); histogram:
-    backpressure_ms (time writers spent blocked in admission). Resolved per
-    call so registry.reset() in tests swaps the group out."""
+    WriterBackpressureError), procs_spawned / procs_killed /
+    procs_respawned (process-grain soak supervisor: writer/reader OS
+    processes started, kill -9'd at crash points or at random, and brought
+    back), crash_recoveries (respawned writers that resolved a landed-but-
+    unacked commit from the snapshot chain instead of replaying it),
+    shed_requests (ingest requests answered with a typed BUSY by a network
+    server while the writer was throttling/rejecting); gauges: read_p50_ms,
+    read_p99_ms (snapshot read latency percentiles, set by the soak
+    harness); histogram: backpressure_ms (time writers spent blocked in
+    admission). Resolved per call so registry.reset() in tests swaps the
+    group out."""
     return registry.group("soak")
 
 

@@ -445,6 +445,41 @@ class CoreOptions:
         "compaction, driving the commit-conflict re-plan path on shared "
         "buckets.",
     )
+    SOAK_PROCESS_DURATION = ConfigOption.duration(
+        "soak.process.duration",
+        "60 s",
+        "Process-grain crash soak (service.proc_soak): how long the "
+        "supervisor runs writer/reader OS processes (killing and respawning "
+        "them) before the drain, oracle fold, and final sweep/audit.",
+    )
+    SOAK_PROCESS_WRITERS = ConfigOption.int_(
+        "soak.process.writers",
+        2,
+        "Process-grain crash soak: number of concurrent writer OS processes "
+        "(each with its own intent/ack journal, sharing only the warehouse "
+        "filesystem).",
+    )
+    SOAK_PROCESS_READERS = ConfigOption.int_(
+        "soak.process.readers",
+        1,
+        "Process-grain crash soak: number of reader OS processes pinning and "
+        "scanning snapshots throughout the kill/respawn churn.",
+    )
+    SOAK_PROCESS_KILL_PERIOD = ConfigOption.duration(
+        "soak.process.kill-period",
+        "8 s",
+        "Process-grain crash soak: mean interval between random SIGKILLs of "
+        "writer processes (seeded; on top of the scripted "
+        "PAIMON_TPU_CRASH_POINT kills). 0 = scripted kills only.",
+    )
+    SOAK_PROCESS_SWEEP_PERIOD = ConfigOption.duration(
+        "soak.process.sweep-period",
+        "12 s",
+        "Process-grain crash soak: cadence of the supervisor's mid-soak "
+        "orphan sweep (threshold soak.process kill debris older than ~45 s; "
+        "a final sweep at threshold 0 runs after the drain regardless). "
+        "0 = final sweep only.",
+    )
     ORPHAN_CLEAN_OLDER_THAN = ConfigOption.duration(
         "orphan.clean.older-than",
         "1 d",

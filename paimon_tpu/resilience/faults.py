@@ -3,9 +3,25 @@
 Instrumented code calls `crash_point("commit:manifests-written")` at exact
 protocol steps; tests arm a point to kill the operation there (raise
 CrashError, simulating the process dying with no cleanup running beyond what
-an exception unwinds) or to run an arbitrary action at the point — the hook
-that lets a test deterministically interleave a competing commit between one
-committer's latest-snapshot read and its snapshot CAS.
+an exception unwinds), to HARD-KILL the whole process (`kill=True` →
+``os._exit(137)``, the process-grain death a SIGKILLed Flink task JVM dies —
+no exception unwinding, no finally blocks, no atexit, torn `.tmp` files and
+unflushed buffers left exactly where they were), or to run an arbitrary
+action at the point — the hook that lets a test deterministically interleave
+a competing commit between one committer's latest-snapshot read and its
+snapshot CAS.
+
+Env arming (the subprocess seam): ``PAIMON_TPU_CRASH_POINT`` is parsed when
+this module imports (and re-parseable via `arm_from_env`), so a supervisor
+can arm a crash in a child process it is about to spawn without any code
+handshake:
+
+    PAIMON_TPU_CRASH_POINT=<name>[:<nth>][:kill][,<spec>...]
+
+`nth` (default 1) is the 1-based hit that fires; `:kill` selects the
+hard-death mode (without it the point raises CrashError in-process). E.g.
+``commit:manifests-written:2:kill`` lets the first commit land and kills the
+process dead in the middle of the second.
 
 Crash-point map of the commit protocol (FileStoreCommit._try_commit):
 
@@ -20,7 +36,20 @@ Crash-point map of the commit protocol (FileStoreCommit._try_commit):
   commit:snapshot-committed  the snapshot CAS succeeded; hints not yet
                              written. Crash leaves a fully-visible commit —
                              replaying the committable must be filtered out
-                             by filter_committed (idempotence contract).
+                             by filter_committed (idempotence contract), and
+                             a journaling writer must resolve the lost ack
+                             from the snapshot chain (find_landed_append).
+
+Writer-side points (MergeTreeWriter, the flush/encode pipeline):
+
+  flush:before-dispatch      the memtable is full but not yet drained; no
+                             merge dispatched, no file written. Crash loses
+                             only unacknowledged buffered rows.
+  flush:files-written        the flushed level-0 data files are durable on
+                             disk but referenced by no snapshot (the commit
+                             that would reference them never ran). Crash
+                             leaves orphan data files; remove_orphan_files
+                             reclaims them.
 
 Unarmed points are a dict lookup on a module-level map — zero cost in
 production paths.
@@ -28,11 +57,22 @@ production paths.
 
 from __future__ import annotations
 
+import os
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable
 
-__all__ = ["CrashError", "crash_point", "arm_crash_point", "disarm_crash_points", "COMMIT_CRASH_POINTS"]
+__all__ = [
+    "CrashError",
+    "crash_point",
+    "arm_crash_point",
+    "arm_from_env",
+    "disarm_crash_points",
+    "COMMIT_CRASH_POINTS",
+    "WRITER_CRASH_POINTS",
+    "ALL_CRASH_POINTS",
+    "KILL_EXIT_CODE",
+]
 
 # the canonical points instrumented in core/commit.py (tests iterate this)
 COMMIT_CRASH_POINTS = (
@@ -40,6 +80,19 @@ COMMIT_CRASH_POINTS = (
     "commit:manifests-written",
     "commit:snapshot-committed",
 )
+
+# the writer-side points instrumented in core/writer.py
+WRITER_CRASH_POINTS = (
+    "flush:before-dispatch",
+    "flush:files-written",
+)
+
+ALL_CRASH_POINTS = COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS
+
+# 128 + SIGKILL: a hard death at a crash point reports like a kill -9 victim
+KILL_EXIT_CODE = 137
+
+ENV_VAR = "PAIMON_TPU_CRASH_POINT"
 
 
 class CrashError(BaseException):
@@ -59,6 +112,7 @@ class _Armed:
     skip: int = 0  # let this many hits pass before acting
     count: int = 1  # act on this many hits after the skip (<=0 = forever)
     action: Callable[[], None] | None = None  # None = raise CrashError
+    kill: bool = False  # hard death: os._exit, no unwinding at all
     hits: int = 0
     fired: int = 0
 
@@ -72,13 +126,15 @@ def arm_crash_point(
     skip: int = 0,
     count: int = 1,
     action: Callable[[], None] | None = None,
+    kill: bool = False,
 ) -> None:
     """Arm `name`: after `skip` passes, the next `count` hits either raise
-    CrashError (action=None) or run `action()` at the point (the action may
+    CrashError (action=None), hard-kill the process (kill=True — use only in
+    a subprocess you own!), or run `action()` at the point (the action may
     itself raise to crash, or just mutate the world — e.g. land a competing
     commit — and return to let the operation continue)."""
     with _lock:
-        _armed[name] = _Armed(skip=skip, count=count, action=action)
+        _armed[name] = _Armed(skip=skip, count=count, action=action, kill=kill)
 
 
 def disarm_crash_points(*names: str) -> None:
@@ -89,6 +145,37 @@ def disarm_crash_points(*names: str) -> None:
                 _armed.pop(n, None)
         else:
             _armed.clear()
+
+
+def _parse_spec(spec: str) -> tuple[str, int, bool]:
+    """'<name>[:<nth>][:kill]' — name itself contains colons, so nth/kill
+    are peeled off the right."""
+    spec = spec.strip()
+    kill = False
+    if spec.endswith(":kill"):
+        kill = True
+        spec = spec[: -len(":kill")]
+    name, _, nth = spec.rpartition(":")
+    if name and nth.isdigit():
+        return name, int(nth), kill
+    return spec, 1, kill
+
+
+def arm_from_env(value: str | None = None) -> list[str]:
+    """Arm crash points from the PAIMON_TPU_CRASH_POINT spec (or an explicit
+    `value`). Returns the armed point names. Called at module import so a
+    freshly spawned subprocess is armed before any table code runs."""
+    spec = os.environ.get(ENV_VAR) if value is None else value
+    if not spec:
+        return []
+    armed = []
+    for item in spec.split(","):
+        if not item.strip():
+            continue
+        name, nth, kill = _parse_spec(item)
+        arm_crash_point(name, skip=nth - 1, count=1, kill=kill)
+        armed.append(name)
+    return armed
 
 
 def crash_point(name: str) -> None:
@@ -106,6 +193,15 @@ def crash_point(name: str) -> None:
             return
         st.fired += 1
         action = st.action
+        kill = st.kill
+    if kill:
+        # a real process death: no exception unwinding, no cleanup, no
+        # atexit — buffered file contents and tmp siblings stay torn
+        os._exit(KILL_EXIT_CODE)
     if action is None:
         raise CrashError(name)
     action()
+
+
+# subprocess seam: a supervisor arms its children via the environment
+arm_from_env()

@@ -10,7 +10,14 @@ on the filesystem like the reference's ServiceManager address files.
 Wire format (both directions): 4-byte big-endian length + UTF-8 JSON.
 Request:  {"id": n, "method": "lookup", "partition": [...], "key": [...]}
           {"id": n, "method": "refresh"} | {"id": n, "method": "ping"}
+          {"id": n, "method": "health"}
 Response: {"id": n, "ok": true, "row": [...] | null} | {"id": n, "ok": false, "error": "..."}
+
+`health` surfaces the writer admission controller's flow-control state
+(core.admission.WriteBufferController.health_dict — the same stable schema
+the Flight server and the soak supervisors report), so a remote ingest
+frontend colocated with this query service can shed load the moment the
+writer side is THROTTLING/REJECTING instead of discovering it by timeout.
 """
 
 from __future__ import annotations
@@ -80,14 +87,27 @@ class ServiceManager:
 
 
 class KvQueryServer:
-    def __init__(self, table: "FileStoreTable", host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        table: "FileStoreTable",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        health_provider=None,
+    ):
+        """`health_provider`: an optional zero-arg callable returning the
+        flow-control dict to serve on the `health` method — typically
+        `TableWrite.health` or `WriteBufferController.health_dict` of the
+        ingest job colocated with this server. Without one the server
+        reports a permanently-ok placeholder (it serves reads only)."""
         from ..table.query import LocalTableQuery
 
         self.table = table
         self.query = LocalTableQuery(table)
+        self.health_provider = health_provider
         self._lock = threading.Lock()
         query = self.query
         lock = self._lock
+        outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -100,6 +120,13 @@ class KvQueryServer:
                         method = req["method"]
                         if method == "ping":
                             _send(self.request, {"id": rid, "ok": True})
+                        elif method == "health":
+                            h = (
+                                outer.health_provider()
+                                if outer.health_provider is not None
+                                else {"state": "ok"}
+                            )
+                            _send(self.request, {"id": rid, "ok": True, "health": h})
                         elif method == "refresh":
                             with lock:
                                 query.refresh()
@@ -163,6 +190,12 @@ class KvQueryClient:
 
     def ping(self) -> bool:
         return self._call("ping")["ok"]
+
+    def health(self) -> dict:
+        """The server's writer flow-control state (admission health_dict
+        schema): callers shed/back off on state != 'ok' instead of timing
+        out against a saturated writer."""
+        return self._call("health")["health"]
 
     def refresh(self) -> None:
         self._call("refresh")

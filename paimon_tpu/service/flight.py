@@ -10,6 +10,20 @@ paimon-hive-connector-common PaimonInputFormat (splits as engine splits),
 flink/source/FlinkSourceBuilder (scan topology), service/ KvQueryServer
 (this repo's JSON-over-TCP service — Flight is its columnar sibling).
 
+Ingest + load shedding (the write half). ``do_put`` streams record batches
+into a table through the real TableWrite/commit path, sharing one
+WriteBufferController per table so every remote ingest stream competes for
+the same admission budget as local writers. When the controller is
+THROTTLING/REJECTING the server answers a TYPED busy signal instead of
+letting the stream block into a timeout: a FlightUnavailableError whose
+message carries a ``BUSY{...}`` JSON payload with the admission state and a
+``retry_after_ms`` hint derived from it. ``do_action("health")`` serves the
+same `health_dict` schema as the KV server's `health` method, so a frontend
+can poll before streaming at all. ``flight_put`` is the client-side
+shed-and-backoff wrapper: it parses the BUSY payload, sleeps the hinted
+backoff, and retries — a remote frontend degrades gracefully under writer
+saturation rather than piling retries onto a saturated writer.
+
 The server mounts a catalog root (warehouse path): descriptors are
 ``db.table`` paths.  Tickets are self-contained JSON so endpoints can be
 fetched from any worker, in any order, in parallel.
@@ -18,18 +32,52 @@ fetched from any worker, in any order, in parallel.
 from __future__ import annotations
 
 import json
+import re
+import threading
+import time
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
     from ..table import FileStoreTable
 
-__all__ = ["PaimonFlightServer", "flight_scan"]
+__all__ = [
+    "PaimonFlightServer",
+    "flight_scan",
+    "flight_put",
+    "flight_health",
+    "FlightBusyError",
+]
+
+# the BUSY payload is flat JSON (no nested braces); non-greedy because gRPC
+# appends client-context text after the server message
+_BUSY_RE = re.compile(r"BUSY(\{.*?\})")
 
 
 def _require_flight():
     import pyarrow.flight as flight
 
     return flight
+
+
+class FlightBusyError(RuntimeError):
+    """The server shed this request with a typed BUSY (writer admission is
+    throttling/rejecting). Carries the server's flow-control snapshot and
+    its retry-after hint — the client-side twin of WriterBackpressureError."""
+
+    def __init__(self, payload: dict):
+        super().__init__(f"ingest shed by server: {payload}")
+        self.payload = payload
+        self.retry_after_ms = int(payload.get("retry_after_ms", 0))
+
+
+def _parse_busy(exc: BaseException) -> dict | None:
+    m = _BUSY_RE.search(str(exc))
+    if not m:
+        return None
+    try:
+        return json.loads(m.group(1))
+    except json.JSONDecodeError:
+        return {"busy": True, "retry_after_ms": 0}
 
 
 class PaimonFlightServer:
@@ -39,9 +87,20 @@ class PaimonFlightServer:
         location = srv.start()          # grpc://127.0.0.1:<port>
         ...
         srv.shutdown()
-    """
 
-    def __init__(self, warehouse: str, host: str = "127.0.0.1", port: int = 0):
+    `ingest_controller`: optional WriteBufferController shared by every
+    do_put stream (a test or an embedding service injects one to couple the
+    Flight surface to its own writers' budget). Without it each table gets
+    a controller from its own `write.buffer.*` options (None when unset —
+    admission off, never BUSY)."""
+
+    def __init__(
+        self,
+        warehouse: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ingest_controller=None,
+    ):
         flight = _require_flight()
         outer = self
 
@@ -81,8 +140,31 @@ class PaimonFlightServer:
                 reader = record_batch_reader(t, projection=req.get("projection"), splits=splits)
                 return flight.RecordBatchStream(reader)
 
+            def do_put(self, context, descriptor, reader, writer):
+                outer._do_put(flight, descriptor, reader)
+
+            # -- control plane --------------------------------------------
+            def list_actions(self, context):
+                return [
+                    ("health", "writer flow-control state (admission health_dict schema); body = db.table"),
+                    ("ping", "liveness"),
+                ]
+
+            def do_action(self, context, action):
+                if action.type == "ping":
+                    return [flight.Result(b"{}")]
+                if action.type == "health":
+                    ident = action.body.to_pybytes().decode() if action.body else ""
+                    return [
+                        flight.Result(json.dumps(outer._health(ident)).encode())
+                    ]
+                raise KeyError(f"unknown action {action.type!r}")
+
         self.warehouse = warehouse
         self._host = host
+        self._ingest_controller = ingest_controller
+        self._controllers: dict[str, object] = {}
+        self._ctl_lock = threading.Lock()
         self._server = _Server()
         self._thread = None
         self._cat = None
@@ -119,6 +201,69 @@ class PaimonFlightServer:
         total = sum(s.row_count for s in splits)
         return flight.FlightInfo(arrow_schema(t.row_type), descriptor, endpoints, total, -1)
 
+    # ---- ingest / flow control -----------------------------------------
+    def _controller(self, ident: str, table: "FileStoreTable"):
+        if self._ingest_controller is not None:
+            return self._ingest_controller
+        with self._ctl_lock:
+            if ident not in self._controllers:
+                from ..core.admission import WriteBufferController
+
+                self._controllers[ident] = WriteBufferController.from_options(table.store.options)
+            return self._controllers[ident]
+
+    def _health(self, ident: str) -> dict:
+        if not ident:
+            if self._ingest_controller is not None:
+                return self._ingest_controller.health_dict()
+            return {"state": "ok"}
+        table = self._table(ident)
+        ctrl = self._controller(ident, table)
+        return ctrl.health_dict() if ctrl is not None else {"state": "ok"}
+
+    def _shed(self, flight, health: dict):
+        """Answer BUSY: a typed, parseable unavailability — never a timeout."""
+        from ..metrics import soak_metrics
+
+        soak_metrics().counter("shed_requests").inc()
+        payload = {
+            "busy": True,
+            "state": health.get("state"),
+            "buffered_bytes": health.get("buffered_bytes"),
+            "pending_flushes": health.get("pending_flushes"),
+            "retry_after_ms": health.get("retry_after_ms", 0),
+        }
+        raise flight.FlightUnavailableError("BUSY" + json.dumps(payload))
+
+    def _do_put(self, flight, descriptor, reader) -> None:
+        from ..core.admission import WriterBackpressureError
+        from ..data.batch import ColumnBatch
+        from ..table.write import TableWrite
+
+        ident = descriptor.path[0].decode()
+        table = self._table(ident)
+        ctrl = self._controller(ident, table)
+        if ctrl is not None:
+            health = ctrl.health_dict()
+            if health["state"] != "ok":
+                # shed BEFORE reading the stream: the client learns now, not
+                # after shipping every byte into a saturated writer
+                self._shed(flight, health)
+        try:
+            data = reader.read_all()
+            tw = TableWrite(table, buffer_controller=ctrl)
+            try:
+                batch = ColumnBatch.from_arrow(data, table.row_type)
+                tw.write(batch)
+                msgs = tw.prepare_commit()
+            finally:
+                tw.close()
+            table.new_batch_write_builder().new_commit().commit(msgs)
+        except WriterBackpressureError:
+            # admission rejected mid-stream: nothing was buffered for the
+            # rejected batch — same typed signal, client may replay
+            self._shed(flight, ctrl.health_dict() if ctrl is not None else {"state": "rejecting"})
+
     # ---- lifecycle ------------------------------------------------------
     @property
     def location(self) -> str:
@@ -153,5 +298,64 @@ def flight_scan(location: str, ident: str):
         for ep in info.endpoints:
             tables.append(client.do_get(ep.ticket).read_all())
         return pa.concat_tables(tables) if tables else info.schema.empty_table()
+    finally:
+        client.close()
+
+
+def flight_health(location: str, ident: str = "") -> dict:
+    """Poll the server's writer flow-control state (health_dict schema)."""
+    flight = _require_flight()
+    client = flight.connect(location)
+    try:
+        results = list(client.do_action(flight.Action("health", ident.encode())))
+        return json.loads(results[0].body.to_pybytes())
+    finally:
+        client.close()
+
+
+def flight_put(
+    location: str,
+    ident: str,
+    data,
+    max_retries: int = 8,
+    max_backoff_ms: int = 2_000,
+) -> dict:
+    """Shed-aware ingest: stream `data` (a pyarrow Table) into the remote
+    table, honoring the server's typed BUSY responses — parse the payload,
+    back off `retry_after_ms` (capped), retry. Raises FlightBusyError after
+    `max_retries` sheds, so the caller's failure mode under sustained writer
+    saturation is an explicit typed signal, never a timeout. Returns
+    {"attempts", "sheds", "rows", "backoff_ms"}."""
+    flight = _require_flight()
+    client = flight.connect(location)
+    sheds = 0
+    total_backoff = 0.0
+    try:
+        for attempt in range(1, max_retries + 2):
+            try:
+                writer, meta = client.do_put(
+                    flight.FlightDescriptor.for_path(ident.encode()), data.schema
+                )
+                try:
+                    writer.write_table(data)
+                finally:
+                    writer.close()
+                return {
+                    "attempts": attempt,
+                    "sheds": sheds,
+                    "rows": data.num_rows,
+                    "backoff_ms": round(total_backoff, 1),
+                }
+            except Exception as exc:  # noqa: BLE001 — only BUSY is retried
+                payload = _parse_busy(exc)
+                if payload is None:
+                    raise
+                sheds += 1
+                if attempt > max_retries:
+                    raise FlightBusyError(payload) from exc
+                backoff = min(int(payload.get("retry_after_ms") or 50), max_backoff_ms)
+                total_backoff += backoff
+                time.sleep(backoff / 1000.0)
+        raise AssertionError("unreachable")
     finally:
         client.close()

@@ -1,0 +1,843 @@
+"""Process-grain crash soak: kill -9 fault injection across OS processes.
+
+The thread soak (service/soak.py) proves the resilience stack composes under
+concurrent load — but a thread "crash" is a raised exception with intact
+process state. The failure grain production traffic actually sees is a whole
+TASK PROCESS dying mid-protocol: a SIGKILLed Flink/Spark JVM vanishes holding
+buffered memtables, an in-flight offloaded flush, and half-written manifests,
+and runs no cleanup at all. This harness reproduces exactly that:
+
+  supervisor (this process)
+  ├── writer-0  (OS process)  ── intent/ack journal-0 ──┐
+  ├── writer-1  (OS process)  ── intent/ack journal-1 ──┤  shared warehouse
+  ├── reader-0  (OS process)  ── read log ──────────────┤  filesystem only
+  └── periodic orphan sweep + kill/respawn scheduling ──┘
+
+Journal/oracle protocol. A writer process appends an INTENT record (round
+identifier + the exact row set) to its own append-only journal and fsyncs it
+BEFORE committing; after the commit lands it appends an ACK with the snapshot
+id. The journal is the only state that survives the writer's death, and is
+torn-tail tolerant (a kill can sever the last line). The truth about whether
+a round landed is the SNAPSHOT CHAIN, not the journal: a writer killed at
+`commit:snapshot-committed` dies after the CAS but before the ACK, so on
+respawn (and again at final verification) every intent without an ACK is
+resolved against the chain (`find_landed_append` — the same landed-snapshot
+probe the thread soak uses in-thread). The end-of-soak oracle fold is the
+union of landed rounds in snapshot-id order, and the final scan must equal
+it exactly: no lost rows, no duplicated rows, `total_record_count` == unique
+keys (a double-applied replay cannot hide), and the post-sweep disk file set
+must equal the reachable closure (independent walk).
+
+Crash injection. The supervisor arms children through the environment
+(`PAIMON_TPU_CRASH_POINT=<point>:<nth>:kill` — resilience/faults.py): the
+child really dies with `os._exit` mid-commit or mid-flush, leaving torn
+`.tmp` siblings, orphaned manifests, and unreferenced level-0 files behind.
+On top of the scripted kills a seeded timer SIGKILLs random writers. Every
+death is respawned until the deadline; the respawned incarnation resumes
+from its journal (next identifier, next key, landed update keys) — the
+cross-process recovery the commit protocol promises but PR 8 never proved.
+
+Run directly:  python -m paimon_tpu.service.proc_soak [base_dir] [flags]
+Child roles:   python -m paimon_tpu.service.proc_soak writer|reader ...
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import traceback
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .soak import KEYSPACE, SCHEMA, find_landed_append, sweep_and_audit
+
+__all__ = [
+    "ProcSoakConfig",
+    "WriterJournal",
+    "ProcSoakSupervisor",
+    "run_proc_soak",
+    "DEFAULT_SCRIPTED_KILLS",
+]
+
+# one kill per writer spawn while specs last, covering every commit-protocol
+# point plus both writer-side flush points (nth >= 2 so each incarnation
+# lands at least one commit before dying mid-operation)
+DEFAULT_SCRIPTED_KILLS = (
+    "commit:manifests-written:2:kill",
+    "commit:snapshot-committed:2:kill",
+    "flush:files-written:3:kill",
+    "commit:before-manifests:2:kill",
+    "flush:before-dispatch:2:kill",
+)
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+class WriterJournal:
+    """Append-only intent/ack log, fsynced per record, torn-tail tolerant.
+
+    Record kinds:
+      intent     {"t":"intent","ident":i,"fresh":[start,n],"rows":{k:v}}
+                 written (and fsynced) BEFORE the commit attempt
+      ack        {"t":"ack","ident":i,"sid":s}   the commit landed at s
+      recovered  {"t":"recovered","ident":i,"sid":s}  a respawned process
+                 resolved a landed-but-unacked round from the snapshot chain
+      abort      {"t":"abort","ident":i}  the round verifiably did not land
+                 (shed by backpressure, or probe-negative after a failure)
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fd: int | None = None
+
+    def open(self) -> "WriterJournal":
+        self._fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        return self
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def _append(self, obj: dict) -> None:
+        assert self._fd is not None, "journal not open"
+        os.write(self._fd, (json.dumps(obj, separators=(",", ":")) + "\n").encode())
+        # the fsync is the protocol: the intent must be durable before the
+        # commit it describes can possibly land
+        os.fsync(self._fd)
+
+    def intent(self, ident: int, fresh_start: int, n_fresh: int, rows: dict) -> None:
+        self._append(
+            {
+                "t": "intent",
+                "ident": ident,
+                "fresh": [fresh_start, n_fresh],
+                "rows": {str(k): v for k, v in rows.items()},
+            }
+        )
+
+    def ack(self, ident: int, sid: int) -> None:
+        self._append({"t": "ack", "ident": ident, "sid": sid})
+
+    def recovered(self, ident: int, sid: int) -> None:
+        self._append({"t": "recovered", "ident": ident, "sid": sid})
+
+    def abort(self, ident: int) -> None:
+        self._append({"t": "abort", "ident": ident})
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse the journal; a torn final line (the writer died mid-append)
+        is dropped — its round resolves through the snapshot-chain probe."""
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path, "rb") as f:
+            for line in f.read().split(b"\n"):
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break  # torn tail: nothing after it can be trusted
+        return out
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+@dataclass
+class ProcSoakConfig:
+    duration_s: float = 60.0
+    writers: int = 2
+    readers: int = 1
+    buckets: int = 4
+    seed: int = 0
+    rows_per_commit: int = 300
+    write_chunk_rows: int = 150
+    update_fraction: float = 0.3
+    compact_every: int = 5  # full-compact every Nth commit per writer
+    # crash injection: one scripted spec per writer spawn while they last,
+    # then a seeded random SIGKILL timer
+    scripted_kills: tuple = DEFAULT_SCRIPTED_KILLS
+    kill_period_s: float = 8.0  # mean seconds between random kills (0 = scripted only)
+    sweep_period_s: float = 12.0  # periodic orphan sweep cadence (0 = final only)
+    sweep_older_than_ms: int = 45_000  # an in-flight round's files must survive
+    # flow control inside each writer process
+    max_memory: int = 256 * 1024
+    block_timeout_ms: int = 20_000
+    # False = seed contrast: no CAS retries, no recovery probe in writers,
+    # no orphan sweep (audit only) — demonstrably loses commits / leaks files
+    resilient: bool = True
+    table_options: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_table_options(cls, options) -> "ProcSoakConfig":
+        from ..options import CoreOptions
+
+        o = options.options
+        return cls(
+            duration_s=o.get(CoreOptions.SOAK_PROCESS_DURATION) / 1000.0,
+            writers=o.get(CoreOptions.SOAK_PROCESS_WRITERS),
+            readers=o.get(CoreOptions.SOAK_PROCESS_READERS),
+            kill_period_s=o.get(CoreOptions.SOAK_PROCESS_KILL_PERIOD) / 1000.0,
+            sweep_period_s=o.get(CoreOptions.SOAK_PROCESS_SWEEP_PERIOD) / 1000.0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# child process: writer
+# ---------------------------------------------------------------------------
+def writer_main(args) -> int:
+    from ..core.admission import WriteBufferController, WriterBackpressureError
+    from ..core.commit import CommitConflictError, CommitGiveUpError
+    from ..core.manifest import ManifestCommittable
+    from ..data.batch import ColumnBatch
+    from ..table import load_table
+    from ..table.write import TableWrite
+
+    wid = args.wid
+    user = f"psoak-w{wid}"
+    rng = np.random.default_rng(args.seed * 7919 + wid * 104729 + args.incarnation)
+    events = WriterJournal.read(args.journal)
+    intents = [e for e in events if e["t"] == "intent"]
+    resolved = {e["ident"] for e in events if e["t"] in ("ack", "recovered", "abort")}
+    acked = {e["ident"] for e in events if e["t"] in ("ack", "recovered")}
+    next_ident = max((e["ident"] for e in intents), default=0) + 1
+    # fresh keys advance past every intent, landed or not: a key is never
+    # reused for a different round, so the fold is unambiguous
+    next_key = max((e["fresh"][0] + e["fresh"][1] for e in intents), default=0)
+    landed_keys = [int(k) for e in intents if e["ident"] in acked for k in e["rows"]]
+
+    table = load_table(args.table, commit_user=user)
+    store = table.store
+    journal = WriterJournal(args.journal).open()
+
+    # ---- cross-process crash recovery ----------------------------------
+    # the previous incarnation died holding intents with no ack: the
+    # snapshot chain (not the exception we never saw) says whether they
+    # landed. Resolving BEFORE writing anything new keeps the journal a
+    # prefix-complete account of this writer's rounds.
+    recovered = 0
+    for e in intents:
+        if e["ident"] in resolved:
+            continue
+        sid = find_landed_append(store, user, e["ident"]) if args.resilient else None
+        if sid is not None:
+            journal.recovered(e["ident"], sid)
+            landed_keys.extend(int(k) for k in e["rows"])
+            recovered += 1
+        else:
+            journal.abort(e["ident"])
+    if recovered:
+        print(f"writer {wid} incarnation {args.incarnation}: recovered {recovered} landed-unacked round(s)", flush=True)
+
+    ctrl = None
+    if args.max_memory > 0:
+        ctrl = WriteBufferController(
+            args.max_memory,
+            stop_trigger=0.6,
+            block_timeout_ms=args.block_timeout_ms,
+            max_pending_flushes=2,
+        )
+
+    rounds = 0
+    while rounds < args.max_rounds and not os.path.exists(args.stop_file):
+        ident = next_ident
+        next_ident += 1
+        rounds += 1
+        n_upd = int(args.rows_per_commit * args.update_fraction) if landed_keys else 0
+        n_new = args.rows_per_commit - n_upd
+        fresh = [wid * KEYSPACE + next_key + i for i in range(n_new)]
+        upd = (
+            [landed_keys[i] for i in rng.integers(0, len(landed_keys), n_upd)] if n_upd else []
+        )
+        keys = fresh + upd
+        vals = (ident * 1_000.0 + wid) + rng.random(len(keys))
+        rows = dict(zip(keys, [float(v) for v in vals]))  # unique keys per round
+        journal.intent(ident, next_key, n_new, rows)
+        next_key += n_new
+        try:
+            tw = TableWrite(table, buffer_controller=ctrl)
+            try:
+                ks = list(rows)
+                vs = [rows[k] for k in ks]
+                for i in range(0, len(ks), args.chunk_rows):
+                    tw.write(
+                        ColumnBatch.from_pydict(
+                            SCHEMA, {"k": ks[i : i + args.chunk_rows], "v": vs[i : i + args.chunk_rows]}
+                        )
+                    )
+                if args.compact_every and ident % args.compact_every == 0:
+                    tw.compact(full=True)
+                msgs = tw.prepare_commit()
+            finally:
+                tw.close()
+            sids = store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+            if sids:
+                journal.ack(ident, sids[0])
+                landed_keys.extend(fresh)
+            else:
+                journal.abort(ident)
+        except WriterBackpressureError:
+            # shed: rejected before any byte buffered — verifiably not landed
+            journal.abort(ident)
+        except (CommitConflictError, CommitGiveUpError):
+            # the COMPACT half lost a cross-process race (or, seed mode, the
+            # first CAS loss aborted) — the APPEND half may still have landed
+            sid = find_landed_append(store, user, ident) if args.resilient else None
+            if sid is not None:
+                journal.ack(ident, sid)
+                landed_keys.extend(fresh)
+            else:
+                journal.abort(ident)
+    journal.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# child process: reader
+# ---------------------------------------------------------------------------
+def reader_main(args) -> int:
+    from ..table import load_table
+
+    table = load_table(args.table, commit_user=f"psoak-r{args.rid}")
+    sm = table.store.snapshot_manager
+    ok = errors = 0
+    with open(args.log, "a", buffering=1) as log:
+        while not os.path.exists(args.stop_file):
+            try:
+                sid = sm.latest_snapshot_id()
+            except Exception:
+                sid = None
+            if sid is None:
+                time.sleep(0.05)
+                continue
+            try:
+                t = table.copy({"scan.snapshot-id": str(sid)})
+                rb = t.new_read_builder()
+                batch = rb.new_read().read_all(rb.new_scan().plan())
+                ks = batch.column("k").values.tolist()
+                if len(ks) != len(set(ks)):
+                    errors += 1
+                    log.write(json.dumps({"t": "dup-keys", "sid": sid, "rows": len(ks)}) + "\n")
+                else:
+                    ok += 1
+            except Exception as exc:  # noqa: BLE001 — every pinned-read error is a finding
+                errors += 1
+                log.write(json.dumps({"t": "err", "sid": sid, "exc": repr(exc)}) + "\n")
+            time.sleep(0.02)
+        log.write(json.dumps({"t": "done", "reads_ok": ok, "read_errors": errors}) + "\n")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+class ProcSoakSupervisor:
+    def __init__(self, base_dir: str, cfg: ProcSoakConfig | None = None):
+        self.cfg = cfg or ProcSoakConfig()
+        self.base_dir = str(base_dir)
+        self.table_root = os.path.join(self.base_dir, "proc_soak_table")
+        # journals/logs live OUTSIDE the table root: the end-of-soak disk
+        # audit walks the table root and must only ever see table files
+        self.run_dir = os.path.join(self.base_dir, "proc_soak_run")
+        self.stop_file = os.path.join(self.run_dir, "stop")
+        self.errors: list[str] = []
+        self.inconsistencies: list[dict] = []
+        self.counts = {
+            "procs_spawned": 0,
+            "procs_killed": 0,
+            "procs_respawned": 0,
+            "writer_errors": 0,
+            "sweeps_during_soak": 0,
+        }
+        self._kill_cursor = 0
+        self._incarnations: dict[tuple, int] = {}
+
+    # ---- setup ---------------------------------------------------------
+    def _table_options(self) -> dict:
+        cfg = self.cfg
+        opts = {
+            "bucket": str(cfg.buckets),
+            # small memtables force real flushes (and the offloaded flush
+            # worker) inside every writer process
+            "write-buffer-rows": str(max(cfg.write_chunk_rows * 2, 64)),
+            "commit.retry-backoff": "2 ms",
+        }
+        if cfg.resilient:
+            opts["commit.max-retries"] = "30"
+        else:
+            # the seed contrast: the first CAS loss aborts the round
+            opts.update({"commit.max-retries": "0", "fs.retry.max-attempts": "1"})
+        opts.update(cfg.table_options)
+        return opts
+
+    def setup(self):
+        from ..core.schema import SchemaManager
+        from ..fs import get_file_io
+
+        os.makedirs(self.run_dir, exist_ok=True)
+        io = get_file_io(self.table_root)
+        SchemaManager(io, self.table_root).create_table(
+            SCHEMA, primary_keys=["k"], options=self._table_options()
+        )
+
+    def _fresh_table(self):
+        from ..table import load_table
+
+        return load_table(self.table_root, commit_user="psoak-supervisor")
+
+    # ---- child process plumbing ----------------------------------------
+    def _child_env(self, crash_spec: str | None) -> dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PAIMON_TPU_CRASH_POINT", None)
+        if crash_spec:
+            env["PAIMON_TPU_CRASH_POINT"] = crash_spec
+        # the package must resolve in the child no matter where the
+        # supervisor was launched from
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        return env
+
+    def _spawn_writer(self, wid: int) -> subprocess.Popen:
+        from ..metrics import soak_metrics
+
+        cfg = self.cfg
+        crash_spec = None
+        if self._kill_cursor < len(cfg.scripted_kills):
+            crash_spec = cfg.scripted_kills[self._kill_cursor]
+            self._kill_cursor += 1
+        inc = self._incarnations.get(("w", wid), 0)
+        self._incarnations[("w", wid)] = inc + 1
+        log = open(os.path.join(self.run_dir, f"writer-{wid}.{inc}.log"), "wb")
+        cmd = [
+            sys.executable,
+            "-m",
+            "paimon_tpu.service.proc_soak",
+            "writer",
+            "--table", self.table_root,
+            "--wid", str(wid),
+            "--journal", os.path.join(self.run_dir, f"journal-{wid}.jsonl"),
+            "--stop-file", self.stop_file,
+            "--seed", str(cfg.seed),
+            "--incarnation", str(inc),
+            "--rows-per-commit", str(cfg.rows_per_commit),
+            "--chunk-rows", str(cfg.write_chunk_rows),
+            "--update-fraction", str(cfg.update_fraction),
+            "--compact-every", str(cfg.compact_every),
+            "--max-memory", str(cfg.max_memory),
+            "--block-timeout-ms", str(cfg.block_timeout_ms),
+        ]
+        if not cfg.resilient:
+            cmd.append("--seed-mode")
+        p = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=self._child_env(crash_spec)
+        )
+        log.close()  # the child holds the fd
+        self.counts["procs_spawned"] += 1
+        soak_metrics().counter("procs_spawned").inc()
+        return p
+
+    def _spawn_reader(self, rid: int) -> subprocess.Popen:
+        from ..metrics import soak_metrics
+
+        inc = self._incarnations.get(("r", rid), 0)
+        self._incarnations[("r", rid)] = inc + 1
+        log = open(os.path.join(self.run_dir, f"reader-{rid}.{inc}.log"), "wb")
+        cmd = [
+            sys.executable,
+            "-m",
+            "paimon_tpu.service.proc_soak",
+            "reader",
+            "--table", self.table_root,
+            "--rid", str(rid),
+            "--log", os.path.join(self.run_dir, f"reads-{rid}.jsonl"),
+            "--stop-file", self.stop_file,
+        ]
+        p = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT, env=self._child_env(None))
+        log.close()
+        self.counts["procs_spawned"] += 1
+        soak_metrics().counter("procs_spawned").inc()
+        return p
+
+    def _reap(self, role: str, idx: int, rc: int) -> None:
+        from ..metrics import soak_metrics
+        from ..resilience.faults import KILL_EXIT_CODE
+
+        if rc == KILL_EXIT_CODE or rc < 0:
+            # armed crash-point death (os._exit 137) or supervisor SIGKILL
+            self.counts["procs_killed"] += 1
+            soak_metrics().counter("procs_killed").inc()
+        elif rc != 0:
+            self.counts["writer_errors"] += 1
+            tail = ""
+            inc = self._incarnations.get((role[0], idx), 1) - 1
+            log = os.path.join(self.run_dir, f"{role}-{idx}.{inc}.log")
+            if os.path.exists(log):
+                with open(log, "rb") as f:
+                    tail = f.read()[-2000:].decode(errors="replace")
+            self.errors.append(f"{role} {idx} exited rc={rc}:\n{tail}")
+
+    # ---- run -----------------------------------------------------------
+    def run(self) -> dict:
+        from ..metrics import soak_metrics
+        from ..resilience.orphan import remove_orphan_files
+
+        cfg = self.cfg
+        g = soak_metrics()
+        if not os.path.exists(self.table_root):
+            self.setup()
+        rng = np.random.default_rng(cfg.seed * 31 + 17)
+        t_start = time.monotonic()
+        deadline = t_start + cfg.duration_s
+        writers = {w: self._spawn_writer(w) for w in range(cfg.writers)}
+        readers = {r: self._spawn_reader(r) for r in range(cfg.readers)}
+        next_kill = (
+            t_start + float(rng.uniform(0.5, 1.5)) * cfg.kill_period_s
+            if cfg.kill_period_s > 0
+            else float("inf")
+        )
+        next_sweep = (
+            t_start + cfg.sweep_period_s
+            if (cfg.sweep_period_s > 0 and cfg.resilient)
+            else float("inf")
+        )
+        while time.monotonic() < deadline:
+            for wid, p in list(writers.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                self._reap("writer", wid, rc)
+                writers[wid] = self._spawn_writer(wid)
+                self.counts["procs_respawned"] += 1
+                g.counter("procs_respawned").inc()
+            for rid, p in list(readers.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                self._reap("reader", rid, rc)
+                readers[rid] = self._spawn_reader(rid)
+                self.counts["procs_respawned"] += 1
+                g.counter("procs_respawned").inc()
+            now = time.monotonic()
+            if now >= next_kill and writers:
+                victim = writers[int(rng.integers(0, cfg.writers))]
+                if victim.poll() is None:
+                    victim.kill()  # SIGKILL: reaped (and counted) next loop
+                next_kill = now + float(rng.uniform(0.5, 1.5)) * cfg.kill_period_s
+            if now >= next_sweep:
+                # the mid-soak sweep: old enough that no in-flight round's
+                # files qualify, young enough to reclaim early kills' orphans
+                try:
+                    remove_orphan_files(self._fresh_table(), older_than_millis=cfg.sweep_older_than_ms)
+                    self.counts["sweeps_during_soak"] += 1
+                except Exception:
+                    self.errors.append(f"mid-soak sweep crashed:\n{traceback.format_exc()}")
+                next_sweep = now + cfg.sweep_period_s
+            time.sleep(0.15)
+        # ---- drain -----------------------------------------------------
+        with open(self.stop_file, "w") as f:
+            f.write("stop")
+        drain_deadline = time.monotonic() + max(60.0, cfg.block_timeout_ms / 1000.0 * 2)
+        procs = list(writers.items()) + [(f"r{r}", p) for r, p in readers.items()]
+        for name, p in procs:
+            timeout = max(1.0, drain_deadline - time.monotonic())
+            try:
+                rc = p.wait(timeout=timeout)
+                if rc not in (0, None):
+                    self._reap("writer" if not str(name).startswith("r") else "reader",
+                               int(str(name).lstrip("r")), rc)
+            except subprocess.TimeoutExpired:
+                self.errors.append(f"proc {name} failed to drain; killed")
+                p.kill()
+                p.wait(timeout=30)
+        wall_s = time.monotonic() - t_start
+        return self._verify(wall_s)
+
+    # ---- verification --------------------------------------------------
+    def _fold_oracle(self, store) -> tuple[dict[int, dict], dict]:
+        """One walk of the snapshot chain (the authority on what landed) +
+        the journals (the authority on what each round contained) → the
+        landed map {append sid: rows} and the bookkeeping counters."""
+        from ..core.snapshot import CommitKind
+
+        sm = store.snapshot_manager
+        chain: dict[tuple, list[int]] = {}
+        latest = sm.latest_snapshot_id()
+        earliest = sm.earliest_snapshot_id()
+        if latest is not None and earliest is not None:
+            for sid in range(earliest, latest + 1):
+                if not sm.snapshot_exists(sid):
+                    continue
+                snap = sm.snapshot(sid)
+                if snap.commit_kind == CommitKind.APPEND and snap.commit_user.startswith("psoak-w"):
+                    chain.setdefault((snap.commit_user, snap.commit_identifier), []).append(sid)
+        landed: dict[int, dict] = {}
+        stats = {
+            "rounds_intended": 0,
+            "rounds_landed": 0,
+            "rounds_failed": 0,  # aborted AND verifiably absent from the chain
+            "rounds_ack_lost": 0,  # landed with no journal ack (probe/chain resolved)
+            "crash_recoveries": 0,
+            "double_applied": [],
+        }
+        seen_pairs = set()
+        for wid in range(self.cfg.writers):
+            user = f"psoak-w{wid}"
+            events = WriterJournal.read(os.path.join(self.run_dir, f"journal-{wid}.jsonl"))
+            acked = {e["ident"] for e in events if e["t"] == "ack"}
+            stats["crash_recoveries"] += sum(1 for e in events if e["t"] == "recovered")
+            for e in events:
+                if e["t"] != "intent":
+                    continue
+                stats["rounds_intended"] += 1
+                sids = chain.get((user, e["ident"]), [])
+                seen_pairs.add((user, e["ident"]))
+                if len(sids) > 1:
+                    stats["double_applied"].append({"user": user, "ident": e["ident"], "sids": sids})
+                if sids:
+                    stats["rounds_landed"] += 1
+                    if e["ident"] not in acked:
+                        stats["rounds_ack_lost"] += 1
+                    landed[sids[0]] = {int(k): v for k, v in e["rows"].items()}
+                else:
+                    stats["rounds_failed"] += 1
+        # every soak APPEND snapshot must trace back to a journaled intent
+        # (the intent fsync precedes the commit — an unjournaled commit is
+        # a protocol violation)
+        for (user, ident), sids in chain.items():
+            if (user, ident) not in seen_pairs:
+                self.inconsistencies.append(
+                    {"kind": "unjournaled-commit", "user": user, "ident": ident, "sids": sids}
+                )
+        return landed, stats
+
+    def _read_reader_logs(self) -> dict:
+        out = {"reads_ok": 0, "read_errors": 0, "read_error_samples": []}
+        for rid in range(self.cfg.readers):
+            path = os.path.join(self.run_dir, f"reads-{rid}.jsonl")
+            if not os.path.exists(path):
+                continue
+            done = False
+            for e in WriterJournal.read(path):  # same torn-tolerant JSONL parse
+                if e.get("t") == "done":
+                    out["reads_ok"] += e["reads_ok"]
+                    out["read_errors"] += e["read_errors"]
+                    done = True
+                elif e.get("t") in ("err", "dup-keys"):
+                    out["read_error_samples"].append(e)
+            if not done:
+                # reader was drained by force: count its logged errors
+                out["read_errors"] += sum(
+                    1 for e in WriterJournal.read(path) if e.get("t") in ("err", "dup-keys")
+                )
+        return out
+
+    def _final_compact(self, table) -> None:
+        from ..core.commit import BATCH_COMMIT_IDENTIFIER
+        from ..core.manifest import ManifestCommittable
+        from ..table.write import TableWrite
+
+        for _ in range(3):  # nothing else runs; retries cover stragglers
+            tw = TableWrite(table)
+            try:
+                tw.compact(full=True)
+                msgs = tw.prepare_commit()
+                if not msgs:
+                    return
+                table.store.new_commit().commit(
+                    ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs)
+                )
+                return
+            except Exception:
+                continue
+            finally:
+                tw.close()
+
+    def _verify(self, wall_s: float) -> dict:
+        table = self._fresh_table()
+        store = table.store
+        landed, stats = self._fold_oracle(store)
+        expected: dict = {}
+        for sid in sorted(landed):
+            expected.update(landed[sid])
+        lost = dup = wrong = 0
+        final_rows = total_record_count = None
+        try:
+            self._final_compact(table)
+            latest = store.snapshot_manager.latest_snapshot()
+            if latest is not None:
+                t = table.copy({"scan.snapshot-id": str(latest.id)})
+                rb = t.new_read_builder()
+                batch = rb.new_read().read_all(rb.new_scan().plan())
+                ks = batch.column("k").values.tolist()
+                got = dict(zip(ks, batch.column("v").values.tolist()))
+                final_rows = len(ks)
+                dup = len(ks) - len(got)
+                lost = sum(1 for k in expected if k not in got)
+                wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
+                dup += sum(1 for k in got if k not in expected)
+                total_record_count = store.snapshot_manager.latest_snapshot().total_record_count
+            elif expected:
+                lost = len(expected)
+        except Exception:
+            self.errors.append(f"final verification crashed:\n{traceback.format_exc()}")
+        audit = {"orphans_removed": None, "leaked_files": ["<audit crashed>"]}
+        try:
+            # resilient: sweep at threshold 0 then audit (file set must equal
+            # the closure). Seed contrast: audit only — the leak list IS the
+            # result being demonstrated.
+            audit = sweep_and_audit(
+                table, self.table_root, older_than_millis=0, sweep=self.cfg.resilient
+            )
+            if self.cfg.resilient and final_rows is not None:
+                latest = store.snapshot_manager.latest_snapshot()
+                t = table.copy({"scan.snapshot-id": str(latest.id)})
+                rb = t.new_read_builder()
+                after = rb.new_read().read_all(rb.new_scan().plan()).num_rows
+                if after != final_rows:
+                    self.inconsistencies.append(
+                        {"kind": "sweep-removed-live-rows", "before": final_rows, "after": after}
+                    )
+        except Exception:
+            self.errors.append(f"orphan audit crashed:\n{traceback.format_exc()}")
+        reads = self._read_reader_logs()
+        if stats["double_applied"]:
+            self.inconsistencies.append({"kind": "double-applied", "rounds": stats["double_applied"]})
+        consistent = (
+            not self.errors
+            and not self.inconsistencies
+            and lost == 0
+            and dup == 0
+            and wrong == 0
+            and reads["read_errors"] == 0
+            and (total_record_count is None or total_record_count == len(expected))
+            and (not self.cfg.resilient or len(audit["leaked_files"]) == 0)
+        )
+        return {
+            "wall_s": round(wall_s, 2),
+            "consistent": consistent,
+            "resilient": self.cfg.resilient,
+            "accepted_commits": len(landed),
+            "expected_unique_keys": len(expected),
+            "final_rows": final_rows,
+            "total_record_count": total_record_count,
+            "lost_rows": lost,
+            "duplicated_rows": dup,
+            "wrong_values": wrong,
+            "commits_per_sec": round(len(landed) / wall_s, 2) if wall_s > 0 else None,
+            **stats,
+            **self.counts,
+            **reads,
+            "orphans_removed": audit["orphans_removed"],
+            "leaked_file_count": len(audit["leaked_files"]),
+            "leaked_files": audit["leaked_files"][:10],
+            "inconsistencies": self.inconsistencies[:10],
+            "errors": self.errors[:5],
+        }
+
+
+def run_proc_soak(base_dir: str, cfg: ProcSoakConfig | None = None) -> dict:
+    """Create a fresh process-soak table under base_dir, run the supervisor,
+    return the report dict (see ProcSoakSupervisor._verify for fields)."""
+    return ProcSoakSupervisor(base_dir, cfg).run()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def _writer_args(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="proc_soak writer")
+    ap.add_argument("--table", required=True)
+    ap.add_argument("--wid", type=int, required=True)
+    ap.add_argument("--journal", required=True)
+    ap.add_argument("--stop-file", required=True, dest="stop_file")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--incarnation", type=int, default=0)
+    ap.add_argument("--rows-per-commit", type=int, default=300, dest="rows_per_commit")
+    ap.add_argument("--chunk-rows", type=int, default=150, dest="chunk_rows")
+    ap.add_argument("--update-fraction", type=float, default=0.3, dest="update_fraction")
+    ap.add_argument("--compact-every", type=int, default=5, dest="compact_every")
+    ap.add_argument("--max-rounds", type=int, default=10**9, dest="max_rounds")
+    ap.add_argument("--max-memory", type=int, default=0, dest="max_memory")
+    ap.add_argument("--block-timeout-ms", type=int, default=20_000, dest="block_timeout_ms")
+    ap.add_argument("--seed-mode", action="store_true", dest="seed_mode")
+    args = ap.parse_args(argv)
+    args.resilient = not args.seed_mode
+    return args
+
+
+def _reader_args(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="proc_soak reader")
+    ap.add_argument("--table", required=True)
+    ap.add_argument("--rid", type=int, required=True)
+    ap.add_argument("--log", required=True)
+    ap.add_argument("--stop-file", required=True, dest="stop_file")
+    return ap.parse_args(argv)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import tempfile
+
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "writer":
+        return writer_main(_writer_args(argv[1:]))
+    if argv and argv[0] == "reader":
+        return reader_main(_reader_args(argv[1:]))
+
+    ap = argparse.ArgumentParser(description="paimon-tpu process-grain crash soak")
+    ap.add_argument("base_dir", nargs="?", default=None)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--writers", type=int, default=2)
+    ap.add_argument("--readers", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--scripted-kills",
+        default=",".join(DEFAULT_SCRIPTED_KILLS),
+        help="comma-separated PAIMON_TPU_CRASH_POINT specs, one per writer spawn",
+    )
+    ap.add_argument("--kill-period", type=float, default=8.0, help="mean s between random SIGKILLs (0=off)")
+    ap.add_argument("--sweep-period", type=float, default=12.0)
+    ap.add_argument("--rows-per-commit", type=int, default=300)
+    ap.add_argument("--min-kills", type=int, default=0, help="fail unless >= N kills were survived")
+    ap.add_argument("--seed-mode", action="store_true", help="seed-like config: no retries, no sweep, no recovery")
+    args = ap.parse_args(argv)
+    base = args.base_dir or tempfile.mkdtemp(prefix="paimon_proc_soak_")
+    cfg = ProcSoakConfig(
+        duration_s=args.duration,
+        writers=args.writers,
+        readers=args.readers,
+        seed=args.seed,
+        scripted_kills=tuple(s for s in args.scripted_kills.split(",") if s.strip()),
+        kill_period_s=args.kill_period,
+        sweep_period_s=args.sweep_period,
+        rows_per_commit=args.rows_per_commit,
+        resilient=not args.seed_mode,
+    )
+    report = run_proc_soak(base, cfg)
+    print(json.dumps(report, indent=2, default=str))
+    ok = report["consistent"] and report["procs_killed"] >= args.min_kills
+    if report["procs_killed"] < args.min_kills:
+        print(
+            f"FAIL: only {report['procs_killed']} kills survived (expected >= {args.min_kills})",
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -47,7 +47,14 @@ import numpy as np
 
 from ..types import BIGINT, DOUBLE, RowType
 
-__all__ = ["SoakConfig", "OracleLog", "SoakHarness", "run_soak", "find_landed_append"]
+__all__ = [
+    "SoakConfig",
+    "OracleLog",
+    "SoakHarness",
+    "run_soak",
+    "find_landed_append",
+    "sweep_and_audit",
+]
 
 SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
 KEYSPACE = 10_000_000  # per-writer key stride: keyspaces never collide
@@ -156,6 +163,54 @@ def find_landed_append(store, user: str, identifier: int) -> int | None:
     except Exception:
         return None
     return None
+
+
+def sweep_and_audit(
+    table, local_root: str, older_than_millis: int = 0, sweep: bool = True
+) -> dict:
+    """Orphan sweep (optional, threshold `older_than_millis`), then an
+    INDEPENDENT disk walk of `local_root`: the surviving file set must be
+    EXACTLY the reachable closure plus table metadata (snapshots/schemas/
+    hints/markers). `sweep=False` audits without reclaiming — the
+    seed-contrast runs use it to show what a sweep-less build leaks."""
+    from ..resilience.orphan import reachable_files, remove_orphan_files
+
+    removed = remove_orphan_files(table, older_than_millis=older_than_millis) if sweep else None
+    closure = reachable_files(table)
+    meta_names = set().union(*closure["meta"].values()) if closure["meta"] else set()
+    index_names = set().union(*closure["index"].values()) if closure["index"] else set()
+    data_names = {name for (_, name) in closure["data"]}
+    leaked = []
+    for dirpath, _dirs, files in os.walk(local_root):
+        rel = os.path.relpath(dirpath, local_root)
+        parts = [] if rel == "." else rel.split(os.sep)
+        top = parts[0] if parts else ""
+        for f in files:
+            if top == "manifest":
+                ok = f in meta_names
+            elif top == "index":
+                ok = f in index_names
+            elif top in (
+                "snapshot",
+                "schema",
+                "branch",
+                "tag",
+                "consumer",
+                "service",
+                "statistics",
+                "changelog",
+            ):
+                ok = True  # metadata planes: hints, schema history, markers
+            elif any(p.startswith("bucket-") for p in parts):
+                ok = f in data_names
+            else:
+                ok = False
+            if not ok:
+                leaked.append(os.path.join(rel, f))
+    return {
+        "orphans_removed": len(removed) if removed is not None else None,
+        "leaked_files": leaked,
+    }
 
 
 class SoakHarness:
@@ -564,44 +619,7 @@ class SoakHarness:
                 tw.close()
 
     def _sweep_and_audit(self) -> dict:
-        """Orphan sweep at threshold 0, then an independent disk walk: the
-        surviving file set must be EXACTLY the reachable closure plus table
-        metadata (snapshots/schemas/hints/markers)."""
-        from ..resilience.orphan import reachable_files, remove_orphan_files
-
-        removed = remove_orphan_files(self._table, older_than_millis=0)
-        closure = reachable_files(self._table)
-        meta_names = set().union(*closure["meta"].values()) if closure["meta"] else set()
-        index_names = set().union(*closure["index"].values()) if closure["index"] else set()
-        data_names = {name for (_, name) in closure["data"]}
-        leaked = []
-        for dirpath, _dirs, files in os.walk(self.local_root):
-            rel = os.path.relpath(dirpath, self.local_root)
-            parts = [] if rel == "." else rel.split(os.sep)
-            top = parts[0] if parts else ""
-            for f in files:
-                if top == "manifest":
-                    ok = f in meta_names
-                elif top == "index":
-                    ok = f in index_names
-                elif top in (
-                    "snapshot",
-                    "schema",
-                    "branch",
-                    "tag",
-                    "consumer",
-                    "service",
-                    "statistics",
-                    "changelog",
-                ):
-                    ok = True  # metadata planes: hints, schema history, markers
-                elif any(p.startswith("bucket-") for p in parts):
-                    ok = f in data_names
-                else:
-                    ok = False
-                if not ok:
-                    leaked.append(os.path.join(rel, f))
-        return {"orphans_removed": len(removed), "leaked_files": leaked}
+        return sweep_and_audit(self._table, self.local_root)
 
     def _verify(self, wall_s: float) -> dict:
         lost = dup = wrong = 0

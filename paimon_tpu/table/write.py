@@ -313,7 +313,7 @@ class TableWrite:
                 writers[f"{partition}/{bucket}"] = h()
         out = {"state": "ok", "writers": writers}
         if self.admission is not None:
-            out.update(self.admission.health())
+            out.update(self.admission.health_dict())
         out["buffered_rows"] = sum(w.get("buffered_rows", 0) for w in writers.values())
         out["pending_flushes_writers"] = sum(w.get("pending_flushes", 0) for w in writers.values())
         return out

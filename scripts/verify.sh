@@ -39,6 +39,20 @@
 #                              leaving the file set exactly equal to the
 #                              reachable closure. Nightly-scale knobs live
 #                              in benchmarks/soak_bench.py.
+#   scripts/verify.sh proc-soak  process-grain crash-soak stage: the crash-
+#                              point / recovery / load-shedding suite, then
+#                              a bounded DETERMINISTIC multi-process soak —
+#                              fixed seed, 2 writer + 1 reader OS processes
+#                              sharing only the warehouse filesystem, four
+#                              scripted kill -9 deaths at distinct commit/
+#                              flush crash points plus seeded random
+#                              SIGKILLs, respawn + journal recovery,
+#                              periodic orphan sweeps — asserting >= 3 kills
+#                              survived, final scan == journal-oracle fold,
+#                              zero lost/duplicated rows, zero read errors,
+#                              and a post-sweep file set exactly equal to
+#                              the reachable closure. Nightly-scale knobs
+#                              live in benchmarks/soak_bench.py --process.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -105,6 +119,16 @@ if [ "${1:-}" = "soak" ]; then
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_SOAK_DURATION=45 PAIMON_TPU_SOAK_SEED=0 \
     timeout -k 10 600 python -m pytest tests/test_soak.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "proc-soak" ]; then
+  env JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python -m pytest tests/test_proc_soak.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  exec env JAX_PLATFORMS=cpu timeout -k 10 240 python -m paimon_tpu.service.proc_soak \
+    --duration 45 --writers 2 --readers 1 --seed 0 \
+    --scripted-kills "commit:manifests-written:2:kill,commit:snapshot-committed:2:kill,flush:files-written:3:kill,commit:before-manifests:2:kill" \
+    --kill-period 9 --sweep-period 12 --min-kills 3
 fi
 
 if [ "${1:-}" = "encode" ]; then

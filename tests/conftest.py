@@ -63,6 +63,48 @@ def _no_worker_thread_leaks():
     assert not leaked(), f"leaked non-daemon worker threads: {[t.name for t in leaked()]}"
 
 
+@pytest.fixture(autouse=True)
+def _no_child_process_leaks():
+    """Fail any test that leaves a live child OS process behind. The
+    process-grain soak (tests/test_proc_soak.py) spawns writer/reader
+    subprocesses; a supervisor bug that orphans one would keep mutating the
+    warehouse under every later test. Zombies (already-exited, not yet
+    reaped) are ignored; live children get a short grace to finish exiting."""
+    yield
+    import time
+
+    def live_children():
+        pid = os.getpid()
+        kids = []
+        try:
+            for task in os.listdir(f"/proc/{pid}/task"):
+                try:
+                    with open(f"/proc/{pid}/task/{task}/children") as f:
+                        kids += [int(p) for p in f.read().split()]
+                except OSError:
+                    pass
+        except OSError:
+            return []  # no /proc: nothing to check on this platform
+        alive = []
+        for k in kids:
+            try:
+                with open(f"/proc/{k}/stat") as f:
+                    stat = f.read()
+                if stat.rsplit(")", 1)[1].split()[0] != "Z":
+                    alive.append(k)
+            except OSError:
+                pass  # exited between listing and stat
+        return alive
+
+    leaked = live_children()
+    if leaked:
+        deadline = time.time() + 5.0
+        while leaked and time.time() < deadline:
+            time.sleep(0.1)
+            leaked = live_children()
+    assert not leaked, f"child processes outlived the test: {leaked}"
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _forced_encoder_coverage():
     """When a verify stage forces PAIMON_TPU_PARQUET_ENCODER=native, the run

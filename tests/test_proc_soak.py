@@ -1,0 +1,381 @@
+"""Process-grain crash soak: env-armed kill -9 crash points, cross-process
+recovery, supervisor bookkeeping, and service-level load shedding.
+
+Every crash here is a REAL process death (`os._exit` at an armed crash
+point, or SIGKILL from the supervisor) — no exception unwinding, no cleanup,
+torn `.tmp` files and orphaned manifests left exactly where a killed Flink
+task JVM would leave them. Recovery is what the on-disk protocol provides:
+the snapshot chain, the intent/ack journal, and the orphan sweep."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paimon_tpu.core.schema import SchemaManager
+from paimon_tpu.fs import get_file_io
+from paimon_tpu.resilience.faults import (
+    COMMIT_CRASH_POINTS,
+    KILL_EXIT_CODE,
+    WRITER_CRASH_POINTS,
+    CrashError,
+    arm_from_env,
+    crash_point,
+    disarm_crash_points,
+)
+from paimon_tpu.service.proc_soak import (
+    ProcSoakConfig,
+    WriterJournal,
+    run_proc_soak,
+)
+from paimon_tpu.service.soak import SCHEMA, find_landed_append, sweep_and_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_table(root: str, extra: dict | None = None) -> None:
+    # ONE bucket: each writer round hits each crash point exactly once, so
+    # an armed `nth` maps 1:1 onto round numbers (with N buckets the flush
+    # points fire once per bucket writer per round)
+    opts = {
+        "bucket": "1",
+        "write-buffer-rows": "64",
+        "commit.max-retries": "30",
+        "commit.retry-backoff": "2 ms",
+    }
+    opts.update(extra or {})
+    SchemaManager(get_file_io(root), root).create_table(SCHEMA, primary_keys=["k"], options=opts)
+
+
+def _run_writer(
+    root: str,
+    run_dir: str,
+    wid: int = 0,
+    rounds: int = 3,
+    crash: str | None = None,
+    incarnation: int = 0,
+) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PAIMON_TPU_CRASH_POINT", None)
+    if crash:
+        env["PAIMON_TPU_CRASH_POINT"] = crash
+    env["PYTHONPATH"] = REPO + (os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    cmd = [
+        sys.executable, "-m", "paimon_tpu.service.proc_soak", "writer",
+        "--table", root,
+        "--wid", str(wid),
+        "--journal", os.path.join(run_dir, f"journal-{wid}.jsonl"),
+        "--stop-file", os.path.join(run_dir, "stop"),
+        "--max-rounds", str(rounds),
+        "--rows-per-commit", "40",
+        "--chunk-rows", "20",
+        "--compact-every", "0",
+        # fresh keys only: physical record count == unique keys without a
+        # compaction, so the no-double-apply assertions are exact
+        "--update-fraction", "0",
+        "--incarnation", str(incarnation),
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+
+
+def _journal_oracle(store, run_dir: str, wid: int = 0) -> dict:
+    """The fold a fresh process reconstructs from the journal + the landed-
+    snapshot probe — the cross-process analog of the thread soak's OracleLog."""
+    events = WriterJournal.read(os.path.join(run_dir, f"journal-{wid}.jsonl"))
+    intents = {e["ident"]: e for e in events if e["t"] == "intent"}
+    acked = {e["ident"]: e["sid"] for e in events if e["t"] in ("ack", "recovered")}
+    landed = {}
+    for ident in intents:
+        sid = acked.get(ident)
+        if sid is None:
+            sid = find_landed_append(store, f"psoak-w{wid}", ident)
+        if sid is not None:
+            landed[sid] = {int(k): v for k, v in intents[ident]["rows"].items()}
+    expected: dict = {}
+    for sid in sorted(landed):
+        expected.update(landed[sid])
+    return expected
+
+
+def _scan(table) -> dict:
+    rb = table.new_read_builder()
+    batch = rb.new_read().read_all(rb.new_scan().plan())
+    ks = batch.column("k").values.tolist()
+    got = dict(zip(ks, batch.column("v").values.tolist()))
+    assert len(ks) == len(got), "duplicate keys in final scan"
+    return got
+
+
+# ---------------------------------------------------------------------------
+# env arming (in-process, CrashError mode — never kill inside pytest!)
+# ---------------------------------------------------------------------------
+def test_arm_from_env_spec_parsing():
+    try:
+        armed = arm_from_env("commit:before-manifests:3,flush:files-written")
+        assert armed == ["commit:before-manifests", "flush:files-written"]
+        # nth=3: two hits pass, the third raises
+        crash_point("commit:before-manifests")
+        crash_point("commit:before-manifests")
+        with pytest.raises(CrashError):
+            crash_point("commit:before-manifests")
+        # count=1: the spec is one-shot
+        crash_point("commit:before-manifests")
+        # default nth=1: first hit fires
+        with pytest.raises(CrashError):
+            crash_point("flush:files-written")
+    finally:
+        disarm_crash_points()
+
+
+def test_arm_from_env_kill_mode_parsed_not_fired():
+    """The :kill suffix must parse into the hard-death mode without firing
+    at arm time (firing would take pytest down with it)."""
+    from paimon_tpu.resilience.faults import _armed
+
+    try:
+        arm_from_env("commit:manifests-written:7:kill")
+        st = _armed["commit:manifests-written"]
+        assert st.kill and st.skip == 6 and st.count == 1 and st.fired == 0
+    finally:
+        disarm_crash_points()
+
+
+# ---------------------------------------------------------------------------
+# kill -9 at every crash point: torn state -> sweep -> journal-oracle re-read
+# ---------------------------------------------------------------------------
+# which points leave unreachable garbage on disk when the process dies there
+# (the "fails without the sweep" half of the test)
+_LEAKS = {
+    # the round's level-0 files were already flushed when the commit died
+    # pre-manifest: at process grain even this point strands data files
+    "commit:before-manifests": True,
+    "commit:manifests-written": True,  # orphan manifests + lists (+ data files)
+    "commit:snapshot-committed": False,  # commit fully visible; only the ack died
+    "flush:before-dispatch": False,  # memtable lost with the process, no bytes on disk
+    "flush:files-written": True,  # orphan level-0 data files
+}
+
+
+@pytest.mark.parametrize("point", COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS)
+def test_kill_at_crash_point_then_sweep_matches_journal_oracle(tmp_path, point):
+    from paimon_tpu.table import load_table
+
+    root = str(tmp_path / "table")
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    _make_table(root)
+    r = _run_writer(root, run_dir, rounds=3, crash=f"{point}:2:kill")
+    assert r.returncode == KILL_EXIT_CODE, (r.returncode, r.stdout, r.stderr)
+
+    table = load_table(root, commit_user="psoak-verify")
+    expected = _journal_oracle(table.store, run_dir)
+    assert expected, "the first round must have landed before the armed kill"
+    if point == "commit:snapshot-committed":
+        # died AFTER the CAS: round 2 is in the table although its ack is not
+        assert len(expected) > 40
+    # a build without the sweep keeps the kill's garbage forever — the
+    # independent disk walk must call it out
+    pre = sweep_and_audit(table, root, sweep=False)
+    if _LEAKS[point]:
+        assert pre["leaked_files"], f"kill at {point} must strand unreachable files"
+    else:
+        assert pre["leaked_files"] == []
+    # fresh-process recovery: sweep at threshold 0 reclaims exactly the
+    # garbage, and the surviving table still equals the journal oracle
+    post = sweep_and_audit(table, root, older_than_millis=0, sweep=True)
+    assert post["leaked_files"] == []
+    assert post["orphans_removed"] >= len(pre["leaked_files"])
+    assert _scan(table) == expected
+
+
+def test_respawned_writer_recovers_landed_unacked_commit(tmp_path):
+    """kill -9 after the snapshot CAS but before the journal ack: the
+    respawned incarnation must resolve the round from the snapshot chain
+    (journal `recovered` record), NOT replay it — no double-applied ADDs."""
+    from paimon_tpu.core.snapshot import CommitKind
+    from paimon_tpu.table import load_table
+
+    root = str(tmp_path / "table")
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    _make_table(root)
+    r = _run_writer(root, run_dir, rounds=3, crash="commit:snapshot-committed:2:kill")
+    assert r.returncode == KILL_EXIT_CODE
+    r2 = _run_writer(root, run_dir, rounds=1, incarnation=1)
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    events = WriterJournal.read(os.path.join(run_dir, "journal-0.jsonl"))
+    kinds = [(e["t"], e["ident"]) for e in events if e["t"] != "intent"]
+    assert ("recovered", 2) in kinds, kinds
+    table = load_table(root, commit_user="psoak-verify")
+    # identifier 2 landed exactly once: the recovery adopted, never replayed
+    snaps = table.store.snapshot_manager.snapshots_of_user_with_identifier("psoak-w0", 2)
+    assert len([s for s in snaps if s.commit_kind == CommitKind.APPEND]) == 1
+    expected = _journal_oracle(table.store, run_dir)
+    assert _scan(table) == expected
+    # physical record count agrees with the key space: a hidden double-apply
+    # could not survive this (rounds update disjoint fresh keys here)
+    assert table.store.snapshot_manager.latest_snapshot().total_record_count == len(expected)
+
+
+# ---------------------------------------------------------------------------
+# supervised mini-soak: kills, respawns, periodic sweep, end-to-end verify
+# ---------------------------------------------------------------------------
+def test_mini_process_soak_with_kills_and_respawns(tmp_path):
+    cfg = ProcSoakConfig(
+        duration_s=8.0,
+        writers=2,
+        readers=1,
+        seed=7,
+        rows_per_commit=80,
+        write_chunk_rows=40,
+        compact_every=4,
+        scripted_kills=(
+            "commit:manifests-written:2:kill",
+            "commit:snapshot-committed:2:kill",
+        ),
+        kill_period_s=3.0,
+        sweep_period_s=4.0,
+        sweep_older_than_ms=30_000,
+        block_timeout_ms=5_000,
+    )
+    report = run_proc_soak(str(tmp_path), cfg)
+    assert report["consistent"], report
+    # supervisor bookkeeping: every death was counted and refilled
+    assert report["procs_killed"] >= 2, report
+    assert report["procs_respawned"] >= report["procs_killed"], report
+    assert report["procs_spawned"] == cfg.writers + cfg.readers + report["procs_respawned"], report
+    assert report["writer_errors"] == 0, report
+    # the service did real work between the kills and lost none of it
+    assert report["accepted_commits"] > 0
+    assert report["lost_rows"] == 0 and report["duplicated_rows"] == 0
+    assert report["read_errors"] == 0
+    assert report["leaked_file_count"] == 0
+    assert report["total_record_count"] == report["expected_unique_keys"]
+    assert report["double_applied"] == []
+
+
+# ---------------------------------------------------------------------------
+# service-level load shedding
+# ---------------------------------------------------------------------------
+def test_kv_health_roundtrip(tmp_warehouse):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.core.admission import WriteBufferController
+    from paimon_tpu.service import KvQueryClient, KvQueryServer
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="svc")
+    t = cat.create_table("db.h", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    ctrl = WriteBufferController(1_000, stop_trigger=0.5, block_timeout_ms=50)
+    server = KvQueryServer(t, health_provider=ctrl.health_dict)
+    server.start()
+    try:
+        client = KvQueryClient.for_table(t)
+        h = client.health()
+        assert h["state"] == "ok" and h["buffered_bytes"] == 0
+        # saturate: the remote surface must report the same stable schema
+        ctrl.try_reserve(600)
+        h = client.health()
+        assert h["state"] == "throttling" and h["retry_after_ms"] > 0
+        assert h["buffered_bytes"] == 600 and "pending_flushes" in h and "backpressure_ms" in h
+        ctrl.release(600)
+        assert client.health()["state"] == "ok"
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_kv_health_without_provider_reports_ok(tmp_warehouse):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.service import KvQueryClient, KvQueryServer
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="svc")
+    t = cat.create_table("db.h2", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    server = KvQueryServer(t)
+    server.start()
+    try:
+        client = KvQueryClient.for_table(t)
+        assert client.health() == {"state": "ok"}
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_flight_health_and_typed_busy_shed(tmp_warehouse):
+    pytest.importorskip("pyarrow.flight")
+    import threading
+
+    import pyarrow as pa
+
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.core.admission import WriteBufferController
+    from paimon_tpu.metrics import soak_metrics
+    from paimon_tpu.service.flight import (
+        FlightBusyError,
+        PaimonFlightServer,
+        flight_health,
+        flight_put,
+        flight_scan,
+    )
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="svc")
+    cat.create_table("db.ing", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    ctrl = WriteBufferController(1_000, stop_trigger=0.5, block_timeout_ms=200)
+    srv = PaimonFlightServer(tmp_warehouse, ingest_controller=ctrl)
+    loc = srv.start()
+    try:
+        assert flight_health(loc, "db.ing")["state"] == "ok"
+        data = pa.table({"k": list(range(100)), "v": [float(i) for i in range(100)]})
+        r = flight_put(loc, "db.ing", data)
+        assert r == {"attempts": 1, "sheds": 0, "rows": 100, "backoff_ms": 0.0}
+        # saturate the writer budget: ingest must shed with a TYPED busy —
+        # parseable state + retry-after, answered immediately (no timeout)
+        ctrl.try_reserve(900)
+        assert flight_health(loc, "db.ing")["state"] == "throttling"
+        shed_before = soak_metrics().counter("shed_requests").count
+        t0 = time.perf_counter()
+        with pytest.raises(FlightBusyError) as ei:
+            flight_put(loc, "db.ing", data, max_retries=2)
+        elapsed = time.perf_counter() - t0
+        assert ei.value.payload["state"] == "throttling"
+        assert ei.value.retry_after_ms > 0
+        # 2 retries x 100 ms hinted backoff, nowhere near a network timeout
+        assert elapsed < 5.0
+        assert soak_metrics().counter("shed_requests").count >= shed_before + 3
+        # pressure releases mid-backoff: the client wrapper rides it out
+        threading.Timer(0.3, lambda: ctrl.release(900)).start()
+        data2 = pa.table({"k": list(range(100, 150)), "v": [2.0] * 50})
+        r2 = flight_put(loc, "db.ing", data2, max_retries=20)
+        assert r2["sheds"] >= 1 and r2["attempts"] == r2["sheds"] + 1
+        got = flight_scan(loc, "db.ing")
+        assert got.num_rows == 150
+    finally:
+        srv.shutdown()
+
+
+def test_table_write_health_reports_admission_schema(tmp_warehouse):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.core.admission import WriteBufferController
+    from paimon_tpu.table.write import TableWrite
+
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="svc")
+    t = cat.create_table("db.tw", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    ctrl = WriteBufferController(10_000, stop_trigger=0.5, block_timeout_ms=50)
+    tw = TableWrite(t, buffer_controller=ctrl)
+    try:
+        tw.write({"k": [1, 2], "v": [1.0, 2.0]})
+        h = tw.health()
+        for key in (
+            "state",
+            "buffered_bytes",
+            "pending_flushes",
+            "backpressure_ms",
+            "retry_after_ms",
+            "writes_throttled",
+            "writes_rejected",
+        ):
+            assert key in h, key
+        assert h["state"] == "ok"
+    finally:
+        tw.close()
