@@ -253,6 +253,70 @@ def bench_lanes(table) -> list:
     ]
 
 
+def bench_dicts(table) -> list:
+    """Compressed-domain merge spot-check (benchmarks/dict_domain_bench.py
+    is the dedicated 3-schema x 3-workload sweep with the >=2x compaction
+    headline): the standard merge-read table read through table.copy with
+    merge.dict-domain off vs on — same files, same cache state — plus the
+    dict{} counter breakdown. Outputs are asserted identical row-for-row."""
+    from paimon_tpu.metrics import dict_metrics
+
+    g = dict_metrics()
+
+    def counters():
+        return {
+            k: g.counter(k).count
+            for k in ("pools_unified", "codes_remapped", "rows_code_domain", "fallback_expanded")
+        }
+
+    results = {}
+    deltas = None
+    for dd in (False, True):
+        t = table.copy(
+            {
+                "merge.dict-domain": "true" if dd else "false",
+                "format.parquet.decoder": "native",
+                "format.parquet.encoder": "native",
+                "cache.data-file.max-memory-size": "0 b",
+            }
+        )
+        rb = t.new_read_builder()
+        best = float("inf")
+        c0 = counters()
+        out = None
+        for it in range(4):
+            t0 = time.perf_counter()
+            out = rb.new_read().read_all(rb.new_scan().plan())
+            out.to_arrow()  # delivery included: the code domain hands arrow dictionaries
+            dt = time.perf_counter() - t0
+            assert out.num_rows == N_ROWS, out.num_rows
+            if it > 0:
+                best = min(best, dt)
+        if dd:
+            deltas = {k: v - c0[k] for k, v in counters().items()}
+        results[dd] = (N_ROWS / best, out)
+    assert results[True][1].to_pylist() == results[False][1].to_pylist()
+    on, off = results[True][0], results[False][0]
+    return [
+        {
+            "metric": "merge-read dict-domain on vs off (same table, native decode)",
+            "rows_per_sec_expanded": round(off, 1),
+            "rows_per_sec_code_domain": round(on, 1),
+            "speedup": round(on / off, 3),
+            "unit": "rows/s",
+        },
+        {
+            "metric": "compressed-domain merge breakdown",
+            "pools_unified": deltas["pools_unified"],
+            "codes_remapped": deltas["codes_remapped"],
+            "rows_code_domain": deltas["rows_code_domain"],
+            "fallback_expanded": deltas["fallback_expanded"],
+            "unify_ms_mean": round(dict_metrics().histogram("unify_ms").mean, 3),
+            "unit": "counters",
+        },
+    ]
+
+
 def bench_mesh() -> list:
     """Mesh-sharded execution headline (benchmarks/multichip_bench.py is the
     dedicated 1/2/4/8-device sweep): 8-bucket merge-read behind simulated
@@ -330,6 +394,7 @@ def main():
         scan_cache_speedup = bench_scan_cache(table)
         decode_row = bench_decode(table)
         lanes_rows = bench_lanes(table)
+        dict_rows = bench_dicts(table)
         pipeline_rows = bench_pipeline()
         encode_rows = bench_encode()
         mesh_rows = bench_mesh()
@@ -369,6 +434,8 @@ def main():
         print(json.dumps(dict(decode_row, platform=_PLATFORM)))
         for lrow in lanes_rows:
             print(json.dumps(dict(lrow, platform=_PLATFORM)))
+        for drow in dict_rows:
+            print(json.dumps(dict(drow, platform=_PLATFORM)))
         for prow in pipeline_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         for erow in encode_rows:

@@ -86,13 +86,28 @@ def _lane_view(lanes: np.ndarray) -> np.ndarray:
 
 
 def _rows_differ(a: KVBatch, b: KVBatch) -> np.ndarray:
+    """A row changed iff some field's validity flipped or both-valid values
+    differ. Value bytes at INVALID slots are unspecified (merge gathers
+    leave whatever the source row held), so they must not vote — masking
+    them also keeps the verdict identical between the expanded and the
+    code-backed (dictionary-domain) column representations."""
+    from ..ops.dicts import cache_usable, remap_codes, unify_pools
+
     out = np.zeros(a.num_rows, dtype=np.bool_)
     for name in a.data.schema.field_names:
         ca, cb = a.data.column(name), b.data.column(name)
-        va, ba = ca.values, cb.values
-        if va.dtype == np.dtype(object):
-            neq = np.fromiter((x != y for x, y in zip(va, ba)), dtype=np.bool_, count=len(va))
+        ok_a, ok_b = ca.valid_mask(), cb.valid_mask()
+        both = ok_a & ok_b
+        if cache_usable(ca) and cache_usable(cb) and (ca.is_code_backed or cb.is_code_backed):
+            # compressed-domain diff: unify the two pools once, compare the
+            # re-mapped uint32 codes — no string objects, same verdict
+            unified, (ra, rb) = unify_pools([ca.dict_cache[0], cb.dict_cache[0]])
+            neq = remap_codes(ra, ca.dict_cache[1]) != remap_codes(rb, cb.dict_cache[1])
         else:
-            neq = va != ba
-        out |= neq | (ca.valid_mask() != cb.valid_mask())
+            va, ba = ca.values, cb.values
+            if va.dtype == np.dtype(object):
+                neq = np.fromiter((x != y for x, y in zip(va, ba)), dtype=np.bool_, count=len(va))
+            else:
+                neq = va != ba
+        out |= (neq & both) | (ok_a != ok_b)
     return out

@@ -294,7 +294,7 @@ class MergeTreeCompactRewriter:
         return out, changelog
 
     def _section_changelog(self, old_top: list[KVBatch], merged: KVBatch) -> KVBatch:
-        from ..data.keys import build_string_pool, encode_key_lanes
+        from ..data.keys import encode_key_lanes, exact_string_pool
         from ..types import TypeRoot
         from .changelog import full_compaction_changelog
 
@@ -304,7 +304,7 @@ class MergeTreeCompactRewriter:
         for k in key_names:
             root = merged.data.schema.field(k).type.root
             if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
-                pools[k] = build_string_pool([before.data.column(k).values, merged.data.column(k).values])
+                pools[k] = exact_string_pool([before.data.column(k), merged.data.column(k)])
         lanes_before = encode_key_lanes(before.data, key_names, pools)
         lanes_after = encode_key_lanes(merged.data, key_names, pools)
         return full_compaction_changelog(

@@ -103,7 +103,8 @@ class DataFileMeta:
 
 
 def _key_tuple(batch: ColumnBatch, key_names: Sequence[str], row: int) -> tuple:
-    return tuple(batch.column(k).values[row] for k in key_names)
+    # value_at: two boundary rows must not expand a code-backed column
+    return tuple(batch.column(k).value_at(row) for k in key_names)
 
 
 def _to_py_tuple(t: tuple) -> tuple:
@@ -199,7 +200,15 @@ class KeyValueFileWriterFactory:
                 _to_py_tuple(_key_tuple(batch, self.key_names, 0)),
                 _to_py_tuple(_key_tuple(batch, self.key_names, batch.num_rows - 1)),
             )
-        order = np.lexsort([batch.column(k).values for k in reversed(self.key_names)])
+        from ..ops.dicts import cache_usable
+
+        def sort_key(k):
+            col = batch.column(k)
+            # codes are rank-order-preserving surrogates: the lexsort
+            # permutation's first/last rows match the expanded sort exactly
+            return col.dict_cache[1] if cache_usable(col) and col.validity is None else col.values
+
+        order = np.lexsort([sort_key(k) for k in reversed(self.key_names)])
         return (
             _to_py_tuple(_key_tuple(batch, self.key_names, int(order[0]))),
             _to_py_tuple(_key_tuple(batch, self.key_names, int(order[-1]))),
@@ -288,7 +297,15 @@ class KeyValueFileReaderFactory:
         # reader-side format options (format.parquet.decoder etc.), applied
         # to the format instance via FileFormat.configure per read
         self.format_options = dict(format_options or {})
-        self.decoder_id = str(self.format_options.get("format.parquet.decoder") or "arrow")
+        # the dict-domain flag joins the decoder identity: a code-backed
+        # batch must never alias an expanded one in the data-file cache
+        # (switching merge.dict-domain or its env override stays sound)
+        from ..ops.dicts import resolve_dict_domain
+
+        decoder = str(self.format_options.get("format.parquet.decoder") or "arrow")
+        if resolve_dict_domain(self.format_options.get("merge.dict-domain")):
+            decoder += "+dict"
+        self.decoder_id = decoder
 
     def read(
         self,

@@ -116,8 +116,10 @@ class MergeExecutor:
         if self._user_seq:
             # user-defined sequence fields order before the system seqno
             # (reference: MergeSorter orders by (key, udsSeq, seqNumber))
+            from ..data.keys import exact_string_pool
+
             useq_pools = {
-                f: build_string_pool([kv.data.column(f).values])
+                f: exact_string_pool([kv.data.column(f)])
                 for f in self._user_seq
                 if kv.data.schema.field(f).type.root in (TypeRoot.CHAR, TypeRoot.VARCHAR)
             }

@@ -153,6 +153,11 @@ class KeyValueFileStore:
         format_options.setdefault(
             "format.parquet.decoder", co.options.get(CoreOptions.FORMAT_PARQUET_DECODER)
         )
+        # compressed-domain merge (merge.dict-domain): readers return
+        # dictionary codes for dict-encoded string chunks instead of
+        # expanding them — one seam for merge read, compaction, sort-compact
+        format_options.setdefault("merge.dict-domain", co.dict_domain)
+        format_options.setdefault("merge.dict-domain.pool-limit", co.dict_domain_pool_limit)
         return KeyValueFileReaderFactory(
             self.file_io,
             self.bucket_dir(partition, bucket),

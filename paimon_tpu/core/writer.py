@@ -351,7 +351,7 @@ class MergeTreeWriter:
     def _lookup_changelog(self, merged: KVBatch, buffer_seq_ordered: bool = True) -> KVBatch:
         """Diff the bucket's visible state before vs after this flush,
         restricted to the flushed key range."""
-        from ..data.keys import build_string_pool, encode_key_lanes
+        from ..data.keys import encode_key_lanes, exact_string_pool
         from ..types import TypeRoot
         from .changelog import full_compaction_changelog
         from .read import MergeFileSplitRead
@@ -381,7 +381,7 @@ class MergeTreeWriter:
         for k in key_names:
             root = merged.data.schema.field(k).type.root
             if root in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY):
-                pools[k] = build_string_pool([before.data.column(k).values, after.data.column(k).values])
+                pools[k] = exact_string_pool([before.data.column(k), after.data.column(k)])
         lanes_before = encode_key_lanes(before.data, key_names, pools)
         lanes_after = encode_key_lanes(after.data, key_names, pools)
         return full_compaction_changelog(

@@ -41,8 +41,15 @@ class Column:
     the key-lane encoder (data/keys.py): the ranks ARE exact dictionary
     codes against the pool, so the native parquet encoder emits dictionary
     pages without ever touching a string object. Structural ops transform
-    the ranks alongside the values; concat drops the cache (pools differ
-    per input)."""
+    the ranks alongside the values.
+
+    A column may also be CODE-BACKED (`from_codes`): no values, no arrow —
+    only the (pool, codes) pair, produced by the code-domain reader mode
+    (merge.dict-domain). Structural ops then touch only the uint32 codes;
+    concat unifies the input pools in the code domain (ops.dicts); the
+    object ndarray materializes lazily only when `.values` is actually
+    needed (counted in dict{fallback_expanded}). Non-code-backed concat
+    drops the cache (pools differ per input)."""
 
     __slots__ = ("_values", "validity", "arrow", "_len", "dict_cache")
 
@@ -59,6 +66,27 @@ class Column:
                 validity = None
         self.validity = validity
 
+    @staticmethod
+    def from_codes(pool: np.ndarray, codes: np.ndarray, validity: np.ndarray | None = None) -> "Column":
+        """Code-backed column over a sorted dictionary pool. Codes are
+        full-length uint32 ranks into the pool; values at invalid slots are
+        meaningless by contract (conventionally 0)."""
+        col = Column.__new__(Column)
+        col._values = None
+        col.arrow = None
+        col.dict_cache = (pool, codes.astype(np.uint32, copy=False))
+        col._len = len(codes)
+        if validity is not None:
+            assert validity.dtype == np.bool_ and len(validity) == col._len
+            if bool(validity.all()):
+                validity = None
+        col.validity = validity
+        return col
+
+    @property
+    def is_code_backed(self) -> bool:
+        return self._values is None and self.arrow is None
+
     def _with_cache(self, out: "Column", transform) -> "Column":
         if self.dict_cache is not None:
             pool, codes = self.dict_cache
@@ -68,6 +96,21 @@ class Column:
     @property
     def values(self) -> np.ndarray:
         if self._values is None:
+            if self.arrow is None:
+                # code-backed: expand pool[codes] on first python-level
+                # access (nulls become None, matching the expanded decode)
+                from ..metrics import dict_metrics
+
+                pool, codes = self.dict_cache
+                if len(pool):
+                    v = pool.take(np.minimum(codes, len(pool) - 1))
+                else:
+                    v = np.empty(self._len, dtype=object)
+                if self.validity is not None:
+                    v[~self.validity] = None
+                dict_metrics().counter("fallback_expanded").inc(self._len)
+                self._values = v
+                return v
             arr = self.arrow
             v = arr.to_numpy(zero_copy_only=False)
             if v.dtype != np.dtype(object):
@@ -75,11 +118,29 @@ class Column:
             self._values = v
         return self._values
 
+    def value_at(self, i: int):
+        """One python value without materializing the whole column (file
+        min/max key extraction over code-backed/arrow columns)."""
+        if self.validity is not None and not self.validity[i]:
+            return None
+        if self._values is None:
+            if self.arrow is None:
+                pool, codes = self.dict_cache
+                return pool[int(codes[i])]
+            return self.arrow[int(i)].as_py()
+        return self._values[i]
+
     def byte_size(self) -> int:
         """Approximate heap footprint — the currency of write-buffer budgets
         (reference MemorySegmentPool accounts bytes, not rows)."""
         if self.arrow is not None:
             total = self.arrow.nbytes
+        elif self._values is None:
+            # code-backed: codes + a sampled estimate of the pool payload
+            pool, codes = self.dict_cache
+            sample = pool[:1024]
+            payload = sum(len(x) if isinstance(x, (str, bytes)) else 16 for x in sample if x is not None)
+            total = codes.nbytes + int(len(pool) * (8 + payload / max(len(sample), 1)))
         elif self._values.dtype == np.dtype(object):
             # object ndarray of str/bytes: pointer + measured payloads
             sample = self._values[:1024]
@@ -112,6 +173,9 @@ class Column:
     def take(self, indices: np.ndarray) -> "Column":
         m = None if self.validity is None else self.validity.take(indices)
         if self._values is None:
+            if self.arrow is None:
+                pool, codes = self.dict_cache
+                return Column.from_codes(pool, codes.take(indices), m)
             import pyarrow.compute as pc
 
             out = Column(validity=m, arrow=pc.take(self.arrow, indices))
@@ -122,6 +186,9 @@ class Column:
     def slice(self, start: int, stop: int) -> "Column":
         m = None if self.validity is None else self.validity[start:stop]
         if self._values is None:
+            if self.arrow is None:
+                pool, codes = self.dict_cache
+                return Column.from_codes(pool, codes[start:stop], m)
             out = Column(validity=m, arrow=self.arrow.slice(start, stop - start))
         else:
             out = Column(self.values[start:stop], m)
@@ -130,6 +197,9 @@ class Column:
     def filter(self, mask: np.ndarray) -> "Column":
         m = None if self.validity is None else self.validity[mask]
         if self._values is None:
+            if self.arrow is None:
+                pool, codes = self.dict_cache
+                return Column.from_codes(pool, codes[mask], m)
             import pyarrow.compute as pc
 
             out = Column(validity=m, arrow=pc.filter(self.arrow, mask))
@@ -138,7 +208,7 @@ class Column:
         return self._with_cache(out, lambda c: c[mask])
 
     def to_pylist(self) -> list:
-        if self._values is None and self.validity is None:
+        if self._values is None and self.arrow is not None and self.validity is None:
             return self.arrow.to_pylist()
         if self.validity is None:
             return self.values.tolist()
@@ -170,7 +240,16 @@ class Column:
         validity = None
         if not all(c.validity is None for c in cols):
             validity = np.concatenate([c.valid_mask() for c in cols])
-        if cols and all(c._values is None for c in cols):
+        if cols and all(c.is_code_backed for c in cols):
+            # code-domain concat: unify the input pools and re-map codes —
+            # no string object materializes (ops.dicts; None = domain past
+            # the pool limit, fall through to the expanded paths)
+            from ..ops.dicts import unify_columns
+
+            out = unify_columns(cols, validity)
+            if out is not None:
+                return out
+        if cols and all(c._values is None and c.arrow is not None for c in cols):
             import pyarrow as pa
 
             chunks = []
@@ -284,6 +363,20 @@ class ColumnBatch:
         arrays = []
         for f in self.schema.fields:
             c = self.columns[f.name]
+            if c._values is None and c.arrow is None:
+                # code-backed: hand arrow the dictionary form directly —
+                # one int32 cast, zero string materialization (parquet
+                # writes it as a dictionary-encoded column)
+                pool, codes = c.dict_cache
+                if len(pool) == 0:  # all-null column: same null array the
+                    arrays.append(pa.nulls(len(c)))  # expanded path infers
+                    continue
+                mask = None if c.validity is None else ~c.validity
+                indices = pa.array(
+                    np.minimum(codes, max(len(pool) - 1, 0)).astype(np.int32), mask=mask
+                )
+                arrays.append(pa.DictionaryArray.from_arrays(indices, pa.array(pool, from_pandas=True)))
+                continue
             if c._values is None:
                 arrays.append(c.arrow)  # zero-conversion passthrough
                 continue
@@ -409,6 +502,36 @@ def _arrow_to_column(arr, dtype: DataType) -> Column:
     if arr.null_count:
         validity = np.asarray(pc.is_valid(arr))
     np_dtype = dtype.numpy_dtype()
+    if (
+        np_dtype == np.dtype(object)
+        and pa.types.is_dictionary(arr.type)
+        and not pa.types.is_nested(arr.type.value_type)
+        and arr.dictionary.null_count == 0
+    ):
+        # arrow decoded the chunk dictionary-encoded (read_dictionary under
+        # merge.dict-domain): populate the code domain in one C pass —
+        # indices + dictionary straight off the buffers, never a string
+        # object per row (the arrow twin of decode/pages.chunk_codes)
+        from ..metrics import dict_metrics
+        from ..ops.dicts import remap_codes, resolve_pool_limit, sort_dictionary
+
+        if len(arr.dictionary) <= resolve_pool_limit(None):
+            indices = arr.indices
+            if indices.null_count:
+                indices = pc.fill_null(indices, 0)
+            codes = indices.to_numpy(zero_copy_only=False).astype(np.uint32, copy=False)
+            dictionary = arr.dictionary.to_numpy(zero_copy_only=False)
+            if dictionary.dtype != np.dtype(object):
+                dictionary = dictionary.astype(object)
+            pool, remap = sort_dictionary(dictionary)
+            dict_metrics().counter("rows_code_domain").inc(len(codes))
+            return Column.from_codes(pool, remap_codes(remap, codes), validity)
+        dict_metrics().counter("fallback_expanded").inc(len(arr))
+    if pa.types.is_dictionary(arr.type):
+        # dictionary shape the code domain can't carry (nested values,
+        # null dictionary entries, fixed-width dictionary): decode to the
+        # plain type and take the ordinary paths below
+        arr = arr.cast(arr.type.value_type)
     if np_dtype == np.dtype(object):
         if pa.types.is_nested(arr.type):
             # nested (list/map/struct) values must stay python lists/dicts —

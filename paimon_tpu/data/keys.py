@@ -37,6 +37,7 @@ __all__ = [
     "encode_key_lanes",
     "lane_count",
     "build_string_pool",
+    "exact_string_pool",
     "split_int64_lanes",
     "lexsort_rows",
 ]
@@ -169,6 +170,51 @@ def build_string_pool(column_values: Sequence[np.ndarray]) -> np.ndarray:
     return np.unique(np.concatenate(non_empty))
 
 
+def exact_string_pool(cols: Sequence) -> np.ndarray:
+    """Sorted distinct PRESENT values across the given Columns — identical
+    to build_string_pool over their expanded values, but computed entirely
+    in the code domain when every column carries a usable dict_cache: each
+    (pool, codes) pair prunes to its referenced entries and the pruned
+    pools unify (object work at |pool| scale). Falls back to the expanded
+    build when any column lacks a cache."""
+    from ..ops.dicts import cache_usable, prune_pool, unify_pools
+
+    cols = list(cols)
+    if cols and all(cache_usable(c) for c in cols):
+        pruned = []
+        for c in cols:
+            pool, codes = c.dict_cache
+            p, _ = prune_pool(pool, codes, c.validity)
+            pruned.append(p)
+        unified, _ = unify_pools(pruned)
+        return unified
+    return build_string_pool([c.values for c in cols])
+
+
+def _ranks_from_cache(pool: np.ndarray, cache: tuple) -> np.ndarray:
+    """Ranks of a cached (pool, codes) column against a caller-supplied
+    sorted pool: the |pool_c|-sized searchsorted replaces the |rows|-sized
+    one — the rows themselves only pay a uint32 gather (ops.dicts). A used
+    code whose value is missing from the pool is the same data-corruption
+    case the expanded path raises for."""
+    from ..ops.dicts import remap_codes
+
+    pool_c, codes = cache
+    if pool_c is pool:
+        return codes.astype(np.uint32, copy=False)
+    if len(pool) == 0 or len(pool_c) == 0:
+        if len(codes) == 0:
+            return codes.astype(np.uint32, copy=False)
+        raise ValueError("string key value(s) missing from pool; pool must cover all merge inputs")
+    idx = np.searchsorted(pool, pool_c)
+    clipped = np.minimum(idx, len(pool) - 1)
+    entry_ok = pool[clipped] == pool_c
+    ranks = remap_codes(clipped.astype(np.uint32), codes)
+    if len(codes) and not bool(entry_ok.take(codes).all()):
+        raise ValueError("string key value(s) missing from pool; pool must cover all merge inputs")
+    return ranks
+
+
 def encode_key_lanes(
     batch: ColumnBatch,
     key_names: Sequence[str],
@@ -190,7 +236,20 @@ def encode_key_lanes(
             raise ValueError(f"key column {name!r} contains nulls")
         root = batch.schema.field(name).type.root
         pool = None if string_pools is None else string_pools.get(name)
-        col_lanes = _encode_column(col.values, root, pool)
+        cache = col.dict_cache
+        if (
+            root in string_roots
+            and pool is not None
+            and cache is not None
+            and len(cache[1]) == len(col)
+        ):
+            # compressed-domain short circuit: the column already carries
+            # dictionary codes — ranks come from a pool-sized remap + one
+            # uint32 gather, zero searchsorted over the rows and zero
+            # string-object comparisons
+            col_lanes = [_ranks_from_cache(pool, cache)]
+        else:
+            col_lanes = _encode_column(col.values, root, pool)
         if pool is not None and root in string_roots:
             col.dict_cache = (pool, col_lanes[0].astype(np.uint32, copy=False))
         lanes.extend(col_lanes)
@@ -226,11 +285,13 @@ def lexsort_rows(lanes: np.ndarray, *tiebreakers: np.ndarray) -> np.ndarray:
 
 def encode_key_lanes_with_pools(batch, key_names):
     """encode_key_lanes with string pools auto-built for string/bytes keys —
-    the idiom every key-encoding call site needs."""
+    the idiom every key-encoding call site needs. Pools prefer the code
+    domain (exact_string_pool): a column the reader delivered as dictionary
+    codes never expands to build its pool."""
     from ..types import TypeRoot
 
     pools = {
-        name: build_string_pool([batch.column(name).values])
+        name: exact_string_pool([batch.column(name)])
         for name in key_names
         if batch.schema.field(name).type.root
         in (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)

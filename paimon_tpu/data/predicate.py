@@ -117,6 +117,31 @@ _NEGATIONS = {
 }
 
 
+# value-determined leaf functions: the verdict depends only on the (non-null)
+# value, and NULL rows fail them all — exactly the set whose eval transfers
+# from the dictionary domain to the rows (decode/pushdown.py gates on the
+# same property)
+_VALUE_FUNCS = frozenset(
+    {
+        "equal",
+        "notEqual",
+        "lessThan",
+        "lessOrEqual",
+        "greaterThan",
+        "greaterOrEqual",
+        "in",
+        "notIn",
+        "between",
+        "startsWith",
+        "endsWith",
+        "contains",
+        "notStartsWith",
+        "notEndsWith",
+        "notContains",
+    }
+)
+
+
 @dataclass(frozen=True)
 class LeafPredicate(Predicate):
     function: str
@@ -136,12 +161,25 @@ class LeafPredicate(Predicate):
     # ---- data evaluation ----------------------------------------------
     def eval(self, batch: ColumnBatch) -> np.ndarray:
         col = batch.column(self.field)
-        v, valid = col.values, col.valid_mask()
         f, lit = self.function, self.literals
         if f == "isNull":
-            return ~valid
+            return ~col.valid_mask()
         if f == "isNotNull":
-            return valid.copy()
+            return col.valid_mask().copy()
+        if col.is_code_backed and f in _VALUE_FUNCS:
+            # compressed-domain eval (LSM-OPD): the remaining functions are
+            # value-determined and NULL rows fail them all (the `& valid`
+            # below), so one |pool|-sized eval + a uint32 verdict gather
+            # replaces the |rows|-sized eval — the column never expands
+            pool, codes = col.dict_cache
+            verdict = self._eval_values(pool, np.ones(len(pool), dtype=np.bool_))
+            if len(pool) == 0:
+                return np.zeros(len(col), dtype=np.bool_)
+            return verdict.take(np.minimum(codes, len(pool) - 1)) & col.valid_mask()
+        return self._eval_values(col.values, col.valid_mask())
+
+    def _eval_values(self, v: np.ndarray, valid: np.ndarray) -> np.ndarray:
+        f, lit = self.function, self.literals
         if f == "equal":
             m = _masked_cmp(v, valid, "==", lit)
         elif f == "notEqual":

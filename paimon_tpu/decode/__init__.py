@@ -54,9 +54,18 @@ def read_native(
     schema: RowType,
     projection: Sequence[str] | None = None,
     predicate: Predicate | None = None,
+    dict_domain: bool = False,
+    pool_limit: int | None = None,
 ) -> list[ColumnBatch]:
     """Decode one parquet file natively: list of ColumnBatches (one per
-    surviving row group) under `schema` projected to `projection`."""
+    surviving row group) under `schema` projected to `projection`.
+
+    dict_domain=True (merge.dict-domain): string/bytes chunks that are fully
+    dictionary-encoded come back as CODE-BACKED columns — (sorted pool,
+    uint32 codes) via one dictionary sort + one code gather, no string
+    object per row — re-using the index runs the pushdown gate already
+    decoded. Chunks outside the envelope (PLAIN pages, a dictionary past
+    pool_limit) expand exactly as before, per chunk."""
     metrics = decode_metrics()
     t0 = time.perf_counter()
     cols = list(projection) if projection is not None else list(schema.field_names)
@@ -78,12 +87,22 @@ def read_native(
         if rg.num_rows == 0:
             continue
         tp = time.perf_counter()
-        keep = row_group_keep_mask(data, footer, rg, predicate, schema, metrics=metrics)
+        code_cache: dict | None = {} if dict_domain else None
+        keep = row_group_keep_mask(
+            data, footer, rg, predicate, schema, metrics=metrics, code_cache=code_cache
+        )
         metrics.histogram("pushdown_ms").update((time.perf_counter() - tp) * 1000)
         if keep is False:
             continue
         columns: dict[str, Column] = {}
         for f in read_schema.fields:
+            if dict_domain:
+                col = _code_domain_column(
+                    data, rg, f, keep, pool_limit, code_cache, metrics
+                )
+                if col is not None:
+                    columns[f.name] = col
+                    continue
             values, validity = decode_chunk(
                 data,
                 rg.columns[f.name],
@@ -103,3 +122,47 @@ def read_native(
     metrics.counter("files_native").inc()
     metrics.histogram("file_ms").update((time.perf_counter() - t0) * 1000)
     return out
+
+
+_STRING_ROOTS = None
+
+
+def _code_domain_column(data, rg, f, keep, pool_limit, code_cache, metrics):
+    """One chunk as a code-backed Column, or None for the expanded path."""
+    global _STRING_ROOTS
+    if _STRING_ROOTS is None:
+        from ..types import TypeRoot
+
+        _STRING_ROOTS = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+    if f.type.root not in _STRING_ROOTS:
+        return None
+    chunk = rg.columns[f.name]
+    from ..decode.container import T_BYTE_ARRAY
+
+    if chunk.physical_type != T_BYTE_ARRAY or not chunk.has_dictionary:
+        return None
+    from ..metrics import dict_metrics
+    from ..ops.dicts import remap_codes, resolve_pool_limit, sort_dictionary
+    from .pages import chunk_codes
+
+    g = dict_metrics()
+    got = chunk_codes(
+        data, chunk, f.type, rg.num_rows, keep=keep,
+        metrics=metrics, reuse=(code_cache or {}).get(f.name),
+    )
+    if got is None:
+        g.counter("fallback_expanded").inc(rg.num_rows)
+        return None
+    dictionary, codes, validity = got
+    if len(dictionary) > resolve_pool_limit(pool_limit):
+        g.counter("fallback_expanded").inc(rg.num_rows)
+        return None
+    pool, remap = sort_dictionary(dictionary)
+    codes = remap_codes(remap, codes)
+    if keep is not None:
+        codes = codes[keep]
+        validity = None if validity is None else validity[keep]
+    if validity is not None and validity.all():
+        validity = None
+    g.counter("rows_code_domain").inc(len(codes))
+    return Column.from_codes(pool, codes, validity)

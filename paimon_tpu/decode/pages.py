@@ -35,7 +35,7 @@ from .container import (
     iter_pages,
 )
 
-__all__ = ["decode_chunk", "chunk_code_pages", "decode_dictionary", "object_nbytes"]
+__all__ = ["decode_chunk", "chunk_codes", "chunk_code_pages", "decode_dictionary", "object_nbytes"]
 
 
 def _is_utf8(dtype: DataType) -> bool:
@@ -203,6 +203,95 @@ def _cast_physical(compact: np.ndarray, physical: int, np_dtype: np.dtype) -> np
         return compact
     # INT32 physical backing int8/int16/date columns etc.
     return compact.astype(np_dtype, copy=False)
+
+
+def chunk_codes(
+    data,
+    chunk: ColumnChunkInfo,
+    dtype: DataType,
+    num_rows: int,
+    keep: np.ndarray | None = None,
+    metrics=None,
+    reuse: tuple[np.ndarray | None, list] | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray | None] | None:
+    """The code-domain read of one chunk: (dictionary, full-length uint32
+    codes, validity) with the values never expanded — the reader mode of
+    merge.dict-domain. Returns None when the chunk is not fully
+    dictionary-encoded (a mid-chunk PLAIN fallback page); the caller then
+    takes the expanded decode_chunk path for this chunk.
+
+    `reuse` is the (dictionary, pages) pair pushdown already decoded for
+    this chunk (chunk_code_pages): the dictionary-domain predicate verdicts
+    and these index runs are the SAME bytes, so the reader assembles codes
+    from them instead of decompressing the pages a second time. Without
+    reuse, pages whose row range is dead under `keep` are skipped before
+    decompression exactly like decode_chunk."""
+    if not chunk.has_dictionary:
+        return None
+    codes_full = np.zeros(num_rows, dtype=np.uint32)
+    validity = np.ones(num_rows, dtype=np.bool_)
+    any_null = False
+    if reuse is not None:
+        dictionary, pages = reuse
+        if dictionary is None or any(codes is None for _, _, codes, _ in pages):
+            return None
+        for row_start, n, codes, page_validity in pages:
+            sl = slice(row_start, row_start + n)
+            if page_validity is None:
+                codes_full[sl] = codes
+            else:
+                any_null = True
+                validity[sl] = page_validity
+                codes_full[sl][page_validity] = codes
+        return dictionary, codes_full, (validity if any_null else None)
+    dict_page: PageInfo | None = None
+    dictionary = None
+    row = 0
+    for page in iter_pages(data, chunk):
+        if page.kind == PAGE_DICTIONARY:
+            dict_page = page
+            continue
+        if page.encoding not in (ENC_RLE_DICTIONARY, ENC_PLAIN_DICTIONARY):
+            return None  # PLAIN fallback page mid-chunk: expanded path owns it
+        n = page.num_values
+        sl = slice(row, row + n)
+        row += n
+        if keep is not None and not keep[sl].any():
+            validity[sl] = False  # dead rows; dropped by keep before assembly
+            any_null = True
+            if metrics is not None:
+                metrics.counter("pages_skipped").inc()
+            continue
+        if page.kind == PAGE_DATA:
+            raw = decompress(chunk.codec, page.payload, page.uncompressed_size)
+        else:
+            raw = _split_v2(page.payload, page, chunk)
+        page_validity, off = _page_levels(raw, page, chunk)
+        n_valid = n if page_validity is None else int(page_validity.sum())
+        if n_valid == 0:
+            any_null = True
+            validity[sl] = False
+            continue
+        width = raw[off]
+        codes = kernels.decode_rle_hybrid(raw, off + 1, len(raw), width, n_valid)
+        if page_validity is None:
+            codes_full[sl] = codes
+        else:
+            any_null = True
+            validity[sl] = page_validity
+            codes_full[sl][page_validity] = codes
+        if metrics is not None:
+            # decoded, yes — but never expanded: only the index runs and
+            # levels touched, so bytes_expanded stays untouched
+            metrics.counter("pages_decoded").inc()
+    if row != num_rows:
+        raise UnsupportedParquetFeature(
+            f"column {chunk.name}: pages cover {row} rows, row group has {num_rows}"
+        )
+    if dict_page is None:
+        return None
+    dictionary = decode_dictionary(dict_page, chunk, dtype)
+    return dictionary, codes_full, (validity if any_null else None)
 
 
 def chunk_code_pages(

@@ -88,9 +88,15 @@ def row_group_keep_mask(
     predicate: Predicate | None,
     schema: RowType,
     metrics=None,
+    code_cache: dict | None = None,
 ):
     """False → the whole row group is skipped; None → keep every row;
-    ndarray[bool] → per-row keep mask (some pages/rows pruned)."""
+    ndarray[bool] → per-row keep mask (some pages/rows pruned).
+
+    `code_cache` (a per-row-group dict the caller owns) collects the
+    (dictionary, pages) pairs this gate decodes, keyed by field name — the
+    code-domain reader re-uses them as its keep-masked code source instead
+    of decompressing the same index runs a second time."""
     if predicate is None:
         return None
     # stage 1: statistics gate (native analog of the arrow path's
@@ -107,6 +113,8 @@ def row_group_keep_mask(
         if chunk is None or not chunk.has_dictionary or part.field not in schema:
             continue
         dictionary, pages = chunk_code_pages(data, chunk, schema.field(part.field).type)
+        if code_cache is not None:
+            code_cache[part.field] = (dictionary, pages)
         if dictionary is None:
             continue
         surviving = dict_surviving_codes(part, dictionary)

@@ -431,6 +431,13 @@ def _byte_array_route(col: Column, validity, n_valid: int, enable_dict: bool):
         pool, codes = cache
         if validity is not None:
             codes = codes[validity]
+        # pool entries no surviving row references (filtered deletes, merge
+        # losers, unified-domain strays) must not reach the file: pruning
+        # keeps dictionaries minimal across compaction chains and equal to
+        # the expanded path's exact pools
+        from ..ops.dicts import prune_pool
+
+        pool, codes = prune_pool(pool, codes)
         codes = np.ascontiguousarray(codes, dtype=np.int64)
         pool_lens, pool_payload = kernels.byte_array_parts(pool)
         lo = pool[int(codes.min())] if len(codes) else None

@@ -109,10 +109,13 @@ def collect_stats(batch: ColumnBatch, truncate: int = _TRUNCATE_LEN) -> dict[str
             continue
         if f.type.numpy_dtype() == np.dtype(object):
             cache = getattr(col, "dict_cache", None)
-            if cache is not None and len(cache[1]) == n and not nulls:
+            if cache is not None and len(cache[1]) == n:
                 # key-lane pool reuse: the pool is sorted, so min/max are a
-                # uint32 reduction over the ranks — no object comparisons
+                # uint32 reduction over the (valid) ranks — no object
+                # comparisons, and a code-backed column never expands
                 pool, codes = cache
+                if nulls:
+                    codes = codes[col.validity]
                 lo, hi = pool[int(codes.min())], pool[int(codes.max())]
             else:
                 v = col.values[col.valid_mask()] if nulls else col.values

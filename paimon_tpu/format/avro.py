@@ -158,7 +158,11 @@ class AvroFormat(FileFormat):
             col = batch.column(f.name)
             validity = col.validity
             if code == CODE_STRING:
-                arr = col.arrow if col._values is None else pa.array(col.values, from_pandas=True)
+                arr = (
+                    col.arrow
+                    if col._values is None and col.arrow is not None
+                    else pa.array(col.values, from_pandas=True)
+                )
                 if isinstance(arr, pa.ChunkedArray):
                     arr = arr.combine_chunks()
                 target = pa.binary() if f.type.root in (TypeRoot.BINARY, TypeRoot.VARBINARY) else pa.utf8()

@@ -31,11 +31,16 @@ from __future__ import annotations
 
 from typing import Iterator, Sequence
 
+import numpy as np
+
 from ..data.batch import ColumnBatch
 from ..data.predicate import FieldStats, Predicate
 from ..fs import FileIO
-from ..types import RowType
+from ..types import RowType, TypeRoot
 from . import FileFormat, register_format
+
+_OBJ_DTYPE = np.dtype(object)
+_STRING_ROOTS = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
 
 
 class ParquetFormat(FileFormat):
@@ -44,14 +49,26 @@ class ParquetFormat(FileFormat):
     def __init__(self, decoder: str = "arrow", encoder: str = "arrow"):
         self.decoder = decoder
         self.encoder = encoder
+        # merge.dict-domain: both decoders return dictionary-encoded
+        # string/bytes chunks as code-backed columns (PAIMON_TPU_DICT_DOMAIN
+        # env overrides, same rollout pattern as the decoder/encoder knobs)
+        from ..ops.dicts import resolve_dict_domain, resolve_pool_limit
+
+        self.dict_domain = resolve_dict_domain(None)
+        self.pool_limit = resolve_pool_limit(None)
 
     def configure(self, format_options: dict | None) -> "ParquetFormat":
-        d = (format_options or {}).get("format.parquet.decoder")
+        from ..ops.dicts import resolve_dict_domain, resolve_pool_limit
+
+        opts = format_options or {}
+        d = opts.get("format.parquet.decoder")
         if d:
             self.decoder = str(d)
-        e = (format_options or {}).get("format.parquet.encoder")
+        e = opts.get("format.parquet.encoder")
         if e:
             self.encoder = str(e)
+        self.dict_domain = resolve_dict_domain(opts.get("merge.dict-domain"))
+        self.pool_limit = resolve_pool_limit(opts.get("merge.dict-domain.pool-limit"))
         return self
 
     def _effective_encoder(self, format_options: dict | None) -> str:
@@ -143,8 +160,26 @@ class ParquetFormat(FileFormat):
         lp = file_io.local_path(path)
         f = lp if lp is not None else file_io.open_input(path)
         pf = None
+        kw = {}
+        if self.dict_domain:
+            # merge.dict-domain through the ARROW decoder: ask arrow to keep
+            # string/bytes columns dictionary-encoded — from_arrow then
+            # populates the code domain in one C pass per chunk, so the
+            # compressed merge fires regardless of decoder choice
+            kw["read_dictionary"] = [
+                n for n in cols if read_schema.field(n).type.root in _STRING_ROOTS
+            ]
         try:
-            pf = pq.ParquetFile(f, memory_map=True)
+            try:
+                pf = pq.ParquetFile(f, memory_map=True, **kw)
+            except (KeyError, OSError, ValueError):
+                if not kw:
+                    raise
+                # a requested dictionary column isn't a plain leaf in this
+                # file (e.g. a collect aggregate stored the STRING field as
+                # a list) — read it expanded like before
+                kw = {}
+                pf = pq.ParquetFile(f, memory_map=True)
             md = pf.metadata
             name_to_idx = {md.schema.column(i).name: i for i in range(md.num_columns)}
             keep = [
@@ -184,7 +219,15 @@ class ParquetFormat(FileFormat):
         from ..decode import UnsupportedParquetFeature, read_native
 
         try:
-            return read_native(file_io, path, schema, projection=cols, predicate=predicate)
+            return read_native(
+                file_io,
+                path,
+                schema,
+                projection=cols,
+                predicate=predicate,
+                dict_domain=self.dict_domain,
+                pool_limit=self.pool_limit,
+            )
         except UnsupportedParquetFeature:
             from ..metrics import decode_metrics
 

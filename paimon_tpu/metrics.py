@@ -23,6 +23,7 @@ __all__ = [
     "registry",
     "timed",
     "decode_metrics",
+    "dict_metrics",
     "encode_metrics",
     "io_metrics",
     "lanes_metrics",
@@ -149,6 +150,21 @@ def decode_metrics() -> MetricGroup:
     (whole-file native decode wall millis), pushdown_ms (per row group).
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("decode")
+
+
+def dict_metrics() -> MetricGroup:
+    """The dict{...} group (compressed-domain merge, paimon_tpu.ops.dicts +
+    the code-domain reader mode in paimon_tpu.decode). Canonical members —
+    counters: pools_unified (per-input sorted pools merged into a shared
+    merge domain), codes_remapped (rows whose dictionary codes re-mapped
+    through a unification/sort gather), rows_code_domain (rows delivered by
+    a reader as dictionary codes instead of expanded strings),
+    fallback_expanded (rows that fell back to the expanded-string path: a
+    non-dictionary chunk, a pool past merge.dict-domain.pool-limit, or a
+    consumer that needed real values); histogram: unify_ms (host wall
+    millis unifying pools — object work at |pool| scale, never |rows|).
+    Resolved per call so registry.reset() in tests swaps the group out."""
+    return registry.group("dict")
 
 
 def encode_metrics() -> MetricGroup:

@@ -419,9 +419,14 @@ def fused_aggregate(
 
 def _gather_column(column: Column, src: np.ndarray) -> Column:
     ok = src >= 0
-    safe = np.clip(src, 0, max(len(column.values) - 1, 0))
-    vals = column.values.take(safe)
+    safe = np.clip(src, 0, max(len(column) - 1, 0))
     validity = ok & column.valid_mask().take(safe)
+    if column.is_code_backed:
+        # compressed domain: gather the codes, keep the pool — partial-update
+        # and aggregation winners never materialize the strings
+        pool, codes = column.dict_cache
+        return Column.from_codes(pool, codes.take(safe), validity)
+    vals = column.values.take(safe)
     if column.values.dtype != np.dtype(object):
         vals = np.where(validity, vals, np.zeros((), column.values.dtype))
     return Column(vals, validity if not validity.all() else None)

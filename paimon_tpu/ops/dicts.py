@@ -1,0 +1,211 @@
+"""Dictionary-domain unification: codes as the merge currency (ISSUE 10).
+
+LSM-OPD computes directly on compressed LSM data; LUDA's GPU compactor
+re-maps input dictionaries on device instead of decompressing. This module
+is that move for the uint32-lane merge kernel: when every input of a merge
+is dictionary-encoded, the per-file sorted pools unify into ONE pool and
+each input's codes re-map through a vectorized gather — the re-mapped codes
+are directly comparable (rank order == string order), so they become key
+lanes, dedup/aggregation operands, and finally the dictionary page of the
+output file without a string object ever materializing in between.
+
+The pieces:
+
+  sort_dictionary — one file dictionary (parquet insertion order) → sorted
+                    pool + old-code→rank gather table
+  unify_pools     — N sorted pools → one sorted pool + per-input gather
+                    tables (the LUDA re-map; host object work is O(sum of
+                    POOL sizes), never O(rows))
+  remap_codes     — the |rows|-sized gather, numpy engine with a jittable
+                    JAX twin (PAIMON_TPU_DICT_ENGINE=jax)
+  unify_columns   — Column.concat's seam: concatenate code-backed columns
+                    entirely in the code domain
+  prune_pool      — drop pool entries no surviving code references before a
+                    dictionary page is written (file dictionaries stay
+                    minimal across compaction chains)
+
+`merge.dict-domain` (default off) gates the reader that produces code-backed
+columns; PAIMON_TPU_DICT_DOMAIN overrides in either direction (the
+decoder/encoder/lanes rollout pattern). A unified domain larger than
+`merge.dict-domain.pool-limit` falls back to the expanded path per merge —
+codes stay uint32 and the pool stays cheap to unify.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "resolve_dict_domain",
+    "resolve_pool_limit",
+    "sort_dictionary",
+    "unify_pools",
+    "remap_codes",
+    "remap_codes_np",
+    "remap_codes_jax",
+    "unify_columns",
+    "prune_pool",
+    "cache_usable",
+]
+
+DEFAULT_POOL_LIMIT = 1 << 20  # codes stay far inside uint32/int32 range
+
+
+def resolve_dict_domain(enabled: bool | str | None) -> bool:
+    """One resolution order everywhere: the PAIMON_TPU_DICT_DOMAIN env var
+    (verify stages force both paths) beats the caller's option value, which
+    beats the default (off)."""
+    env = os.environ.get("PAIMON_TPU_DICT_DOMAIN", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    if enabled is None:
+        return False
+    if isinstance(enabled, str):
+        return enabled.strip().lower() in ("1", "on", "true")
+    return bool(enabled)
+
+
+def resolve_pool_limit(limit: int | str | None) -> int:
+    """PAIMON_TPU_DICT_POOL_LIMIT env beats the option value beats the
+    default. The limit bounds BOTH a single file's dictionary (reader
+    admission) and a unified merge domain (concat fallback)."""
+    env = os.environ.get("PAIMON_TPU_DICT_POOL_LIMIT", "").strip()
+    if env:
+        return int(env)
+    if limit is None:
+        return DEFAULT_POOL_LIMIT
+    return int(limit)
+
+
+def _metrics():
+    from ..metrics import dict_metrics
+
+    return dict_metrics()
+
+
+def sort_dictionary(dictionary: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(sorted pool, remap) for one file dictionary: pool is the sorted
+    distinct value set and remap[old_code] is the value's rank in the pool.
+    Parquet dictionaries are insertion-ordered and normally duplicate-free;
+    np.unique tolerates duplicates (they collapse to one rank)."""
+    if len(dictionary) == 0:
+        return dictionary.astype(object, copy=False), np.zeros(0, dtype=np.uint32)
+    pool, inverse = np.unique(dictionary, return_inverse=True)
+    if pool.dtype != np.dtype(object):
+        pool = pool.astype(object)
+    return pool, inverse.astype(np.uint32, copy=False)
+
+
+def unify_pools(
+    pools: Sequence[np.ndarray],
+) -> tuple[np.ndarray, list[np.ndarray | None]]:
+    """Merge N sorted pools into one sorted pool; returns per-input gather
+    tables mapping input ranks to unified ranks (None = identity). Object
+    comparisons stay O(sum |pool|) — the rows never participate."""
+    g = _metrics()
+    t0 = time.perf_counter()
+    first = pools[0]
+    if all(p is first for p in pools):
+        g.counter("pools_unified").inc(len(pools))
+        g.histogram("unify_ms").update((time.perf_counter() - t0) * 1000)
+        return first, [None] * len(pools)
+    merged = np.concatenate([p for p in pools]) if pools else np.empty(0, dtype=object)
+    if len(merged) == 0:
+        unified = np.empty(0, dtype=object)
+        remaps: list[np.ndarray | None] = [np.zeros(0, dtype=np.uint32) for _ in pools]
+    else:
+        unified, inverse = np.unique(merged, return_inverse=True)
+        if unified.dtype != np.dtype(object):
+            unified = unified.astype(object)
+        inverse = inverse.astype(np.uint32, copy=False)
+        remaps = []
+        off = 0
+        for p in pools:
+            remaps.append(inverse[off : off + len(p)])
+            off += len(p)
+    g.counter("pools_unified").inc(len(pools))
+    g.histogram("unify_ms").update((time.perf_counter() - t0) * 1000)
+    return unified, remaps
+
+
+def remap_codes_np(remap: np.ndarray, codes: np.ndarray) -> np.ndarray:
+    return remap.take(codes).astype(np.uint32, copy=False)
+
+
+def remap_codes_jax(remap, codes):
+    import jax.numpy as jnp
+
+    return jnp.take(jnp.asarray(remap), jnp.asarray(codes), axis=0)
+
+
+def remap_codes(remap: np.ndarray | None, codes: np.ndarray) -> np.ndarray:
+    """codes → remap[codes], the |rows|-sized vectorized gather (LUDA's
+    device re-map). Engine-routed like decode.kernels.gather: numpy by
+    default, the JAX twin under PAIMON_TPU_DICT_ENGINE=jax."""
+    codes = codes.astype(np.uint32, copy=False)
+    if remap is None or len(codes) == 0:
+        return codes
+    _metrics().counter("codes_remapped").inc(len(codes))
+    if os.environ.get("PAIMON_TPU_DICT_ENGINE") == "jax":
+        return np.asarray(remap_codes_jax(remap, codes)).astype(np.uint32, copy=False)
+    return remap_codes_np(remap, codes)
+
+
+def cache_usable(col) -> bool:
+    """True when a Column's dict_cache is a full-length (pool, codes) pair —
+    the precondition every code-domain consumer checks."""
+    cache = getattr(col, "dict_cache", None)
+    return cache is not None and len(cache[1]) == len(col)
+
+
+def unify_columns(cols: Sequence, validity: np.ndarray | None, limit: int | None = None):
+    """Concatenate code-backed columns without leaving the code domain:
+    unify their pools, re-map and concatenate their codes. Returns the
+    concatenated code-backed Column, or None when the unified domain
+    exceeds the pool limit (the caller falls back to expanded concat)."""
+    from ..data.batch import Column
+
+    pools = [c.dict_cache[0] for c in cols]
+    if sum(len(p) for p in pools) > resolve_pool_limit(limit) and len(set(map(id, pools))) > 1:
+        # cheap upper bound first; the exact unified size needs the unify
+        # itself, which we refuse to pay past the limit
+        g = _metrics()
+        g.counter("fallback_expanded").inc(sum(len(c) for c in cols))
+        return None
+    unified, remaps = unify_pools(pools)
+    if len(unified) > resolve_pool_limit(limit):
+        g = _metrics()
+        g.counter("fallback_expanded").inc(sum(len(c) for c in cols))
+        return None
+    codes = np.concatenate(
+        [remap_codes(r, c.dict_cache[1]) for r, c in zip(remaps, cols)]
+    )
+    return Column.from_codes(unified, codes, validity)
+
+
+def prune_pool(
+    pool: np.ndarray, codes: np.ndarray, validity: np.ndarray | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Restrict a (pool, codes) pair to the entries actually referenced by
+    valid rows: returns (pruned pool, re-mapped codes). The pruned pool is
+    exactly the sorted distinct set of the column's present values — the
+    same pool build_string_pool computes from expanded values — so lane
+    ranks and emitted dictionary pages are identical in both domains.
+    Codes at invalid slots are re-mapped through a clip (their value is
+    meaningless by contract)."""
+    if len(pool) == 0:
+        return pool, codes.astype(np.uint32, copy=False)
+    live = codes if validity is None else codes[validity]
+    used = np.zeros(len(pool), dtype=np.bool_)
+    used[live] = True
+    if used.all():
+        return pool, codes.astype(np.uint32, copy=False)
+    remap = np.cumsum(used, dtype=np.int64) - 1
+    remap[~used] = 0  # dead entries: clip to a harmless rank
+    return pool[used], remap_codes(remap.astype(np.uint32), codes)

@@ -340,6 +340,29 @@ class CoreOptions:
         "a device-computed offset-value code lane (OVC). Output is "
         "bit-identical to the uncompressed path; off restores it.",
     )
+    MERGE_DICT_DOMAIN = ConfigOption.bool_(
+        "merge.dict-domain",
+        False,
+        "Carry dictionary codes as the merge currency end-to-end: readers "
+        "return (pool, codes) columns for dictionary-encoded string/bytes "
+        "chunks instead of expanding them, per-file pools unify into one "
+        "sorted merge domain (ops.dicts — the LSM-OPD/LUDA move), re-mapped "
+        "codes become key lanes with zero searchsorted, dedup/partial-"
+        "update/aggregation and sort-compact run on codes, and flush/"
+        "compaction encode emits dictionary pages straight from the unified "
+        "pool. Falls back to the expanded path per file/merge when a column "
+        "is not dictionary-encoded or the domain exceeds "
+        "merge.dict-domain.pool-limit. Output rows are bit-identical to the "
+        "expanded path. PAIMON_TPU_DICT_DOMAIN overrides.",
+    )
+    MERGE_DICT_DOMAIN_POOL_LIMIT = ConfigOption.int_(
+        "merge.dict-domain.pool-limit",
+        1 << 20,
+        "Largest dictionary domain (distinct values per column) the "
+        "code-domain merge path will carry — a single file dictionary or a "
+        "unified merge pool above this expands to strings instead "
+        "(dict{fallback_expanded}). PAIMON_TPU_DICT_POOL_LIMIT overrides.",
+    )
     MERGE_EXEC_ENGINE = ConfigOption.string(
         "merge.engine",
         "single",
@@ -918,6 +941,14 @@ class CoreOptions:
     @property
     def lane_compression(self) -> bool:
         return self.options.get(CoreOptions.MERGE_LANE_COMPRESSION)
+
+    @property
+    def dict_domain(self) -> bool:
+        return self.options.get(CoreOptions.MERGE_DICT_DOMAIN)
+
+    @property
+    def dict_domain_pool_limit(self) -> int:
+        return self.options.get(CoreOptions.MERGE_DICT_DOMAIN_POOL_LIMIT)
 
     @property
     def changelog_producer(self) -> ChangelogProducer:

@@ -14,7 +14,7 @@ import numpy as np
 
 from ..core.kv import KVBatch
 from ..core.manifest import CommitMessage, ManifestCommittable
-from ..data.keys import build_string_pool, encode_key_lanes
+from ..data.keys import encode_key_lanes, exact_string_pool
 from ..ops.merge import merge_plan
 from ..options import CoreOptions
 from ..ops.zorder import hilbert_lanes, z_order_lanes
@@ -82,8 +82,11 @@ def sort_compact(
         if kv.num_rows == 0:
             continue
         var_roots = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+        # exact pools (code-domain aware): len(pools[c]) must equal the
+        # expanded build's so the zorder spread factor — and therefore the
+        # clustering permutation — is identical with merge.dict-domain on
         pools = {
-            c: build_string_pool([kv.data.column(c).values])
+            c: exact_string_pool([kv.data.column(c)])
             for c in columns
             if kv.data.schema.field(c).type.root in var_roots
         }

@@ -28,6 +28,14 @@
 #                              then forced single — so the mesh-sharded and
 #                              single-device execution engines both prove
 #                              bit-identical merge output.
+#   scripts/verify.sh dicts    compressed-domain merge parity stage: the
+#                              tests/test_dict_domain.py suite (which
+#                              compares merge.dict-domain on vs off
+#                              directly per table) plus the randomized
+#                              whole-store oracle run TWICE —
+#                              PAIMON_TPU_DICT_DOMAIN forced 1, then 0 —
+#                              so dictionary-code and expanded-string
+#                              merges both prove bit-identical output.
 #   scripts/verify.sh soak     traffic-soak stage: the writer flow-control /
 #                              conflict-storm suite plus a bounded (~60 s
 #                              total) DETERMINISTIC mini-soak — fixed seed,
@@ -79,22 +87,36 @@ if [ "${1:-}" = "pipeline" ]; then
 fi
 
 if [ "${1:-}" = "faults" ]; then
-  # mesh engine forced ON: the fault matrix (transient retries, crash
-  # points, torn writes) must stay green through the mesh-sharded executor
-  # and its feeder workers (ISSUE 7)
+  # mesh engine + code-domain merge forced ON: the fault matrix (transient
+  # retries, crash points, torn writes) must stay green through the
+  # mesh-sharded executor, its feeder workers, and the dictionary-code
+  # merge currency (ISSUE 7 / ISSUE 10)
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_FAULT_SEEDS="0 1 2 3 4" PAIMON_TPU_PARQUET_ENCODER=native \
-    PAIMON_TPU_LANE_COMPRESSION=1 PAIMON_TPU_MERGE_ENGINE=mesh \
+    PAIMON_TPU_LANE_COMPRESSION=1 PAIMON_TPU_MERGE_ENGINE=mesh PAIMON_TPU_DICT_DOMAIN=1 \
     timeout -k 10 600 python -m pytest tests/test_resilience.py tests/test_commit_faults.py \
     tests/test_encode.py::test_native_encoder_under_transient_faults -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "dicts" ]; then
+  # parity suite (compares the table option on vs off directly), then the
+  # randomized whole-store oracle with the code domain forced on and off
+  for dd in 1 0; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_DICT_DOMAIN=$dd \
+      timeout -k 10 600 python -m pytest tests/test_dict_domain.py tests/test_randomized_oracle.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
 fi
 
 if [ "${1:-}" = "mesh" ]; then
   # parity suites with the merge execution engine forced mesh, then single:
   # both sides of the merge.engine switch must produce bit-identical output
   # (the conftest forces the 8-device virtual CPU mesh)
+  # the code domain rides along forced ON (ISSUE 10): mesh-batched merges
+  # must stay bit-identical when their lanes are dictionary codes
   for eng in mesh single; do
-    env JAX_PLATFORMS=cpu PAIMON_TPU_MERGE_ENGINE=$eng \
+    env JAX_PLATFORMS=cpu PAIMON_TPU_MERGE_ENGINE=$eng PAIMON_TPU_DICT_DOMAIN=1 \
       timeout -k 10 600 python -m pytest tests/test_mesh_exec.py tests/test_mesh_execution.py \
       tests/test_randomized_oracle.py -q \
       -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
