@@ -317,6 +317,97 @@ def bench_dicts(table) -> list:
     ]
 
 
+def bench_pallas(table) -> list:
+    """Fused pallas merge kernel spot-check (benchmarks/pallas_bench.py is
+    the dedicated per-schema comparison): the standard merge-read table read
+    through table.copy with sort-engine pallas vs xla-segmented, key-range
+    tiled at 2^17 rows so the tiles pad to a VMEM-resident size and the
+    pallas side runs the FUSED sort+segment kernel (on a CPU rig the kernel
+    executes under interpret=True — the row is the parity + no-collapse
+    guard; fused speed is a chip question). Outputs asserted identical
+    row-for-row, plus the pallas{} counter breakdown."""
+    from paimon_tpu.metrics import pallas_metrics
+
+    g = pallas_metrics()
+
+    def counters():
+        return {k: g.counter(k).count for k in ("kernels_launched", "tiles", "fallback_xla")}
+
+    results = {}
+    deltas = None
+    for engine in ("xla-segmented", "pallas"):
+        t = table.copy({"sort-engine": engine, "merge.read-batch-rows": str(1 << 17)})
+        rb = t.new_read_builder()
+        best = float("inf")
+        c0 = counters()
+        out = None
+        for it in range(3):
+            t0 = time.perf_counter()
+            out = rb.new_read().read_all(rb.new_scan().plan())
+            dt = time.perf_counter() - t0
+            assert out.num_rows == N_ROWS, out.num_rows
+            if it > 0:
+                best = min(best, dt)
+        if engine == "pallas":
+            deltas = {k: v - c0[k] for k, v in counters().items()}
+        results[engine] = (N_ROWS / best, out)
+    assert results["pallas"][1].to_pylist() == results["xla-segmented"][1].to_pylist()
+    pal, xla = results["pallas"][0], results["xla-segmented"][0]
+    return [
+        {
+            "metric": "merge-read sort-engine pallas vs xla-segmented (same table, 128k tiles)",
+            "rows_per_sec_xla_segmented": round(xla, 1),
+            "rows_per_sec_pallas": round(pal, 1),
+            "speedup": round(pal / xla, 3),
+            "identical_output": True,
+            "unit": "rows/s",
+        },
+        {
+            "metric": "pallas kernel breakdown",
+            "kernels_launched": deltas["kernels_launched"],
+            "tiles": deltas["tiles"],
+            "fallback_xla": deltas["fallback_xla"],
+            "kernel_ms_mean": round(pallas_metrics().histogram("kernel_ms").mean, 3),
+            "unit": "counters",
+        },
+    ]
+
+
+def bench_adaptive() -> dict:
+    """Adaptive-vs-inline compaction spot-check (benchmarks/
+    adaptive_compact_bench.py is the dedicated 60 s skewed soak with the
+    >=1.2x headline): a short two-mode run — inline compaction in the
+    writers vs the LUDA-style background scheduler with debt admission —
+    reporting sustained ingest, the read-amp bound, and the zero-lost/dup
+    invariants."""
+    import importlib.util
+
+    p = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "adaptive_compact_bench.py"
+    )
+    spec = importlib.util.spec_from_file_location("_adaptive_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    inline = mod.run_mode("inline", duration=8.0, seed=0)
+    adaptive = mod.run_mode("adaptive", duration=8.0, seed=0)
+    clean = all(
+        r["lost_rows"] == 0 and r["duplicated_rows"] == 0 and r["wrong_values"] == 0
+        for r in (inline, adaptive)
+    )
+    return {
+        "metric": "adaptive vs inline compaction (8 s skewed soak spot-check)",
+        "rows_per_sec_inline": inline["rows_per_sec"],
+        "rows_per_sec_adaptive": adaptive["rows_per_sec"],
+        "speedup": round(adaptive["rows_per_sec"] / max(inline["rows_per_sec"], 1e-9), 3),
+        "read_amp_p99_inline": inline["read_amp_p99"],
+        "read_amp_p99_adaptive": adaptive["read_amp_p99"],
+        "read_amp_ceiling": adaptive.get("read_amp_ceiling"),
+        "adaptive_runs": adaptive.get("adaptive_runs"),
+        "zero_lost_dup": clean,
+        "unit": "counters",
+    }
+
+
 def bench_mesh() -> list:
     """Mesh-sharded execution headline (benchmarks/multichip_bench.py is the
     dedicated 1/2/4/8-device sweep): 8-bucket merge-read behind simulated
@@ -395,6 +486,8 @@ def main():
         decode_row = bench_decode(table)
         lanes_rows = bench_lanes(table)
         dict_rows = bench_dicts(table)
+        pallas_rows = bench_pallas(table)
+        adaptive_row = bench_adaptive()
         pipeline_rows = bench_pipeline()
         encode_rows = bench_encode()
         mesh_rows = bench_mesh()
@@ -436,6 +529,9 @@ def main():
             print(json.dumps(dict(lrow, platform=_PLATFORM)))
         for drow in dict_rows:
             print(json.dumps(dict(drow, platform=_PLATFORM)))
+        for prow in pallas_rows:
+            print(json.dumps(dict(prow, platform=_PLATFORM)))
+        print(json.dumps(dict(adaptive_row, platform=_PLATFORM)))
         for prow in pipeline_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         for erow in encode_rows:

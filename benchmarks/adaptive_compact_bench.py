@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Benchmark: adaptive background compaction vs inline compaction under a
+sustained skewed-write soak (ISSUE 11, the LUDA scheduling headline).
+
+Setup: an 8-bucket primary-key table, 2 writer threads on disjoint
+keyspaces, 80% of each round's rows aimed at two HOT buckets (key pools are
+pre-bucketed through the table's own hash function, so the skew is real
+bucket skew, not just key skew). Two modes over the same workload + seed:
+
+  inline    — write-only=false: every writer pays the universal-compaction
+              pick inside its own flush/commit path (the pre-PR behavior)
+  adaptive  — write-only=true writers + AdaptiveCompactorService draining
+              compaction debt in the background by heat/read-amp priority
+
+A sampler thread snapshots per-bucket sorted-run counts (= merge-read
+amplification) every 250 ms in both modes. After the deadline the adaptive
+service drains remaining debt, both modes full-compact, and the final scan
+is verified row-for-row against the in-memory oracle (last write per key):
+0 lost, 0 duplicated.
+
+Acceptance (ISSUE 11): adaptive sustained ingest >= 1.2x inline rows/s at
+equal-or-lower p99 read-amplification, with per-bucket read-amp bounded by
+compaction.adaptive.read-amp-ceiling. Results land in
+benchmarks/results/adaptive_compact_bench.json.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+RESULTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "results", "adaptive_compact_bench.json"
+)
+
+BUCKETS = 8
+WRITERS = 4
+HOT_BUCKETS = (0, 1)
+HOT_FRACTION = 0.8
+ROWS_PER_COMMIT = 400
+KEY_STRIDE = 10_000_000
+READ_AMP_CEILING = 7
+
+
+def _make_table(base_dir, mode):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    opts = {
+        "bucket": str(BUCKETS),
+        # one flush per commit (buffer >= commit size): per-bucket file
+        # creation is exactly one per touched bucket per commit, so the
+        # admission projection is exact
+        "write-buffer-rows": "1024",
+        "snapshot.num-retained.min": "12",
+        "compaction.adaptive.read-amp-ceiling": str(READ_AMP_CEILING),
+        "compaction.adaptive.trigger": "3",
+        # deep rewrites only on a ceiling breach: the steady state is cheap
+        # shallow universal picks of the L0 pileup
+        "compaction.adaptive.deep-runs": "6",
+        "compaction.adaptive.max-buckets-per-round": "2",
+        "compaction.adaptive.interval": "50 ms",
+        "write-only": "true" if mode == "adaptive" else "false",
+    }
+    cat = FileSystemCatalog(base_dir, commit_user=f"acb-{mode}")
+    return cat.create_table(
+        f"db.{mode}",
+        RowType.of(("k", BIGINT(False)), ("v", DOUBLE())),
+        primary_keys=["k"],
+        options=opts,
+    )
+
+
+def _bucket_pools(table, wid, pool_size=24_000):
+    """Pre-bucket a candidate keyspace through the table's own hash, so the
+    workload can aim rows at specific buckets."""
+    from paimon_tpu.data.batch import ColumnBatch
+    from paimon_tpu.table.bucket import key_hashes
+
+    keys = np.arange(wid * KEY_STRIDE, wid * KEY_STRIDE + pool_size, dtype=np.int64)
+    batch = ColumnBatch.from_pydict(table.row_type, {"k": keys, "v": np.zeros(pool_size)})
+    hashes = key_hashes(batch, table.store.key_names)
+    buckets = hashes % BUCKETS
+    return {b: keys[buckets == b] for b in range(BUCKETS)}
+
+
+def _round_keys(rng, pools):
+    """Skewed round: HOT_FRACTION of commits aim every row at the two hot
+    buckets; the rest hit one rotating cold bucket. Per-bucket file-creation
+    rate is therefore genuinely skewed (~40x hot vs cold) — the shape the
+    adaptive policy exists for."""
+    cold_buckets = [b for b in range(BUCKETS) if b not in HOT_BUCKETS]
+    if rng.random() < HOT_FRACTION:
+        target = list(HOT_BUCKETS)
+        parts = [
+            pools[b][rng.integers(0, len(pools[b]), ROWS_PER_COMMIT // len(HOT_BUCKETS))]
+            for b in HOT_BUCKETS
+        ]
+    else:
+        b = cold_buckets[int(rng.integers(0, len(cold_buckets)))]
+        target = [b]
+        parts = [pools[b][rng.integers(0, len(pools[b]), ROWS_PER_COMMIT)]]
+    return np.unique(np.concatenate(parts)), target
+
+
+def _observe_runs(table):
+    plan = table.store.new_scan().plan()
+    out = {}
+    for partition, buckets in plan.grouped().items():
+        for bucket, files in buckets.items():
+            level0 = sum(1 for f in files if f.level == 0)
+            upper = {f.level for f in files if f.level > 0}
+            out[bucket] = level0 + len(upper)
+    return out
+
+
+def run_mode(mode, duration, seed=0, base_dir=None):
+    from paimon_tpu.table.compactor import AdaptiveCompactorService
+
+    own_tmp = base_dir is None
+    base_dir = base_dir or tempfile.mkdtemp(prefix=f"paimon_acb_{mode}_")
+    table = _make_table(base_dir, mode)
+    pools = {w: _bucket_pools(table, w) for w in range(WRITERS)}
+    expected: dict[int, float] = {}
+    exp_lock = threading.Lock()
+    accepted_rows = [0] * WRITERS
+    commits = [0] * WRITERS
+    errors: list[str] = []
+    stop = threading.Event()
+    samples: list[dict] = []
+
+    def writer_loop(wid):
+        import traceback
+
+        from paimon_tpu.core.commit import CommitConflictError, CommitGiveUpError
+        from paimon_tpu.core.manifest import ManifestCommittable
+        from paimon_tpu.service.soak import find_landed_append
+        from paimon_tpu.table.write import TableWrite
+
+        rng = np.random.default_rng(seed * 1000 + wid)
+        user = f"acb-{mode}-w{wid}"
+        handle = table.with_user(user)
+        store = handle.store
+        ident = 0
+        deadline = t_start + duration
+        while not stop.is_set() and time.monotonic() < deadline:
+            ks, target_buckets = _round_keys(rng, pools[wid])
+            if svc is not None:
+                # debt admission: block while any TARGET bucket's projected
+                # sorted-run count sits at/over the read-amp ceiling (the
+                # write-only stop-trigger analog; cold ingest keeps flowing
+                # while hot debt drains) — THIS is what makes "sustained
+                # ingest at bounded read-amplification" a real operating
+                # point, not a race between writers and the scheduler
+                svc.admit(target_buckets, timeout_s=10.0)
+                if stop.is_set() or time.monotonic() >= deadline:
+                    break
+            ident += 1
+            vs = ks.astype(np.float64) * 0.001 + ident
+            landed = False
+            try:
+                try:
+                    w = TableWrite(handle)
+                    try:
+                        w.write({"k": ks, "v": vs})
+                        msgs = w.prepare_commit()
+                    finally:
+                        w.close()
+                    landed = bool(
+                        store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+                    )
+                except (CommitConflictError, CommitGiveUpError):
+                    # a raised commit may still have landed its APPEND half
+                    # (conflict on the COMPACT phase): the snapshot chain,
+                    # not the exception, decides what the oracle counts
+                    landed = find_landed_append(store, user, ident) is not None
+                except Exception:
+                    errors.append(traceback.format_exc())
+                    return
+            finally:
+                if svc is not None:
+                    svc.settle(target_buckets, landed=landed)
+            if landed:
+                with exp_lock:
+                    for k, v in zip(ks.tolist(), vs.tolist()):
+                        expected[k] = v
+                accepted_rows[wid] += len(ks)
+                commits[wid] += 1
+
+    def sampler_loop():
+        deadline = t_start + duration
+        while not stop.is_set() and time.monotonic() < deadline:
+            try:
+                samples.append(_observe_runs(table))
+            except Exception:
+                pass  # planning races a commit: skip the sample
+            time.sleep(0.25)
+
+    svc = None
+    if mode == "adaptive":
+        svc = AdaptiveCompactorService(table)
+        svc.start()
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=writer_loop, args=(w,)) for w in range(WRITERS)]
+    threads.append(threading.Thread(target=sampler_loop))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    stop.set()
+
+    drain_s = 0.0
+    if svc is not None:
+        # drain remaining debt (not counted toward ingest wall time), then
+        # stop the service
+        t0 = time.monotonic()
+        deadline = t0 + 10.0
+        while time.monotonic() < deadline:
+            runs = _observe_runs(table)
+            # drained = back under the ceiling everywhere (cold buckets
+            # below the trigger stay deferred BY DESIGN; the quiesced full
+            # compact below squares the rest away before verification)
+            if all(r < READ_AMP_CEILING for r in runs.values()):
+                break
+            time.sleep(0.2)
+        drain_s = time.monotonic() - t0
+        svc.close()
+
+    # final verification: quiesced full compact + scan == oracle fold
+    from paimon_tpu.table.compactor import DedicatedCompactor
+
+    for _ in range(3):
+        if not DedicatedCompactor(table).run_once(full=True):
+            break
+    rb = table.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    ks = out.column("k").values.tolist()
+    got = dict(zip(ks, out.column("v").values.tolist()))
+    dup = len(ks) - len(got)
+    lost = sum(1 for k in expected if k not in got)
+    wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
+    extra = sum(1 for k in got if k not in expected)
+
+    amps = [r for s in samples for r in s.values()]
+    hot_amps = [s.get(b, 0) for s in samples for b in HOT_BUCKETS]
+    row = {
+        "mode": mode,
+        "duration_s": round(wall, 2),
+        "accepted_rows": int(sum(accepted_rows)),
+        "commits": int(sum(commits)),
+        "rows_per_sec": round(sum(accepted_rows) / wall, 1) if wall else 0.0,
+        "read_amp_p99": float(np.percentile(amps, 99)) if amps else None,
+        "read_amp_max": int(max(amps)) if amps else None,
+        "read_amp_hot_p99": float(np.percentile(hot_amps, 99)) if hot_amps else None,
+        "read_amp_samples": len(samples),
+        "drain_s": round(drain_s, 2),
+        "lost_rows": lost,
+        "duplicated_rows": dup,
+        "wrong_values": wrong,
+        "extra_rows": extra,
+        "unique_keys": len(expected),
+        "final_rows": len(ks),
+        "errors": errors[:3],
+    }
+    if mode == "adaptive":
+        from paimon_tpu.metrics import registry
+
+        snap = registry.snapshot().get("compaction", {})
+        row["adaptive_runs"] = snap.get("adaptive_runs", 0)
+        row["deferred_buckets"] = snap.get("deferred_buckets", 0)
+        row["read_amp_ceiling"] = READ_AMP_CEILING
+    if own_tmp:
+        shutil.rmtree(base_dir, ignore_errors=True)
+    return row
+
+
+def run(duration=60.0, seed=0, write_json=True):
+    from paimon_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    rows = [run_mode("inline", duration, seed), run_mode("adaptive", duration, seed)]
+    inline, adaptive = rows
+    summary = {
+        "speedup": round(adaptive["rows_per_sec"] / max(inline["rows_per_sec"], 1e-9), 3),
+        "target": 1.2,
+        "read_amp_bounded": (
+            adaptive["read_amp_p99"] is not None
+            and adaptive["read_amp_p99"] <= READ_AMP_CEILING
+        ),
+        "read_amp_equal_or_lower": (
+            adaptive["read_amp_p99"] is not None
+            and inline["read_amp_p99"] is not None
+            and adaptive["read_amp_p99"] <= inline["read_amp_p99"]
+        ),
+        "zero_lost_dup": all(
+            r["lost_rows"] == 0 and r["duplicated_rows"] == 0 and r["wrong_values"] == 0
+            and r["extra_rows"] == 0 and not r["errors"]
+            for r in rows
+        ),
+    }
+    for r in rows:
+        print(json.dumps(r))
+    print(json.dumps({"metric": "adaptive vs inline", **summary}))
+    if write_json:
+        os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+        with open(RESULTS, "w") as f:
+            json.dump({"rows": rows, "summary": summary, "duration_s": duration}, f, indent=2)
+    return rows, summary
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    _, summary = run(duration=args.duration, seed=args.seed)
+    sys.exit(0 if summary["zero_lost_dup"] else 1)

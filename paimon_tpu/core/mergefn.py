@@ -81,8 +81,11 @@ class MergeExecutor:
         return self.options.lane_compression
 
     def effective_sort_engine(self):
-        """The merge backend actually used. sort-engine set on the table wins
-        unconditionally; otherwise the default ADAPTS to the resolved
+        """The merge backend actually used. sort-engine set on the table
+        wins unconditionally (a table that explicitly chose numpy/pallas
+        keeps it); then the PAIMON_TPU_SORT_ENGINE env var (the CI forcing
+        knob, pattern of PAIMON_TPU_MERGE_ENGINE) pins every table that
+        did not choose; otherwise the default ADAPTS to the resolved
         platform: the host lexsort path on a CPU-only backend (a single
         stable `np.lexsort` beats XLA:CPU's variadic stable sort ~3x at the
         1M-row scale), the device kernel everywhere else. The check never
@@ -93,15 +96,27 @@ class MergeExecutor:
 
         from ..options import CoreOptions, SortEngine
 
-        if self.options.options.contains(CoreOptions.SORT_ENGINE) or (
-            os.environ.get("PAIMON_TPU_FORCE_DEVICE_ENGINE", "") == "1"
-        ):
+        if self.options.options.contains(CoreOptions.SORT_ENGINE):
+            return SortEngine(self.options.sort_engine)
+        env = os.environ.get("PAIMON_TPU_SORT_ENGINE", "").strip().lower()
+        if env:
+            return SortEngine(env)
+        if os.environ.get("PAIMON_TPU_FORCE_DEVICE_ENGINE", "") == "1":
             return SortEngine(self.options.sort_engine)
         from ..ops.merge import resolved_platform_is_cpu
 
         if resolved_platform_is_cpu():
             return SortEngine.NUMPY
         return SortEngine(self.options.sort_engine)
+
+    def _engine_str(self) -> str:
+        """The ops-layer engine tag for the sorted_segments seam: 'pallas'
+        routes every merge kernel's sort+boundary preamble through the fused
+        pallas kernels; everything else is the stock XLA path. (The numpy
+        engine never reaches a device kernel — callers branch before.)"""
+        from ..options import SortEngine
+
+        return "pallas" if self.effective_sort_engine() == SortEngine.PALLAS else "xla"
 
     def _key_lanes(self, kv: KVBatch) -> np.ndarray:
         from ..data.keys import encode_key_lanes_with_pools
@@ -148,7 +163,7 @@ class MergeExecutor:
 
     def _plan(self, kv: KVBatch, seq_ascending: bool = False):
         lanes, seq_lanes = self._lanes(kv, seq_ascending)
-        return merge_plan(lanes, seq_lanes, compress=self._compress)
+        return merge_plan(lanes, seq_lanes, compress=self._compress, engine=self._engine_str())
 
     def merge(self, kv: KVBatch, seq_ascending: bool = False) -> KVBatch:
         """One output row per key, key-sorted. Dedup keeps the winning row's
@@ -253,7 +268,12 @@ class MergeExecutor:
                 cols = [kv.data.column(f.name) for f in fields]
                 if fused_routable(specs, cols):
                     return ("sync", self._aggregate_fused(kv, lanes, seq_lanes, fields, specs, cols))
-        return ("sync", self._merge_with_plan(kv, merge_plan(lanes, seq_lanes, compress=self._compress)))
+        return (
+            "sync",
+            self._merge_with_plan(
+                kv, merge_plan(lanes, seq_lanes, compress=self._compress, engine=self._engine_str())
+            ),
+        )
 
     def merge_resolve(self, handle) -> KVBatch:
         tag = handle[0]
@@ -359,6 +379,7 @@ class MergeExecutor:
             kv.kind,
             remove_record_on_delete=remove_on_delete,
             compress=self._compress,
+            engine=self._engine_str(),
         )
         cols: dict[str, Column] = {}
         for k in self.key_names:
@@ -376,7 +397,9 @@ class MergeExecutor:
         the same kernel as the sort."""
         from ..ops.aggregates import fused_aggregate
 
-        agg_cols, last_take = fused_aggregate(lanes, seq_lanes, cols_in, specs, kv.kind, compress=self._compress)
+        agg_cols, last_take = fused_aggregate(
+            lanes, seq_lanes, cols_in, specs, kv.kind, compress=self._compress, engine=self._engine_str()
+        )
         cols: dict[str, Column] = {}
         for k in self.key_names:
             cols[k] = kv.data.column(k).take(last_take)
@@ -436,7 +459,7 @@ class MergeExecutor:
         g_lanes = self._lanes_nullsafe(gcol, root, gpool, seq_col)
         hi, lo = split_int64_lanes(kv.seq)
         seq_lanes = np.concatenate([g_lanes, np.stack([hi, lo], axis=1)], axis=1)
-        gplan = merge_plan(key_lanes, seq_lanes, compress=self._compress)
+        gplan = merge_plan(key_lanes, seq_lanes, compress=self._compress, engine=self._engine_str())
         candidate = g_valid & np.isin(kv.kind, (int(RowKind.INSERT), int(RowKind.UPDATE_AFTER)))
         src = _pick_fn(True)(
             jnp.asarray(gplan.perm), jnp.asarray(gplan.seg_id), jnp.asarray(pad_to(candidate, gplan.m, False))

@@ -22,12 +22,14 @@ __all__ = [
     "MetricRegistry",
     "registry",
     "timed",
+    "compaction_metrics",
     "decode_metrics",
     "dict_metrics",
     "encode_metrics",
     "io_metrics",
     "lanes_metrics",
     "mesh_metrics",
+    "pallas_metrics",
     "pipeline_metrics",
     "soak_metrics",
 ]
@@ -238,6 +240,42 @@ def soak_metrics() -> MetricGroup:
     admission). Resolved per call so registry.reset() in tests swaps the
     group out."""
     return registry.group("soak")
+
+
+def pallas_metrics() -> MetricGroup:
+    """The pallas{...} group (fused merge kernels, paimon_tpu.ops.
+    pallas_kernels, routed by sort-engine=pallas). Canonical members —
+    counters: kernels_launched (merge dispatches routed through the pallas
+    engine), tiles (pallas grid steps: 1 per fused sort+segment call, one
+    per _BLOCK rows for the post-lax.sort boundary sweep), fallback_xla
+    (dispatches that exceeded the fused kernel's VMEM admission test — or
+    found no pallas at all — and fell back to lax.sort; the boundary sweep
+    still runs in pallas when available); histogram: kernel_ms (wall millis
+    of synchronously-resolved fused dispatches: merge_plan and the fused
+    partial-update/aggregate kernels; async dedup dispatch latency is
+    benchmarked in benchmarks/pallas_bench.py instead). Resolved per call
+    so registry.reset() in tests swaps the group out."""
+    return registry.group("pallas")
+
+
+def compaction_metrics() -> MetricGroup:
+    """The compaction{...} group (LSM compaction execution, core.compact,
+    plus the adaptive scheduler, table.compactor.AdaptiveCompactorService).
+    Canonical members — counters: compactions, files_rewritten (execution
+    side, incremented per committed rewrite), adaptive_runs (buckets the
+    adaptive scheduler compacted), deferred_buckets (buckets with pending
+    sorted runs the policy deliberately left for later — cold or below
+    trigger), adaptive_conflicts (adaptive rounds abandoned to a rival
+    commit), admission_waits (ingest commits that blocked in the service's
+    debt-admission gate because a target bucket sat at/over the read-amp
+    ceiling); gauges: debt_files / debt_bytes (files and bytes above one
+    run per bucket, summed over buckets — the compaction debt the
+    scheduler is draining), read_amplification_p99 (p99 of per-bucket
+    sorted-run counts at the last observation — the bound
+    compaction.adaptive.read-amp-ceiling enforces); histogram: duration_ms
+    (per compaction execution). Resolved per call so registry.reset() in
+    tests swaps the group out."""
+    return registry.group("compaction")
 
 
 def io_metrics() -> MetricGroup:

@@ -295,7 +295,7 @@ def _host_reduce(plan: MergePlan, values: np.ndarray, eff_valid: np.ndarray, fn:
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_aggregate_fn(num_key: int, num_seq: int, col_fns: tuple[str, ...]):
+def _fused_aggregate_fn(num_key: int, num_seq: int, col_fns: tuple[str, ...], engine: str = "xla"):
     """Sort + every column's segment reduction in ONE kernel (the aggregation
     analog of the fused dedup kernel): uploads lanes + value columns once,
     downloads only the (C, k) results — no plan arrays, no per-column
@@ -308,7 +308,7 @@ def _fused_aggregate_fn(num_key: int, num_seq: int, col_fns: tuple[str, ...]):
     def f(key_lanes, seq_lanes, pad_flag, values, valids, signs):
         m = pad_flag.shape[0]
         pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
-            num_key, num_seq, key_lanes, seq_lanes, pad_flag
+            num_key, num_seq, key_lanes, seq_lanes, pad_flag, engine=engine
         )
         pos = jnp.arange(m, dtype=jnp.int32)
         outs = []
@@ -359,6 +359,7 @@ def fused_aggregate(
     specs: list[AggregateSpec],
     row_kind: np.ndarray,
     compress: bool | None = None,
+    engine: str = "xla",
 ) -> tuple[list[Column], np.ndarray]:
     """Single-call aggregation merge over every value column. Returns
     (aggregated columns in key order, last_take winning-row indices). Key
@@ -398,7 +399,11 @@ def fused_aggregate(
             values.append(pad_to(col.values, m, 0))
             valids.append(pad_to(valid & include, m, False))
             signs.append(pad_to(sign.astype(np.int8), m, 1))
-    outs, anyv, packed, count = _fused_aggregate_fn(k, s, tuple(col_fns))(
+    if engine == "pallas":
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 1 + k + s)
+    outs, anyv, packed, count = _fused_aggregate_fn(k, s, tuple(col_fns), engine)(
         klp, slp, pad, tuple(values), tuple(valids), tuple(signs)
     )
     kk = int(count)

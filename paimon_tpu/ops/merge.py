@@ -56,7 +56,9 @@ def pad_to(arr: np.ndarray, m: int, fill=0) -> np.ndarray:
     return out
 
 
-def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes, pad_flag, extra_keys=()):
+def sorted_segments(
+    num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes, pad_flag, extra_keys=(), engine: str = "xla"
+):
     """The shared in-kernel preamble (traced inside each jitted kernel): one
     stable lexicographic sort on (pad, key lanes, seq lanes, iota), then
     segment detection over (pad, key lanes) only — sequence lanes do NOT
@@ -72,23 +74,46 @@ def sorted_segments(num_key_lanes: int, num_seq_lanes: int, key_lanes, seq_lanes
     tested FIRST in boundary detection. An extra key must satisfy the OVC
     contract — where it differs it agrees with full-key order, where it ties
     the key lanes decide — so both the permutation and the segmentation stay
-    bit-identical to the plain path."""
+    bit-identical to the plain path.
+
+    engine="pallas" is the sort-engine=pallas seam every merge kernel
+    inherits: batches that pass the VMEM admission test run the FUSED
+    pallas kernel (sort + boundary + keep-last in one pass,
+    ops/pallas_kernels.fused_sort_segments); larger batches keep `lax.sort`
+    but compute the boundary mask with the pallas sweep kernel. Both tiers
+    are bit-identical to the plain path; when pallas is unavailable the
+    engine silently degrades to xla."""
     m = pad_flag.shape[0]
-    iota = jnp.arange(m, dtype=jnp.int32)
     extra = list(extra_keys)
-    operands = (
-        [pad_flag]
-        + extra
-        + [key_lanes[i] for i in range(num_key_lanes)]
-        + [seq_lanes[i] for i in range(num_seq_lanes)]
-        + [iota]
-    )
-    out = jax.lax.sort(
-        operands, num_keys=1 + len(extra) + num_key_lanes + num_seq_lanes, is_stable=True
-    )
+    boundary = [pad_flag] + extra + [key_lanes[i] for i in range(num_key_lanes)]
+    order = [seq_lanes[i] for i in range(num_seq_lanes)]
+    if engine == "pallas":
+        from . import pallas_kernels as pk
+
+        if pk.fusable(m, len(boundary) + len(order)):
+            return pk.fused_sort_segments(boundary, order)
+        if not pk._PALLAS_OK:
+            engine = "xla"  # automatic fallback: no pallas in this build
+    iota = jnp.arange(m, dtype=jnp.int32)
+    operands = boundary + order + [iota]
+    out = jax.lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
     perm = out[-1]
+    if engine == "pallas":
+        # large-batch tier: lax.sort + the fused pallas boundary sweep
+        # (narrowed lanes may be u8/u16 — widening on device costs nothing)
+        from .pallas_kernels import keep_last_mask, pallas_interpret
+
+        stacked = jnp.stack(
+            [lane.astype(jnp.uint32) for lane in out[: len(boundary)]], axis=0
+        )
+        keep_last = keep_last_mask(stacked, interpret=pallas_interpret(), mask_pad=False).astype(
+            jnp.bool_
+        )
+        seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), keep_last[:-1]])
+        seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+        return out[0], perm, seg_start, keep_last, seg_id
     neq = jnp.zeros(m - 1, dtype=jnp.bool_)
-    for lane in out[: 1 + len(extra) + num_key_lanes]:
+    for lane in out[: len(boundary)]:
         neq = neq | (lane[1:] != lane[:-1])
     seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), neq])
     keep_last = jnp.concatenate([neq, jnp.ones((1,), jnp.bool_)])
@@ -279,10 +304,11 @@ def prepare_lanes_planned(
 
 
 @functools.lru_cache(maxsize=None)
-def _plan_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0):
+def _plan_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0, engine: str = "xla"):
     """Builds the jitted sort+segment kernel for a lane arity. ovc_vbits > 0
     adds the device-computed offset-value code as the leading key (and the
-    base values as a traced (G,) operand)."""
+    base values as a traced (G,) operand). engine routes the preamble
+    through the sort-engine seam (pallas = fused sort+segment kernel)."""
     if ovc_vbits:
         from .lanes import ovc_codes_jax
 
@@ -293,7 +319,7 @@ def _plan_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0):
             )
             _, perm, seg_start, keep_last, seg_id = sorted_segments(
                 num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag,
-                extra_keys=(code,),
+                extra_keys=(code,), engine=engine,
             )
             return perm, seg_start, keep_last, seg_id
 
@@ -304,7 +330,7 @@ def _plan_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0):
         # key/seq lanes: (K, m)/(S, m) arrays OR lists of (m,) mixed-dtype
         # uint arrays (narrowed upload); pad_flag: (m,) uint
         _, perm, seg_start, keep_last, seg_id = sorted_segments(
-            num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
+            num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag, engine=engine
         )
         return perm, seg_start, keep_last, seg_id
 
@@ -347,7 +373,10 @@ def drop_constant_lanes(lanes: np.ndarray) -> np.ndarray:
 
 
 def merge_plan(
-    key_lanes: np.ndarray, seq_lanes: np.ndarray | None = None, compress: bool | None = None
+    key_lanes: np.ndarray,
+    seq_lanes: np.ndarray | None = None,
+    compress: bool | None = None,
+    engine: str = "xla",
 ) -> MergePlan:
     """key_lanes: (n, K) uint32. seq_lanes: (n, S) uint32 ordering within a
     key group (user-defined sequence lanes first, then sequence-number lanes —
@@ -376,7 +405,7 @@ def merge_plan(
         # sort dispatched at all (the old path kept a dummy constant lane
         # "for shape sanity" and sorted it anyway)
         return _scalar_plan(key_lanes.shape[0])
-    return _merge_plan_padded(kl_kept, seq_keep, plan)
+    return _merge_plan_padded(kl_kept, seq_keep, plan, engine)
 
 
 def _scalar_plan(n: int) -> MergePlan:
@@ -397,7 +426,9 @@ def _scalar_plan(n: int) -> MergePlan:
     return MergePlan(perm=perm, seg_start=seg_start, keep_last=keep_last, seg_id=seg_id, n=n, m=m)
 
 
-def _merge_plan_padded(key_lanes: np.ndarray, seq_lanes: np.ndarray | None, plan=None) -> MergePlan:
+def _merge_plan_padded(
+    key_lanes: np.ndarray, seq_lanes: np.ndarray | None, plan=None, engine: str = "xla"
+) -> MergePlan:
     n, k = key_lanes.shape
     if seq_lanes is None:
         seq_lanes = np.zeros((n, 0), dtype=np.uint32)
@@ -409,14 +440,28 @@ def _merge_plan_padded(key_lanes: np.ndarray, seq_lanes: np.ndarray | None, plan
     sl[:, :n] = seq_lanes.T
     pad = np.zeros(m, dtype=np.uint32)
     pad[n:] = 1
-    if plan is not None and plan.use_ovc:
+    use_ovc = plan is not None and plan.use_ovc
+    timer = None
+    if engine == "pallas":
+        from ..metrics import pallas_metrics, timed
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 1 + k + s + (1 if use_ovc else 0))
+        # this path resolves synchronously just below (np.asarray), so the
+        # wall time around dispatch+download is the kernel latency
+        timer = timed(pallas_metrics().histogram("kernel_ms"))
+        timer.__enter__()
+    if use_ovc:
         # this path uploads unshifted u32 lanes, so the packed-space base
         # passes through unshifted too
-        perm, seg_start, keep_last, seg_id = _plan_fn(k, s, plan.ovc_vbits)(
+        perm, seg_start, keep_last, seg_id = _plan_fn(k, s, plan.ovc_vbits, engine)(
             kl, sl, pad, np.asarray(plan.base, dtype=np.uint32)
         )
     else:
-        perm, seg_start, keep_last, seg_id = _plan_fn(k, s)(kl, sl, pad)
+        perm, seg_start, keep_last, seg_id = _plan_fn(k, s, 0, engine)(kl, sl, pad)
+    if timer is not None:
+        np.asarray(perm)  # force the async dispatch before stopping the clock
+        timer.__exit__(None, None, None)
     return MergePlan(
         perm=np.asarray(perm),
         seg_start=np.asarray(seg_start),
@@ -434,37 +479,16 @@ def deduplicate_take(plan: MergePlan) -> np.ndarray:
     return plan.perm[plan.keep_last & plan.valid_sorted]
 
 
-def _pallas_keep_last_select(pad_flag, key_lanes, seq_lanes=()):
-    """In-kernel: stable sort on (pad, keys..., seqs...) then the fused
-    pallas boundary sweep (keep_last_mask) -> (sel, perm). The single
-    implementation of the pallas dedup epilogue, shared by the wide and
-    delta-upload kernels so the interpret flag and u32-upcast rule can
-    never diverge between them."""
-    m = pad_flag.shape[0]
-    iota = jnp.arange(m, dtype=jnp.int32)
-    operands = [pad_flag, *key_lanes, *seq_lanes, iota]
-    out = jax.lax.sort(operands, num_keys=len(operands) - 1, is_stable=True)
-    perm = out[-1]
-    from .pallas_kernels import keep_last_mask
-
-    # upcast to u32 for the pallas kernel (narrowed lanes may be u8/u16;
-    # widening on device costs nothing vs the link)
-    stacked = jnp.stack(
-        [lane.astype(jnp.uint32) for lane in out[: 1 + len(key_lanes)]], axis=0
-    )
-    sel = keep_last_mask(stacked, interpret=jax.default_backend() == "cpu").astype(jnp.bool_)
-    return sel, perm
-
-
 @functools.lru_cache(maxsize=None)
 def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla", ovc_vbits: int = 0):
     """Sort + keep-last + device-side compaction: returns ONLY the selected
     input indices (packed to the front) and their count — the minimal
     device->host transfer for the dominant dedup path. backend="pallas"
-    computes the boundary mask with the fused pallas sweep
-    (ops/pallas_kernels.keep_last_mask). ovc_vbits > 0 computes the
-    offset-value code lane on device and leads the sort + boundary detection
-    with it (ops/lanes.py)."""
+    runs the fused pallas sort+segment kernel (or the lax.sort + pallas
+    boundary sweep above the VMEM cap) through the sorted_segments seam;
+    ovc_vbits > 0 computes the offset-value code lane on device and leads
+    the sort + boundary detection with it (ops/lanes.py) — composing with
+    either engine."""
     if ovc_vbits:
         from .lanes import ovc_codes_jax
 
@@ -475,7 +499,7 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla
             )
             pad_sorted, perm, _, keep_last, _ = sorted_segments(
                 num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag,
-                extra_keys=(code,),
+                extra_keys=(code,), engine=backend,
             )
             return pack_selected(keep_last & (pad_sorted == 0), perm)
 
@@ -483,17 +507,10 @@ def _dedup_select_fn(num_key_lanes: int, num_seq_lanes: int, backend: str = "xla
 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag):
-        if backend == "pallas":
-            sel, perm = _pallas_keep_last_select(
-                pad_flag,
-                [key_lanes[i] for i in range(num_key_lanes)],
-                [seq_lanes[i] for i in range(num_seq_lanes)],
-            )
-        else:
-            pad_sorted, perm, _, keep_last, _ = sorted_segments(
-                num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
-            )
-            sel = keep_last & (pad_sorted == 0)  # exclude pad rows
+        pad_sorted, perm, _, keep_last, _ = sorted_segments(
+            num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag, engine=backend
+        )
+        sel = keep_last & (pad_sorted == 0)  # exclude pad rows
         return pack_selected(sel, perm)
 
     return f
@@ -511,14 +528,19 @@ def deduplicate_select_async(
     The key matrix goes through the lane-compression seam first; an
     all-constant key short-circuits to the scalar winner without any device
     dispatch."""
-    klp, slp, pad, n, k, s, _, plan = prepare_lanes_planned(key_lanes, seq_lanes, compress=compress)
+    klp, slp, pad, n, k, s, m, plan = prepare_lanes_planned(key_lanes, seq_lanes, compress=compress)
     if k == 0:
         # all keys equal: one winner — the last row in (seq, input) order;
         # no key sort, no device trip (host lexsort of the seq lanes only)
         from .lanes import scalar_dedup_winner
 
         return ("scalar", scalar_dedup_winner(seq_lanes, n))
-    if plan is not None and plan.use_ovc and backend != "pallas":
+    use_ovc = plan is not None and plan.use_ovc
+    if backend == "pallas":
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 1 + k + s + (1 if use_ovc else 0))
+    if use_ovc:
         return _dedup_select_fn(k, s, backend, plan.ovc_vbits)(
             klp, slp, pad, np.asarray(plan.base, dtype=np.uint32)
         )
@@ -585,11 +607,12 @@ def _pad_starts(starts_real: Sequence[int], m: int) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0):
+def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: int = 0, engine: str = "xla"):
     """Sort + keep-last + compact-encoded selection: the downlink-minimal
     dedup kernel (bit-packed keep-mask + run-id interleave instead of int32
     indices). ovc_vbits > 0 leads sort + boundary detection with the
-    device-computed offset-value code lane."""
+    device-computed offset-value code lane; engine routes the preamble
+    through the sort-engine seam."""
     if ovc_vbits:
         from .lanes import ovc_codes_jax
 
@@ -600,7 +623,7 @@ def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: 
             )
             pad_sorted, perm, _, keep_last, _ = sorted_segments(
                 num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag,
-                extra_keys=(code,),
+                extra_keys=(code,), engine=engine,
             )
             return pack_selection_compact(keep_last & (pad_sorted == 0), perm, starts)
 
@@ -609,7 +632,7 @@ def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: 
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag, starts):
         pad_sorted, perm, _, keep_last, _ = sorted_segments(
-            num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag
+            num_key_lanes, num_seq_lanes, key_lanes, seq_lanes, pad_flag, engine=engine
         )
         sel = keep_last & (pad_sorted == 0)
         return pack_selection_compact(sel, perm, starts)
@@ -618,7 +641,7 @@ def _dedup_select_compact_fn(num_key_lanes: int, num_seq_lanes: int, ovc_vbits: 
 
 
 def deduplicate_select_compact_async(
-    key_lanes: np.ndarray, run_offsets: Sequence[int], compress: bool | None = None
+    key_lanes: np.ndarray, run_offsets: Sequence[int], compress: bool | None = None, backend: str = "xla"
 ):
     """Compact-download dispatch for run-structured inputs (each run
     key-sorted ascending). Returns an opaque handle for
@@ -635,12 +658,17 @@ def deduplicate_select_compact_async(
 
         return ("scalar", scalar_dedup_winner(None, n))
     starts_p = _pad_starts(starts_real, m)
-    if plan is not None and plan.use_ovc:
-        outs = _dedup_select_compact_fn(k, s, plan.ovc_vbits)(
+    use_ovc = plan is not None and plan.use_ovc
+    if backend == "pallas":
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 1 + k + s + (1 if use_ovc else 0))
+    if use_ovc:
+        outs = _dedup_select_compact_fn(k, s, plan.ovc_vbits, backend)(
             klp, slp, pad, starts_p, np.asarray(plan.base, dtype=np.uint32)
         )
     else:
-        outs = _dedup_select_compact_fn(k, s)(klp, slp, pad, starts_p)
+        outs = _dedup_select_compact_fn(k, s, 0, backend)(klp, slp, pad, starts_p)
     return ("compact", outs, n, len(starts_real), _runid_bits(len(starts_p)))
 
 
@@ -701,7 +729,7 @@ def _dedup_select_delta_fn(backend: str = "xla"):
     @jax.jit
     def f(deltas, starts, bases, pad_flag):
         lane = _delta_reconstruct_lane(deltas, starts, bases, pad_flag)
-        pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag)
+        pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag, engine=backend)
         sel = keep_last & (pad_sorted == 0)
         return pack_selection_compact(sel, perm, starts)
 
@@ -712,17 +740,13 @@ def _dedup_select_delta_fn(backend: str = "xla"):
 def _dedup_select_delta_wide_fn(backend: str = "xla"):
     """Delta-packed UPLOAD with the legacy index DOWNLOAD (pack_selected):
     keeps the halved uplink bytes when the compact download encoding is
-    unavailable — run counts past its u8 run-id limit (>256), and the
-    pallas backend (whose epilogue is the mask kernel under benchmark)."""
+    unavailable — run counts past its u8 run-id limit (>256)."""
 
     @jax.jit
     def f(deltas, starts, bases, pad_flag):
         lane = _delta_reconstruct_lane(deltas, starts, bases, pad_flag)
-        if backend == "pallas":
-            sel, perm = _pallas_keep_last_select(pad_flag, [lane])
-        else:
-            pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag)
-            sel = keep_last & (pad_sorted == 0)
+        pad_sorted, perm, _, keep_last, _ = sorted_segments(1, 0, [lane], [], pad_flag, engine=backend)
+        sel = keep_last & (pad_sorted == 0)
         return pack_selected(sel, perm)
 
     return f
@@ -731,16 +755,21 @@ def _dedup_select_delta_wide_fn(backend: str = "xla"):
 def deduplicate_select_delta_async(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: str = "xla"):
     """Delta-packed dispatch for single-lane run-sorted keys; None when the
     lane does not qualify (multi-lane, non-ascending, sparse deltas, or a
-    range the u16 narrowing already covers). Above 256 runs and on the
-    pallas backend, the upload stays delta-packed but the download falls
-    back to packed indices (_dedup_select_delta_wide_fn)."""
+    range the u16 narrowing already covers). Above 256 runs the upload
+    stays delta-packed but the download falls back to packed indices
+    (_dedup_select_delta_wide_fn). Both downloads route the sort+boundary
+    preamble through the sort-engine seam."""
     if key_lanes.shape[1] != 1:
         return None
     packed = pack_delta_runs(key_lanes[:, 0], run_offsets)
     if packed is None:
         return None
-    deltas, starts, bases, pad, n, _m, num_runs = packed
-    if num_runs > 256 or backend == "pallas":
+    deltas, starts, bases, pad, n, m, num_runs = packed
+    if backend == "pallas":
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 2)
+    if num_runs > 256:
         return _dedup_select_delta_wide_fn(backend)(deltas, starts, bases, pad)
     outs = _dedup_select_delta_fn(backend)(deltas, starts, bases, pad)
     return ("compact", outs, n, num_runs, _runid_bits(len(starts)))
@@ -753,15 +782,15 @@ def _dedup_dispatch(key_lanes: np.ndarray, run_offsets: Sequence[int], backend: 
     (_link_encodings_pay_off): there are no link bytes to save. Callers
     (the tiled dispatcher) have already run the lane-compression seam, so
     every path here suppresses it (compress=False) — plans are made once
-    per merge, not once per tile."""
+    per merge, not once per tile. The sort-engine seam (backend) composes
+    with every encoding: the link format is independent of which kernel
+    computes the sort + boundary."""
     if not _link_encodings_pay_off():
         return deduplicate_select_async(key_lanes, None, backend=backend, compress=False)
     handle = deduplicate_select_delta_async(key_lanes, run_offsets, backend=backend)
     if handle is not None:
         return handle
-    if backend == "pallas":
-        return deduplicate_select_async(key_lanes, None, backend=backend, compress=False)
-    handle = deduplicate_select_compact_async(key_lanes, run_offsets, compress=False)
+    handle = deduplicate_select_compact_async(key_lanes, run_offsets, compress=False, backend=backend)
     if handle is None:  # >256 runs: index-download fallback
         handle = deduplicate_select_async(key_lanes, None, backend=backend, compress=False)
     return handle
@@ -1051,7 +1080,7 @@ def _partial_update_select(perm, pad_sorted, seg_id, field_valid, is_add, is_del
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_partial_update_compact_fn(num_key: int, num_seq: int, num_fields: int):
+def _fused_partial_update_compact_fn(num_key: int, num_seq: int, num_fields: int, engine: str = "xla"):
     """The fused partial-update kernel with compact downloads: instead of
     the (F, k) int32 source matrix (the dominant link bytes of the
     partial-update read on tunnel-attached chips), each field ships a
@@ -1064,7 +1093,7 @@ def _fused_partial_update_compact_fn(num_key: int, num_seq: int, num_fields: int
     def f(key_lanes, seq_lanes, pad_flag, field_valid, is_add, is_delete, starts):
         m = pad_flag.shape[0]
         pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
-            num_key, num_seq, key_lanes, seq_lanes, pad_flag
+            num_key, num_seq, key_lanes, seq_lanes, pad_flag, engine=engine
         )
         src, exists = _partial_update_select(perm, pad_sorted, seg_id, field_valid, is_add, is_delete)
         # ---- compact encodings --------------------------------------------
@@ -1115,7 +1144,7 @@ def unpack_field_selection_compact(
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int):
+def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int, engine: str = "xla"):
     """Sort + segment + partial-update selection in ONE kernel: the plan never
     leaves the device, and the only downloads are the per-field source indices
     (F, k), the per-key existence bits and the winning-row indices — instead
@@ -1125,7 +1154,7 @@ def _fused_partial_update_fn(num_key: int, num_seq: int, num_fields: int):
     @jax.jit
     def f(key_lanes, seq_lanes, pad_flag, field_valid, is_add, is_delete):
         pad_sorted, perm, _, keep_last, seg_id = sorted_segments(
-            num_key, num_seq, key_lanes, seq_lanes, pad_flag
+            num_key, num_seq, key_lanes, seq_lanes, pad_flag, engine=engine
         )
         src, exists = _partial_update_select(perm, pad_sorted, seg_id, field_valid, is_add, is_delete)
         packed, count = pack_selected(keep_last & (pad_sorted == 0), perm)
@@ -1141,6 +1170,7 @@ def fused_partial_update(
     row_kind: np.ndarray,  # (n,) uint8
     remove_record_on_delete: bool = False,
     compress: bool | None = None,
+    engine: str = "xla",
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Single-call partial-update merge: returns (src (F, k), exists (k,),
     last_take (k,)) in key order — the same contract as
@@ -1161,12 +1191,16 @@ def fused_partial_update(
     fv = np.zeros((max(F, 1), m), dtype=np.bool_)
     if F:
         fv[:F, :n] = field_valid
+    if engine == "pallas":
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 1 + k + s)
     starts_real = _ascending_block_starts(key_lanes) if F and _link_encodings_pay_off() else None
     if starts_real is not None:
         starts_p = _pad_starts(starts_real, m)
         rbits = _runid_bits(len(starts_p))
         win_bits, present_bits, blk_bits, exists_bits, mask_last, runs_last, count = (
-            _fused_partial_update_compact_fn(k, s, fv.shape[0])(
+            _fused_partial_update_compact_fn(k, s, fv.shape[0], engine)(
                 klp, slp, pad, fv, pad_to(is_add, m, False), pad_to(is_delete, m, False), starts_p
             )
         )
@@ -1185,7 +1219,7 @@ def fused_partial_update(
             present, vals = unpack_field_selection_compact(winb[f], prb[f], blb[f], kk, n, rbits)
             src_out[f, present] = vals
         return src_out, exists, last_take
-    src, exists, packed, count = _fused_partial_update_fn(k, s, fv.shape[0])(
+    src, exists, packed, count = _fused_partial_update_fn(k, s, fv.shape[0], engine)(
         klp, slp, pad, fv, pad_to(is_add, m, False), pad_to(is_delete, m, False)
     )
     kk = int(count)

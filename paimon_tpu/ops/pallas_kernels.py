@@ -1,61 +1,288 @@
 """Pallas TPU kernels for the merge hot path.
 
-The sort itself stays `lax.sort` (XLA's TPU sort is already tiled onto the
-hardware), but the post-sort phase — detecting segment boundaries across all
-key lanes at once — is a bandwidth-bound elementwise pass that pallas
-expresses as one fused VMEM-resident sweep: each grid step loads a block of
-the stacked lanes plus a one-element lookahead (the same operand bound a
-second time with a +1 block index map) and emits the keep-last mask directly.
+Two tiers, selected by table option `sort-engine=pallas`
+(CoreOptions.SortEngine); `interpret=True` runs the same kernels on CPU so
+CI proves bit-identical output without hardware:
 
-Enabled via table option `sort-engine=pallas` (CoreOptions.SortEngine);
-`interpret=True` runs the same kernel on CPU for tests.
+1. **Fused sort+segment kernel** (`fused_sort_segments`): the whole inner
+   merge — stable lexicographic sort, run-boundary detection, and the
+   keep-last winner mask — in ONE `pallas_call` over VMEM-resident lanes.
+   The sort is a bitonic compare-exchange network over the stacked
+   (pad, key lanes, seq lanes, iota) matrix: the iota lane rides as the
+   final comparison lane, which makes the strict total order identical to
+   XLA's stable variadic sort, so the permutation AND the segmentation are
+   bit-for-bit the `lax.sort` path's. Unsigned lanes are bijected into
+   sign-flipped int32 space (order-preserving) because Mosaic's integer
+   compares are signed. Boundary detection then folds XORs across the
+   segment lanes of adjacent sorted rows — all while the data never leaves
+   VMEM.
+
+2. **Boundary-sweep kernel** (`keep_last_mask`): the post-`lax.sort`
+   fallback when the fused kernel does not qualify (`fusable`): a
+   bandwidth-bound elementwise pass detecting segment boundaries across all
+   key lanes at once, each grid step loading a block of the stacked lanes
+   plus a one-element lookahead.
+
+The fallback ladder mirrors every other engine in this repo: numpy oracle
+(sort-engine=numpy) == xla-segmented == pallas, asserted per-seed by
+tests/test_pallas_merge.py; when pallas itself is unavailable (import
+failure, oversized batch) the dispatch silently degrades to the
+`lax.sort` path and counts `pallas{fallback_xla}`.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
 
-__all__ = ["keep_last_mask"]
+try:  # the pallas import can fail on exotic jax builds: degrade, don't die
+    from jax.experimental import pallas as pl
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover - import-time environment dependent
+    pl = None
+    _PALLAS_OK = False
+
+__all__ = [
+    "keep_last_mask",
+    "fused_sort_segments",
+    "fusable",
+    "note_dispatch",
+    "pallas_interpret",
+]
 
 _BLOCK = 2048
 
-
-def _keep_last_kernel(cur_ref, nxt_ref, out_ref):
-    cur = cur_ref[...]  # (L, B) — stacked pad+key lanes
-    nxt = nxt_ref[...]  # (L, B) — the following block (clamped at the end)
-    # "next element" of each position: shift left, last column from the
-    # lookahead block's first column
-    shifted = jnp.concatenate([cur[:, 1:], nxt[:, :1]], axis=1)
-    # stay 2D throughout (mosaic wants tiled vectors) and avoid reductions
-    # (unsigned reductions are unimplemented): fold lanes with bitwise-or,
-    # the lane count is static and small
-    xor = cur ^ shifted
-    diff = xor[0:1, :]
-    for i in range(1, xor.shape[0]):
-        diff = diff | xor[i : i + 1, :]
-    neq = jnp.where(diff != 0, jnp.uint32(1), jnp.uint32(0))
-    not_pad = jnp.where(cur[0:1, :] == 0, jnp.uint32(1), jnp.uint32(0))
-    out_ref[...] = neq * not_pad  # (1, B) uint32
+# fused-kernel admission: rows beyond this take the lax.sort + sweep path
+# (VMEM is ~16 MB/core; the compare network holds (lanes+1) int32 rows plus
+# double-buffered temps). Both knobs are env-tunable for chip experiments.
+_FUSE_MAX_ROWS = int(os.environ.get("PAIMON_TPU_PALLAS_FUSE_ROWS", str(1 << 18)))
+_FUSE_MAX_LANES = int(os.environ.get("PAIMON_TPU_PALLAS_FUSE_LANES", "8"))
+_FUSE_VMEM_BUDGET = 12 * 1024 * 1024
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def keep_last_mask(stacked: jax.Array, interpret: bool = False) -> jax.Array:
+def pallas_interpret() -> bool:
+    """interpret=True whenever the live backend is CPU: the same kernel
+    trace serves CI (interpreted) and the chip (Mosaic-compiled)."""
+    return jax.default_backend() == "cpu"
+
+
+def fusable(m: int, num_lanes: int) -> bool:
+    """Static admission test for the fused sort+segment kernel: m must be a
+    power of two (pad_size guarantees it) small enough that the compare
+    network and its temps stay VMEM-resident, with a bounded lane count
+    (each extra lane widens every compare-exchange)."""
+    if not _PALLAS_OK:
+        return False
+    if m < 2 or m & (m - 1):
+        return False
+    if m > _FUSE_MAX_ROWS or num_lanes + 1 > _FUSE_MAX_LANES:
+        return False
+    return (num_lanes + 1) * m * 4 * 3 <= _FUSE_VMEM_BUDGET
+
+
+def note_dispatch(m: int, num_lanes: int, tiles: int | None = None) -> bool:
+    """Host-side metric hook for a sort-engine=pallas dispatch: records the
+    pallas{kernels_launched, tiles, fallback_xla} counters from the SAME
+    admission predicate the traced kernel uses (the decision is static in
+    (m, lanes), so host bookkeeping and trace-time routing cannot drift).
+    Returns whether the fused kernel serves the dispatch."""
+    from ..metrics import pallas_metrics
+
+    g = pallas_metrics()
+    fused = fusable(m, num_lanes)
+    g.counter("kernels_launched").inc()
+    if fused:
+        g.counter("tiles").inc(1 if tiles is None else tiles)
+    else:
+        # lax.sort fallback still runs the pallas boundary sweep (one grid
+        # step per _BLOCK rows) when pallas imports at all
+        if _PALLAS_OK:
+            g.counter("tiles").inc(max(1, m // _BLOCK) if tiles is None else tiles)
+        g.counter("fallback_xla").inc()
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# fused sort + run-boundary + keep-last kernel
+# ---------------------------------------------------------------------------
+
+
+def _lex_gt(a, b):
+    """Strict lexicographic a > b over the lane axis (axis 0). The caller
+    stacks an iota lane last, so tuples are distinct and the order total."""
+    gt = jnp.zeros(a.shape[1:], dtype=jnp.bool_)
+    eq = jnp.ones(a.shape[1:], dtype=jnp.bool_)
+    lanes = a.shape[0]
+    for i in range(lanes):
+        ai, bi = a[i], b[i]
+        gt = gt | (eq & (ai > bi))
+        if i + 1 < lanes:
+            eq = eq & (ai == bi)
+    return gt
+
+
+def _bitonic_sort_lanes(arr):
+    """In-kernel bitonic sort of the columns of arr (L, m) int32 by
+    ascending lexicographic row-tuple order; m is a power of two. Each
+    (k, j) stage pairs element i with i^j via the reshape view
+    (L, m/(2j), 2, j) — the partner of (q, 0, r) is (q, 1, r) — and the
+    merge direction comes from bit log2(k) of i, which inside a pair block
+    is constant: (q*2j) & k."""
+    lanes, m = arr.shape
+    k = 2
+    while k <= m:
+        j = k // 2
+        while j >= 1:
+            g = m // (2 * j)
+            v = arr.reshape(lanes, g, 2, j)
+            a = v[:, :, 0, :]
+            b = v[:, :, 1, :]
+            gt = _lex_gt(a, b)
+            q = jax.lax.broadcasted_iota(jnp.int32, (g, j), 0)
+            desc = ((q * (2 * j)) & k) != 0
+            swap = (gt != desc)[None, :, :]
+            na = jnp.where(swap, b, a)
+            nb = jnp.where(swap, a, b)
+            arr = jnp.concatenate([na[:, :, None, :], nb[:, :, None, :]], axis=2).reshape(
+                lanes, m
+            )
+            j //= 2
+        k *= 2
+    return arr
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_kernel(num_boundary: int):
+    """Kernel body for a given boundary-lane count. Input (L+1, m) int32:
+    rows [0, num_boundary) split segments (pad flag + OVC/extra + key
+    lanes), rows [num_boundary, L) order within segments only (sequence
+    lanes), row L is the iota / permutation carry. Output (3, m) int32:
+    row 0 = perm (sorted -> input), row 1 = keep_last (1 at the last row of
+    each segment, pad segments included — the sorted_segments contract),
+    row 2 = the sorted pad+boundary lane 0 (still sign-flipped; the wrapper
+    flips it back)."""
+
+    def kernel(arr_ref, out_ref):
+        arr = _bitonic_sort_lanes(arr_ref[...])
+        m = arr.shape[1]
+        cur = arr[:num_boundary]  # (B, m) sorted segment lanes
+        nxt = jnp.concatenate([cur[:, 1:], cur[:, -1:]], axis=1)
+        xor = cur ^ nxt
+        diff = xor[0:1, :]
+        for i in range(1, num_boundary):
+            diff = diff | xor[i : i + 1, :]
+        keep = jnp.where(diff != 0, jnp.int32(1), jnp.int32(0))  # (1, m)
+        # the global last row has no successor: it always closes its segment
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
+        keep = jnp.where(pos == m - 1, jnp.int32(1), keep)
+        out_ref[0:1, :] = arr[-1:, :]  # perm (the iota lane, sorted)
+        out_ref[1:2, :] = keep
+        out_ref[2:3, :] = arr[0:1, :]  # sorted pad lane (flipped space)
+
+    return kernel
+
+
+def _flip(lane):
+    """Order-preserving bijection uint{8,16,32} -> int32 (Mosaic compares
+    are signed; XOR of the sign bit keeps unsigned order)."""
+    return jax.lax.bitcast_convert_type(
+        lane.astype(jnp.uint32) ^ jnp.uint32(0x80000000), jnp.int32
+    )
+
+
+def fused_sort_segments(boundary_lanes, order_lanes):
+    """The fused inner merge (traced inside a consumer jit): stable sort +
+    run-boundary detection + keep-last in one pallas pass.
+
+    boundary_lanes: [(m,) uint] — pad flag first, then OVC/extra keys, then
+    key lanes; these both order rows and split segments. order_lanes:
+    [(m,) uint] sequence lanes — order within a segment only. Returns the
+    sorted_segments contract (pad_sorted, perm, seg_start, keep_last,
+    seg_id), bit-identical to the `lax.sort` path."""
+    m = boundary_lanes[0].shape[0]
+    rows = [_flip(l) for l in list(boundary_lanes) + list(order_lanes)]
+    rows.append(jnp.arange(m, dtype=jnp.int32))
+    arr = jnp.stack(rows, axis=0)
+    out = pl.pallas_call(
+        _fused_kernel(len(boundary_lanes)),
+        out_shape=jax.ShapeDtypeStruct((3, m), jnp.int32),
+        interpret=pallas_interpret(),
+    )(arr)
+    perm = out[0]
+    keep_last = out[1] != 0
+    pad_sorted = jax.lax.bitcast_convert_type(out[2], jnp.uint32) ^ jnp.uint32(0x80000000)
+    seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), keep_last[:-1]])
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    return pad_sorted, perm, seg_start, keep_last, seg_id
+
+
+# ---------------------------------------------------------------------------
+# post-sort boundary sweep (the large-batch fallback)
+# ---------------------------------------------------------------------------
+
+
+def _keep_last_kernel_factory(mask_pad: bool):
+    def _keep_last_kernel(cur_ref, nxt_ref, out_ref):
+        cur = cur_ref[...]  # (L, B) — stacked pad+key lanes
+        nxt = nxt_ref[...]  # (L, B) — the following block (clamped at the end)
+        # "next element" of each position: shift left, last column from the
+        # lookahead block's first column
+        shifted = jnp.concatenate([cur[:, 1:], nxt[:, :1]], axis=1)
+        # stay 2D throughout (mosaic wants tiled vectors) and avoid reductions
+        # (unsigned reductions are unimplemented): fold lanes with bitwise-or,
+        # the lane count is static and small
+        xor = cur ^ shifted
+        diff = xor[0:1, :]
+        for i in range(1, xor.shape[0]):
+            diff = diff | xor[i : i + 1, :]
+        neq = jnp.where(diff != 0, jnp.uint32(1), jnp.uint32(0))
+        if mask_pad:
+            not_pad = jnp.where(cur[0:1, :] == 0, jnp.uint32(1), jnp.uint32(0))
+            neq = neq * not_pad
+        out_ref[...] = neq  # (1, B) uint32
+
+    return _keep_last_kernel
+
+
+def _sweep_block(m: int) -> tuple[int, int]:
+    """(padded size, block) for the boundary sweep: the grid must tile m
+    exactly, so non-multiples are padded up — to the next multiple of 128
+    under one block, of _BLOCK beyond (the old wrapper silently REQUIRED
+    m % 128 == 0 and truncated the tail otherwise)."""
+    if m <= _BLOCK:
+        m2 = ((m + 127) // 128) * 128
+        return m2, m2
+    m2 = ((m + _BLOCK - 1) // _BLOCK) * _BLOCK
+    return m2, _BLOCK
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "mask_pad"))
+def keep_last_mask(stacked: jax.Array, interpret: bool = False, mask_pad: bool = True) -> jax.Array:
     """stacked: (L, m) uint32, lane 0 = pad flag, lanes 1.. = key lanes,
     rows sorted. Returns (m,) uint32: 1 where the row is the last of its
-    segment and not padding. m must be a multiple of 128 (pad_size ensures
-    powers of two >= 128)."""
+    segment (mask_pad=True additionally zeroes pad rows — the legacy dedup
+    contract; mask_pad=False returns the raw sorted_segments keep_last,
+    where the trailing pad segment closes too). Any m >= 1 is accepted:
+    non-multiples of the block are padded inside the wrapper with pad-flag
+    rows whose boundary against the true last row closes its segment."""
     l, m = stacked.shape
-    block = min(_BLOCK, m)
-    grid = m // block
+    m2, block = _sweep_block(m)
+    if m2 != m:
+        ext = jnp.zeros((l, m2 - m), dtype=stacked.dtype)
+        # synthetic pad rows: pad flag set, key lanes zero — they differ
+        # from any real last row in lane 0, closing its segment exactly
+        ext = ext.at[0, :].set(jnp.uint32(1))
+        stacked = jnp.concatenate([stacked, ext], axis=1)
+    grid = m2 // block
     last_block = grid - 1
 
     out = pl.pallas_call(
-        _keep_last_kernel,
+        _keep_last_kernel_factory(mask_pad),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((l, block), lambda i: (0, i)),
@@ -64,11 +291,14 @@ def keep_last_mask(stacked: jax.Array, interpret: bool = False) -> jax.Array:
             pl.BlockSpec((l, block), lambda i: (0, jnp.minimum(i + 1, last_block))),
         ],
         out_specs=pl.BlockSpec((1, block), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((1, m), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((1, m2), jnp.uint32),
         interpret=interpret,
     )(stacked, stacked)
-    out = out[0]
+    out = out[0, :m]
     # the global last element has no successor: it always closes its segment
-    # (unless it is padding)
-    last_valid = jnp.where(stacked[0, m - 1] == 0, jnp.uint32(1), jnp.uint32(0))
+    # (under mask_pad, only when it is not padding)
+    if mask_pad:
+        last_valid = jnp.where(stacked[0, m - 1] == 0, jnp.uint32(1), jnp.uint32(0))
+    else:
+        last_valid = jnp.uint32(1)
     return out.at[m - 1].set(last_valid)

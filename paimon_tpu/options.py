@@ -706,6 +706,67 @@ class CoreOptions:
     FULL_COMPACTION_DELTA_COMMITS = ConfigOption.int_(
         "full-compaction.delta-commits", None, "Full compaction every N commits."
     )
+    COMPACTION_ADAPTIVE_ENABLED = ConfigOption.bool_(
+        "compaction.adaptive.enabled",
+        False,
+        "Drain compaction debt through the LUDA-style adaptive background "
+        "scheduler (table.compactor.AdaptiveCompactorService) instead of "
+        "inline with writers: hot buckets compact deeper and earlier, cold "
+        "ones defer, and per-bucket read amplification stays under "
+        "compaction.adaptive.read-amp-ceiling. Ingest writers typically run "
+        "write-only alongside it.",
+    )
+    COMPACTION_ADAPTIVE_INTERVAL = ConfigOption.duration(
+        "compaction.adaptive.interval",
+        "200 ms",
+        "Pause between adaptive-scheduler observation rounds (each round "
+        "scans the latest snapshot's per-bucket LSM shape and compacts the "
+        "buckets the policy picks).",
+    )
+    COMPACTION_ADAPTIVE_READ_AMP_CEILING = ConfigOption.int_(
+        "compaction.adaptive.read-amp-ceiling",
+        12,
+        "Per-bucket sorted-run ceiling: a bucket at or above it is compacted "
+        "with mandatory priority regardless of heat, bounding merge-read "
+        "amplification under sustained ingest.",
+    )
+    COMPACTION_ADAPTIVE_TRIGGER = ConfigOption.int_(
+        "compaction.adaptive.trigger",
+        3,
+        "Sorted runs before a bucket becomes eligible for proactive adaptive "
+        "compaction; below it the bucket is deferred (counted in "
+        "compaction{deferred_buckets}).",
+    )
+    COMPACTION_ADAPTIVE_MAX_BUCKETS = ConfigOption.int_(
+        "compaction.adaptive.max-buckets-per-round",
+        2,
+        "Proactive buckets compacted per scheduler round — bounds the "
+        "background work one round can steal from ingest (ceiling breaches "
+        "are exempt: the read-amp bound always wins).",
+    )
+    COMPACTION_ADAPTIVE_DEEP_RUNS = ConfigOption.int_(
+        "compaction.adaptive.deep-runs",
+        8,
+        "Sorted runs at or above which an adaptive compaction goes deep "
+        "(full rewrite to the top level) instead of a shallow universal "
+        "pick — LUDA's compact-hotter-buckets-deeper rule.",
+    )
+    COMPACTION_ADAPTIVE_PARALLELISM = ConfigOption.int_(
+        "compaction.adaptive.parallelism",
+        2,
+        "Worker threads executing the adaptive scheduler's per-bucket "
+        "compactions concurrently (distinct buckets commit independently "
+        "through the snapshot CAS; LUDA's premise is that compaction is "
+        "cheap enough to run ahead of demand — parallel workers are how "
+        "the drain rate scales past one bucket at a time).",
+    )
+    COMPACTION_ADAPTIVE_STARVATION_TIMEOUT = ConfigOption.duration(
+        "compaction.adaptive.starvation-timeout",
+        "10 s",
+        "A bucket whose compaction debt has been deferred longer than this "
+        "is promoted to mandatory priority — cold buckets cannot starve "
+        "under sustained skewed writes.",
+    )
     DYNAMIC_BUCKET_TARGET_ROW_NUM = ConfigOption.int_(
         "dynamic-bucket.target-row-num", 2_000_000, "Rows per dynamic bucket."
     )

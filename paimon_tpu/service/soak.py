@@ -78,6 +78,14 @@ class SoakConfig:
     compact_every: int = 4  # full-compact every Nth commit per writer
     compactor_pause_s: float = 0.4
     expire_every_s: float = 1.5
+    # the churn compactor: False = periodic all-bucket full compaction;
+    # True = the LUDA-style adaptive scheduler (table.compactor.
+    # AdaptiveCompactorService) draining debt by heat/read-amp priority.
+    # PAIMON_TPU_SOAK_ADAPTIVE=1 flips the default (the verify.sh soak
+    # stage runs with it on).
+    adaptive: bool = field(
+        default_factory=lambda: os.environ.get("PAIMON_TPU_SOAK_ADAPTIVE", "") == "1"
+    )
     mesh: bool = False
     # flow control (the shared WriteBufferController)
     backpressure: bool = True
@@ -491,6 +499,27 @@ class SoakHarness:
 
         table = self._handle("soak-compactor")
         store = table.store
+        if self.cfg.adaptive:
+            # adaptive churn: the LUDA scheduler observes per-bucket LSM
+            # shape each round and compacts by heat/read-amp priority —
+            # run_round() is driven from this thread (no service thread),
+            # so drain/join semantics stay identical to the legacy loop
+            from ..table.compactor import AdaptiveCompactorService
+
+            svc = AdaptiveCompactorService(table)
+            while not self.stop.is_set() and time.monotonic() < deadline:
+                time.sleep(self.cfg.compactor_pause_s)
+                try:
+                    done = svc.run_round()
+                    if done:
+                        with self._lock:
+                            self.counts["compactor_commits"] += done
+                except (CommitConflictError, CommitGiveUpError, ArtificialException):
+                    # a fault mid-observation/compaction aborts the round;
+                    # rows are untouched — writers own them
+                    with self._lock:
+                        self.counts["compactor_conflicts"] += 1
+            return
         while not self.stop.is_set() and time.monotonic() < deadline:
             time.sleep(self.cfg.compactor_pause_s)
             try:
@@ -713,6 +742,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fault-possibility", type=int, default=20, help="1/N ops fail (20 = 5%%)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mesh", action="store_true")
+    ap.add_argument("--adaptive", action="store_true", help="adaptive (LUDA) churn compactor")
     ap.add_argument("--no-backpressure", action="store_true")
     ap.add_argument("--seed-mode", action="store_true", help="seed-like resilience: no IO/CAS retries")
     args = ap.parse_args(argv)
@@ -724,6 +754,7 @@ def main(argv: list[str] | None = None) -> int:
         fault_possibility=args.fault_possibility,
         seed=args.seed,
         mesh=args.mesh,
+        adaptive=args.adaptive or os.environ.get("PAIMON_TPU_SOAK_ADAPTIVE", "") == "1",
         backpressure=not args.no_backpressure,
         resilient=not args.seed_mode,
     )

@@ -1,5 +1,5 @@
-"""Dedicated compaction: a separate job owns ALL compaction for a table
-whose ingest writers run write-only.
+"""Dedicated + adaptive compaction: a separate job owns ALL compaction for
+a table whose ingest writers run write-only.
 
 Parity: /root/reference/paimon-flink/paimon-flink-common/.../sink/
 CompactorSink.java + compact/ (the dedicated compaction job: ingest jobs set
@@ -10,21 +10,46 @@ coordinator plans small-file tasks, workers execute them, the coordinator
 commits). Conflict safety comes from the commit protocol itself: a COMPACT
 commit whose deleted files were concurrently removed fails the conflict
 check and the compactor abandons that round (reference noConflictsOrFail).
+
+The adaptive half (AdaptiveCompactorService + AdaptiveCompactionPolicy) is
+the LUDA scheduling insight applied to this LSM: once compaction runs on the
+accelerator it is cheap enough to schedule AHEAD of demand, so instead of a
+fixed per-flush trigger inline with writers, a background service observes
+every bucket's LSM shape from the snapshot chain (sorted runs, level-0
+pileup, write rate) and drains compaction debt by priority — buckets over
+the read-amplification ceiling first (the bound always wins), starving debt
+next (no bucket waits forever), then the hottest eligible buckets, deeper
+when their debt is deeper. Cold buckets defer, keeping background work off
+the ingest path entirely.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
-from ..core.commit import CommitConflictError
+import numpy as np
+
+from ..core.commit import CommitConflictError, CommitGiveUpError
 from ..core.datafile import DataFileMeta
 from ..core.manifest import CommitMessage
+from ..options import CoreOptions
 
 if TYPE_CHECKING:
     from . import FileStoreTable
 
-__all__ = ["DedicatedCompactor", "AppendCompactionCoordinator", "CompactionTask", "execute_compaction_task"]
+__all__ = [
+    "DedicatedCompactor",
+    "AppendCompactionCoordinator",
+    "CompactionTask",
+    "execute_compaction_task",
+    "BucketShape",
+    "CompactionDecision",
+    "AdaptiveCompactionPolicy",
+    "AdaptiveCompactorService",
+]
 
 
 class DedicatedCompactor:
@@ -154,3 +179,450 @@ def execute_compaction_task(table: "FileStoreTable", task: CompactionTask) -> Co
         compact_before=list(task.files),
         compact_after=out,
     )
+
+
+# ---------------------------------------------------------------------------
+# adaptive background compaction (LUDA-style scheduling)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BucketShape:
+    """One bucket's observed LSM shape — everything the policy scores,
+    derivable from any committed snapshot (the service never touches writer
+    state, so it composes with concurrent ingest by construction)."""
+
+    partition: tuple
+    bucket: int
+    runs: int  # sorted runs = level-0 files + populated levels > 0
+    level0_files: int
+    files: int
+    bytes: int
+    debt_files: int  # files not at the top non-empty level
+    debt_bytes: int
+    write_rate: float  # EMA of sequence-number advance per second
+    max_seq: int
+
+    @property
+    def read_amp(self) -> int:
+        """Merge-read amplification of a point in this bucket = sorted runs
+        the merge must consult."""
+        return self.runs
+
+
+@dataclass
+class CompactionDecision:
+    partition: tuple
+    bucket: int
+    deep: bool  # full rewrite to the top level vs shallow universal pick
+    reason: str  # "ceiling" | "starvation" | "hot"
+    runs: int = 0  # sorted runs observed when the decision was made
+
+
+class AdaptiveCompactionPolicy:
+    """Pure scoring — no IO, fully unit-testable (tests/test_compactor.py).
+
+    Priority order per round:
+      1. ceiling: every bucket at/above `read_amp_ceiling` compacts NOW
+         (deep) — the read-amplification bound is unconditional, so it is
+         exempt from `max_buckets`.
+      2. starvation: debt deferred longer than `starvation_s` promotes to
+         mandatory — sustained skew cannot starve a cold bucket forever.
+      3. hot: remaining slots (up to `max_buckets`) go to the buckets with
+         the highest heat x debt score among those at/above `trigger` runs;
+         `deep_runs` or more runs makes the pick deep (LUDA: hotter buckets
+         compact deeper and earlier).
+    Buckets with debt that were not chosen are the round's deferrals.
+    """
+
+    def __init__(
+        self,
+        read_amp_ceiling: int = 12,
+        trigger: int = 3,
+        deep_runs: int = 8,
+        max_buckets: int = 2,
+        starvation_s: float = 10.0,
+    ):
+        self.read_amp_ceiling = read_amp_ceiling
+        self.trigger = trigger
+        self.deep_runs = deep_runs
+        self.max_buckets = max_buckets
+        self.starvation_s = starvation_s
+        # (partition, bucket) -> monotonic time its current debt was first
+        # seen; cleared when the bucket compacts or drains below 2 runs
+        self._debt_since: dict[tuple, float] = {}
+
+    def _deep(self, shape: BucketShape) -> bool:
+        return shape.runs >= self.deep_runs
+
+    def decide(self, shapes: list[BucketShape], now_s: float) -> tuple[list[CompactionDecision], int]:
+        """-> (decisions in execution-priority order, deferred bucket count)."""
+        decisions: list[CompactionDecision] = []
+        chosen: set[tuple] = set()
+        live = set()
+        for s in shapes:
+            key = (s.partition, s.bucket)
+            live.add(key)
+            if s.runs > 1:
+                self._debt_since.setdefault(key, now_s)
+            else:
+                self._debt_since.pop(key, None)
+        for key in list(self._debt_since):
+            if key not in live:
+                self._debt_since.pop(key)
+
+        # 1. read-amp ceiling: unconditional, uncapped, worst first. Depth
+        # stays the policy's deep_runs call — restoring the bound needs the
+        # CHEAPEST run-count reduction (an L0 merge), not necessarily a
+        # full rewrite of the (large, already-merged) top level
+        for s in sorted(shapes, key=lambda x: -x.runs):
+            if s.read_amp >= self.read_amp_ceiling:
+                decisions.append(
+                    CompactionDecision(s.partition, s.bucket, self._deep(s), "ceiling", s.runs)
+                )
+                chosen.add((s.partition, s.bucket))
+
+        # 2. starvation promotion: oldest debt first
+        starving = [
+            s
+            for s in shapes
+            if (s.partition, s.bucket) not in chosen
+            and s.runs > 1
+            and now_s - self._debt_since.get((s.partition, s.bucket), now_s) >= self.starvation_s
+        ]
+        for s in sorted(starving, key=lambda x: self._debt_since[(x.partition, x.bucket)]):
+            decisions.append(CompactionDecision(s.partition, s.bucket, self._deep(s), "starvation", s.runs))
+            chosen.add((s.partition, s.bucket))
+
+        # 3. heat-ranked proactive picks under the per-round budget
+        slots = max(0, self.max_buckets - len(decisions))
+        eligible = [
+            s for s in shapes if (s.partition, s.bucket) not in chosen and s.runs >= self.trigger
+        ]
+        eligible.sort(key=lambda s: (-(s.write_rate + 1.0) * s.debt_files, -s.runs))
+        for s in eligible[:slots]:
+            decisions.append(CompactionDecision(s.partition, s.bucket, self._deep(s), "hot", s.runs))
+            chosen.add((s.partition, s.bucket))
+
+        deferred = sum(
+            1 for s in shapes if s.runs > 1 and (s.partition, s.bucket) not in chosen
+        )
+        return decisions, deferred
+
+    def note_compacted(self, partition: tuple, bucket: int) -> None:
+        self._debt_since.pop((partition, bucket), None)
+
+
+class AdaptiveCompactorService:
+    """Background compaction scheduler for one table (LUDA-style).
+
+    Observation is snapshot-only: each round scans the latest plan, folds it
+    into per-bucket `BucketShape`s (write rate = EMA of max-sequence-number
+    advance between rounds), feeds the policy, and executes its decisions as
+    per-bucket COMPACT commits through the normal snapshot-CAS path — a lost
+    race is abandoned (compaction{adaptive_conflicts}) and re-observed next
+    round, exactly the DedicatedCompactor loser semantics. Rides the PR 4
+    flush-executor pattern: one dedicated `paimon-compactor` thread drains
+    debt while writers keep filling memtables; `close()` (or the context
+    manager) always tears it down, and tests/conftest.py asserts the thread
+    never outlives a test."""
+
+    THREAD_PREFIX = "paimon-compactor"
+
+    def __init__(self, table: "FileStoreTable", policy: AdaptiveCompactionPolicy | None = None):
+        opts = table.options.options
+        base = table.copy({"write-only": "false"}) if table.options.write_only else table
+        if policy is None:
+            policy = AdaptiveCompactionPolicy(
+                read_amp_ceiling=opts.get(CoreOptions.COMPACTION_ADAPTIVE_READ_AMP_CEILING),
+                trigger=opts.get(CoreOptions.COMPACTION_ADAPTIVE_TRIGGER),
+                deep_runs=opts.get(CoreOptions.COMPACTION_ADAPTIVE_DEEP_RUNS),
+                max_buckets=opts.get(CoreOptions.COMPACTION_ADAPTIVE_MAX_BUCKETS),
+                starvation_s=opts.get(CoreOptions.COMPACTION_ADAPTIVE_STARVATION_TIMEOUT) / 1000.0,
+            )
+        self.policy = policy
+        # shallow picks must fire at the ADAPTIVE trigger, not the writer's
+        # inline one: the service's own handle lowers the universal pick
+        # threshold so a decided bucket always produces work
+        self.table = base.copy(
+            {"num-sorted-run.compaction-trigger": str(max(policy.trigger - 1, 1))}
+        )
+        self.interval_s = opts.get(CoreOptions.COMPACTION_ADAPTIVE_INTERVAL) / 1000.0
+        self.parallelism = max(1, opts.get(CoreOptions.COMPACTION_ADAPTIVE_PARALLELISM))
+        self._pool = None
+        self._prev: dict[tuple, tuple[int, float]] = {}  # (p, b) -> (max_seq, t)
+        self._rate: dict[tuple, float] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._errors: list[str] = []
+        self.rounds = 0
+        self.compactions = 0
+        # debt-admission surface: the latest observed per-bucket run counts,
+        # published under a condition so ingest writers can block while any
+        # bucket sits at/over the read-amp ceiling (the stop-trigger analog
+        # for write-only ingest: PR 8's admission idea applied to compaction
+        # debt instead of buffer bytes)
+        self._runs_cond = threading.Condition()
+        self._runs: dict[tuple, int] = {}
+        self._inflight: dict[tuple, int] = {}
+
+    # ---- observation ---------------------------------------------------
+    def observe(self) -> list[BucketShape]:
+        now = time.monotonic()
+        plan = self.table.store.new_scan().plan()
+        shapes: list[BucketShape] = []
+        for partition, buckets in plan.grouped().items():
+            for bucket, files in buckets.items():
+                level0 = [f for f in files if f.level == 0]
+                upper = sorted({f.level for f in files if f.level > 0})
+                runs = len(level0) + len(upper)
+                top = upper[-1] if upper else None
+                debt = [f for f in files if top is None or f.level != top]
+                max_seq = max((f.max_sequence_number for f in files), default=0)
+                key = (partition, bucket)
+                prev = self._prev.get(key)
+                if prev is not None and now > prev[1]:
+                    inst = max(0.0, (max_seq - prev[0]) / (now - prev[1]))
+                    self._rate[key] = 0.5 * self._rate.get(key, inst) + 0.5 * inst
+                self._prev[key] = (max_seq, now)
+                shapes.append(
+                    BucketShape(
+                        partition=partition,
+                        bucket=bucket,
+                        runs=runs,
+                        level0_files=len(level0),
+                        files=len(files),
+                        bytes=sum(f.file_size for f in files),
+                        debt_files=len(debt) if runs > 1 else 0,
+                        debt_bytes=sum(f.file_size for f in debt) if runs > 1 else 0,
+                        write_rate=self._rate.get(key, 0.0),
+                        max_seq=max_seq,
+                    )
+                )
+        with self._runs_cond:
+            self._runs = {(s.partition, s.bucket): s.runs for s in shapes}
+            self._runs_cond.notify_all()
+        self._publish(shapes)
+        return shapes
+
+    # ---- debt admission (ingest-side backpressure) ----------------------
+    def over_ceiling(self) -> list[tuple]:
+        """Buckets at/over the read-amp ceiling as of the last observation."""
+        bound = self.policy.read_amp_ceiling
+        with self._runs_cond:
+            return [k for k, r in self._runs.items() if r >= bound]
+
+    def wait_for_headroom(self, timeout_s: float = 30.0) -> bool:
+        """Block the calling ingest writer until no bucket sits at/over the
+        read-amp ceiling (re-evaluated at every observation round) — the
+        num-sorted-run stop-trigger analog for write-only ingest, which
+        bypasses the inline compaction manager entirely. Returns False on
+        timeout (the caller may proceed; the breach is the scheduler's to
+        drain)."""
+        return self.admit(buckets=None, timeout_s=timeout_s, project=False)
+
+    def _keys_for(self, b):
+        if isinstance(b, tuple):
+            return [b]
+        hits = [k for k in self._runs if k[1] == b]
+        return hits or [((), b)]
+
+    def _projected(self, key) -> int:
+        return self._runs.get(key, 0) + self._inflight.get(key, 0)
+
+    def admit(self, buckets=None, timeout_s: float = 30.0, project: bool = True) -> bool:
+        """Admission for one ingest commit against the compaction-debt
+        budget: blocks while any target bucket's PROJECTED sorted-run count
+        (last observed runs + in-flight admitted commits) sits at/over the
+        read-amp ceiling, then (project=True) charges the admitted commit
+        one in-flight run per target bucket. The in-flight charge is what
+        makes the bound hold between observation rounds — observations are
+        periodic, admissions are not, and an uncharged burst of commits
+        would sail past the ceiling before the next scan. The caller
+        releases the charge with settle() once its commit lands (or
+        aborts); observe() then folds landed files into the observed half.
+        `buckets` may hold ints (bucket ids, any partition) or
+        (partition, bucket) tuples; None blocks on a breach anywhere and
+        charges nothing. Returns False on timeout. Blocking admissions
+        count in compaction{admission_waits}."""
+        bound = self.policy.read_amp_ceiling
+        waited = False
+        with self._runs_cond:
+            targets = (
+                None if buckets is None else [k for b in buckets for k in self._keys_for(b)]
+            )
+
+            def ok():
+                if self._stop.is_set():
+                    return True  # a closing service must not strand waiters
+                if targets is None:
+                    return all(self._projected(k) < bound for k in self._runs)
+                return all(self._projected(k) < bound for k in targets)
+
+            if not ok():
+                waited = True
+                admitted = self._runs_cond.wait_for(ok, timeout_s)
+            else:
+                admitted = True
+            if admitted and project and targets is not None:
+                for k in targets:
+                    self._inflight[k] = self._inflight.get(k, 0) + 1
+        if waited:
+            from ..metrics import compaction_metrics
+
+            compaction_metrics().counter("admission_waits").inc()
+        return admitted
+
+    def settle(self, buckets, landed: bool = True) -> None:
+        """Release admit()'s in-flight charge after the commit landed or
+        aborted (call from a finally:). A landed commit's charge moves into
+        the observed half immediately — the next observation replaces it
+        with scanned truth — so the ceiling has no uncharged window; an
+        aborted commit's charge simply vanishes."""
+        with self._runs_cond:
+            for b in buckets:
+                for k in self._keys_for(b):
+                    cur = self._inflight.get(k, 0)
+                    if cur <= 1:
+                        self._inflight.pop(k, None)
+                    else:
+                        self._inflight[k] = cur - 1
+                    if landed:
+                        self._runs[k] = self._runs.get(k, 0) + 1
+            self._runs_cond.notify_all()
+
+    @staticmethod
+    def _publish(shapes: list[BucketShape]) -> None:
+        from ..metrics import compaction_metrics
+
+        g = compaction_metrics()
+        g.gauge("debt_files").set(sum(s.debt_files for s in shapes))
+        g.gauge("debt_bytes").set(sum(s.debt_bytes for s in shapes))
+        if shapes:
+            g.gauge("read_amplification_p99").set(
+                float(np.percentile([s.read_amp for s in shapes], 99))
+            )
+
+    # ---- execution -----------------------------------------------------
+    def _compact_group(self, group: list[CompactionDecision], deep: bool) -> int:
+        """One COMPACT commit covering every bucket of the group (one
+        snapshot CAS instead of one per bucket — commit protocol cost is
+        the background drain's main overhead). 0 = nothing to do or lost
+        the race (abandoned, fresh state next round)."""
+        from ..metrics import compaction_metrics
+        from .write import BatchWriteBuilder, TableCommit, TableWrite
+
+        if self._stop.is_set() or not group:
+            return 0
+        g = compaction_metrics()
+        tw = TableWrite(self.table)
+        try:
+            for d in group:
+                tw._writer(d.partition, d.bucket)  # register ONLY these buckets
+            tw.compact(full=deep)
+            msgs = tw.prepare_commit()
+            if not msgs:
+                return 0
+            TableCommit(self.table).commit_messages(BatchWriteBuilder.COMMIT_IDENTIFIER, msgs)
+        except (CommitConflictError, CommitGiveUpError):
+            g.counter("adaptive_conflicts").inc()
+            return 0
+        finally:
+            tw.close()
+        g.counter("adaptive_runs").inc(len(group))
+        for d in group:
+            self.policy.note_compacted(d.partition, d.bucket)
+            if d.deep:
+                # a landed deep rewrite consumed the runs observed at
+                # decision time (files landed SINCE the plan survive as
+                # fresh level-0 runs — admissions charged mid-rewrite must
+                # stay charged): fold that into the projection and wake
+                # admission waiters now instead of at the next observation
+                key = (d.partition, d.bucket)
+                with self._runs_cond:
+                    cur = self._runs.get(key, d.runs)
+                    self._runs[key] = max(1, cur - d.runs + 1)
+                    self._runs_cond.notify_all()
+        return len(group)
+
+    def run_round(self) -> int:
+        """One observe -> decide -> execute round; returns #buckets
+        compacted. Safe to call from any thread (the soak harness drives it
+        from its own churn thread instead of start())."""
+        from ..metrics import compaction_metrics
+
+        g = compaction_metrics()
+        shapes = self.observe()
+        decisions, deferred = self.policy.decide(shapes, time.monotonic())
+        if deferred:
+            g.counter("deferred_buckets").inc(deferred)
+        deep_group = [d for d in decisions if d.deep]
+        shallow_group = [d for d in decisions if not d.deep]
+        groups = [(grp, deep) for grp, deep in ((deep_group, True), (shallow_group, False)) if grp]
+        if len(groups) > 1 and self.parallelism > 1:
+            # the two groups commit independently (snapshot CAS absorbs the
+            # interleaving): fan them over the worker pool so deep drains
+            # don't serialize behind shallow maintenance. Buckets within a
+            # group share ONE commit — protocol cost, not rewrite cost, is
+            # the background drain's main overhead
+            done = sum(self._executor().map(lambda gd: self._compact_group(*gd), groups))
+        else:
+            done = sum(self._compact_group(grp, deep) for grp, deep in groups)
+        self.rounds += 1
+        self.compactions += done
+        return done
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.parallelism, thread_name_prefix=f"{self.THREAD_PREFIX}-exec"
+            )
+        return self._pool
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> "AdaptiveCompactorService":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self.THREAD_PREFIX}-{id(self) & 0xFFFF:x}", daemon=False
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        import traceback
+
+        while not self._stop.is_set():
+            done = 0
+            try:
+                done = self.run_round()
+            except Exception:
+                # observation races (snapshot expired mid-plan) and injected
+                # faults are survivable: record, back off, re-observe
+                self._errors.append(traceback.format_exc())
+                if len(self._errors) > 20:
+                    del self._errors[:-20]
+            # pressure-adaptive pacing: a round that compacted something
+            # re-observes immediately (debt is live, writers may be blocked
+            # on the ceiling); an idle round sleeps the configured interval
+            self._stop.wait(self.interval_s if done == 0 else 0.005)
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._runs_cond:
+            self._runs_cond.notify_all()  # release admission waiters
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=120.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool = None
+
+    def __enter__(self) -> "AdaptiveCompactorService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -48,7 +48,11 @@ def sort_compact(
     # CPU-only backend: clustering is a plain stable sort of the curve
     # codes — the host lexsort wins (same adaptive rule as merge reads,
     # mergefn.effective_sort_engine); resolved once for the whole call
-    use_host_sort = store.merge_executor().effective_sort_engine() == SortEngine.NUMPY
+    effective_engine = store.merge_executor().effective_sort_engine()
+    use_host_sort = effective_engine == SortEngine.NUMPY
+    # sort-engine=pallas: the clustering sort inherits the fused kernel
+    # through the same sorted_segments seam as every merge
+    kernel_engine = "pallas" if effective_engine == SortEngine.PALLAS else "xla"
     jobs = [
         (partition, bucket, files)
         for partition, buckets in plan.grouped().items()
@@ -128,7 +132,8 @@ def sort_compact(
             sort_lanes, _plan = compress_key_lanes(lanes, compress, enable_ovc=False)
             perm = lexsort_rows(sort_lanes)
         elif perm is None:
-            p = merge_plan(lanes, compress=compress)  # device sort; stability keeps arrival order on ties
+            # device sort; stability keeps arrival order on ties
+            p = merge_plan(lanes, compress=compress, engine=kernel_engine)
             perm = p.perm[p.valid_sorted]
         sorted_kv = kv.take(perm)
         wf = store.writer_factory(partition, bucket)

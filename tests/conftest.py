@@ -37,8 +37,9 @@ import pytest
 def _no_worker_thread_leaks():
     """Fail any test that leaves the pipelined scheduler's non-daemon worker
     threads alive (paimon-pipeline-* stage pools, paimon-flush writer
-    offload). The process-wide shared decode pool (paimon-decode) is exempt:
-    it is never torn down by design. Abandoned executors tear down via
+    offload, the paimon-compactor adaptive-compaction scheduler). The
+    process-wide shared decode pool (paimon-decode) is exempt: it is never
+    torn down by design. Abandoned executors tear down via
     ThreadPoolExecutor's weakref callback, so collect + briefly wait before
     declaring a leak."""
     yield
@@ -52,7 +53,7 @@ def _no_worker_thread_leaks():
             for t in threading.enumerate()
             if t.is_alive()
             and not t.daemon
-            and t.name.startswith(("paimon-pipeline", "paimon-flush"))
+            and t.name.startswith(("paimon-pipeline", "paimon-flush", "paimon-compactor"))
         ]
 
     if leaked():

@@ -1,0 +1,321 @@
+"""Adaptive compaction scheduling (table.compactor): policy units — hot
+buckets compact before cold, the read-amplification ceiling is
+unconditional, no bucket starves under sustained skew — plus service-level
+rounds against a real table and the background-thread lifecycle (conftest's
+autouse fixture asserts the paimon-compactor thread never outlives a
+test)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from paimon_tpu.table.compactor import (
+    AdaptiveCompactionPolicy,
+    AdaptiveCompactorService,
+    BucketShape,
+    CompactionDecision,
+)
+
+
+def shape(bucket, runs, write_rate=0.0, debt_files=None, partition=()):
+    debt = (runs - 1) if debt_files is None else debt_files
+    return BucketShape(
+        partition=partition,
+        bucket=bucket,
+        runs=runs,
+        level0_files=max(runs - 1, 0),
+        files=runs,
+        bytes=runs * 1000,
+        debt_files=debt if runs > 1 else 0,
+        debt_bytes=debt * 1000 if runs > 1 else 0,
+        write_rate=write_rate,
+        max_seq=0,
+    )
+
+
+def policy(**kw):
+    base = dict(read_amp_ceiling=10, trigger=3, deep_runs=8, max_buckets=1, starvation_s=5.0)
+    base.update(kw)
+    return AdaptiveCompactionPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+
+
+def test_hot_bucket_compacts_before_cold():
+    p = policy(max_buckets=1)
+    hot = shape(0, runs=4, write_rate=1000.0)
+    cold = shape(1, runs=4, write_rate=1.0)
+    decisions, deferred = p.decide([cold, hot], now_s=0.0)
+    assert [d.bucket for d in decisions] == [0]
+    assert decisions[0].reason == "hot"
+    assert deferred == 1  # the cold bucket waits
+
+
+def test_read_amp_ceiling_is_unconditional():
+    """Every bucket at/above the ceiling compacts this round — the bound
+    wins over the per-round budget AND over heat. Depth stays the
+    deep_runs call (restoring the bound wants the cheapest run-count
+    reduction, not necessarily a full top-level rewrite)."""
+    p = policy(read_amp_ceiling=6, max_buckets=1, deep_runs=8)
+    shapes = [shape(b, runs=6 + b, write_rate=0.0) for b in range(4)]
+    shapes.append(shape(9, runs=5, write_rate=1e9))  # hottest, under ceiling
+    decisions, _ = p.decide(shapes, now_s=0.0)
+    ceiling = [d for d in decisions if d.reason == "ceiling"]
+    assert sorted(d.bucket for d in ceiling) == [0, 1, 2, 3]
+    assert [d.deep for d in ceiling] == [True, True, False, False]  # runs 9,8 deep; 7,6 shallow
+    # worst read-amp first
+    assert [d.bucket for d in ceiling] == [3, 2, 1, 0]
+
+
+def test_deep_vs_shallow_by_debt_depth():
+    p = policy(deep_runs=6, max_buckets=2)
+    decisions, _ = p.decide(
+        [shape(0, runs=7, write_rate=10.0), shape(1, runs=3, write_rate=10.0)], now_s=0.0
+    )
+    by_bucket = {d.bucket: d for d in decisions}
+    assert by_bucket[0].deep is True
+    assert by_bucket[1].deep is False
+
+
+def test_below_trigger_defers():
+    p = policy(trigger=4)
+    decisions, deferred = p.decide([shape(0, runs=2), shape(1, runs=3)], now_s=0.0)
+    assert decisions == []
+    assert deferred == 2
+
+
+def test_single_run_bucket_is_not_debt():
+    p = policy()
+    decisions, deferred = p.decide([shape(0, runs=1), shape(1, runs=0)], now_s=0.0)
+    assert decisions == [] and deferred == 0
+
+
+def test_starvation_promotion():
+    """A deferred bucket's debt ages; past starvation-timeout it compacts
+    even though a hotter bucket keeps winning the proactive slot."""
+    p = policy(max_buckets=1, starvation_s=5.0, trigger=3)
+    cold = shape(1, runs=3, write_rate=0.0)
+    hot = shape(0, runs=4, write_rate=1000.0)
+    d0, _ = p.decide([cold, hot], now_s=0.0)
+    assert [d.bucket for d in d0] == [0]
+    # hot keeps its debt (re-observed identically); cold not compacted yet
+    d1, _ = p.decide([cold, hot], now_s=4.0)
+    assert [d.bucket for d in d1] == [0]
+    d2, _ = p.decide([cold, hot], now_s=5.5)
+    reasons = {d.bucket: d.reason for d in d2}
+    assert reasons[1] == "starvation"  # cold promoted past the budget
+
+
+def test_starvation_clock_resets_on_compaction():
+    p = policy(max_buckets=1, starvation_s=5.0)
+    cold = shape(1, runs=3)
+    p.decide([cold], now_s=0.0)
+    p.note_compacted((), 1)
+    # fresh debt epoch: not starving at t=6 (first re-seen at t=6)
+    decisions, _ = p.decide([cold], now_s=6.0)
+    assert all(d.reason != "starvation" for d in decisions)
+
+
+def test_starvation_free_under_sustained_skew():
+    """Simulated skewed steady state: one scorching bucket, three cold ones
+    with debt, one proactive slot per round. Every bucket must be chosen
+    within ceiling(starvation) + |buckets| rounds — no permanent loser."""
+    p = policy(max_buckets=1, starvation_s=3.0, trigger=3)
+    shapes = [shape(0, runs=5, write_rate=1e6)] + [
+        shape(b, runs=3, write_rate=0.0) for b in (1, 2, 3)
+    ]
+    compacted: set[int] = set()
+    for step in range(20):
+        decisions, _ = p.decide(shapes, now_s=float(step))
+        for d in decisions:
+            compacted.add(d.bucket)
+            p.note_compacted(d.partition, d.bucket)
+        if compacted >= {0, 1, 2, 3}:
+            break
+    assert compacted >= {0, 1, 2, 3}, f"starved buckets: { {0,1,2,3} - compacted }"
+
+
+# ---------------------------------------------------------------------------
+# service rounds against a real table
+# ---------------------------------------------------------------------------
+
+
+def _write_rounds(table, rng, rounds, rows=150, keyspace=400, buckets_keys=None):
+    for _ in range(rounds):
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        ks = rng.integers(0, keyspace, rows) if buckets_keys is None else buckets_keys(rng, rows)
+        w.write({"k": ks, "v": ks.astype(np.float64)})
+        wb.new_commit().commit(w.prepare_commit())
+
+
+def _pk_table(tmp_warehouse, buckets=2, extra=None):
+    from paimon_tpu.catalog import FileSystemCatalog
+    from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+    opts = {"bucket": str(buckets), "write-only": "true", "write-buffer-rows": "64"}
+    opts.update(extra or {})
+    cat = FileSystemCatalog(tmp_warehouse, commit_user="ac")
+    return cat.create_table(
+        "db.ac", RowType.of(("k", BIGINT()), ("v", DOUBLE())), primary_keys=["k"], options=opts
+    )
+
+
+def test_service_round_drains_debt(tmp_warehouse, rng):
+    t = _pk_table(tmp_warehouse)
+    _write_rounds(t, rng, 6)
+    svc = AdaptiveCompactorService(
+        t, policy=AdaptiveCompactionPolicy(read_amp_ceiling=5, trigger=2, deep_runs=6, max_buckets=4)
+    )
+    before = {(s.partition, s.bucket): s.runs for s in svc.observe()}
+    assert max(before.values()) > 1
+    rb = t.new_read_builder()
+    rows_before = rb.new_read().read_all(rb.new_scan().plan()).to_pylist()
+    assert svc.run_round() > 0
+    after = svc.observe()
+    assert all(s.runs <= 1 for s in after), [(s.bucket, s.runs) for s in after]
+    rows_after = rb.new_read().read_all(rb.new_scan().plan()).to_pylist()
+    assert rows_after == rows_before  # compaction never changes content
+
+
+def test_service_read_amp_bound_enforced(tmp_warehouse, rng):
+    """Write far past the ceiling, run one round: every bucket must land
+    back under it (ceiling decisions are uncapped and deep)."""
+    t = _pk_table(tmp_warehouse, buckets=3)
+    _write_rounds(t, rng, 10, rows=120)
+    ceiling = 4
+    svc = AdaptiveCompactorService(
+        t,
+        policy=AdaptiveCompactionPolicy(
+            read_amp_ceiling=ceiling, trigger=3, deep_runs=6, max_buckets=1
+        ),
+    )
+    assert max(s.runs for s in svc.observe()) >= ceiling
+    svc.run_round()
+    assert all(s.read_amp < ceiling for s in svc.observe())
+
+
+def test_service_skips_clean_table(tmp_warehouse, rng):
+    t = _pk_table(tmp_warehouse)
+    _write_rounds(t, rng, 1)
+    svc = AdaptiveCompactorService(t)
+    assert svc.run_round() == 0  # single run per bucket: nothing to do
+
+
+def test_service_background_thread_lifecycle(tmp_warehouse, rng):
+    import threading
+
+    t = _pk_table(tmp_warehouse, extra={"compaction.adaptive.interval": "50 ms"})
+    _write_rounds(t, rng, 6)
+    with AdaptiveCompactorService(
+        t, policy=AdaptiveCompactionPolicy(read_amp_ceiling=5, trigger=2, max_buckets=4)
+    ) as svc:
+        deadline = time.time() + 20.0
+        while time.time() < deadline:
+            if svc.compactions > 0 and all(s.runs <= 1 for s in svc.observe()):
+                break
+            time.sleep(0.05)
+        assert svc.compactions > 0
+        assert svc._errors == []
+    assert not any(
+        th.name.startswith("paimon-compactor") for th in threading.enumerate() if th.is_alive()
+    )
+
+
+def test_service_concurrent_ingest_consistency(tmp_warehouse, rng):
+    """Adaptive rounds racing a live writer: content equals the oracle fold
+    (last write per key), zero lost/duplicated rows — conflicts abandon."""
+    import threading
+
+    t = _pk_table(tmp_warehouse, extra={"compaction.adaptive.interval": "30 ms"})
+    expected: dict[int, float] = {}
+    stop = threading.Event()
+
+    svc = AdaptiveCompactorService(
+        t, policy=AdaptiveCompactionPolicy(read_amp_ceiling=4, trigger=2, max_buckets=4)
+    )
+    svc.start()
+    try:
+        for i in range(12):
+            ks = rng.integers(0, 300, 120)
+            vs = ks.astype(np.float64) + i
+            wb = t.new_batch_write_builder()
+            w = wb.new_write()
+            w.write({"k": ks, "v": vs})
+            wb.new_commit().commit(w.prepare_commit())
+            for k, v in zip(ks.tolist(), vs.tolist()):
+                expected[k] = v  # numpy write order == arrival order per round
+    finally:
+        stop.set()
+        svc.close()
+    rb = t.new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    ks = out.column("k").values.tolist()
+    got = dict(zip(ks, out.column("v").values.tolist()))
+    assert len(ks) == len(got) == len(expected)  # no dup, no lost
+    assert got == expected
+
+
+def test_admission_gate_bounds_projected_runs(tmp_warehouse, rng):
+    """The debt-admission gate (the write-only stop-trigger analog):
+    admissions charge an in-flight run per target bucket, block at the
+    ceiling, and release on settle — so an ingest burst between two
+    observations cannot sail past the read-amp bound."""
+    import threading
+
+    t = _pk_table(tmp_warehouse, buckets=1)
+    _write_rounds(t, rng, 2)
+    svc = AdaptiveCompactorService(
+        t, policy=AdaptiveCompactionPolicy(read_amp_ceiling=4, trigger=2, max_buckets=1)
+    )
+    svc.observe()  # runs = 2 observed
+    assert svc.admit([0], timeout_s=0.1)  # projected 3
+    assert svc.admit([0], timeout_s=0.1)  # projected 4 == ceiling from here
+    t0 = time.time()
+    assert not svc.admit([0], timeout_s=0.3)  # blocked: over the ceiling
+    assert time.time() - t0 >= 0.25
+    # other buckets are unaffected (per-bucket bound, cold ingest flows)
+    assert svc.admit([5], timeout_s=0.1)
+    # an aborted commit releases its charge without adding a run
+    svc.settle([0], landed=False)
+    assert svc.admit([0], timeout_s=0.1)
+    # a landed commit's charge moves to the observed half: still bounded
+    svc.settle([0], landed=True)
+    t0 = time.time()
+    assert not svc.admit([0], timeout_s=0.2)
+    # draining the bucket under the ceiling wakes a blocked admitter
+    waiter_ok = []
+    th = threading.Thread(target=lambda: waiter_ok.append(svc.admit([0], timeout_s=10.0)))
+    th.start()
+    time.sleep(0.1)
+    assert svc.run_round() > 0  # ceiling breach -> compacts, re-observes next call
+    svc.observe()
+    th.join(timeout=10.0)
+    assert waiter_ok == [True]
+    from paimon_tpu.metrics import compaction_metrics
+
+    assert compaction_metrics().counter("admission_waits").count >= 2
+
+
+def test_metrics_surface(tmp_warehouse, rng):
+    from paimon_tpu.metrics import registry
+
+    with registry._lock:
+        registry.groups.pop(("compaction", ()), None)
+    t = _pk_table(tmp_warehouse)
+    _write_rounds(t, rng, 5)
+    svc = AdaptiveCompactorService(
+        t, policy=AdaptiveCompactionPolicy(read_amp_ceiling=50, trigger=2, max_buckets=1)
+    )
+    svc.observe()
+    snap = registry.snapshot()["compaction"]
+    assert snap["debt_files"] > 0 and snap["debt_bytes"] > 0
+    assert snap["read_amplification_p99"] > 1
+    svc.run_round()
+    snap = registry.snapshot()["compaction"]
+    assert snap["adaptive_runs"] >= 1
+    assert snap["deferred_buckets"] >= 1  # 2 buckets with debt, 1 slot
