@@ -373,6 +373,22 @@ def bench_pallas(table) -> list:
     ]
 
 
+def bench_join() -> list:
+    """Device-join spot-check (benchmarks/join_bench.py is the dedicated
+    1M x 100k fact x dimension sweep with the >=5x headline and the skew
+    degradation bound): a scaled code-domain-key join, device kernel vs the
+    host row-at-a-time dict loop, output asserted identical, plus the
+    join{} counter breakdown (code_domain_joins must be > 0)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "join_bench", os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "join_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_adaptive() -> dict:
     """Adaptive-vs-inline compaction spot-check (benchmarks/
     adaptive_compact_bench.py is the dedicated 60 s skewed soak with the
@@ -486,6 +502,7 @@ def main():
         decode_row = bench_decode(table)
         lanes_rows = bench_lanes(table)
         dict_rows = bench_dicts(table)
+        join_rows = bench_join()
         pallas_rows = bench_pallas(table)
         adaptive_row = bench_adaptive()
         pipeline_rows = bench_pipeline()
@@ -529,6 +546,8 @@ def main():
             print(json.dumps(dict(lrow, platform=_PLATFORM)))
         for drow in dict_rows:
             print(json.dumps(dict(drow, platform=_PLATFORM)))
+        for jrow in join_rows:
+            print(json.dumps(dict(jrow, platform=_PLATFORM)))
         for prow in pallas_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         print(json.dumps(dict(adaptive_row, platform=_PLATFORM)))

@@ -274,6 +274,19 @@ class KeyValueFileStore:
                 expire_predicate=self.record_expire_predicate(),
             )
             compact_manager = MergeTreeCompactManager(levels, strategy, rewriter, self.options)
+        debt_gate = None
+        if self.options.write_only and self.options.options.get(
+            CoreOptions.COMPACTION_ADAPTIVE_INGEST_GATE
+        ):
+            # write-only ingest has no inline compaction manager bounding its
+            # sorted runs: resolve the adaptive scheduler's debt-admission
+            # gate lazily per flush, so a service started AFTER this writer
+            # still bounds it (ISSUE 12, PR 11 follow-up)
+            import functools
+
+            from ..table.compactor import active_debt_gate
+
+            debt_gate = functools.partial(active_debt_gate, self.table_path)
         return MergeTreeWriter(
             partition,
             bucket,
@@ -284,6 +297,7 @@ class KeyValueFileStore:
             self.options,
             restored_max_seq=max_seq,
             admission=admission,
+            debt_gate=debt_gate,
         )
 
     # ---- read ----------------------------------------------------------

@@ -37,6 +37,7 @@ class MergeTreeWriter:
         options: CoreOptions,
         restored_max_seq: int = -1,
         admission=None,
+        debt_gate=None,
     ):
         self.partition = partition
         self.bucket = bucket
@@ -54,6 +55,14 @@ class MergeTreeWriter:
         # can release the remainder without double-counting what in-flight
         # flush workers already returned.
         self.admission = admission
+        # debt-admission gate (ISSUE 12, PR 11 follow-up): a zero-arg
+        # resolver returning the table's running AdaptiveCompactorService
+        # (or None). Write-only writers have no inline compaction manager,
+        # so every flush — the moment a new sorted run is born — first
+        # admits against the service's read-amp ceiling and settles the
+        # charge once the run's files land. Resolved per flush so a service
+        # started after this writer still bounds it.
+        self.debt_gate = debt_gate
         self._accounted = 0
         self._slots_held = 0
         import threading
@@ -273,6 +282,17 @@ class MergeTreeWriter:
         self._drain_flushes()
         if not self._buffer:
             return None
+        gate = self.debt_gate() if self.debt_gate is not None else None
+        if gate is not None:
+            # block (bounded) while this bucket's projected sorted-run count
+            # sits at/over the read-amp ceiling, then charge the in-flight
+            # run this flush is about to create; flush_complete settles. A
+            # timeout proceeds — the breach is the scheduler's to drain, the
+            # gate must never wedge ingest on a stalled compactor.
+            from ..options import CoreOptions as _CO
+
+            timeout_ms = self.options.options.get(_CO.COMPACTION_ADAPTIVE_INGEST_GATE_TIMEOUT)
+            gate.admit([(self.partition, self.bucket)], timeout_s=timeout_ms / 1000.0)
         from ..resilience.faults import crash_point
 
         # memtable full, nothing drained: a kill here loses only rows no
@@ -298,18 +318,24 @@ class MergeTreeWriter:
         buffer_seq_ordered = self._buffer_seq_ordered
         handle = self.merge.merge_async(kv, seq_ascending=buffer_seq_ordered)
         self._buffer_seq_ordered = True
-        return (handle, buffer_seq_ordered, drained_bytes)
+        return (handle, buffer_seq_ordered, drained_bytes, gate)
 
     def flush_complete(self, state) -> None:
         """Phase 2: resolve the merge and write level-0 files + changelog,
         then trigger compaction. The batch's buffer reservation returns to
         the admission controller when the encode lands (or fails) — that is
-        the moment the bytes stop being host-memory the flush pipeline owes."""
-        handle, buffer_seq_ordered, drained_bytes = state
+        the moment the bytes stop being host-memory the flush pipeline owes.
+        The debt-gate charge settles here too: landed when the level-0 run's
+        files exist, abandoned when the flush failed."""
+        handle, buffer_seq_ordered, drained_bytes, gate = state
+        landed = False
         try:
             self._flush_complete_inner(handle, buffer_seq_ordered)
+            landed = True
         finally:
             self._acct_release(drained_bytes)
+            if gate is not None:
+                gate.settle([(self.partition, self.bucket)], landed=landed)
 
     def _flush_complete_inner(self, handle, buffer_seq_ordered) -> None:
         merged = self.merge.merge_resolve(handle)

@@ -98,16 +98,22 @@ class Column:
         if self._values is None:
             if self.arrow is None:
                 # code-backed: expand pool[codes] on first python-level
-                # access (nulls become None, matching the expanded decode)
+                # access. Object pools fill nulls with None (matching the
+                # expanded decode); fixed-width pools fill with the zero
+                # sentinel, exactly like decode_chunk's null fill
                 from ..metrics import dict_metrics
 
                 pool, codes = self.dict_cache
                 if len(pool):
                     v = pool.take(np.minimum(codes, len(pool) - 1))
+                    if v is pool or not v.flags.writeable:
+                        v = v.copy()
                 else:
-                    v = np.empty(self._len, dtype=object)
+                    v = np.empty(self._len, dtype=pool.dtype)
+                    if pool.dtype.kind in "biufM":
+                        v[:] = 0
                 if self.validity is not None:
-                    v[~self.validity] = None
+                    v[~self.validity] = None if pool.dtype == np.dtype(object) else 0
                 dict_metrics().counter("fallback_expanded").inc(self._len)
                 self._values = v
                 return v
@@ -527,9 +533,40 @@ def _arrow_to_column(arr, dtype: DataType) -> Column:
             dict_metrics().counter("rows_code_domain").inc(len(codes))
             return Column.from_codes(pool, remap_codes(remap, codes), validity)
         dict_metrics().counter("fallback_expanded").inc(len(arr))
+    if (
+        np_dtype != np.dtype(object)
+        and np_dtype.kind in "iu"
+        and pa.types.is_dictionary(arr.type)
+        and not pa.types.is_nested(arr.type.value_type)
+        and arr.dictionary.null_count == 0
+    ):
+        # fixed-width dictionary (int/date/timestamp — ISSUE 12): same one-
+        # C-pass code-domain population as the string branch, with the pool
+        # kept in the column's native numpy dtype
+        from ..metrics import dict_metrics
+        from ..ops.dicts import remap_codes, resolve_pool_limit, sort_dictionary
+
+        if len(arr.dictionary) <= resolve_pool_limit(None):
+            d = arr.dictionary
+            if pa.types.is_timestamp(d.type):
+                d = d.cast(pa.int64())
+            elif pa.types.is_date32(d.type):
+                d = d.cast(pa.int32())
+            dnp = d.to_numpy(zero_copy_only=False)
+            if dnp.dtype != np_dtype and dnp.dtype.kind in "iu":
+                dnp = dnp.astype(np_dtype)
+            if dnp.dtype == np_dtype:
+                indices = arr.indices
+                if indices.null_count:
+                    indices = pc.fill_null(indices, 0)
+                codes = indices.to_numpy(zero_copy_only=False).astype(np.uint32, copy=False)
+                pool, remap = sort_dictionary(dnp)
+                dict_metrics().counter("rows_code_domain").inc(len(codes))
+                return Column.from_codes(pool, remap_codes(remap, codes), validity)
+        dict_metrics().counter("fallback_expanded").inc(len(arr))
     if pa.types.is_dictionary(arr.type):
         # dictionary shape the code domain can't carry (nested values,
-        # null dictionary entries, fixed-width dictionary): decode to the
+        # null dictionary entries, float/decimal dictionary): decode to the
         # plain type and take the ordinary paths below
         arr = arr.cast(arr.type.value_type)
     if np_dtype == np.dtype(object):

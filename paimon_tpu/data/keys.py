@@ -248,6 +248,13 @@ def encode_key_lanes(
             # uint32 gather, zero searchsorted over the rows and zero
             # string-object comparisons
             col_lanes = [_ranks_from_cache(pool, cache)]
+        elif root not in string_roots and col.is_code_backed:
+            # fixed-width code domain (ISSUE 12): encode the POOL once
+            # (O(|pool|)) and gather each lane through the codes — element-
+            # wise encoding commutes with the gather, so the lanes are
+            # numerically identical to encoding the expanded values
+            cpool, codes = col.dict_cache
+            col_lanes = [pl.take(codes) for pl in _encode_column(cpool, root, None)]
         else:
             col_lanes = _encode_column(col.values, root, pool)
         if pool is not None and root in string_roots:

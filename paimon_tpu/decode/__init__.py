@@ -125,21 +125,40 @@ def read_native(
 
 
 _STRING_ROOTS = None
+_FIXED_CODE_ROOTS = None
 
 
 def _code_domain_column(data, rg, f, keep, pool_limit, code_cache, metrics):
-    """One chunk as a code-backed Column, or None for the expanded path."""
-    global _STRING_ROOTS
+    """One chunk as a code-backed Column, or None for the expanded path.
+    Covers dictionary-encoded BYTE_ARRAY chunks (string/bytes) and — ISSUE
+    12 — fixed-width INT32/INT64 chunks (int/bigint/date/timestamp), whose
+    sorted pools keep their native dtype so low-cardinality numeric join
+    keys match in the code domain too."""
+    global _STRING_ROOTS, _FIXED_CODE_ROOTS
+    from ..decode.container import T_BYTE_ARRAY, T_INT32, T_INT64
+
     if _STRING_ROOTS is None:
         from ..types import TypeRoot
 
         _STRING_ROOTS = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
-    if f.type.root not in _STRING_ROOTS:
-        return None
+        _FIXED_CODE_ROOTS = {
+            TypeRoot.TINYINT: T_INT32,
+            TypeRoot.SMALLINT: T_INT32,
+            TypeRoot.INT: T_INT32,
+            TypeRoot.DATE: T_INT32,
+            TypeRoot.TIME: T_INT32,
+            TypeRoot.BIGINT: T_INT64,
+            TypeRoot.TIMESTAMP: T_INT64,
+            TypeRoot.TIMESTAMP_LTZ: T_INT64,
+        }
     chunk = rg.columns[f.name]
-    from ..decode.container import T_BYTE_ARRAY
-
-    if chunk.physical_type != T_BYTE_ARRAY or not chunk.has_dictionary:
+    root = f.type.root
+    if root in _STRING_ROOTS:
+        if chunk.physical_type != T_BYTE_ARRAY:
+            return None
+    elif _FIXED_CODE_ROOTS.get(root) != chunk.physical_type:
+        return None
+    if not chunk.has_dictionary:
         return None
     from ..metrics import dict_metrics
     from ..ops.dicts import remap_codes, resolve_pool_limit, sort_dictionary
@@ -154,6 +173,10 @@ def _code_domain_column(data, rg, f, keep, pool_limit, code_cache, metrics):
         g.counter("fallback_expanded").inc(rg.num_rows)
         return None
     dictionary, codes, validity = got
+    if root not in _STRING_ROOTS:
+        np_dtype = f.type.numpy_dtype()
+        if dictionary.dtype != np_dtype:
+            dictionary = dictionary.astype(np_dtype, copy=False)
     if len(dictionary) > resolve_pool_limit(pool_limit):
         g.counter("fallback_expanded").inc(rg.num_rows)
         return None

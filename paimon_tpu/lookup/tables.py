@@ -14,18 +14,27 @@ so decoded data files land in (and are served from) the process-wide
 data-file cache (utils.cache) — a lookup table bootstrapping next to a query
 workload, or several lookup tables over one physical table, decode each
 immutable file once. Snapshot expiry invalidates through the same subsystem.
+
+Vectorized probes (ISSUE 12): `get_batch` and `lookup_join` replace the
+per-row `get` loop for enrichment reads — the cached state becomes one
+ColumnBatch plus a `JoinIndex` (ops/join.py: key lanes encoded once per
+refresh epoch, folded to <= 64-bit codes, sorted once), and a whole probe
+batch pays one searchsorted instead of one dict probe per row. The scalar
+`get` is a thin wrapper over the same index, parity-pinned against the
+legacy dict semantics.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Sequence
+from typing import TYPE_CHECKING, Mapping, Sequence
 
 from ..types import RowKind
 
 if TYPE_CHECKING:
+    from ..data.batch import ColumnBatch
     from ..table import FileStoreTable
 
-__all__ = ["FullCacheLookupTable"]
+__all__ = ["FullCacheLookupTable", "lookup_join"]
 
 
 class FullCacheLookupTable:
@@ -54,6 +63,9 @@ class FullCacheLookupTable:
         self._jk_idx = [self.field_names.index(k) for k in self.join_keys]
         self._scan = table.new_read_builder().new_stream_scan()
         self._read = table.new_read_builder().new_read()
+        # vectorized probe state (ISSUE 12): rebuilt lazily after any change
+        self._join_idx = None
+        self._state: "ColumnBatch | None" = None
         self.refresh()
 
     # ---- load / refresh -------------------------------------------------
@@ -91,6 +103,8 @@ class FullCacheLookupTable:
         return kv.data.to_pylist(), kv.kind.tolist()
 
     def _apply(self, row: tuple, kind: int) -> None:
+        self._join_idx = None  # any change invalidates the vectorized view
+        self._state = None
         add = kind in (int(RowKind.INSERT), int(RowKind.UPDATE_AFTER))
         jk = tuple(row[i] for i in self._jk_idx)
         if self.mode == "no-pk":
@@ -116,10 +130,76 @@ class FullCacheLookupTable:
         else:
             self._rows.pop(pk, None)
 
+    # ---- vectorized state ----------------------------------------------
+    def state_batch(self) -> "ColumnBatch":
+        """The cached table state as ONE ColumnBatch (deterministic order:
+        primary/secondary = pk-map insertion order, no-pk = per-key append
+        order in key insertion order). Rebuilt lazily per refresh epoch."""
+        if self._state is None:
+            from ..data.batch import ColumnBatch
+
+            if self.mode == "no-pk":
+                rows = [r for rs in self._multi.values() for r in rs]
+            else:
+                rows = list(self._rows.values())
+            self._state = ColumnBatch.from_pylist(self.table.row_type, rows)
+        return self._state
+
+    def _join_index(self):
+        if self._join_idx is None:
+            from ..ops.join import JoinIndex
+
+            self._join_idx = JoinIndex(self.state_batch(), self.join_keys)
+        return self._join_idx
+
+    def _probe_batch(self, keys) -> "ColumnBatch":
+        """Normalize probe input: a ColumnBatch carrying the join-key
+        columns, a {column: sequence} mapping, or a sequence of key tuples."""
+        from ..data.batch import ColumnBatch
+
+        if hasattr(keys, "schema") and hasattr(keys, "columns"):
+            return keys
+        schema = self.table.row_type.project(self.join_keys)
+        if isinstance(keys, Mapping):
+            return ColumnBatch.from_pydict(schema, {k: keys[k] for k in self.join_keys})
+        rows = [tuple(k) if isinstance(k, (tuple, list)) else (k,) for k in keys]
+        return ColumnBatch.from_pylist(schema, rows)
+
     # ---- lookup ---------------------------------------------------------
+    def get_batch(self, keys, how: str = "inner"):
+        """Vectorized probe: rows whose join key matches each probe key,
+        probe-major (each probe key's matches are contiguous, in state
+        order). Returns (matched rows as a ColumnBatch of the table's row
+        type, probe-row indices aligned with it). how='left' additionally
+        keeps unmatched probe keys as all-NULL rows."""
+        probe = self._probe_batch(keys)
+        res = self._join_index().probe(probe, self.join_keys, how=how)
+        state = self.state_batch()
+        if how == "left":
+            from ..ops.join import materialize_join
+
+            pairs = [(n, n) for n in state.schema.field_names]
+            return materialize_join(probe, state, res, [], pairs), res.left_take
+        import numpy as np
+
+        return state.take(np.asarray(res.right_take)), res.left_take
+
     def get(self, key: tuple | Sequence) -> list[tuple]:
-        """Rows whose join key equals `key` (a tuple aligned with join_keys)."""
+        """Rows whose join key equals `key` (a tuple aligned with join_keys)
+        — a thin wrapper over the vectorized get_batch. NULL key components
+        never match under join semantics, so those keys keep the legacy
+        dict probe (None == None)."""
         key = tuple(key)
+        if any(k is None for k in key):
+            return self._legacy_get(key)
+        batch, _ = self.get_batch([key])
+        rows = batch.to_pylist()
+        if self.mode == "secondary":
+            # legacy contract: secondary matches come back sorted by pk
+            rows.sort(key=lambda r: tuple(r[i] for i in self._pk_idx))
+        return rows
+
+    def _legacy_get(self, key: tuple) -> list[tuple]:
         if self.mode == "no-pk":
             return list(self._multi.get(key, ()))
         if self.mode == "primary":
@@ -132,3 +212,28 @@ class FullCacheLookupTable:
         if self.mode == "no-pk":
             return sum(len(v) for v in self._multi.values())
         return len(self._rows)
+
+
+def lookup_join(
+    lookup: FullCacheLookupTable,
+    probe: "ColumnBatch",
+    probe_keys: Sequence[str] | None = None,
+    suffix: str = "_lookup",
+) -> "ColumnBatch":
+    """Vectorized enrichment read (the batch replacement for the reference's
+    per-row lookup-join operator): LEFT-join `probe` against the cached
+    table on its join keys, appending every table column (names colliding
+    with probe columns get `suffix`). Probe rows with no match keep NULL
+    enrichment columns; a multimap (no-pk) table may fan one probe row out
+    to several output rows."""
+    keys = list(probe_keys) if probe_keys is not None else list(lookup.join_keys)
+    from ..ops.join import materialize_join
+
+    res = lookup._join_index().probe(probe, keys, how="left")
+    state = lookup.state_batch()
+    left_pairs = [(n, n) for n in probe.schema.field_names]
+    right_pairs = [
+        (n, n if n not in probe.schema else f"{n}{suffix}")
+        for n in state.schema.field_names
+    ]
+    return materialize_join(probe, state, res, left_pairs, right_pairs)

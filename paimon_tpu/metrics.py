@@ -27,6 +27,7 @@ __all__ = [
     "dict_metrics",
     "encode_metrics",
     "io_metrics",
+    "join_metrics",
     "lanes_metrics",
     "mesh_metrics",
     "pallas_metrics",
@@ -204,6 +205,23 @@ def lanes_metrics() -> MetricGroup:
     sort). Resolved per call so registry.reset() in tests swaps the group
     out."""
     return registry.group("lanes")
+
+
+def join_metrics() -> MetricGroup:
+    """The join{...} group (device-side skew-aware joins, paimon_tpu.ops.
+    join, surfaced through SQL JOIN and lookup joins). Canonical members —
+    counters: joins (two-batch join_batches calls), index_probes (cached
+    JoinIndex probe calls: the vectorized lookup path), rows_probed,
+    rows_matched, hash_joins (single fused key operand: binary-search
+    probe), sort_merge_joins (multi-operand keys through the
+    sorted_segments seam), code_domain_joins (joins where at least one key
+    column matched on unified dictionary codes with zero string
+    materialization), skew_keys (heavy-hitter keys whose probe rows were
+    split across partitions), skew_split_rows (probe rows so split);
+    histograms: build_ms (key encode + lane planning), probe_ms (kernel +
+    pair expansion). Resolved per call so registry.reset() in tests swaps
+    the group out."""
+    return registry.group("join")
 
 
 def mesh_metrics() -> MetricGroup:

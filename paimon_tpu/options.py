@@ -363,6 +363,62 @@ class CoreOptions:
         "unified merge pool above this expands to strings instead "
         "(dict{fallback_expanded}). PAIMON_TPU_DICT_POOL_LIMIT overrides.",
     )
+    JOIN_ALGORITHM = ConfigOption.string(
+        "join.algorithm",
+        "auto",
+        "Equi-join kernel: 'hash' probes a sorted single-operand key by "
+        "binary search, 'sort-merge' routes multi-operand keys through the "
+        "merge kernel's sorted_segments seam (inheriting sort-engine=pallas), "
+        "'auto' picks hash exactly when the global lane plan packed the key "
+        "into one fused uint32 operand.",
+    )
+    JOIN_ENGINE = ConfigOption.string(
+        "join.engine",
+        "auto",
+        "Join execution backend: 'numpy' (host lexsort/searchsorted), 'xla' "
+        "or 'pallas' (device kernels). 'auto' mirrors the merge rule — host "
+        "below join.device-rows or on a CPU-only platform, device otherwise, "
+        "with the device flavor following sort-engine. "
+        "PAIMON_TPU_JOIN_ENGINE overrides.",
+    )
+    JOIN_DEVICE_ROWS = ConfigOption.int_(
+        "join.device-rows",
+        4096,
+        "Smallest combined row count (probe + build) the auto engine sends "
+        "to the device kernels; smaller joins stay on the host where "
+        "dispatch overhead dominates.",
+    )
+    JOIN_CHUNK_ROWS = ConfigOption.int_(
+        "join.chunk-rows",
+        1 << 20,
+        "Probe rows per join partition: a probe side larger than this "
+        "splits into ceil(rows / chunk) key-disjoint partitions (bounding "
+        "device batch size), with heavy-hitter keys skew-split across all "
+        "partitions (JSPIM). join.partitions overrides the count directly.",
+    )
+    JOIN_PARTITIONS = ConfigOption.int_(
+        "join.partitions",
+        0,
+        "Explicit join partition count (0 = derive from join.chunk-rows). "
+        "Values > 1 enable the skew-aware split even for small probes.",
+    )
+    JOIN_SKEW_FACTOR = ConfigOption.float_(
+        "join.skew-factor",
+        0.5,
+        "A join key is a heavy hitter when it holds >= this fraction of "
+        "the fair per-partition probe share (probe_rows / partitions) — a "
+        "hot key cannot be subdivided by hashing, so it is dealt "
+        "round-robin across every partition with its build rows "
+        "replicated, and never serializes one partition (JSPIM).",
+    )
+    JOIN_PUSHDOWN_IN_LIMIT = ConfigOption.int_(
+        "join.pushdown-in-limit",
+        1024,
+        "SELECT ... JOIN planning: when the smaller side's distinct join "
+        "keys number at most this, the big side's scan is pruned with an "
+        "IN predicate over those keys (file/row-group skipping); above it, "
+        "a BETWEEN over the small side's key range is pushed instead.",
+    )
     MERGE_EXEC_ENGINE = ConfigOption.string(
         "merge.engine",
         "single",
@@ -759,6 +815,26 @@ class CoreOptions:
         "through the snapshot CAS; LUDA's premise is that compaction is "
         "cheap enough to run ahead of demand — parallel workers are how "
         "the drain rate scales past one bucket at a time).",
+    )
+    COMPACTION_ADAPTIVE_INGEST_GATE = ConfigOption.bool_(
+        "compaction.adaptive.ingest-gate",
+        True,
+        "Bound write-only ingest by the adaptive scheduler's debt-admission "
+        "gate: when an AdaptiveCompactorService is running for the table, "
+        "every MergeTreeWriter flush first admits against the read-amp "
+        "ceiling (blocking while the target bucket's projected sorted-run "
+        "count sits at/over it, up to "
+        "compaction.adaptive.ingest-gate-timeout) and settles its one-run "
+        "charge when the flush lands — so ANY write-only writer is "
+        "read-amp-bounded, not just harnesses that call admit() by hand.",
+    )
+    COMPACTION_ADAPTIVE_INGEST_GATE_TIMEOUT = ConfigOption.duration(
+        "compaction.adaptive.ingest-gate-timeout",
+        "30 s",
+        "Longest a gated write-only flush blocks waiting for compaction "
+        "headroom; on timeout the flush proceeds (the breach is the "
+        "scheduler's to drain) — the gate bounds read amplification, it "
+        "must never wedge ingest on a stalled compactor.",
     )
     COMPACTION_ADAPTIVE_STARVATION_TIMEOUT = ConfigOption.duration(
         "compaction.adaptive.starvation-timeout",

@@ -11,11 +11,22 @@ covering the query shapes maintenance runbooks actually use::
     SELECT * FROM db.t$snapshots                    -- system tables work too
     SELECT count(*), sum(v), min(v) FROM db.t WHERE k < 100
     SELECT region, count(*), avg(amount) FROM db.t GROUP BY region ORDER BY region
+    SELECT f.k, d.name, sum(f.v) FROM db.fact f JOIN db.dim d ON f.k = d.id
+        WHERE d.region = 'EU' GROUP BY f.k, d.name
 
 Pushdown is real, not cosmetic: WHERE lowers onto the predicate algebra
 (file/row-group skipping via stats + bloom indexes), the projection prunes
 column decode, and a bare LIMIT n stops the scan early — the same paths a
 planner-bearing engine would drive through `arrow_dataset`.
+
+JOIN (ISSUE 12) plans through the same machinery: single-side WHERE
+conjuncts push into that side's scan, each side decodes only the columns
+the query touches, and the smaller side's join-key statistics prune the
+bigger side's scan (an IN list under `join.pushdown-in-limit` distinct
+keys, a BETWEEN above it) before the device join kernel
+(ops/join.join_batches) matches the rows. Inner and LEFT equi-joins; the
+residual (cross-side) WHERE evaluates over the joined batch with SQL
+three-valued logic.
 """
 
 from __future__ import annotations
@@ -25,7 +36,7 @@ from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
-from .expr import ExprError, _Parser, _tokenize, parse_expr, to_predicate
+from .expr import ExprError, _Parser, _tokenize, eval_mask, parse_expr, to_predicate
 
 if TYPE_CHECKING:
     from ..catalog import Catalog
@@ -39,13 +50,26 @@ class QueryError(ValueError):
 
 
 _SELECT_RE = re.compile(
-    r"^\s*SELECT\s+(?:(?P<distinct>DISTINCT)\s+)?(?P<cols>.*?)\s+FROM\s+(?P<table>`?[\w.$]+`?)"
-    r"(?:\s*/\*\+\s*OPTIONS\s*\((?P<hints>.*?)\)\s*\*/)?"
-    r"(?:\s+FOR\s+(?P<tt_kind>VERSION|TIMESTAMP|TAG)\s+AS\s+OF\s+(?P<tt_val>'[^']*'|[^\s;]+))?"
+    r"^\s*SELECT\s+(?:(?P<distinct>DISTINCT)\s+)?(?P<cols>.*?)\s+FROM\s+(?P<from>.*?)"
     r"(?:\s+WHERE\s+(?P<where>.*?))?"
     r"(?:\s+GROUP\s+BY\s+(?P<group>.*?))?"
     r"(?:\s+ORDER\s+BY\s+(?P<order>.*?))?"
     r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.I | re.S,
+)
+
+# the FROM clause: table [hints] [time travel] [alias] [JOIN table [hints]
+# [alias] ON <equi conjunction>]
+_KEYWORDS_NOT_ALIAS = r"(?!JOIN\b|INNER\b|LEFT\b|ON\b|AS\b)"
+_FROM_RE = re.compile(
+    r"^(?P<table>`?[\w.$]+`?)"
+    r"(?:\s*/\*\+\s*OPTIONS\s*\((?P<hints>.*?)\)\s*\*/)?"
+    r"(?:\s+FOR\s+(?P<tt_kind>VERSION|TIMESTAMP|TAG)\s+AS\s+OF\s+(?P<tt_val>'[^']*'|[^\s;]+))?"
+    r"(?:\s+(?:AS\s+)?(?P<alias>" + _KEYWORDS_NOT_ALIAS + r"[A-Za-z_]\w*))?"
+    r"(?:\s+(?:(?P<jtype>INNER|LEFT(?:\s+OUTER)?)\s+)?JOIN\s+(?P<jtable>`?[\w.$]+`?)"
+    r"(?:\s*/\*\+\s*OPTIONS\s*\((?P<jhints>.*?)\)\s*\*/)?"
+    r"(?:\s+(?:AS\s+)?(?P<jalias>" + _KEYWORDS_NOT_ALIAS + r"[A-Za-z_]\w*))?"
+    r"\s+ON\s+(?P<on>.*))?$",
     re.I | re.S,
 )
 
@@ -72,43 +96,36 @@ def _split_select_list(cols: str) -> list[str]:
 
 
 def _parse_agg(item: str):
-    """'sum(v)' -> ('sum', 'v') | 'count(*)' -> ('count', '*') | None."""
-    m = re.match(r"^(\w+)\s*\(\s*(\*|`?\w+`?)\s*\)$", item)
+    """'sum(v)' -> ('sum', 'v') | 'count(*)' -> ('count', '*') | None.
+    Join queries may qualify the column: 'sum(f.v)' -> ('sum', 'f.v')."""
+    m = re.match(r"^(\w+)\s*\(\s*(\*|`?[\w.]+`?)\s*\)$", item)
     if m and m.group(1).lower() in _AGG_FNS:
         return m.group(1).lower(), m.group(2).strip("`")
     return None
 
 
-def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
-    """Execute one SELECT statement; returns the result as a ColumnBatch."""
-    m = _SELECT_RE.match(statement)
-    if not m:
-        raise QueryError(f"not a SELECT statement: {statement!r}")
-    table_name = m.group("table").strip("`")
-    t = catalog.get_table(table_name)
-
-    # per-query dynamic options: OPTIONS hints + time travel accumulate into
-    # ONE table copy
+def _dynamic_options(hints: str | None, tt_kind: str | None, tt_val: str | None) -> dict:
+    """OPTIONS hints + time travel accumulate into ONE table copy."""
     dynamic: dict[str, str] = {}
-    if m.group("hints") is not None:
+    if hints is not None:
         # Flink's dynamic table options: SELECT ... FROM t /*+ OPTIONS('k'='v') */
         # (reference FlinkConnectorOptions dynamic hints) — per-query overrides
         # of ANY table option: scan modes, time travel, merge knobs
         from .ddl import DdlError, _parse_options
 
         try:
-            hints = _parse_options(m.group("hints"))
+            parsed = _parse_options(hints)
         except DdlError as e:
             raise QueryError(f"cannot parse OPTIONS hint: {e}") from e
-        if not hints:
+        if not parsed:
             raise QueryError("empty OPTIONS hint")
-        dynamic.update(hints)
+        dynamic.update(parsed)
 
-    if m.group("tt_kind"):
+    if tt_kind:
         # time travel (Spark grammar: FOR VERSION|TIMESTAMP AS OF; TAG as an
         # explicit alias): lowers onto the scan options
-        kind = m.group("tt_kind").upper()
-        val = m.group("tt_val").strip("'")
+        kind = tt_kind.upper()
+        val = (tt_val or "").strip("'")
         if not val:
             raise QueryError(f"FOR {kind} AS OF requires a non-empty value")
         if kind == "VERSION":
@@ -130,21 +147,29 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
                     f"'YYYY-MM-DD[ HH:MM:SS]', got {val!r}"
                 ) from None
             dynamic["scan.timestamp"] = val
+    return dynamic
 
+
+def _resolve_table(catalog: "Catalog", name: str, hints, tt_kind, tt_val):
+    t = catalog.get_table(name.strip("`"))
+    dynamic = _dynamic_options(hints, tt_kind, tt_val)
     if dynamic:
         if not hasattr(t, "copy"):
             raise QueryError(
                 "OPTIONS hints / time travel apply to data tables, not system tables"
             )
         t = t.copy(dynamic)
+    return t
 
-    where_text = m.group("where")
-    pred = None
-    if where_text:
-        try:
-            pred = to_predicate(parse_expr(where_text), where_text)
-        except ExprError as e:
-            raise QueryError(str(e)) from e
+
+def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
+    """Execute one SELECT statement; returns the result as a ColumnBatch."""
+    m = _SELECT_RE.match(statement)
+    if not m:
+        raise QueryError(f"not a SELECT statement: {statement!r}")
+    fm = _FROM_RE.match(m.group("from").strip())
+    if not fm:
+        raise QueryError(f"cannot parse FROM clause: {m.group('from')!r}")
 
     cols_text = m.group("cols").strip()
     items = _split_select_list(cols_text)
@@ -168,6 +193,22 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
 
     order_text = m.group("order")
     limit = int(m.group("limit")) if m.group("limit") else None
+    where_text = m.group("where")
+
+    if fm.group("jtable"):
+        return _join_query(catalog, m, fm, items, aggs, is_agg, group_cols,
+                           order_text, limit, cols_text)
+
+    t = _resolve_table(
+        catalog, fm.group("table"), fm.group("hints"), fm.group("tt_kind"), fm.group("tt_val")
+    )
+    table_name = fm.group("table").strip("`")
+    pred = None
+    if where_text:
+        try:
+            pred = to_predicate(parse_expr(where_text), where_text)
+        except ExprError as e:
+            raise QueryError(str(e)) from e
 
     if not hasattr(t, "new_read_builder"):
         # system tables ($snapshots, $files, ...) are static batches:
@@ -205,6 +246,12 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
                 rb = rb.with_limit(limit)
         out = rb.new_read().read_all(rb.new_scan().plan())
 
+    return _finish(out, items, aggs, is_agg, group_cols, order_text, limit, cols_text)
+
+
+def _finish(out, items, aggs, is_agg, group_cols, order_text, limit, cols_text):
+    """The engine-independent tail: GROUP BY / aggregates / ORDER BY /
+    LIMIT / final projection over an already-scanned (or joined) batch."""
     if group_cols:
         # ORDER BY may reference group columns outside the select list: carry
         # them as hidden output columns through the sort, then project away
@@ -229,6 +276,297 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
     if cols_text != "*":
         out = out.select([i.strip("`") for i in items])
     return out
+
+
+# ---------------------------------------------------------------------------
+# JOIN planning (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def _conjuncts(node) -> list:
+    return list(node[1]) if node[0] == "and" else [node]
+
+
+def _col_nodes(node, acc: list) -> list:
+    """Collect every ('col', alias, name) reference in an AST."""
+    if not isinstance(node, tuple):
+        return acc
+    if node[0] == "col":
+        acc.append(node)
+        return acc
+    for part in node[1:]:
+        if isinstance(part, tuple):
+            _col_nodes(part, acc)
+        elif isinstance(part, list):
+            for p in part:
+                _col_nodes(p, acc)
+    return acc
+
+
+class _JoinScope:
+    """Name resolution over the two joined tables: alias-qualified refs pin
+    a side, bare refs resolve by unique membership; canonical output names
+    stay bare when unambiguous and qualify as 'alias.col' on collision."""
+
+    def __init__(self, la, t_l, ra, t_r):
+        if la == ra:
+            raise QueryError(f"duplicate table alias {la!r} in JOIN")
+        self.aliases = (la, ra)
+        self.tables = (t_l, t_r)
+
+    def resolve_ref(self, alias, name):
+        name = name.strip("`")
+        if alias is not None:
+            if alias not in self.aliases:
+                raise QueryError(
+                    f"unknown table alias {alias!r} (have {list(self.aliases)})"
+                )
+            side = self.aliases.index(alias)
+            if name not in self.tables[side].row_type:
+                raise QueryError(f"unknown column {name!r} in {alias!r}")
+            return side, name
+        in_l = name in self.tables[0].row_type
+        in_r = name in self.tables[1].row_type
+        if in_l and in_r:
+            raise QueryError(f"ambiguous column {name!r}: qualify with an alias")
+        if in_l:
+            return 0, name
+        if in_r:
+            return 1, name
+        raise QueryError(f"unknown column {name!r}")
+
+    def resolve_tok(self, tok: str):
+        tok = tok.strip().strip("`")
+        if "." in tok:
+            a, n = tok.split(".", 1)
+            return self.resolve_ref(a, n)
+        return self.resolve_ref(None, tok)
+
+    def canonical(self, side: int, col: str) -> str:
+        other = self.tables[1 - side]
+        if col in other.row_type:
+            return f"{self.aliases[side]}.{col}"
+        return col
+
+
+def _estimate_rows(splits) -> int:
+    return sum(f.row_count for s in splits for f in getattr(s, "files", []))
+
+
+def _key_prune_predicate(batch, src_col: str, target_col: str, in_limit: int):
+    """The small side's join-key statistics as a predicate on the big side:
+    an exact IN list under in_limit distinct keys, a BETWEEN envelope above
+    it. Code-backed key columns derive both from the pruned POOL — no row
+    ever expands. Returns None when nothing can be derived (empty side:
+    the caller shortcuts)."""
+    from ..data import predicate as P
+    from ..ops.dicts import prune_pool
+
+    col = batch.column(src_col)
+    if col.is_code_backed:
+        pool, codes = col.dict_cache
+        pruned, _ = prune_pool(pool, codes, col.validity)
+        vals = pruned.tolist()
+    else:
+        v = col.values
+        if col.validity is not None:
+            v = v[col.validity]
+        if len(v) == 0:
+            return None
+        try:
+            vals = np.unique(v).tolist()
+        except TypeError:
+            vals = sorted(set(v.tolist()))
+    if not vals:
+        return None
+    if len(vals) <= in_limit:
+        return P.in_(target_col, vals)
+    return P.between(target_col, vals[0], vals[-1])
+
+
+def _join_query(catalog, m, fm, items, aggs, is_agg, group_cols, order_text, limit, cols_text):
+    from ..data import predicate as P
+    from ..ops.join import JoinError, join_batches, materialize_join
+
+    how = "left" if (fm.group("jtype") or "").strip().upper().startswith("LEFT") else "inner"
+    t_l = _resolve_table(
+        catalog, fm.group("table"), fm.group("hints"), fm.group("tt_kind"), fm.group("tt_val")
+    )
+    t_r = _resolve_table(catalog, fm.group("jtable"), fm.group("jhints"), None, None)
+    for t in (t_l, t_r):
+        if not hasattr(t, "new_read_builder"):
+            raise QueryError("JOIN applies to data tables, not system tables")
+    la = fm.group("alias") or fm.group("table").strip("`").split(".")[-1]
+    ra = fm.group("jalias") or fm.group("jtable").strip("`").split(".")[-1]
+    scope = _JoinScope(la, t_l, ra, t_r)
+
+    # ---- ON: a conjunction of cross-side column equalities ---------------
+    try:
+        on_ast = parse_expr(fm.group("on"))
+    except ExprError as e:
+        raise QueryError(f"cannot parse ON clause: {e}") from e
+    left_keys, right_keys = [], []
+    for c in _conjuncts(on_ast):
+        if not (c[0] == "cmp" and c[1] == "=" and c[2][0] == "col" and c[3][0] == "col"):
+            raise QueryError(
+                "JOIN ON supports a conjunction of equalities between the two "
+                f"tables' columns, got {fm.group('on')!r}"
+            )
+        sides = [scope.resolve_ref(c[2][1], c[2][2]), scope.resolve_ref(c[3][1], c[3][2])]
+        if {sides[0][0], sides[1][0]} != {0, 1}:
+            raise QueryError("each ON equality must reference BOTH tables")
+        pair = dict(sides)
+        left_keys.append(pair[0])
+        right_keys.append(pair[1])
+
+    # ---- WHERE: single-side conjuncts push into that side's scan ---------
+    where_text = m.group("where")
+    side_preds: list[list] = [[], []]
+    residual: list = []
+    if where_text:
+        try:
+            where_ast = parse_expr(where_text)
+        except ExprError as e:
+            raise QueryError(str(e)) from e
+        for c in _conjuncts(where_ast):
+            refs = {scope.resolve_ref(n[1], n[2]) for n in _col_nodes(c, [])}
+            sides = {s for s, _ in refs}
+            pushable = sides == {0} or (sides == {1} and how == "inner")
+            if pushable:
+                # a LEFT join's right-side conjunct must see post-join NULLs,
+                # so only the inner case pushes the right side
+                try:
+                    side_preds[sides.pop()].append(to_predicate(c, where_text))
+                    continue
+                except ExprError:
+                    pass  # not predicate-lowerable (e.g. col vs col): residual
+            residual.append(c)
+
+    # ---- needed columns & output naming ----------------------------------
+    def out_cols_for_star():
+        cols = [(0, n) for n in t_l.row_type.field_names]
+        cols += [(1, n) for n in t_r.row_type.field_names]
+        return cols
+
+    plain_refs: list[tuple[int, str]] = []  # select-list order
+    if cols_text == "*":
+        plain_refs = out_cols_for_star()
+        items = [scope.canonical(s, n) for s, n in plain_refs]
+        aggs = [None] * len(items)
+        cols_text = ", ".join(items)
+    else:
+        new_items = []
+        for item, agg in zip(items, aggs):
+            if agg is None:
+                side, col = scope.resolve_tok(item)
+                plain_refs.append((side, col))
+                new_items.append(scope.canonical(side, col))
+            elif agg[1] == "*":
+                new_items.append(re.sub(r"\s+", "", item).lower())
+            else:
+                side, col = scope.resolve_tok(agg[1])
+                plain_refs.append((side, col))
+                canon = scope.canonical(side, col)
+                new_items.append(f"{agg[0]}({canon})")
+        items = new_items
+        aggs = [_parse_agg(i) for i in items]
+    group_refs = [scope.resolve_tok(g) for g in group_cols]
+    group_cols = [scope.canonical(s, n) for s, n in group_refs]
+    order_refs = []
+    if order_text:
+        parts = []
+        for part in [p.strip() for p in order_text.split(",")]:
+            toks = part.split()
+            side, col = scope.resolve_tok(toks[0])
+            order_refs.append((side, col))
+            parts.append(" ".join([scope.canonical(side, col)] + toks[1:]))
+        order_text = ", ".join(parts)
+    residual_refs = [
+        scope.resolve_ref(n[1], n[2]) for c in residual for n in _col_nodes(c, [])
+    ]
+
+    needed: list[list[str]] = [[], []]
+    out_pairs: list[list[tuple[str, str]]] = [[], []]
+    seen = set()
+    for side, col in plain_refs + group_refs + order_refs + residual_refs:
+        if (side, col) not in seen:
+            seen.add((side, col))
+            out_pairs[side].append((col, scope.canonical(side, col)))
+        if col not in needed[side]:
+            needed[side].append(col)
+    for side, keys in ((0, left_keys), (1, right_keys)):
+        for col in keys:
+            if col not in needed[side]:
+                needed[side].append(col)
+
+    # ---- scans: per-side pushdown + small-side key pruning ---------------
+    def builder(side):
+        t = scope.tables[side]
+        rb = t.new_read_builder()
+        preds = side_preds[side]
+        if preds:
+            rb = rb.with_filter(P.and_(*preds) if len(preds) > 1 else preds[0])
+        rb = rb.with_projection(list(needed[side]))
+        return rb
+
+    rb_l, rb_r = builder(0), builder(1)
+    plan_l, plan_r = rb_l.new_scan().plan(), rb_r.new_scan().plan()
+    est = (_estimate_rows(plan_l), _estimate_rows(plan_r))
+    # which side's key stats prune the other: the smaller one — except a
+    # LEFT join must never prune its preserved (left) side
+    prune_from = 0 if (how == "left" or est[0] <= est[1]) else 1
+    key_pairs = list(zip(left_keys, right_keys))
+    from ..options import CoreOptions
+
+    in_limit = t_l.options.options.get(CoreOptions.JOIN_PUSHDOWN_IN_LIMIT)
+    if prune_from == 0:
+        batch_l = rb_l.new_read().read_all(plan_l)
+        prune = [
+            _key_prune_predicate(batch_l, lk, rk, in_limit) for lk, rk in key_pairs
+        ]
+        prune = [p for p in prune if p is not None]
+        if prune:
+            rb_r = rb_r.with_filter(P.and_(*prune) if len(prune) > 1 else prune[0])
+            plan_r = rb_r.new_scan().plan()
+        batch_r = rb_r.new_read().read_all(plan_r)
+    else:
+        batch_r = rb_r.new_read().read_all(plan_r)
+        prune = [
+            _key_prune_predicate(batch_r, rk, lk, in_limit) for lk, rk in key_pairs
+        ]
+        prune = [p for p in prune if p is not None]
+        if prune:
+            rb_l = rb_l.with_filter(P.and_(*prune) if len(prune) > 1 else prune[0])
+            plan_l = rb_l.new_scan().plan()
+        batch_l = rb_l.new_read().read_all(plan_l)
+
+    # ---- the join itself -------------------------------------------------
+    try:
+        res = join_batches(
+            batch_l, batch_r, left_keys, right_keys, how=how,
+            options=t_l.options.options,
+        )
+    except JoinError as e:
+        raise QueryError(str(e)) from e
+    joined = materialize_join(batch_l, batch_r, res, out_pairs[0], out_pairs[1])
+
+    # ---- residual WHERE over the joined batch (SQL 3-valued logic) -------
+    if residual:
+
+        def resolve(alias, name):
+            side, col = scope.resolve_ref(alias, name)
+            c = joined.column(scope.canonical(side, col))
+            return np.asarray(c.values), c.validity
+
+        node = residual[0] if len(residual) == 1 else ("and", residual)
+        try:
+            mask = eval_mask(node, resolve, joined.num_rows)
+        except ExprError as e:
+            raise QueryError(str(e)) from e
+        if not mask.all():
+            joined = joined.filter(mask)
+
+    return _finish(joined, items, aggs, is_agg, group_cols, order_text, limit, cols_text)
 
 
 def _order_cols(order_text: str | None) -> list[str]:

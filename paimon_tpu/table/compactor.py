@@ -49,7 +49,21 @@ __all__ = [
     "CompactionDecision",
     "AdaptiveCompactionPolicy",
     "AdaptiveCompactorService",
+    "active_debt_gate",
 ]
+
+# running services by table path, so write-only ingest writers can find the
+# debt-admission gate of the compactor draining their table (ISSUE 12,
+# declared PR 11 follow-up: the gate wired into MergeTreeWriter itself,
+# not just harnesses that call admit() by hand)
+_ACTIVE_GATES: dict[str, "AdaptiveCompactorService"] = {}
+_GATES_LOCK = threading.Lock()
+
+
+def active_debt_gate(table_path) -> "AdaptiveCompactorService | None":
+    """The running AdaptiveCompactorService for a table path, if any."""
+    with _GATES_LOCK:
+        return _ACTIVE_GATES.get(str(table_path))
 
 
 class DedicatedCompactor:
@@ -586,6 +600,8 @@ class AdaptiveCompactorService:
         if self._thread is not None:
             return self
         self._stop.clear()
+        with _GATES_LOCK:
+            _ACTIVE_GATES[str(self.table.path)] = self
         self._thread = threading.Thread(
             target=self._loop, name=f"{self.THREAD_PREFIX}-{id(self) & 0xFFFF:x}", daemon=False
         )
@@ -612,6 +628,9 @@ class AdaptiveCompactorService:
 
     def close(self) -> None:
         self._stop.set()
+        with _GATES_LOCK:
+            if _ACTIVE_GATES.get(str(self.table.path)) is self:
+                _ACTIVE_GATES.pop(str(self.table.path))
         with self._runs_cond:
             self._runs_cond.notify_all()  # release admission waiters
         t, self._thread = self._thread, None

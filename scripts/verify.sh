@@ -61,6 +61,17 @@
 #                              and a post-sweep file set exactly equal to
 #                              the reachable closure. Nightly-scale knobs
 #                              live in benchmarks/soak_bench.py --process.
+#   scripts/verify.sh join     device-join parity stage: the
+#                              tests/test_join.py suite (kernel oracle
+#                              parity across skew x null rates x engines x
+#                              partitions, the pinned 50%-skew regression,
+#                              code-domain joins, SQL JOIN vs pandas,
+#                              vectorized lookups) run TWICE —
+#                              PAIMON_TPU_LANE_COMPRESSION forced on, then
+#                              off — so compressed and legacy key lanes
+#                              both prove bit-identical join output; the
+#                              second pass also forces the dict-domain
+#                              reader on.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -164,6 +175,18 @@ if [ "${1:-}" = "proc-soak" ]; then
     --duration 45 --writers 2 --readers 1 --seed 0 \
     --scripted-kills "commit:manifests-written:2:kill,commit:snapshot-committed:2:kill,flush:files-written:3:kill,commit:before-manifests:2:kill" \
     --kill-period 9 --sweep-period 12 --min-kills 3
+fi
+
+if [ "${1:-}" = "join" ]; then
+  # parity suite with lane compression forced on, then off (the kernels'
+  # global lane plan is the piece that differs); the compressed pass also
+  # forces the code-domain reader so table-level joins run on codes
+  env JAX_PLATFORMS=cpu PAIMON_TPU_LANE_COMPRESSION=1 PAIMON_TPU_DICT_DOMAIN=1 \
+    timeout -k 10 600 python -m pytest tests/test_join.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_LANE_COMPRESSION=0 \
+    timeout -k 10 600 python -m pytest tests/test_join.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
 if [ "${1:-}" = "encode" ]; then

@@ -301,6 +301,76 @@ def test_admission_gate_bounds_projected_runs(tmp_warehouse, rng):
     assert compaction_metrics().counter("admission_waits").count >= 2
 
 
+def test_ingest_gate_wired_into_writer(tmp_warehouse, rng):
+    """ISSUE 12 (declared PR 11 follow-up): a ceiling-breaching write-only
+    ingest BLOCKS in MergeTreeWriter's own flush path — no harness calls
+    admit() — and proceeds once the service drains the debt. The gate
+    self-tracks runs between observation rounds via the settle(landed)
+    charge, so the bound holds even while the background loop sleeps."""
+    import threading
+
+    from paimon_tpu.table.compactor import active_debt_gate
+
+    t = _pk_table(
+        tmp_warehouse,
+        buckets=1,
+        extra={
+            "compaction.adaptive.read-amp-ceiling": "3",
+            "compaction.adaptive.interval": "60 s",  # loop sleeps: the WRITER must gate
+            "compaction.adaptive.ingest-gate-timeout": "30 s",
+        },
+    )
+    svc = AdaptiveCompactorService(t)
+    svc.start()
+    try:
+        assert active_debt_gate(t.path) is svc
+        # three flushes land three sorted runs; settle() advances the
+        # projected count without any observation round
+        _write_rounds(t, rng, 3, rows=64)
+        done = []
+
+        def breaching_write():
+            _write_rounds(t, rng, 1, rows=64)
+            done.append(True)
+
+        th = threading.Thread(target=breaching_write)
+        th.start()
+        time.sleep(0.5)
+        assert not done, "ceiling-breaching ingest should block in write()"
+        from paimon_tpu.metrics import compaction_metrics
+
+        assert compaction_metrics().counter("admission_waits").count >= 1
+        svc.run_round()  # drain: ceiling breach compacts, waiters wake
+        th.join(timeout=30)
+        assert done, "gated ingest must proceed after the drain"
+    finally:
+        svc.close()
+    assert active_debt_gate(t.path) is None
+    rb = t.new_read_builder()
+    assert rb.new_read().read_all(rb.new_scan().plan()).num_rows > 0
+
+
+def test_ingest_gate_off_by_option(tmp_warehouse, rng):
+    """compaction.adaptive.ingest-gate=false restores ungated write-only
+    ingest even with a service running."""
+    t = _pk_table(
+        tmp_warehouse,
+        buckets=1,
+        extra={
+            "compaction.adaptive.read-amp-ceiling": "2",
+            "compaction.adaptive.interval": "60 s",
+            "compaction.adaptive.ingest-gate": "false",
+        },
+    )
+    svc = AdaptiveCompactorService(t)
+    svc.start()
+    try:
+        _write_rounds(t, rng, 5, rows=64)  # sails past the ceiling unblocked
+    finally:
+        svc.close()
+    assert max(s.runs for s in svc.observe()) >= 2
+
+
 def test_metrics_surface(tmp_warehouse, rng):
     from paimon_tpu.metrics import registry
 
