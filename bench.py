@@ -389,6 +389,21 @@ def bench_join() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_point_get() -> list:
+    """Batched point-get spot-check (benchmarks/point_get_bench.py is the
+    dedicated benchmark with the 30 s mixed soak row): 10k-key get_batch vs
+    the scalar lookup() loop on a 1M-row PK table (every pass asserting
+    identical results), the bloom key-index pruning contrast on a sparse
+    absent-key set, and the get{} counter breakdown."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "point_get_bench.py")
+    spec = importlib.util.spec_from_file_location("_point_get_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_adaptive() -> dict:
     """Adaptive-vs-inline compaction spot-check (benchmarks/
     adaptive_compact_bench.py is the dedicated 60 s skewed soak with the
@@ -503,6 +518,7 @@ def main():
         lanes_rows = bench_lanes(table)
         dict_rows = bench_dicts(table)
         join_rows = bench_join()
+        point_get_rows = bench_point_get()
         pallas_rows = bench_pallas(table)
         adaptive_row = bench_adaptive()
         pipeline_rows = bench_pipeline()
@@ -548,6 +564,8 @@ def main():
             print(json.dumps(dict(drow, platform=_PLATFORM)))
         for jrow in join_rows:
             print(json.dumps(dict(jrow, platform=_PLATFORM)))
+        for grow in point_get_rows:
+            print(json.dumps(dict(grow, platform=_PLATFORM)))
         for prow in pallas_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         print(json.dumps(dict(adaptive_row, platform=_PLATFORM)))

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Batched point-get serving benchmark (ISSUE 13).
+
+A 1M-row primary-key table (4 overlapping sorted runs, 1 bucket, bloom key
+indexes on) served three ways:
+
+  1. headline — 10k-key batches through `LocalTableQuery.get_batch`
+     (one key-lane encode + one vectorized searchsorted per surviving file)
+     vs the scalar `lookup()` loop (LookupLevels walk per key). EVERY timed
+     pass asserts the batched results identical to the scalar oracle.
+     Target: >= 10x.
+  2. bloom pruning — a sparse (absent-key) batch against a cold data-file
+     cache, bloom-prune on vs off: with the PTIX key index consulted the
+     files prune with zero data IO (files_pruned > 0 asserted); without it
+     every candidate file decodes.
+  3. mixed soak — 4 writers + a batched get storm + the read-your-writes
+     checker for 30 s (service/soak.py): sustained gets/s, per-key p99
+     latency, zero mismatches vs the scalar oracle, typed-BUSY-only
+     shedding.
+
+Results land in benchmarks/results/point_get_bench.json; bench.py calls
+run_headline() for its spot-check rows.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_ROWS = 1_000_000
+N_RUNS = 4
+BATCH_KEYS = 10_000
+
+
+def build_table(path: str, n_rows: int = N_ROWS):
+    import paimon_tpu as pt
+    from paimon_tpu.catalog import FileSystemCatalog
+
+    cat = FileSystemCatalog(path, commit_user="getbench")
+    schema = pt.RowType.of(
+        ("id", pt.BIGINT(False)),
+        ("c1", pt.BIGINT()),
+        ("s1", pt.STRING()),
+        ("d1", pt.DOUBLE()),
+    )
+    table = cat.create_table(
+        "bench.kv",
+        schema,
+        primary_keys=["id"],
+        options={
+            "bucket": "1",
+            "write-only": "true",
+            "file-index.bloom-filter.primary-key.enabled": "true",
+        },
+    )
+    rng = np.random.default_rng(11)
+    # EVEN ids only: odd keys inside [0, 2*n) are in-range absents — the
+    # case where only the bloom key index (never min/max) can prune
+    ids = rng.permutation(n_rows).astype(np.int64) * 2
+    per = n_rows // N_RUNS
+    for r in range(N_RUNS):
+        chunk = np.sort(ids[r * per : (r + 1) * per])
+        wb = table.new_batch_write_builder()
+        w = wb.new_write()
+        w.write(
+            {
+                "id": chunk,
+                "c1": chunk * 3,
+                "s1": np.array([f"val-{int(x) % 1000:04d}" for x in chunk], dtype=object),
+                "d1": chunk.astype(np.float64) * 0.5,
+            }
+        )
+        wb.new_commit().commit(w.prepare_commit())
+    return table
+
+
+def _scalar_loop(q, keys):
+    out = []
+    for k in keys:
+        row = q.lookup((), int(k))
+        out.append(None if row is None else row.to_pylist()[0])
+    return out
+
+
+def bench_batched_vs_scalar(table, iters: int = 2, n_keys: int = BATCH_KEYS) -> dict:
+    from paimon_tpu.metrics import get_metrics
+    from paimon_tpu.table.query import LocalTableQuery
+
+    q = LocalTableQuery(table)
+    rng = np.random.default_rng(7)
+    keys = [int(k) for k in rng.integers(0, N_ROWS * 2, n_keys)]  # ~50% absent
+    # warm both paths (file decode + lookup-file conversion are one-time)
+    q.get_batch(keys[:64])
+    _scalar_loop(q, keys[:64])
+    g = get_metrics()
+    best_batch = float("inf")
+    best_scalar = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        batched = q.get_batch(keys).to_pylist()
+        best_batch = min(best_batch, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        scalar = _scalar_loop(q, keys)
+        best_scalar = min(best_scalar, time.perf_counter() - t0)
+        assert batched == scalar, "batched gets diverged from the scalar oracle"
+    found = sum(1 for r in batched if r is not None)
+    p99_us = best_batch / n_keys * 1e6
+    g.gauge("p99_us").set(p99_us)
+    return {
+        "metric": "point get: batched get_batch vs scalar lookup() loop (1M-row PK table)",
+        "keys_per_batch": n_keys,
+        "keys_found": found,
+        "batched_ms": round(best_batch * 1000, 2),
+        "scalar_ms": round(best_scalar * 1000, 2),
+        "speedup": round(best_scalar / best_batch, 2),
+        "batched_gets_per_sec": round(n_keys / best_batch, 1),
+        "per_key_us": round(p99_us, 3),
+        "identical_to_oracle": True,
+        "unit": "x",
+    }
+
+
+def bench_bloom_pruning(table, iters: int = 2, n_keys: int = 64) -> dict:
+    """Sparse absent-key batch, COLD data-file cache: bloom-on prunes every
+    file with zero data IO; bloom-off pays the decode."""
+    from paimon_tpu.metrics import get_metrics
+    from paimon_tpu.table.query import LocalTableQuery
+    from paimon_tpu.utils import cache as cache_mod
+
+    rng = np.random.default_rng(13)
+    # ODD keys inside the table's key range: every id is even, so these are
+    # absent — and range pruning is powerless, only the bloom index prunes
+    absent = [int(k) * 2 + 1 for k in rng.integers(0, N_ROWS - 1, n_keys)]
+    g = get_metrics()
+    out = {}
+    for mode, opt in (("pruned", "true"), ("unpruned", "false")):
+        t2 = table.copy({"lookup.get.bloom-prune.enabled": opt})
+        best = float("inf")
+        pruned = 0
+        for _ in range(iters):
+            cache_mod.clear_all()
+            q = LocalTableQuery(t2)
+            p0 = g.counter("files_pruned").count
+            t0 = time.perf_counter()
+            res = q.get_batch(absent)
+            best = min(best, time.perf_counter() - t0)
+            pruned = g.counter("files_pruned").count - p0
+            assert res.to_pylist() == [None] * len(absent)
+        out[mode] = (best, pruned)
+    assert out["pruned"][1] > 0, "bloom key index pruned no files under a sparse key set"
+    return {
+        "metric": "point get: bloom key-index pruning (sparse absent keys, cold cache)",
+        "keys": n_keys,
+        "pruned_ms": round(out["pruned"][0] * 1000, 2),
+        "unpruned_ms": round(out["unpruned"][0] * 1000, 2),
+        "files_pruned": out["pruned"][1],
+        "speedup": round(out["unpruned"][0] / max(out["pruned"][0], 1e-9), 2),
+        "unit": "x",
+    }
+
+
+def bench_get_breakdown() -> dict:
+    from paimon_tpu.metrics import get_metrics
+
+    g = get_metrics()
+    return {
+        "metric": "point get breakdown",
+        "gets": g.counter("gets").count,
+        "keys_probed": g.counter("keys_probed").count,
+        "files_pruned": g.counter("files_pruned").count,
+        "index_hits": g.counter("index_hits").count,
+        "memtable_hits": g.counter("memtable_hits").count,
+        "probe_ms_mean": round(g.histogram("probe_ms").mean, 3),
+        "p99_us": round(g.gauge("p99_us").value, 1),
+        "unit": "counters",
+    }
+
+
+def bench_mixed_soak(duration: float = 30.0, seed: int = 0) -> dict:
+    """4 writers + batched get storm + RYW checker + typed-BUSY overload
+    bursts; oracle = the scalar lookup() loop per round."""
+    from paimon_tpu.service.soak import SoakConfig, run_soak
+
+    base = tempfile.mkdtemp(prefix="paimon_get_soak_")
+    try:
+        cfg = SoakConfig(
+            duration_s=duration,
+            writers=4,
+            readers=1,
+            getters=2,
+            fault_possibility=0,
+            seed=seed,
+            get_batch_keys=2048,
+            get_oracle_keys=16,
+        )
+        rep = run_soak(base, cfg)
+        return {
+            "metric": f"mixed ingest + point-get soak ({int(duration)} s, 4 writers + 2 getters)",
+            "consistent": rep["consistent"],
+            "gets_per_sec": rep["gets_per_sec"],
+            "gets_served": rep["gets_served"],
+            "get_p50_us": rep["get_p50_us"],
+            "get_p99_us": rep["get_p99_us"],
+            "get_mismatches": rep["get_mismatches"],
+            "ryw_rounds": rep["ryw_rounds"],
+            "ryw_misses": rep["ryw_misses"],
+            "gets_shed_typed": rep["gets_shed_typed"],
+            "gets_shed_untyped": rep["gets_shed_untyped"],
+            "commits_ok": rep["commits_ok"],
+            "lost_rows": rep["lost_rows"],
+            "duplicated_rows": rep["duplicated_rows"],
+            "wrong_values": rep["wrong_values"],
+            "leaked_files": rep["leaked_file_count"],
+            "unit": "counters",
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def run_headline(iters: int = 2) -> list:
+    """bench.py spot-check rows: batched-vs-scalar + pruning + breakdown."""
+    tmp = tempfile.mkdtemp(prefix="paimon_get_bench_")
+    try:
+        table = build_table(tmp)
+        rows = [
+            bench_batched_vs_scalar(table, iters=iters),
+            bench_bloom_pruning(table, iters=iters),
+            bench_get_breakdown(),
+        ]
+        return rows
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="batched point-get benchmark")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--soak-duration", type=float, default=30.0)
+    ap.add_argument("--no-soak", action="store_true")
+    args = ap.parse_args()
+
+    rows = run_headline(iters=args.iters)
+    if not args.no_soak:
+        rows.append(bench_mixed_soak(duration=args.soak_duration))
+    for row in rows:
+        print(json.dumps(row))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "results", "point_get_bench.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({"rows": rows, "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S")}, f, indent=2)
+    headline = rows[0]
+    ok = headline["speedup"] >= 10.0 and (args.no_soak or (rows[-1]["consistent"] and rows[-1]["gets_per_sec"] >= 10_000))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    from paimon_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    raise SystemExit(main())

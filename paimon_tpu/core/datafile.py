@@ -127,6 +127,8 @@ class KeyValueFileWriterFactory:
         target_file_size: int = 128 << 20,
         bloom_columns: Sequence[str] = (),
         bloom_fpp: float = 0.05,
+        key_bloom: bool = False,
+        key_bloom_fpp: float = 0.001,
         index_in_manifest_threshold: int = 500,
         keyed: bool = True,
         format_options: dict | None = None,
@@ -144,6 +146,11 @@ class KeyValueFileWriterFactory:
         self.target_file_size = target_file_size
         self.bloom_columns = list(bloom_columns)
         self.bloom_fpp = bloom_fpp
+        # composite primary-key bloom (file-index.bloom-filter.primary-key.
+        # enabled): written at flush AND compaction time — both routes land
+        # here — so the batched get path can prune any file without data IO
+        self.key_bloom = bool(key_bloom) and keyed and bool(key_names)
+        self.key_bloom_fpp = key_bloom_fpp
         self.index_in_manifest_threshold = index_in_manifest_threshold
         # keyed=False: append-only tables — plain rows on disk, no
         # _SEQUENCE_NUMBER/_VALUE_KIND columns, no key range
@@ -227,10 +234,18 @@ class KeyValueFileWriterFactory:
         fmt.write(self.file_io, path, disk, compression, format_options=self.format_options)
         extra: list[str] = []
         embedded: bytes | None = None
-        if self.bloom_columns:
+        if self.bloom_columns or self.key_bloom:
             from ..format.fileindex import build_index_payload, index_path
 
-            payload = build_index_payload(kv.data, self.bloom_columns, self.bloom_fpp)
+            hashes = None
+            if self.key_bloom:
+                from ..table.bucket import key_hashes
+
+                hashes = key_hashes(kv.data, self.key_names)
+            payload = build_index_payload(
+                kv.data, self.bloom_columns, self.bloom_fpp,
+                key_hashes=hashes, key_fpp=self.key_bloom_fpp,
+            )
             if payload is not None:
                 if len(payload) <= self.index_in_manifest_threshold:
                     # small index rides in the manifest entry: zero extra

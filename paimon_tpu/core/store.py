@@ -47,6 +47,12 @@ def _parse_per_level(spec: str | None) -> dict[int, str]:
     return out
 
 
+def _resolve_key_bloom(co: CoreOptions) -> bool:
+    from ..format.fileindex import resolve_key_bloom
+
+    return resolve_key_bloom(co.options.get(CoreOptions.FILE_INDEX_BLOOM_KEY_ENABLED))
+
+
 class KeyValueFileStore:
     def __init__(self, file_io: FileIO, table_path: str, schema: TableSchema, commit_user: str = "anonymous"):
         self.table_path = table_path
@@ -129,6 +135,8 @@ class KeyValueFileStore:
             target_file_size=co.target_file_size,
             bloom_columns=[c.strip() for c in bloom_cols.split(",")] if bloom_cols else (),
             bloom_fpp=co.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP),
+            key_bloom=_resolve_key_bloom(co),
+            key_bloom_fpp=co.options.get(CoreOptions.FILE_INDEX_BLOOM_KEY_FPP),
             index_in_manifest_threshold=int(
                 co.options.get(CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD)
             ),

@@ -72,6 +72,11 @@ class MergeTreeWriter:
         self._buffered_rows = 0
         self._buffered_bytes = 0
         self._buffer_seq_ordered = True
+        # read-your-writes visibility: batches drained from the memtable but
+        # whose flush has not yet landed level-0 files stay listed here, so
+        # delta_snapshot never has a blind window between flush_dispatch
+        # clearing the buffer and flush_complete publishing _new_files
+        self._inflight_delta: list[KVBatch] = []
         self._new_files: list[DataFileMeta] = []
         self._compact_before: list[DataFileMeta] = []
         self._compact_after: list[DataFileMeta] = []
@@ -267,6 +272,7 @@ class MergeTreeWriter:
             self._flush_pending = []
             self._shutdown_flush_pool()  # also returns cancelled flushes' depth slots
             self._acct_release_all()
+            self._inflight_delta.clear()
 
     def flush_dispatch(self):
         """Phase 1 of a (possibly mesh-batched) flush: drain the memtable,
@@ -300,6 +306,7 @@ class MergeTreeWriter:
         crash_point("flush:before-dispatch")
         kv = KVBatch.concat(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
         drained_bytes = self._buffered_bytes
+        self._inflight_delta.append(kv)  # visible to delta_snapshot until the L0 files land
         self._buffer.clear()
         self._buffered_rows = 0
         self._buffered_bytes = 0
@@ -318,7 +325,7 @@ class MergeTreeWriter:
         buffer_seq_ordered = self._buffer_seq_ordered
         handle = self.merge.merge_async(kv, seq_ascending=buffer_seq_ordered)
         self._buffer_seq_ordered = True
-        return (handle, buffer_seq_ordered, drained_bytes, gate)
+        return (handle, buffer_seq_ordered, drained_bytes, gate, kv)
 
     def flush_complete(self, state) -> None:
         """Phase 2: resolve the merge and write level-0 files + changelog,
@@ -327,13 +334,19 @@ class MergeTreeWriter:
         the moment the bytes stop being host-memory the flush pipeline owes.
         The debt-gate charge settles here too: landed when the level-0 run's
         files exist, abandoned when the flush failed."""
-        handle, buffer_seq_ordered, drained_bytes, gate = state
+        handle, buffer_seq_ordered, drained_bytes, gate, kv = state
         landed = False
         try:
             self._flush_complete_inner(handle, buffer_seq_ordered)
             landed = True
         finally:
             self._acct_release(drained_bytes)
+            try:
+                # the L0 files (or the failure) are published: the raw batch
+                # leaves the read-your-writes in-flight window
+                self._inflight_delta.remove(kv)
+            except ValueError:
+                pass  # close() may have cleared the window already
             if gate is not None:
                 gate.settle([(self.partition, self.bucket)], landed=landed)
 
@@ -465,17 +478,23 @@ class MergeTreeWriter:
             # failure — e.g. the input-changelog write — left it alive)
             self._shutdown_flush_pool()
         # a file produced by one compaction round and consumed by a later
-        # round within the same commit cancels out of the message
-        before_names = {f.file_name for f in self._compact_before}
-        after_names = {f.file_name for f in self._compact_after}
-        cancel = before_names & after_names
+        # round within the same commit cancels out of the message. Keyed by
+        # (name, LEVEL), not name alone: an upgrade emits DELETE(F@k) +
+        # ADD(F@higher) under ONE name — name-based cancel would erase the
+        # whole chain, deleting the rewrite's inputs while never adding F
+        # (silent row loss once the orphan sweep reclaims it). With the
+        # level in the key only the true create-then-consume pair (F@k in
+        # both lists) cancels, leaving DELETE inputs + ADD F@higher.
+        before_keys = {(f.file_name, f.level) for f in self._compact_before}
+        after_keys = {(f.file_name, f.level) for f in self._compact_after}
+        cancel = before_keys & after_keys
         msg = CommitMessage(
             partition=self.partition,
             bucket=self.bucket,
             total_buckets=self.total_buckets,
             new_files=list(self._new_files),
-            compact_before=[f for f in self._compact_before if f.file_name not in cancel],
-            compact_after=[f for f in self._compact_after if f.file_name not in cancel],
+            compact_before=[f for f in self._compact_before if (f.file_name, f.level) not in cancel],
+            compact_after=[f for f in self._compact_after if (f.file_name, f.level) not in cancel],
             changelog_files=list(self._changelog),
             compact_changelog_files=list(self._compact_changelog),
         )
@@ -485,6 +504,16 @@ class MergeTreeWriter:
         self._changelog.clear()
         self._compact_changelog.clear()
         return msg
+
+    def delta_snapshot(self) -> tuple[list[KVBatch], list[DataFileMeta]]:
+        """Point-in-time view of this writer's uncommitted state for the
+        read-your-writes get tier: buffered memtable batches (plus any
+        drained-but-not-yet-landed flush input) and the level-0 files no
+        snapshot references yet. List copies — safe to take from a serving
+        thread while this writer keeps ingesting (a row caught by BOTH an
+        in-flight batch and its landed file resolves identically: same key,
+        same sequence, same value)."""
+        return list(self._buffer) + list(self._inflight_delta), list(self._new_files)
 
     @property
     def max_sequence_number(self) -> int:

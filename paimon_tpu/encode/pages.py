@@ -357,6 +357,49 @@ def encode_chunk(
                 )
     else:
         compact, enc = _fixed_values(col, dtype, physical, validity, n_valid)
+        dict_route = (
+            _fixed_dict_route(compact, n_valid) if enc == ENC_PLAIN and enable_dict and physical in (T_INT32, T_INT64) else None
+        )
+        if dict_route is not None:
+            # numeric dictionary route (ISSUE 13, declared PR 12 follow-up):
+            # low-cardinality int32/int64/date columns emit a sorted
+            # dictionary page + RLE_DICTIONARY codes, so NATIVE-written
+            # files join the fixed-width code-domain reads (merge.
+            # dict-domain) the arrow path already enables — lookups and
+            # joins on these columns then match on codes, zero expansion
+            pool, codes = dict_route
+            if n_valid:
+                t0 = time.perf_counter()
+                # np.unique pools are sorted and fully referenced: chunk
+                # stats reduce over the pool edges, no row-sized pass
+                stats_min, stats_max = _fixed_stat_bytes(pool[[0, -1]], physical)
+                t_stats += time.perf_counter() - t0
+            sink.add_dict_page(kernels.encode_plain(pool, physical), len(pool), True)
+            if metrics is not None:
+                metrics.counter("dict_pages").inc()
+            width = kernels.bit_width_for(max(len(pool) - 1, 0))
+            if n_valid > 50_000 and 0 < width < 32 and width % 8:
+                width = (width + 7) & ~7  # byte-aligned pack fast path
+            encodings |= {ENC_PLAIN, ENC_RLE_DICTIONARY}
+            bounds = _page_bounds(n, max(width, 1) / 8 + 0.125, page_size)
+            for start in bounds:
+                stop = min(start + bounds.step, n)
+                page_codes = codes[cidx[start] : cidx[stop]]
+                body = bytes([width]) + kernels.encode_rle_hybrid(page_codes, width)
+                sink.add_data_page(
+                    _level_bytes(levels, start, stop),
+                    body,
+                    stop - start,
+                    len(page_codes),
+                    ENC_RLE_DICTIONARY,
+                )
+            null_count = n - n_valid
+            chunk.stats = _stats_struct(stats_min, stats_max, null_count)
+            chunk.encodings = tuple(sorted(encodings))
+            if metrics is not None:
+                metrics.counter("pages_written").inc(chunk.num_pages)
+                metrics.histogram("stats_ms").update(t_stats * 1000)
+            return chunk
         if stats_min is None and n_valid:
             t0 = time.perf_counter()
             stats_min, stats_max = _fixed_stat_bytes(compact, physical)
@@ -418,6 +461,18 @@ def _fixed_values(col: Column, dtype: DataType, physical: int, validity, n_valid
         # stream compresses far below PLAIN and packs vectorized
         return compact, ENC_DELTA_BINARY_PACKED
     return compact, ENC_PLAIN
+
+
+def _fixed_dict_route(compact: np.ndarray, n_valid: int):
+    """(sorted pool, int64 codes) for a low-cardinality fixed-width column,
+    or None for the PLAIN/DELTA path. Small columns (< 64 valid values)
+    stay PLAIN — a dictionary page cannot pay for itself there."""
+    if n_valid < 64:
+        return None
+    pool, codes = np.unique(compact, return_inverse=True)
+    if len(pool) * _DICT_RATIO_DEN > n_valid * _DICT_RATIO_NUM:
+        return None  # domain ~as large as the data: PLAIN wins
+    return pool, codes.astype(np.int64)
 
 
 def _byte_array_route(col: Column, validity, n_valid: int, enable_dict: bool):

@@ -26,9 +26,40 @@ from ..data.batch import Column, ColumnBatch
 from ..data.predicate import CompoundPredicate, LeafPredicate, Predicate
 from ..fs import FileIO
 
-__all__ = ["BloomFilter", "write_file_index", "FileIndexPredicate", "index_path"]
+__all__ = [
+    "BloomFilter",
+    "write_file_index",
+    "FileIndexPredicate",
+    "index_path",
+    "KEY_INDEX_NAME",
+    "resolve_key_bloom",
+]
 
 _MAGIC = b"PTIX"
+
+# the composite primary-key bloom rides in the PTIX container as a pseudo
+# column (reference: bloom-filter file index per column; the key entry is the
+# point-get extension — one bloom over the combined key-column hash, the same
+# splitmix64 hash the bucket router and lookup files use)
+KEY_INDEX_NAME = "__KEY__"
+
+
+def resolve_key_bloom(enabled: bool | str | None) -> bool:
+    """One resolution order everywhere (mirrors ops.dicts.resolve_dict_domain):
+    the PAIMON_TPU_KEY_BLOOM env var (the verify `get` stage forces both
+    paths) beats the caller's option value, which beats the default (off)."""
+    import os
+
+    env = os.environ.get("PAIMON_TPU_KEY_BLOOM", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    if enabled is None:
+        return False
+    if isinstance(enabled, str):
+        return enabled.strip().lower() in ("1", "on", "true")
+    return bool(enabled)
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
@@ -116,24 +147,30 @@ def index_path(data_file_path: str) -> str:
 
 
 def build_index_payload(
-    batch: ColumnBatch, columns: Sequence[str], fpp: float = 0.05
+    batch: ColumnBatch,
+    columns: Sequence[str],
+    fpp: float = 0.05,
+    key_hashes: np.ndarray | None = None,
+    key_fpp: float = 0.001,
 ) -> bytes | None:
     """The PTIX container bytes for `columns`, or None when nothing to index.
     Callers decide placement: sidecar file, or embedded in the manifest entry
-    when small (reference file-index.in-manifest-threshold)."""
+    when small (reference file-index.in-manifest-threshold).
+
+    `key_hashes`: optional (n,) uint64 combined primary-key hashes
+    (table.bucket.key_hashes) — adds the composite KEY_INDEX_NAME bloom the
+    batched get path prunes files with. A tighter fpp than the per-column
+    default: a point-get batch probes many keys per file, so the per-file
+    false-positive budget must survive the union over the batch."""
     cols = [c for c in columns if c in batch.schema]
-    if not cols or batch.num_rows == 0:
+    if (not cols and key_hashes is None) or batch.num_rows == 0:
         return None
     header: dict = {"columns": {}}
     blobs: list[bytes] = []
     offset = 0
-    for name in cols:
-        col = batch.column(name)
-        valid = col.valid_mask()
-        values = col.values[valid]
-        bf = BloomFilter.for_items(len(values), fpp)
-        if len(values):
-            bf.add_hashes(_hash64(values))
+
+    def add(name: str, bf: BloomFilter, extra: dict | None = None) -> None:
+        nonlocal offset
         blob = bf.to_bytes()
         header["columns"][name] = {
             "type": "bloom",
@@ -141,9 +178,23 @@ def build_index_payload(
             "length": len(blob),
             "numHashFunctions": bf.num_hashes,
             "numBits": bf.num_bits,
+            **(extra or {}),
         }
         blobs.append(blob)
         offset += len(blob)
+
+    for name in cols:
+        col = batch.column(name)
+        valid = col.valid_mask()
+        values = col.values[valid]
+        bf = BloomFilter.for_items(len(values), fpp)
+        if len(values):
+            bf.add_hashes(_hash64(values))
+        add(name, bf)
+    if key_hashes is not None and len(key_hashes):
+        bf = BloomFilter.for_items(len(key_hashes), key_fpp)
+        bf.add_hashes(np.asarray(key_hashes, dtype=np.uint64))
+        add(KEY_INDEX_NAME, bf, {"key": True})
     hdr = json.dumps(header).encode()
     return _MAGIC + struct.pack("<I", len(hdr)) + hdr + b"".join(blobs)
 
@@ -189,6 +240,19 @@ class FileIndexPredicate:
             return None
         raw = self.blob[meta["offset"] : meta["offset"] + meta["length"]]
         return BloomFilter.from_bytes(raw, meta["numBits"], meta["numHashFunctions"])
+
+    def key_bloom(self) -> BloomFilter | None:
+        """The composite primary-key bloom, or None for pre-key-index files."""
+        return self._bloom(KEY_INDEX_NAME)
+
+    def test_key_hashes(self, hashes: np.ndarray) -> np.ndarray | None:
+        """(n,) bool mask — True where the key MIGHT be in this file — or
+        None when the file carries no key index (cannot prune). One
+        vectorized membership test for the whole probe batch."""
+        bf = self.key_bloom()
+        if bf is None:
+            return None
+        return bf.might_contain_hashes(np.asarray(hashes, dtype=np.uint64))
 
     def test(self, predicate: Predicate | None) -> bool:
         if predicate is None:

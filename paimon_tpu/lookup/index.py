@@ -1,0 +1,175 @@
+"""Vectorized probe indexes for batched primary-key gets.
+
+The batched twin of LookupLevels (lookup/__init__.py): where the scalar path
+pays one python probe per key per file, this layer encodes a whole probe
+batch ONCE through the JoinIndex machinery (ops/join.py: key lanes → global
+LanePlan → truncate/pack → <= 64-bit fold) and pays one vectorized
+searchsorted per surviving sorted run. Files are pruned BEFORE any data IO
+by two zero-IO tests — the key range recorded in the manifest entry and the
+PTIX composite key bloom (format/fileindex.py, written at flush/compaction
+when file-index.bloom-filter.primary-key.enabled) — then surviving files'
+decoded KVBatches come from the process-wide data-file cache (utils.cache),
+so a sustained get workload decodes each immutable file exactly once.
+Code-domain columns (merge.dict-domain) are probed on their dictionary
+codes: the build side of the index never materializes a string.
+
+Level resolution happens on the caller's side (table/get.py): every file's
+matches carry (sequence, kind), the winner per key is the max-sequence row,
+deletes mask to absent — the same merge rule the scalar LookupLevels walk
+applies file-by-file, applied once over the whole batch.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Sequence
+
+import numpy as np
+
+from ..core.datafile import DataFileMeta, KeyValueFileReaderFactory
+from ..core.kv import KVBatch
+from ..metrics import get_metrics
+
+__all__ = ["FileProbeIndex", "BucketGetIndex", "GetResult"]
+
+
+class GetResult:
+    """Batched get outcome aligned with the probe keys: `found[i]` says key i
+    resolved to a live row; `rows` holds exactly the found rows (in probe
+    order) and `take[j]` is the probe index of rows[j]."""
+
+    def __init__(self, n: int, found: np.ndarray, rows, take: np.ndarray):
+        self.n = n
+        self.found = found
+        self.rows = rows  # ColumnBatch over the table's value schema
+        self.take = take  # (found.sum(),) int64 probe indices, ascending
+
+    def to_pylist(self) -> list:
+        """list[tuple | None], one entry per probe key — the exact shape of
+        a scalar lookup() loop (the parity oracle's contract)."""
+        out: list = [None] * self.n
+        vals = self.rows.to_pylist()
+        for j, i in enumerate(self.take):
+            out[int(i)] = vals[j]
+        return out
+
+    def row(self, i: int):
+        """Row for probe key i as a tuple, or None."""
+        if not self.found[i]:
+            return None
+        j = int(np.searchsorted(self.take, i))
+        return tuple(c.value_at(j) for c in self.rows.columns.values())
+
+
+class FileProbeIndex:
+    """One data file (or one memtable generation), indexed for batch probes:
+    a JoinIndex over the key columns plus the row-aligned (seq, kind)
+    system vectors the level resolution needs."""
+
+    def __init__(self, kv: KVBatch, key_names: Sequence[str]):
+        from ..ops.join import JoinIndex
+
+        self.kv = kv
+        self.key_names = list(key_names)
+        self.index = JoinIndex(kv.data, self.key_names)
+
+    def probe(self, probe_batch) -> tuple[np.ndarray, np.ndarray]:
+        """(probe_idx, row) pairs for every key match in this file."""
+        if self.kv.num_rows == 0 or probe_batch.num_rows == 0:
+            return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+        res = self.index.probe(probe_batch, self.key_names, how="inner")
+        return np.asarray(res.left_take, dtype=np.int64), np.asarray(res.right_take, dtype=np.int64)
+
+
+class BucketGetIndex:
+    """One bucket's files, served for batched gets: zero-IO pruning (key
+    range + bloom key index), lazily-built per-file probe indexes, matches
+    returned with their resolution metadata. Instances are immutable views
+    of one snapshot's file set — LocalTableQuery.refresh() diffs per bucket
+    and keeps instances whose (files, deletion vectors) are unchanged, so
+    built indexes survive snapshot advances that didn't touch the bucket."""
+
+    def __init__(
+        self,
+        files: list[DataFileMeta],
+        reader_factory: KeyValueFileReaderFactory,
+        key_names: Sequence[str],
+        deletion_vectors: dict | None = None,
+        bloom_prune: bool = True,
+    ):
+        self.files = list(files)
+        self.reader_factory = reader_factory
+        self.key_names = list(key_names)
+        self.deletion_vectors = deletion_vectors or {}
+        self.bloom_prune = bloom_prune
+        self._indexes: dict[str, FileProbeIndex] = {}
+        self._payloads: dict[str, object] = {}  # file -> FileIndexPredicate|None
+
+    # ---- pruning (no data IO) ------------------------------------------
+    def _index_predicate(self, meta: DataFileMeta):
+        """The file's PTIX index (embedded bytes or the small sidecar read),
+        parsed once; None when the file carries no index."""
+        name = meta.file_name
+        if name not in self._payloads:
+            from ..format.fileindex import FileIndexPredicate, index_path
+
+            pred = None
+            try:
+                if meta.embedded_index is not None:
+                    pred = FileIndexPredicate.from_bytes(meta.embedded_index)
+                elif any(x.endswith(".index") for x in meta.extra_files):
+                    data_path = f"{self.reader_factory.bucket_dir}/{name}"
+                    pred = FileIndexPredicate(self.reader_factory.file_io, index_path(data_path))
+            except (OSError, AssertionError, ValueError):
+                pred = None  # a torn/missing sidecar never fails a get
+            self._payloads[name] = pred
+        return self._payloads[name]
+
+    def _pruned(self, meta: DataFileMeta, hashes: np.ndarray, sorted_keys: list | None) -> bool:
+        g = get_metrics()
+        if sorted_keys and meta.min_key and meta.max_key:
+            i = bisect_left(sorted_keys, tuple(meta.min_key))
+            if i == len(sorted_keys) or sorted_keys[i] > tuple(meta.max_key):
+                return True  # no probe key inside the file's key range
+        if not self.bloom_prune:
+            return False
+        pred = self._index_predicate(meta)
+        if pred is None:
+            return False
+        mask = pred.test_key_hashes(hashes)
+        if mask is None:
+            return False  # pre-key-index file: cannot prune by bloom
+        g.counter("index_hits").inc()
+        return not bool(mask.any())
+
+    # ---- probing --------------------------------------------------------
+    def _file_index(self, meta: DataFileMeta) -> FileProbeIndex:
+        name = meta.file_name
+        idx = self._indexes.get(name)
+        if idx is None:
+            kv = self.reader_factory.read(meta)
+            dv = self.deletion_vectors.get(name)
+            if dv is not None:
+                keep = ~dv.deleted_mask(kv.num_rows)
+                if not keep.all():
+                    kv = kv.filter(keep)
+            idx = self._indexes[name] = FileProbeIndex(kv, self.key_names)
+        return idx
+
+    def probe(self, probe_batch, hashes: np.ndarray, sorted_keys: list | None = None):
+        """[(FileProbeIndex, probe_idx, rows)] across surviving files.
+        `hashes`: the probe keys' combined uint64 hashes (computed once per
+        get_batch, shared with bucket routing); `sorted_keys`: the probe key
+        tuples sorted ascending (computed once, shared across buckets)."""
+        g = get_metrics()
+        out = []
+        for meta in self.files:
+            if self._pruned(meta, hashes, sorted_keys):
+                g.counter("files_pruned").inc()
+                continue
+            fi = self._file_index(meta)
+            g.counter("keys_probed").inc(probe_batch.num_rows)
+            pi, rows = fi.probe(probe_batch)
+            if len(pi):
+                out.append((fi, pi, rows))
+        return out

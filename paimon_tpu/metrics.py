@@ -26,6 +26,7 @@ __all__ = [
     "decode_metrics",
     "dict_metrics",
     "encode_metrics",
+    "get_metrics",
     "io_metrics",
     "join_metrics",
     "lanes_metrics",
@@ -294,6 +295,24 @@ def compaction_metrics() -> MetricGroup:
     (per compaction execution). Resolved per call so registry.reset() in
     tests swaps the group out."""
     return registry.group("compaction")
+
+
+def get_metrics() -> MetricGroup:
+    """The get{...} group (batched point-lookup serving, paimon_tpu.table.
+    get + lookup.index, surfaced as LocalTableQuery.get_batch, the KV
+    server's get_batch method and Flight do_action("get_batch")). Canonical
+    members — counters: gets (probe keys served, found or not), keys_probed
+    (key x surviving-file probe work actually executed), files_pruned (data
+    files skipped with NO data IO: key-range or bloom key-index verdict),
+    index_hits (files whose PTIX key bloom was consulted), memtable_hits
+    (keys whose winning row came from the read-your-writes delta tier:
+    an attached writer's memtable or its not-yet-committed level-0 files),
+    busy_rejected (get_batch requests a server answered with a typed BUSY
+    because lookup.get.max-inflight was saturated); histogram: probe_ms
+    (end-to-end get_batch wall millis per call); gauge: p99_us (per-key p99
+    latency in microseconds, set by the serving soak / benchmark).
+    Resolved per call so registry.reset() in tests swaps the group out."""
+    return registry.group("get")
 
 
 def io_metrics() -> MetricGroup:

@@ -933,6 +933,19 @@ class CoreOptions:
     FILE_INDEX_READ_ENABLED = ConfigOption.bool_(
         "file-index.read.enabled", True, "Evaluate file index (bloom sidecars / embedded) during planning."
     )
+    FILE_INDEX_BLOOM_KEY_ENABLED = ConfigOption.bool_(
+        "file-index.bloom-filter.primary-key.enabled", False,
+        "Primary-key tables: write a composite key bloom (one __KEY__ entry "
+        "over the combined key-column hash) into every data file's PTIX "
+        "index at flush/compaction time, so batched point-get planning can "
+        "prune files with zero data IO. PAIMON_TPU_KEY_BLOOM=1/0 overrides.",
+    )
+    FILE_INDEX_BLOOM_KEY_FPP = ConfigOption.float_(
+        "file-index.bloom-filter.primary-key.fpp", 0.001,
+        "Key bloom false-positive rate. Tighter than the per-column default "
+        "because a batched get probes MANY keys per file: the per-file "
+        "false-positive budget must survive the union over the batch.",
+    )
     FILE_INDEX_IN_MANIFEST_THRESHOLD = ConfigOption.memory(
         "file-index.in-manifest-threshold",
         "500 b",
@@ -1018,6 +1031,17 @@ class CoreOptions:
     )
     LOOKUP_HASH_LOAD_FACTOR = ConfigOption.float_(
         "lookup.hash-load-factor", 0.75, "Fill ratio of the sorted-hash lookup sidecar's slot table."
+    )
+    LOOKUP_GET_BLOOM_PRUNE = ConfigOption.bool_(
+        "lookup.get.bloom-prune.enabled", True,
+        "Batched gets consult per-file key blooms (and key ranges) to prune "
+        "files before any data IO. Off = every candidate file is probed.",
+    )
+    LOOKUP_GET_MAX_INFLIGHT = ConfigOption.int_(
+        "lookup.get.max-inflight", 64,
+        "Concurrent get_batch requests a serving endpoint (KV server / "
+        "Flight do_action) admits before answering a typed BUSY instead of "
+        "queueing into a timeout.",
     )
     MANIFEST_FULL_COMPACTION_THRESHOLD_SIZE = ConfigOption.memory(
         "manifest.full-compaction-threshold-size", "16 mb",

@@ -9,9 +9,20 @@ on the filesystem like the reference's ServiceManager address files.
 
 Wire format (both directions): 4-byte big-endian length + UTF-8 JSON.
 Request:  {"id": n, "method": "lookup", "partition": [...], "key": [...]}
+          {"id": n, "method": "get_batch", "partition": [...], "keys": [[...], ...]}
           {"id": n, "method": "refresh"} | {"id": n, "method": "ping"}
           {"id": n, "method": "health"}
 Response: {"id": n, "ok": true, "row": [...] | null} | {"id": n, "ok": false, "error": "..."}
+          {"id": n, "ok": true, "rows": [[...] | null, ...]}
+          {"id": n, "ok": false, "busy": true, "state": "...", "retry_after_ms": m}
+
+`get_batch` is the batched serving path (LocalTableQuery.get_batch): N keys
+resolve in one vectorized probe pass, read-your-writes when the server was
+constructed with an attached TableWrite. It rides the same admission idea as
+the ingest side: at most `lookup.get.max-inflight` concurrent get_batch
+requests are admitted — the next one is answered with a TYPED busy response
+(KvBusyError on the client, mirroring WriterBackpressureError/
+FlightBusyError), never a queue-into-timeout.
 
 `health` surfaces the writer admission controller's flow-control state
 (core.admission.WriteBufferController.health_dict — the same stable schema
@@ -35,7 +46,18 @@ from ..utils import dumps, loads
 if TYPE_CHECKING:
     from ..table import FileStoreTable
 
-__all__ = ["KvQueryServer", "KvQueryClient", "ServiceManager"]
+__all__ = ["KvQueryServer", "KvQueryClient", "KvBusyError", "ServiceManager"]
+
+
+class KvBusyError(RuntimeError):
+    """The server shed a get_batch with a typed BUSY (lookup.get.max-inflight
+    saturated). Carries the payload and the server's retry-after hint — the
+    read-side twin of the ingest path's FlightBusyError."""
+
+    def __init__(self, payload: dict):
+        super().__init__(f"get shed by server: {payload}")
+        self.payload = payload
+        self.retry_after_ms = int(payload.get("retry_after_ms", 0))
 
 
 def _send(sock: socket.socket, obj: dict) -> None:
@@ -93,17 +115,33 @@ class KvQueryServer:
         host: str = "127.0.0.1",
         port: int = 0,
         health_provider=None,
+        table_write=None,
+        max_inflight_gets: int | None = None,
     ):
         """`health_provider`: an optional zero-arg callable returning the
         flow-control dict to serve on the `health` method — typically
         `TableWrite.health` or `WriteBufferController.health_dict` of the
         ingest job colocated with this server. Without one the server
-        reports a permanently-ok placeholder (it serves reads only)."""
+        reports a permanently-ok placeholder (it serves reads only).
+
+        `table_write`: an optional live TableWrite whose buffered state
+        get_batch serves (read-your-writes: an ingest frontend colocated
+        with this server answers gets with committed-plus-buffered rows).
+
+        `max_inflight_gets`: get_batch admission depth (default from
+        lookup.get.max-inflight); the request past the cap is answered with
+        a typed busy response, not queued."""
+        from ..options import CoreOptions
         from ..table.query import LocalTableQuery
 
         self.table = table
         self.query = LocalTableQuery(table)
+        if table_write is not None:
+            self.query.attach_write(table_write)
         self.health_provider = health_provider
+        if max_inflight_gets is None:
+            max_inflight_gets = int(table.options.options.get(CoreOptions.LOOKUP_GET_MAX_INFLIGHT))
+        self._get_gate = threading.BoundedSemaphore(max(int(max_inflight_gets), 1))
         self._lock = threading.Lock()
         query = self.query
         lock = self._lock
@@ -138,6 +176,34 @@ class KvQueryServer:
                                 self.request,
                                 {"id": rid, "ok": True, "row": None if row is None else list(row.to_pylist()[0])},
                             )
+                        elif method == "get_batch":
+                            if not outer._get_gate.acquire(blocking=False):
+                                # typed BUSY: the admission depth is
+                                # saturated — shed NOW, never queue the
+                                # client into a timeout
+                                from ..metrics import get_metrics, soak_metrics
+
+                                get_metrics().counter("busy_rejected").inc()
+                                soak_metrics().counter("shed_requests").inc()
+                                _send(
+                                    self.request,
+                                    {
+                                        "id": rid,
+                                        "ok": False,
+                                        "busy": True,
+                                        "state": "busy-reads",
+                                        "retry_after_ms": 25,
+                                    },
+                                )
+                                continue
+                            try:
+                                ks = [tuple(k) if isinstance(k, list) else (k,) for k in req["keys"]]
+                                with lock:
+                                    res = query.get_batch(ks, tuple(req.get("partition", ())))
+                                rows = [None if r is None else list(r) for r in res.to_pylist()]
+                            finally:
+                                outer._get_gate.release()
+                            _send(self.request, {"id": rid, "ok": True, "rows": rows})
                         else:
                             _send(self.request, {"id": rid, "ok": False, "error": f"unknown method {method}"})
                     except Exception as e:  # noqa: BLE001 — surface to the client
@@ -185,6 +251,8 @@ class KvQueryClient:
         if resp is None:
             raise ConnectionError("server closed the connection")
         if not resp.get("ok"):
+            if resp.get("busy"):
+                raise KvBusyError(resp)
             raise RuntimeError(resp.get("error", "unknown server error"))
         return resp
 
@@ -205,6 +273,14 @@ class KvQueryClient:
             key = (key,)
         row = self._call("lookup", partition=list(partition), key=list(key)).get("row")
         return None if row is None else tuple(row)
+
+    def get_batch(self, keys, partition: tuple = ()) -> list:
+        """Batched gets: list[tuple | None] aligned with `keys`. Raises
+        KvBusyError (typed, with retry_after_ms) when the server shed the
+        request under read overload — callers back off, never time out."""
+        ks = [list(k) if isinstance(k, (tuple, list)) else [k] for k in keys]
+        rows = self._call("get_batch", partition=list(partition), keys=ks)["rows"]
+        return [None if r is None else tuple(r) for r in rows]
 
     def close(self) -> None:
         self._sock.close()

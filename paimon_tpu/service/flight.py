@@ -45,6 +45,7 @@ __all__ = [
     "flight_scan",
     "flight_put",
     "flight_health",
+    "flight_get_batch",
     "FlightBusyError",
 ]
 
@@ -147,6 +148,7 @@ class PaimonFlightServer:
             def list_actions(self, context):
                 return [
                     ("health", "writer flow-control state (admission health_dict schema); body = db.table"),
+                    ("get_batch", 'batched primary-key gets; body = {"table", "keys", "partition"?} JSON'),
                     ("ping", "liveness"),
                 ]
 
@@ -158,6 +160,9 @@ class PaimonFlightServer:
                     return [
                         flight.Result(json.dumps(outer._health(ident)).encode())
                     ]
+                if action.type == "get_batch":
+                    req = json.loads(action.body.to_pybytes().decode())
+                    return [flight.Result(json.dumps(outer._get_batch(flight, req)).encode())]
                 raise KeyError(f"unknown action {action.type!r}")
 
         self.warehouse = warehouse
@@ -165,6 +170,13 @@ class PaimonFlightServer:
         self._ingest_controller = ingest_controller
         self._controllers: dict[str, object] = {}
         self._ctl_lock = threading.Lock()
+        # batched get serving: one LocalTableQuery per table, behind the
+        # same admission idea as do_put — at most lookup.get.max-inflight
+        # concurrent get_batch actions, the next one sheds a typed BUSY
+        self._queries: dict[str, object] = {}
+        self._query_locks: dict[str, threading.Lock] = {}
+        self._get_inflight = 0
+        self._get_lock = threading.Lock()
         self._server = _Server()
         self._thread = None
         self._cat = None
@@ -220,6 +232,41 @@ class PaimonFlightServer:
         table = self._table(ident)
         ctrl = self._controller(ident, table)
         return ctrl.health_dict() if ctrl is not None else {"state": "ok"}
+
+    # ---- batched gets ---------------------------------------------------
+    def _query(self, ident: str):
+        with self._ctl_lock:
+            q = self._queries.get(ident)
+            if q is None:
+                from ..table.query import LocalTableQuery
+
+                q = self._queries[ident] = LocalTableQuery(self._table(ident))
+                self._query_locks[ident] = threading.Lock()
+            return q, self._query_locks[ident]
+
+    def _get_batch(self, flight, req: dict) -> dict:
+        from ..metrics import get_metrics
+        from ..options import CoreOptions
+
+        ident = req["table"]
+        q, lock = self._query(ident)
+        cap = int(q.table.options.options.get(CoreOptions.LOOKUP_GET_MAX_INFLIGHT))
+        with self._get_lock:
+            if self._get_inflight >= cap:
+                get_metrics().counter("busy_rejected").inc()
+                # the same typed-BUSY wire shape as the ingest side: the
+                # client backs off retry_after_ms instead of timing out
+                self._shed(flight, {"state": "busy-reads", "retry_after_ms": 25})
+            self._get_inflight += 1
+        try:
+            keys = [tuple(k) if isinstance(k, list) else (k,) for k in req["keys"]]
+            with lock:
+                q.refresh()
+                res = q.get_batch(keys, tuple(req.get("partition", ())))
+            return {"rows": [None if r is None else list(r) for r in res.to_pylist()]}
+        finally:
+            with self._get_lock:
+                self._get_inflight -= 1
 
     def _shed(self, flight, health: dict):
         """Answer BUSY: a typed, parseable unavailability — never a timeout."""
@@ -309,6 +356,48 @@ def flight_health(location: str, ident: str = "") -> dict:
     try:
         results = list(client.do_action(flight.Action("health", ident.encode())))
         return json.loads(results[0].body.to_pybytes())
+    finally:
+        client.close()
+
+
+def flight_get_batch(
+    location: str,
+    ident: str,
+    keys,
+    partition: tuple = (),
+    max_retries: int = 8,
+    max_backoff_ms: int = 2_000,
+) -> list:
+    """Shed-aware batched gets: do_action("get_batch") honoring the server's
+    typed BUSY responses — parse the payload, back off retry_after_ms
+    (capped), retry; FlightBusyError after max_retries sheds. Returns
+    list[tuple | None] aligned with `keys` (the same contract as
+    LocalTableQuery.get_batch().to_pylist())."""
+    flight = _require_flight()
+    client = flight.connect(location)
+    body = json.dumps(
+        {
+            "table": ident,
+            "partition": list(partition),
+            "keys": [list(k) if isinstance(k, (tuple, list)) else [k] for k in keys],
+        }
+    ).encode()
+    sheds = 0
+    try:
+        for attempt in range(1, max_retries + 2):
+            try:
+                results = list(client.do_action(flight.Action("get_batch", body)))
+                rows = json.loads(results[0].body.to_pybytes())["rows"]
+                return [None if r is None else tuple(r) for r in rows]
+            except Exception as exc:  # noqa: BLE001 — only BUSY is retried
+                payload = _parse_busy(exc)
+                if payload is None:
+                    raise
+                sheds += 1
+                if attempt > max_retries:
+                    raise FlightBusyError(payload) from exc
+                time.sleep(min(int(payload.get("retry_after_ms") or 25), max_backoff_ms) / 1000.0)
+        raise AssertionError("unreachable")
     finally:
         client.close()
 

@@ -93,6 +93,15 @@ class SoakConfig:
     stop_trigger: float = 0.6
     block_timeout_ms: int = 30_000
     max_pending_flushes: int = 2
+    # point-get storm (ISSUE 13): getter threads running batched gets with
+    # a scalar-lookup()-loop oracle, a read-your-writes checker committing
+    # through an attached TableWrite, and (get_server) a KvQueryServer the
+    # getters deliberately overload to prove typed-BUSY shedding
+    getters: int = 0
+    get_batch_keys: int = 512
+    get_oracle_keys: int = 16  # scalar lookups verified per round
+    ryw: bool = True  # read-your-writes checker rides along with getters
+    get_server: bool = True  # typed-BUSY overload bursts via KvQueryServer
     # resilience (False = seed-like config: first fault aborts, no CAS retry)
     resilient: bool = True
     table_options: dict = field(default_factory=dict)
@@ -233,6 +242,7 @@ class SoakHarness:
         self.errors: list[str] = []  # unexpected thread crashes
         self.inconsistencies: list[dict] = []
         self.read_latencies_ms: list[float] = []
+        self.get_latencies_us: list[float] = []  # per-key batched get latency
         self._lock = threading.Lock()
         self.counts = {
             "commits_ok": 0,
@@ -246,6 +256,14 @@ class SoakHarness:
             "reads_ok": 0,
             "reads_expired_race": 0,
             "read_errors": 0,
+            "gets_served": 0,  # probe keys answered by batched gets
+            "get_rounds": 0,
+            "get_oracle_checks": 0,
+            "get_mismatches": 0,
+            "gets_shed_typed": 0,  # KvBusyError responses under overload
+            "gets_shed_untyped": 0,  # anything else (timeouts = failures)
+            "ryw_rounds": 0,
+            "ryw_misses": 0,
         }
         self._table = None
         self._controller = None
@@ -490,6 +508,189 @@ class SoakHarness:
                 with self._lock:
                     self.counts["reads_ok"] += 1
 
+    # ---- point-get storm (ISSUE 13) ------------------------------------
+    RYW_WID = 97  # read-your-writes checker keyspace, disjoint from writers
+
+    def _getter_loop(self, gid: int, deadline: float) -> None:
+        """Batched point-gets against the live table: every round runs ONE
+        vectorized get_batch over a random slice of a random writer's
+        keyspace (present, absent and deleted keys all occur naturally),
+        then verifies a random subset against the scalar lookup() walk —
+        the independent oracle. Getter queries are private, so the levels
+        they probe are frozen between their own refresh() calls."""
+        from ..table.query import LocalTableQuery
+
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed * 104729 + gid)
+        table = self._handle(f"soak-g{gid}")
+        q = None
+        while not self.stop.is_set() and time.monotonic() < deadline:
+            try:
+                if q is None:
+                    q = LocalTableQuery(table)
+                else:
+                    q.refresh()
+            except Exception:
+                time.sleep(0.05)  # no snapshot yet / a refresh racing expiry
+                continue
+            wid = int(rng.integers(0, cfg.writers))
+            keys = [
+                int(wid * KEYSPACE + k)
+                for k in rng.integers(0, 6000, size=cfg.get_batch_keys)
+            ]
+            t0 = time.perf_counter()
+            try:
+                got = q.get_batch(keys).to_pylist()
+            except Exception as exc:
+                with self._lock:
+                    self.counts["read_errors"] += 1
+                    self.errors.append(f"getter {gid}: {exc!r}")
+                continue
+            self.get_latencies_us.append(
+                (time.perf_counter() - t0) / max(len(keys), 1) * 1e6
+            )
+            # scalar oracle on a random subset: the batched path and the
+            # LookupLevels walk read the SAME frozen per-bucket state
+            for i in rng.choice(len(keys), size=min(cfg.get_oracle_keys, len(keys)), replace=False):
+                row = q.lookup((), keys[int(i)])
+                expect = None if row is None else row.to_pylist()[0]
+                with self._lock:
+                    self.counts["get_oracle_checks"] += 1
+                if got[int(i)] != expect:
+                    with self._lock:
+                        self.counts["get_mismatches"] += 1
+                    self.inconsistencies.append(
+                        {"kind": "get-mismatch", "key": keys[int(i)],
+                         "batched": got[int(i)], "scalar": expect}
+                    )
+            with self._lock:
+                self.counts["gets_served"] += len(keys)
+                self.counts["get_rounds"] += 1
+
+    def _get_overload_loop(self, deadline: float) -> None:
+        """Deliberately overload a KvQueryServer (max_inflight_gets=1) with
+        concurrent get_batch bursts: under saturation the server must answer
+        a TYPED busy (KvBusyError with a retry hint) — a socket timeout or
+        any other failure counts as untyped and fails the soak."""
+        from ..service import KvBusyError, KvQueryClient, KvQueryServer
+
+        try:
+            server = KvQueryServer(self._table, max_inflight_gets=1)
+            host, port = server.start()
+        except Exception as exc:
+            self.errors.append(f"get-overload server failed to start: {exc!r}")
+            return
+        try:
+            clients = [KvQueryClient(host, port, timeout=30.0) for _ in range(4)]
+            keys = [list(range(64))]
+
+            def one(c):
+                try:
+                    c.get_batch(keys[0])
+                    with self._lock:
+                        self.counts["gets_served"] += len(keys[0])
+                except KvBusyError:
+                    with self._lock:
+                        self.counts["gets_shed_typed"] += 1
+                except Exception:
+                    with self._lock:
+                        self.counts["gets_shed_untyped"] += 1
+
+            while not self.stop.is_set() and time.monotonic() < deadline:
+                burst = [threading.Thread(target=one, args=(c,)) for c in clients]
+                for t in burst:
+                    t.start()
+                for t in burst:
+                    t.join(timeout=30.0)
+                time.sleep(0.1)
+            for c in clients:
+                c.close()
+        finally:
+            server.shutdown()
+
+    def _ryw_loop(self, deadline: float) -> None:
+        """Read-your-writes checker: a committer on its own keyspace whose
+        attached query must see every buffered row BEFORE the commit lands,
+        and (after refresh) the committed rows after. Landed commits are
+        recorded in the oracle exactly like writer commits, so the final
+        verification covers this keyspace too."""
+        from ..core.commit import CommitConflictError, CommitGiveUpError
+        from ..core.manifest import ManifestCommittable
+        from ..data.batch import ColumnBatch
+        from ..fs.testing import ArtificialException
+        from ..table.query import LocalTableQuery
+        from ..table.write import TableWrite
+
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed * 7919 + 9999)
+        user = "soak-ryw"
+        table = self._handle(user)
+        store = table.store
+        tw = None
+        q = None
+        ident = 0
+        next_key = 0
+        while not self.stop.is_set() and time.monotonic() < deadline:
+            ident += 1
+            keys = [self.RYW_WID * KEYSPACE + next_key + i for i in range(32)]
+            vals = [float(ident * 1000 + i) + float(rng.random()) for i in range(32)]
+            rows = dict(zip(keys, vals))
+            try:
+                if tw is None:
+                    tw = TableWrite(table, buffer_controller=self._controller)
+                    q = None
+                if q is None:
+                    q = LocalTableQuery(table).attach_write(tw)
+                else:
+                    q.refresh()
+                tw.write(ColumnBatch.from_pydict(SCHEMA, {"k": keys, "v": vals}))
+                got = q.get_batch(keys).to_pylist()
+                with self._lock:
+                    self.counts["ryw_rounds"] += 1
+                misses = [
+                    k for k, g in zip(keys, got) if g is None or g[1] != rows[k]
+                ]
+                if misses:
+                    with self._lock:
+                        self.counts["ryw_misses"] += len(misses)
+                    self.inconsistencies.append(
+                        {"kind": "ryw-miss", "ident": ident, "missing": misses[:3]}
+                    )
+                msgs = tw.prepare_commit()
+                sids = store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+                if sids:
+                    self.oracle.record(sids[0], rows)
+                    next_key += 32
+            except (CommitConflictError, CommitGiveUpError, ArtificialException):
+                sid = find_landed_append(store, user, ident)
+                if sid is not None:
+                    self.oracle.record(sid, rows)
+                    next_key += 32
+                    with self._lock:
+                        self.counts["commits_conflict_survived"] += 1
+                else:
+                    with self._lock:
+                        self.counts["commits_conflict_aborted"] += 1
+                # a failed round may leave writer state ambiguous: rebuild
+                try:
+                    tw.close()
+                except Exception:
+                    pass
+                tw = None
+            except Exception as exc:
+                with self._lock:
+                    self.errors.append(f"ryw checker: {exc!r}")
+                try:
+                    tw.close()
+                except Exception:
+                    pass
+                tw = None
+        if tw is not None:
+            try:
+                tw.close()
+            except Exception:
+                pass
+
     # ---- churn ---------------------------------------------------------
     def _compactor_loop(self, deadline: float) -> None:
         from ..core.commit import BATCH_COMMIT_IDENTIFIER, CommitConflictError, CommitGiveUpError
@@ -593,6 +794,14 @@ class SoakHarness:
             self._spawn(f"soak-reader-{r}", self._reader_loop, r, deadline)
             for r in range(cfg.readers)
         ]
+        threads += [
+            self._spawn(f"soak-getter-{g}", self._getter_loop, g, deadline)
+            for g in range(cfg.getters)
+        ]
+        if cfg.getters and cfg.ryw:
+            threads.append(self._spawn("soak-ryw", self._ryw_loop, deadline))
+        if cfg.getters and cfg.get_server:
+            threads.append(self._spawn("soak-get-overload", self._get_overload_loop, deadline))
         threads.append(self._spawn("soak-compactor", self._compactor_loop, deadline))
         threads.append(self._spawn("soak-expirer", self._expirer_loop, deadline))
         for t in threads:
@@ -622,6 +831,18 @@ class SoakHarness:
             report["read_p99_ms"] = round(p99, 2)
         else:
             report["read_p50_ms"] = report["read_p99_ms"] = None
+        report["gets_per_sec"] = (
+            round(self.counts["gets_served"] / wall_s, 1) if wall_s > 0 else None
+        )
+        if self.get_latencies_us:
+            from ..metrics import get_metrics
+
+            p99_us = float(np.percentile(self.get_latencies_us, 99))
+            get_metrics().gauge("p99_us").set(p99_us)
+            report["get_p50_us"] = round(float(np.percentile(self.get_latencies_us, 50)), 2)
+            report["get_p99_us"] = round(p99_us, 2)
+        else:
+            report["get_p50_us"] = report["get_p99_us"] = None
         return report
 
     # ---- post-soak verification ----------------------------------------
@@ -697,6 +918,7 @@ class SoakHarness:
             and lost == 0
             and dup == 0
             and wrong == 0
+            and self.counts["gets_shed_untyped"] == 0  # overload must shed TYPED
             and (total_record_count is None or total_record_count == len(self.oracle.expected_final()))
         )
         report = {
@@ -739,6 +961,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--duration", type=float, default=45.0)
     ap.add_argument("--writers", type=int, default=3)
     ap.add_argument("--readers", type=int, default=2)
+    ap.add_argument("--getters", type=int, default=0, help="batched point-get storm threads")
     ap.add_argument("--fault-possibility", type=int, default=20, help="1/N ops fail (20 = 5%%)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mesh", action="store_true")
@@ -751,6 +974,7 @@ def main(argv: list[str] | None = None) -> int:
         duration_s=args.duration,
         writers=args.writers,
         readers=args.readers,
+        getters=args.getters,
         fault_possibility=args.fault_possibility,
         seed=args.seed,
         mesh=args.mesh,

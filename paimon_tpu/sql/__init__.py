@@ -595,18 +595,24 @@ def _proc_rewrite_file_index(cat, table: str, partitions: str | None = None):
     (same data file, new extra_files/embedded_index)."""
     import dataclasses
 
-    from ..format.fileindex import build_index_payload, index_path
+    from ..format.fileindex import build_index_payload, index_path, resolve_key_bloom
     from ..options import CoreOptions
 
     t = _t(cat, table)
     opts = t.options
     cols_opt = opts.options.get(CoreOptions.FILE_INDEX_BLOOM_COLUMNS)
-    if not cols_opt:
+    # composite key bloom (ISSUE 13): tables that enabled the primary-key
+    # index AFTER writing data backfill it through the same procedure
+    key_bloom = (
+        resolve_key_bloom(opts.options.get(CoreOptions.FILE_INDEX_BLOOM_KEY_ENABLED))
+        and t.is_primary_key_table
+    )
+    if not cols_opt and not key_bloom:
         raise ProcedureError(
-            "table has no file-index.bloom-filter.columns configured; "
-            "set the option, then CALL sys.rewrite_file_index"
+            "table has no file-index.bloom-filter.columns (or primary-key "
+            "bloom) configured; set the option, then CALL sys.rewrite_file_index"
         )
-    bloom_cols = [c.strip() for c in cols_opt.split(",") if c.strip()]
+    bloom_cols = [c.strip() for c in cols_opt.split(",") if c.strip()] if cols_opt else []
     fpp = opts.options.get(CoreOptions.FILE_INDEX_BLOOM_FPP)
     threshold = opts.options.get(CoreOptions.FILE_INDEX_IN_MANIFEST_THRESHOLD)
     part_filter = _parse_partition_specs(partitions) if partitions else None
@@ -634,10 +640,16 @@ def _proc_rewrite_file_index(cat, table: str, partitions: str | None = None):
                 continue
         rf = store.reader_factory(e.partition, e.bucket)
         present = [c for c in bloom_cols if c in t.row_type]
-        if not present:
+        if not present and not key_bloom:
             continue
-        kv = rf.read(f, fields=present, system_columns=False)
-        payload = build_index_payload(kv.data, present, fpp)
+        read_fields = sorted(set(present) | (set(store.key_names) if key_bloom else set()))
+        kv = rf.read(f, fields=read_fields, system_columns=False)
+        hashes = None
+        if key_bloom:
+            from ..table.bucket import key_hashes
+
+            hashes = key_hashes(kv.data, store.key_names)
+        payload = build_index_payload(kv.data, present, fpp, key_hashes=hashes)
         if payload is None:
             continue
         extra = list(f.extra_files)

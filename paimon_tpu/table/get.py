@@ -1,0 +1,221 @@
+"""Batched device-side point gets: the serving fast path of LocalTableQuery.
+
+"Fast Updates on Read-Optimized Databases Using Multi-Core CPUs" (PAPERS.md)
+frames a store like this one as delta-plus-main: a read-optimized main
+(compacted LSM levels) merged with an in-memory delta (the writer's
+memtable) at query time. `batch_get` is that merge for primary-key point
+lookups, batched:
+
+  1. N probe keys normalize into ONE ColumnBatch; their combined uint64
+     hashes (table/bucket.py — the same splitmix64 the bucket router and the
+     bloom key indexes use) and a sorted key list are computed once.
+  2. Keys route to buckets vectorized (fixed-bucket tables hash; dynamic
+     tables probe every bucket of the partition with the full batch — the
+     probe indexes' present masks make absent keys nearly free).
+  3. Per bucket, BucketGetIndex (lookup/index.py) prunes files with zero
+     data IO (manifest key range + PTIX bloom key index), then runs one
+     vectorized JoinIndex probe per surviving file over the PR-1-cached
+     decoded batch — code-domain columns are probed on dictionary codes,
+     zero string materialization.
+  4. The read-your-writes tier: when a TableWrite is attached, each target
+     bucket's live memtable (plus its flushed-but-uncommitted level-0
+     files) joins the candidate set, so gets serve committed-plus-buffered
+     state ("the delta never outruns the reader").
+  5. Resolution: one lexsort over (probe key, sequence, tier) picks the
+     max-sequence winner per key — exactly the scalar LookupLevels merge
+     rule — and DELETE/UPDATE_BEFORE winners mask to absent. Deletion
+     vectors were already applied when the per-file indexes were built.
+
+The scalar `LocalTableQuery.lookup` walk is the independent oracle: every
+test and every timed benchmark pass asserts `batch_get` == the scalar loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, Mapping
+
+import numpy as np
+
+from ..core.kv import KVBatch
+from ..lookup.index import BucketGetIndex, FileProbeIndex, GetResult
+from ..metrics import get_metrics
+from ..types import RowKind
+
+if TYPE_CHECKING:
+    from .query import LocalTableQuery
+
+__all__ = ["batch_get", "GetResult"]
+
+# resolution tiers: higher wins a sequence tie (a raw memtable row and the
+# level-0 file its in-flight flush is writing can carry the same sequence)
+_TIER_MAIN, _TIER_DELTA_FILE, _TIER_MEMTABLE = 0, 1, 2
+
+
+def probe_batch(query: "LocalTableQuery", keys):
+    """Normalize probe input to a ColumnBatch over the trimmed-key schema:
+    a ColumnBatch carrying the key columns, a {column: sequence} mapping,
+    or a sequence of key tuples/scalars."""
+    from ..data.batch import ColumnBatch
+
+    key_names = query.store.key_names
+    schema = query.store.value_schema.project(key_names)
+    if hasattr(keys, "schema") and hasattr(keys, "columns"):
+        return keys
+    if isinstance(keys, Mapping):
+        return ColumnBatch.from_pydict(schema, {k: keys[k] for k in key_names})
+    rows = [tuple(k) if isinstance(k, (tuple, list)) else (k,) for k in keys]
+    return ColumnBatch.from_pylist(schema, rows)
+
+
+def _bucket_groups(query: "LocalTableQuery", probe, partition: tuple):
+    """[(bucket, probe_row_indices | None)] — None means the whole batch
+    (dynamic-bucket tables probe every bucket of the partition)."""
+    if query.store.options.bucket > 0:
+        from .bucket import bucket_ids
+
+        ids = bucket_ids(probe, query.table.schema.bucket_keys, query.store.options.bucket)
+        return [(int(b), np.flatnonzero(ids == b)) for b in np.unique(ids)]
+    buckets = sorted({pb[1] for pb in query._get_indexes if pb[0] == partition})
+    return [(b, None) for b in buckets]
+
+
+class _Candidates:
+    """Accumulates (probe_idx, seq, kind, source row) matches across files,
+    buckets and tiers, then resolves max-sequence winners per probe key."""
+
+    def __init__(self):
+        self.sources: list[KVBatch] = []
+        self.probe_idx: list[np.ndarray] = []
+        self.seqs: list[np.ndarray] = []
+        self.kinds: list[np.ndarray] = []
+        self.src_ids: list[np.ndarray] = []
+        self.rows: list[np.ndarray] = []
+        self.tiers: list[np.ndarray] = []
+
+    def add(self, kv: KVBatch, probe_idx: np.ndarray, rows: np.ndarray, tier: int) -> None:
+        if len(probe_idx) == 0:
+            return
+        sid = len(self.sources)
+        self.sources.append(kv)
+        self.probe_idx.append(probe_idx)
+        self.seqs.append(kv.seq[rows])
+        self.kinds.append(kv.kind[rows])
+        self.src_ids.append(np.full(len(rows), sid, dtype=np.int64))
+        self.rows.append(rows)
+        self.tiers.append(np.full(len(rows), tier, dtype=np.int8))
+
+    def resolve(self, n: int, value_schema) -> GetResult:
+        from ..data.batch import ColumnBatch, concat_batches
+
+        g = get_metrics()
+        if not self.sources:
+            return GetResult(
+                n, np.zeros(n, dtype=np.bool_), ColumnBatch.empty(value_schema),
+                np.empty(0, dtype=np.int64),
+            )
+        pi = np.concatenate(self.probe_idx)
+        seq = np.concatenate(self.seqs)
+        kind = np.concatenate(self.kinds)
+        src = np.concatenate(self.src_ids)
+        row = np.concatenate(self.rows)
+        tier = np.concatenate(self.tiers)
+        # one lexsort resolves the whole batch: per probe key ascending by
+        # (seq, tier) — the LAST entry of each group is the winning version
+        order = np.lexsort((tier, seq, pi))
+        ps = pi[order]
+        last = np.ones(len(ps), dtype=np.bool_)
+        last[:-1] = ps[1:] != ps[:-1]
+        win = order[last]
+        win_pi = pi[win]
+        live = ~np.isin(kind[win], (int(RowKind.DELETE), int(RowKind.UPDATE_BEFORE)))
+        g.counter("memtable_hits").inc(int((tier[win] > _TIER_MAIN)[live].sum()))
+        win = win[live]
+        win_pi = win_pi[live]
+        found = np.zeros(n, dtype=np.bool_)
+        found[win_pi] = True
+        # `win` is already in ascending probe order (the lexsort's primary
+        # key): gather winners source-by-source, then permute back
+        w_src, w_row = src[win], row[win]
+        by_src = np.argsort(w_src, kind="stable")
+        parts = []
+        for s in np.unique(w_src):
+            sel = by_src[w_src[by_src] == s]
+            parts.append(self.sources[s].data.take(w_row[sel]))
+        combined = concat_batches(parts) if parts else ColumnBatch.empty(value_schema)
+        if parts:
+            inv = np.empty(len(by_src), dtype=np.int64)
+            inv[by_src] = np.arange(len(by_src))
+            combined = combined.take(inv)
+        return GetResult(n, found, combined, win_pi.astype(np.int64))
+
+
+def _delta_sources(query: "LocalTableQuery", partition: tuple, bucket: int):
+    """[(KVBatch | BucketGetIndex tier pieces)] for one bucket's live delta:
+    the attached TableWrite's buffered memtable batches (+ any in-flight
+    flush) and its flushed-but-uncommitted level-0 files."""
+    tw = query._write
+    if tw is None:
+        return None, ()
+    snap = tw.delta_snapshot().get((partition, bucket))
+    if snap is None:
+        return None, ()
+    batches, new_files = snap
+    mem = None
+    if batches:
+        kv = KVBatch.concat(batches) if len(batches) > 1 else batches[0]
+        if kv.num_rows:
+            mem = FileProbeIndex(kv, query.store.key_names)
+    files = ()
+    if new_files:
+        names = tuple(f.file_name for f in new_files)
+        cached = query._delta_indexes.get((partition, bucket))
+        if cached is None or cached[0] != names:
+            idx = BucketGetIndex(
+                new_files,
+                query.store.reader_factory(partition, bucket),
+                query.store.key_names,
+                bloom_prune=query._bloom_prune,
+            )
+            query._delta_indexes[(partition, bucket)] = cached = (names, idx)
+        files = (cached[1],)
+    return mem, files
+
+
+def batch_get(query: "LocalTableQuery", keys, partition: tuple = ()) -> GetResult:
+    """Batched primary-key get against `query`'s current view (plus the
+    attached writer's delta). Returns a GetResult aligned with `keys`."""
+    from .bucket import key_hashes
+
+    g = get_metrics()
+    t0 = time.perf_counter()
+    probe = probe_batch(query, keys)
+    n = probe.num_rows
+    cand = _Candidates()
+    if n:
+        hashes = key_hashes(probe, query.store.key_names)
+        sorted_keys = sorted(probe.to_pylist())
+        for bucket, rows in _bucket_groups(query, probe, partition):
+            if rows is None or len(rows) == n:
+                sub, sub_hashes, sub_keys, back = probe, hashes, sorted_keys, None
+            else:
+                sub = probe.take(rows)
+                sub_hashes = hashes[rows]
+                sub_keys = sorted(sub.to_pylist())
+                back = rows
+            idx = query._get_indexes.get((partition, bucket))
+            if idx is not None:
+                for fi, pi, rr in idx.probe(sub, sub_hashes, sub_keys):
+                    cand.add(fi.kv, pi if back is None else back[pi], rr, _TIER_MAIN)
+            mem, delta_files = _delta_sources(query, partition, bucket)
+            for didx in delta_files:
+                for fi, pi, rr in didx.probe(sub, sub_hashes, sub_keys):
+                    cand.add(fi.kv, pi if back is None else back[pi], rr, _TIER_DELTA_FILE)
+            if mem is not None:
+                g.counter("keys_probed").inc(sub.num_rows)
+                pi, rr = mem.probe(sub)
+                cand.add(mem.kv, pi if back is None else back[pi], rr, _TIER_MEMTABLE)
+    res = cand.resolve(n, query.store.value_schema)
+    g.counter("gets").inc(n)
+    g.histogram("probe_ms").update((time.perf_counter() - t0) * 1000)
+    return res

@@ -4,6 +4,20 @@ Parity: /root/reference/paimon-core/.../table/query/LocalTableQuery.java:55 —
 the engine-side primitive behind lookup joins and the KV query service:
 per-bucket LookupLevels over the latest snapshot's files, refreshed on
 demand.
+
+Two probe paths share the per-bucket state:
+  * `lookup(partition, key)` — the scalar walk (LookupLevels): level-0
+    newest-first, then each level's run by key range. Kept as the
+    independent oracle the batched path is verified against.
+  * `get_batch(keys)` — the serving fast path (table/get.py): N keys encode
+    once, files prune via manifest key ranges + PTIX bloom key indexes with
+    zero data IO, one vectorized probe per surviving file, winners resolved
+    by sequence. `attach_write` adds the read-your-writes delta tier.
+
+`refresh()` diffs the plan per bucket: a snapshot advance only rebuilds the
+buckets whose (file set, deletion vectors) actually changed, so streaming
+ingest into bucket 3 never evicts bucket 5's built lookup files or probe
+indexes (cache-friendly under sustained commit churn).
 """
 
 from __future__ import annotations
@@ -11,9 +25,11 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, Sequence
 
 from ..lookup import LookupFileCache, LookupLevels
+from ..lookup.index import BucketGetIndex, GetResult
 
 if TYPE_CHECKING:
     from . import FileStoreTable
+    from .write import TableWrite
 
 __all__ = ["LocalTableQuery"]
 
@@ -40,30 +56,50 @@ class LocalTableQuery:
         self._hash_load_factor = opts.get(CoreOptions.LOOKUP_HASH_LOAD_FACTOR)
         self._max_disk_bytes = int(opts.get(CoreOptions.LOOKUP_CACHE_MAX_DISK_SIZE))
         self._file_retention_ms = opts.get(CoreOptions.LOOKUP_CACHE_FILE_RETENTION)
+        self._bloom_prune = bool(opts.get(CoreOptions.LOOKUP_GET_BLOOM_PRUNE))
         self.local_store_dir = local_store_dir
         self._levels: dict[tuple, LookupLevels] = {}
+        self._get_indexes: dict[tuple, BucketGetIndex] = {}
+        self._bucket_sigs: dict[tuple, tuple] = {}
+        self._delta_indexes: dict[tuple, tuple] = {}  # (pb) -> (file names, BucketGetIndex)
+        self._write: "TableWrite | None" = None
         self._snapshot_id: int | None = None
         self.refresh()
 
+    def attach_write(self, table_write: "TableWrite | None") -> "LocalTableQuery":
+        """Read-your-writes: gets additionally consult `table_write`'s live
+        memtables and its flushed-but-uncommitted level-0 files, so a query
+        colocated with an ingest job serves committed-plus-buffered state."""
+        self._write = table_write
+        self._delta_indexes.clear()
+        return self
+
     def refresh(self) -> None:
         """Re-plan against the latest snapshot (reference: file-change
-        monitoring feeds refresh in the query service)."""
+        monitoring feeds refresh in the query service). Per-bucket diff:
+        buckets whose file set + DV index are unchanged keep their built
+        LookupLevels and BucketGetIndex."""
         plan = self.store.new_scan().plan()
         sid = plan.snapshot.id if plan.snapshot else None
         if sid == self._snapshot_id:
             return
         self._snapshot_id = sid
-        self._levels.clear()
         from ..core.deletionvectors import DeletionVectorsIndexFile
 
         dv_io = DeletionVectorsIndexFile(self.table.file_io, self.table.path)
+        seen: set[tuple] = set()
         for partition, buckets in plan.grouped().items():
             for bucket, files in buckets.items():
+                pb = (partition, bucket)
+                seen.add(pb)
                 dv_index = plan.dv_index_for(partition, bucket)
+                sig = (tuple(sorted((f.file_name, f.level) for f in files)), dv_index)
+                if self._bucket_sigs.get(pb) == sig:
+                    continue  # unchanged bucket: keep the warm state
                 dvs = dv_io.read_all(dv_index) if dv_index else {}
                 for name in dvs:
                     self.cache.invalidate(name)  # DV changed: cached rows stale
-                self._levels[(partition, bucket)] = LookupLevels(
+                self._levels[pb] = LookupLevels(
                     files,
                     self.store.reader_factory(partition, bucket),
                     self.store.key_names,
@@ -76,7 +112,32 @@ class LocalTableQuery:
                     max_disk_bytes=self._max_disk_bytes,
                     file_retention_millis=self._file_retention_ms,
                 )
+                self._get_indexes[pb] = BucketGetIndex(
+                    files,
+                    self.store.reader_factory(partition, bucket),
+                    self.store.key_names,
+                    deletion_vectors=dvs,
+                    bloom_prune=self._bloom_prune,
+                )
+                self._bucket_sigs[pb] = sig
+        for pb in list(self._levels):
+            if pb not in seen:
+                del self._levels[pb]
+                self._get_indexes.pop(pb, None)
+                self._bucket_sigs.pop(pb, None)
 
+    # ---- batched path ---------------------------------------------------
+    def get_batch(self, keys, partition: tuple = ()) -> GetResult:
+        """Vectorized primary-key gets: `keys` is a sequence of key tuples
+        (or scalars for single-column keys), a {column: values} mapping, or
+        a ColumnBatch carrying the key columns. Returns a GetResult aligned
+        with the probe keys; `to_pylist()` matches a scalar lookup() loop
+        entry for entry."""
+        from .get import batch_get
+
+        return batch_get(self, keys, partition)
+
+    # ---- scalar path (the oracle) ---------------------------------------
     def lookup(self, partition: tuple, key: "tuple | object"):
         """Latest value row for `key` (a tuple over the trimmed primary key,
         or a scalar for single-column keys); None if absent/deleted."""

@@ -211,6 +211,18 @@ class TableWrite:
             )
         return self._writers[key]
 
+    def delta_snapshot(self) -> dict[tuple, tuple]:
+        """{(partition, bucket): (buffered KVBatches, uncommitted level-0
+        DataFileMetas)} across every merge-tree writer this write opened —
+        the read-your-writes delta tier LocalTableQuery.attach_write serves
+        (committed-plus-buffered gets)."""
+        out: dict[tuple, tuple] = {}
+        for pb, w in list(self._writers.items()):
+            ds = getattr(w, "delta_snapshot", None)
+            if ds is not None:
+                out[pb] = ds()
+        return out
+
     def compact(self, full: bool = False) -> None:
         """Compact every bucket this write touched — or, when no rows were
         written (dedicated compact job), every live bucket of the table.

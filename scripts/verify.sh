@@ -72,6 +72,16 @@
 #                              both prove bit-identical join output; the
 #                              second pass also forces the dict-domain
 #                              reader on.
+#   scripts/verify.sh get      batched point-get parity stage: the
+#                              tests/test_point_get.py suite (randomized
+#                              get_batch == scalar lookup() == fold parity
+#                              across schemas x engines, bloom key-index
+#                              pruning, read-your-writes tiers, typed-BUSY
+#                              serving, the compaction-chain cancel
+#                              regression) run TWICE — PAIMON_TPU_KEY_BLOOM
+#                              forced 1, then 0 — so gets prove identical
+#                              with and without bloom key indexes on every
+#                              written file.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -187,6 +197,18 @@ if [ "${1:-}" = "join" ]; then
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_LANE_COMPRESSION=0 \
     timeout -k 10 600 python -m pytest tests/test_join.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "get" ]; then
+  # parity suite with bloom key indexes forced onto every written file,
+  # then forced off: batched gets must serve identical rows either way
+  # (pruning is an optimization, never a semantic)
+  for kb in 1 0; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_KEY_BLOOM=$kb \
+      timeout -k 10 600 python -m pytest tests/test_point_get.py tests/test_lookup.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
 fi
 
 if [ "${1:-}" = "encode" ]; then

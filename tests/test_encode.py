@@ -640,3 +640,85 @@ def test_env_override_forces_native(tmp_path, rng, monkeypatch):
     batch = _random_batch(rng, 100)
     ParquetFormat().write(IO, str(tmp_path / "env.parquet"), batch)  # no option set
     assert g.counter("files_native").count == n0 + 1
+
+
+# ---------------------------------------------------------------------------
+# numeric dictionary route (ISSUE 13, declared PR 12 follow-up)
+# ---------------------------------------------------------------------------
+
+
+def test_numeric_dictionary_route_roundtrip(tmp_path):
+    """Low-cardinality int32/int64/date columns dictionary-encode natively:
+    dict page + RLE_DICTIONARY codes, read back bit-identically by the
+    native decoder, pyarrow AND the code-domain reader (so native-written
+    files join fixed-width code-domain lookups/joins)."""
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(3)
+    n = 2000
+    schema = pt.RowType.of(
+        ("k", pt.BIGINT()), ("c32", pt.INT()), ("c64", pt.BIGINT()), ("d", pt.DATE())
+    )
+    batch = ColumnBatch.from_pydict(
+        schema,
+        {
+            "k": np.arange(n, dtype=np.int64),  # monotone: stays DELTA
+            "c32": rng.integers(-50, 50, n).astype(np.int32),
+            "c64": (rng.integers(0, 9, n) * 10_000).astype(np.int64),
+            "d": rng.integers(18000, 18020, n).astype(np.int32),
+        },
+    )
+    g = encode_metrics()
+    d0 = g.counter("dict_pages").count
+    path = str(tmp_path / "nd.parquet")
+    write_native(IO, path, batch, "zstd", {})
+    assert g.counter("dict_pages").count >= d0 + 3  # c32, c64, d
+    # native decode parity
+    got = concat_batches(read_native(IO, path, schema))
+    for c in schema.field_names:
+        assert np.array_equal(got.column(c).values, batch.column(c).values), c
+    # pyarrow readback parity
+    at = pq.read_table(path)
+    for c in ("c32", "c64", "d"):
+        assert at.column(c).to_pylist() == batch.column(c).values.tolist()
+    # code-domain read: the fixed-width dict chunks come back code-backed
+    coded = concat_batches(read_native(IO, path, schema, dict_domain=True))
+    assert coded.column("c32").is_code_backed
+    assert np.array_equal(coded.column("c32").values, batch.column("c32").values)
+    pool, codes = coded.column("c64").dict_cache
+    assert pool.dtype == np.int64 and np.array_equal(pool[codes], batch.column("c64").values)
+
+
+def test_numeric_dictionary_route_skips_high_cardinality(tmp_path):
+    """Unique-ish int columns must stay PLAIN/DELTA — a dictionary the size
+    of the data would only add a page."""
+    rng = np.random.default_rng(4)
+    n = 1000
+    schema = pt.RowType.of(("k", pt.BIGINT()), ("u", pt.BIGINT()))
+    batch = ColumnBatch.from_pydict(
+        schema,
+        {"k": np.arange(n, dtype=np.int64), "u": rng.permutation(n).astype(np.int64) * 7 + 1},
+    )
+    g = encode_metrics()
+    d0 = g.counter("dict_pages").count
+    path = str(tmp_path / "hc.parquet")
+    write_native(IO, path, batch, "none", {})
+    assert g.counter("dict_pages").count == d0
+    got = concat_batches(read_native(IO, path, schema))
+    assert np.array_equal(got.column("u").values, batch.column("u").values)
+
+
+def test_numeric_dictionary_route_with_nulls(tmp_path):
+    rng = np.random.default_rng(5)
+    n = 1500
+    schema = pt.RowType.of(("k", pt.BIGINT()), ("c", pt.INT()))
+    vals = rng.integers(0, 12, n).astype(np.int32)
+    validity = rng.random(n) > 0.3
+    col = Column(vals.copy(), validity.copy())
+    batch = ColumnBatch(schema, {"k": Column(np.arange(n, dtype=np.int64)), "c": col})
+    path = str(tmp_path / "nn.parquet")
+    write_native(IO, path, batch, "zstd", {})
+    got = concat_batches(read_native(IO, path, schema))
+    gc = got.column("c")
+    assert np.array_equal(gc.valid_mask(), validity)
+    assert np.array_equal(gc.values[validity], vals[validity])
