@@ -404,6 +404,22 @@ def bench_point_get() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_subscribe() -> list:
+    """CDC subscription fan-out spot-check (benchmarks/subscribe_bench.py is
+    the dedicated 1/8/32/128-subscriber sweep): 32 subscribers on one
+    decode-once hub vs 32 independent StreamTableScan loops (shared decode
+    cache off — the N-separate-processes model), every subscriber asserting
+    it received every snapshot, plus the decode{pages_decoded} flatness
+    counters and per-subscriber p99 delivery lag."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "subscribe_bench.py")
+    spec = importlib.util.spec_from_file_location("_subscribe_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=1)
+
+
 def bench_adaptive() -> dict:
     """Adaptive-vs-inline compaction spot-check (benchmarks/
     adaptive_compact_bench.py is the dedicated 60 s skewed soak with the
@@ -519,6 +535,7 @@ def main():
         dict_rows = bench_dicts(table)
         join_rows = bench_join()
         point_get_rows = bench_point_get()
+        subscribe_rows = bench_subscribe()
         pallas_rows = bench_pallas(table)
         adaptive_row = bench_adaptive()
         pipeline_rows = bench_pipeline()
@@ -566,6 +583,8 @@ def main():
             print(json.dumps(dict(jrow, platform=_PLATFORM)))
         for grow in point_get_rows:
             print(json.dumps(dict(grow, platform=_PLATFORM)))
+        for srow in subscribe_rows:
+            print(json.dumps(dict(srow, platform=_PLATFORM)))
         for prow in pallas_rows:
             print(json.dumps(dict(prow, platform=_PLATFORM)))
         print(json.dumps(dict(adaptive_row, platform=_PLATFORM)))

@@ -34,6 +34,7 @@ __all__ = [
     "pallas_metrics",
     "pipeline_metrics",
     "soak_metrics",
+    "sub_metrics",
 ]
 
 
@@ -325,6 +326,25 @@ def io_metrics() -> MetricGroup:
     sleeps). Resolved per call so registry.reset() in tests swaps the group
     out."""
     return registry.group("io")
+
+
+def sub_metrics() -> MetricGroup:
+    """The sub{...} group (streaming CDC subscription service,
+    paimon_tpu.service.subscription). Canonical members — gauges:
+    subscribers (live subscribers across hubs), lag_snapshots (max over
+    subscribers of frontier minus its next-expected snapshot — how far the
+    slowest live reader trails the chain), queue_high_water (max batches
+    observed in any subscriber queue, bounded by subscription.queue-depth);
+    counters: batches_fanned (ChangelogBatch deliveries: one per subscriber
+    per snapshot, live fan-out and catch-up replay both count),
+    rows_fanned (rows delivered, rows x subscribers), decode_reuse_hits
+    (deliveries that reused an already-decoded batch: live fan-out beyond
+    the first subscriber plus catch-up reads served from the data-file
+    cache — the decode-once proof, vs decode{pages_decoded} which stays
+    flat in subscriber count), shed_subscribers (slow consumers shed with
+    the typed SubscriberShedError carrying their durable restart offset).
+    Resolved per call so registry.reset() in tests swaps the group out."""
+    return registry.group("sub")
 
 
 class timed:

@@ -46,6 +46,8 @@ __all__ = [
     "flight_put",
     "flight_health",
     "flight_get_batch",
+    "flight_subscribe_poll",
+    "flight_subscribe",
     "FlightBusyError",
 ]
 
@@ -136,6 +138,10 @@ class PaimonFlightServer:
                 from ..table.read import DataSplit
 
                 req = json.loads(ticket.ticket.decode())
+                if "subscribe" in req:
+                    # long-poll subscription window as one Arrow stream:
+                    # row columns + __row_kind + __snapshot_id
+                    return flight.RecordBatchStream(outer._subscribe_arrow(flight, req["subscribe"]))
                 t = outer._table(req["table"])
                 splits = [DataSplit.from_dict(d) for d in req["splits"]]
                 reader = record_batch_reader(t, projection=req.get("projection"), splits=splits)
@@ -149,6 +155,11 @@ class PaimonFlightServer:
                 return [
                     ("health", "writer flow-control state (admission health_dict schema); body = db.table"),
                     ("get_batch", 'batched primary-key gets; body = {"table", "keys", "partition"?} JSON'),
+                    (
+                        "subscribe_poll",
+                        'long-poll changelog subscription; body = {"table", "consumer", '
+                        '"nextSnapshot"?, "format"?, "maxBatches"?, "timeoutMs"?} JSON',
+                    ),
                     ("ping", "liveness"),
                 ]
 
@@ -163,6 +174,9 @@ class PaimonFlightServer:
                 if action.type == "get_batch":
                     req = json.loads(action.body.to_pybytes().decode())
                     return [flight.Result(json.dumps(outer._get_batch(flight, req)).encode())]
+                if action.type == "subscribe_poll":
+                    req = json.loads(action.body.to_pybytes().decode())
+                    return [flight.Result(json.dumps(outer._subscribe_poll(flight, req)).encode())]
                 raise KeyError(f"unknown action {action.type!r}")
 
         self.warehouse = warehouse
@@ -177,6 +191,12 @@ class PaimonFlightServer:
         self._query_locks: dict[str, threading.Lock] = {}
         self._get_inflight = 0
         self._get_lock = threading.Lock()
+        # changelog subscriptions: one private SubscriptionHub per table
+        # (single decode-once tailer shared by every remote consumer of that
+        # table through this server) + one live Subscription per consumer-id
+        self._hubs: dict[str, object] = {}
+        self._flight_subs: dict[tuple[str, str], object] = {}
+        self._sub_lock = threading.Lock()
         self._server = _Server()
         self._thread = None
         self._cat = None
@@ -268,18 +288,128 @@ class PaimonFlightServer:
             with self._get_lock:
                 self._get_inflight -= 1
 
+    # ---- changelog subscriptions ----------------------------------------
+    def _subscription(self, ident: str, consumer: str, next_snapshot: int | None):
+        """The live server-side Subscription for (table, consumer): reused
+        across long-polls so the hub queue keeps filling between requests.
+        A client presenting a different nextSnapshot than the subscription's
+        checkpoint re-anchors it (close + resubscribe; the durable consumer
+        position still wins when it is older — at-least-once replay)."""
+        from .subscription import SubscriptionHub
+
+        key = (ident, consumer)
+        with self._sub_lock:
+            hub = self._hubs.get(ident)
+            if hub is None:
+                hub = self._hubs[ident] = SubscriptionHub(self._table(ident))
+            sub = self._flight_subs.get(key)
+            # a subscription shed between polls is NOT silently resumed: the
+            # next poll hits its SubscriberShedError and answers the typed
+            # BUSY (with the restart offset) once; the poll after that finds
+            # the registry empty and resumes from the durable position
+            if sub is not None and next_snapshot is not None and sub.checkpoint != next_snapshot and not sub.is_shed:
+                sub.close()
+                self._flight_subs.pop(key, None)
+                sub = None
+            if sub is None:
+                sub = hub.subscribe(consumer_id=consumer, from_snapshot=next_snapshot)
+                self._flight_subs[key] = sub
+            return sub
+
+    def _poll_window(self, flight, req: dict) -> tuple[list, int]:
+        """Drain one long-poll window: up to maxBatches, blocking up to
+        timeoutMs for the first. A shed subscription answers the typed BUSY
+        carrying the durable restart offset (the next poll resubscribes and
+        resumes from it)."""
+        from .subscription import SubscriberShedError
+
+        ident = req["table"]
+        consumer = req["consumer"]
+        nxt = req.get("nextSnapshot")
+        timeout_s = int(req.get("timeoutMs", 1_000)) / 1000.0
+        max_batches = int(req.get("maxBatches", 64))
+        sub = self._subscription(ident, consumer, nxt)
+        batches = []
+        deadline = time.monotonic() + timeout_s
+        try:
+            while len(batches) < max_batches:
+                remaining = deadline - time.monotonic()
+                b = sub.poll(timeout=max(remaining, 0.0) if not batches else 0.0)
+                if b is None:
+                    break
+                batches.append(b)
+        except SubscriberShedError as exc:
+            with self._sub_lock:
+                if self._flight_subs.get((ident, consumer)) is sub:
+                    del self._flight_subs[(ident, consumer)]
+            payload = dict(exc.payload)
+            payload.setdefault("retry_after_ms", 25)
+            self._shed(flight, payload)
+        return batches, sub.checkpoint
+
+    def _subscribe_poll(self, flight, req: dict) -> dict:
+        """JSON long-poll: rows (kind short strings + row values) or any
+        table/cdc_format.py wire format."""
+        fmt = req.get("format", "rows")
+        batches, checkpoint = self._poll_window(flight, req)
+        out = []
+        for b in batches:
+            if fmt == "rows":
+                out.append(
+                    {
+                        "snapshot": b.snapshot_id,
+                        "rows": [list(r) for r in b.data.to_pylist()],
+                        "kinds": b.kinds.tolist(),
+                    }
+                )
+            else:
+                from ..table.cdc_format import encode_changelog
+
+                out.append(
+                    {"snapshot": b.snapshot_id, "messages": encode_changelog(b.data, b.kinds, fmt)}
+                )
+        return {"batches": out, "nextSnapshot": checkpoint}
+
+    def _subscribe_arrow(self, flight, req: dict):
+        """One long-poll window as a pyarrow Table: the table's row columns
+        plus __row_kind (uint8) and __snapshot_id (int64)."""
+        import pyarrow as pa
+
+        from ..interop.arrow_surface import arrow_schema
+
+        t = self._table(req["table"])
+        batches, checkpoint = self._poll_window(flight, req)
+        base = arrow_schema(t.row_type)
+        schema = base.append(pa.field("__row_kind", pa.uint8())).append(
+            pa.field("__snapshot_id", pa.int64())
+        )
+        # the checkpoint rides the schema metadata so a client that received
+        # only empty/partial windows still learns where to resume
+        schema = schema.with_metadata({b"next_snapshot": str(checkpoint).encode()})
+        if not batches:
+            return pa.Table.from_arrays(
+                [pa.array([], type=f.type) for f in schema], schema=schema
+            )
+        parts = []
+        for b in batches:
+            arrow = b.data.to_arrow()
+            arrow = arrow.append_column("__row_kind", pa.array(b.kinds, type=pa.uint8()))
+            arrow = arrow.append_column(
+                "__snapshot_id", pa.array([b.snapshot_id] * b.num_rows, type=pa.int64())
+            )
+            parts.append(arrow.cast(pa.schema(list(schema))))
+        out = pa.concat_tables(parts)
+        return out.replace_schema_metadata({b"next_snapshot": str(checkpoint).encode()})
+
     def _shed(self, flight, health: dict):
         """Answer BUSY: a typed, parseable unavailability — never a timeout."""
         from ..metrics import soak_metrics
 
         soak_metrics().counter("shed_requests").inc()
-        payload = {
-            "busy": True,
-            "state": health.get("state"),
-            "buffered_bytes": health.get("buffered_bytes"),
-            "pending_flushes": health.get("pending_flushes"),
-            "retry_after_ms": health.get("retry_after_ms", 0),
-        }
+        payload = dict(health)  # typed extras (e.g. a shed subscription's
+        payload["busy"] = True  # consumer_id + restart next_snapshot) ride
+        payload.setdefault("retry_after_ms", 0)  # along with the core shape
+        payload.setdefault("state", None)
         raise flight.FlightUnavailableError("BUSY" + json.dumps(payload))
 
     def _do_put(self, flight, descriptor, reader) -> None:
@@ -326,6 +456,18 @@ class PaimonFlightServer:
         return self.location
 
     def shutdown(self) -> None:
+        with self._sub_lock:
+            subs = list(self._flight_subs.values())
+            hubs = list(self._hubs.values())
+            self._flight_subs.clear()
+            self._hubs.clear()
+        for sub in subs:
+            try:
+                sub.close()
+            except Exception:
+                pass
+        for hub in hubs:
+            hub.close()
         self._server.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
@@ -398,6 +540,85 @@ def flight_get_batch(
                     raise FlightBusyError(payload) from exc
                 time.sleep(min(int(payload.get("retry_after_ms") or 25), max_backoff_ms) / 1000.0)
         raise AssertionError("unreachable")
+    finally:
+        client.close()
+
+
+def flight_subscribe_poll(
+    location: str,
+    ident: str,
+    consumer: str,
+    next_snapshot: int | None = None,
+    fmt: str = "rows",
+    max_batches: int = 64,
+    timeout_ms: int = 1_000,
+) -> tuple[list[dict], int]:
+    """One long-poll window of the changelog subscription: returns
+    (batches, nextSnapshot). Each batch dict carries "snapshot" plus either
+    "rows"+"kinds" (fmt="rows") or cdc wire "messages" (fmt one of the
+    table/cdc_format.py formats). Pass the returned nextSnapshot into the
+    next call; a typed BUSY (this consumer was shed as too slow) raises
+    FlightBusyError whose payload carries the durable restart offset —
+    polling again resumes from it."""
+    flight = _require_flight()
+    client = flight.connect(location)
+    body = {
+        "table": ident,
+        "consumer": consumer,
+        "format": fmt,
+        "maxBatches": max_batches,
+        "timeoutMs": timeout_ms,
+    }
+    if next_snapshot is not None:
+        body["nextSnapshot"] = next_snapshot
+    try:
+        results = list(client.do_action(flight.Action("subscribe_poll", json.dumps(body).encode())))
+        out = json.loads(results[0].body.to_pybytes())
+        return out["batches"], out["nextSnapshot"]
+    except Exception as exc:  # noqa: BLE001 — only BUSY is typed
+        payload = _parse_busy(exc)
+        if payload is None:
+            raise
+        raise FlightBusyError(payload) from exc
+    finally:
+        client.close()
+
+
+def flight_subscribe(
+    location: str,
+    ident: str,
+    consumer: str,
+    next_snapshot: int | None = None,
+    max_batches: int = 64,
+    timeout_ms: int = 1_000,
+):
+    """Arrow long-poll subscription window via do_get: returns
+    (pyarrow.Table, nextSnapshot). The table carries the row columns plus
+    __row_kind (uint8) and __snapshot_id (int64); nextSnapshot comes from
+    the stream's schema metadata so empty windows still advance the
+    client's resume token."""
+    flight = _require_flight()
+    client = flight.connect(location)
+    body = {
+        "subscribe": {
+            "table": ident,
+            "consumer": consumer,
+            "maxBatches": max_batches,
+            "timeoutMs": timeout_ms,
+        }
+    }
+    if next_snapshot is not None:
+        body["subscribe"]["nextSnapshot"] = next_snapshot
+    try:
+        table = client.do_get(flight.Ticket(json.dumps(body).encode())).read_all()
+        meta = table.schema.metadata or {}
+        nxt = int(meta.get(b"next_snapshot", b"0"))
+        return table, nxt
+    except Exception as exc:  # noqa: BLE001 — only BUSY is typed
+        payload = _parse_busy(exc)
+        if payload is None:
+            raise
+        raise FlightBusyError(payload) from exc
     finally:
         client.close()
 

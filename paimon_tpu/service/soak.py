@@ -102,6 +102,21 @@ class SoakConfig:
     get_oracle_keys: int = 16  # scalar lookups verified per round
     ryw: bool = True  # read-your-writes checker rides along with getters
     get_server: bool = True  # typed-BUSY overload bursts via KvQueryServer
+    # CDC subscription storm (ISSUE 14): subscriber threads on one shared
+    # decode-once hub, each folding its received changelog stream and
+    # asserting fold == pinned-snapshot scan at its checkpoint; subscriber 0
+    # is deliberately SLOW (must be shed with the typed protocol and resume
+    # from its consumer-id), and an optional subscriber OS process rides
+    # along, journaling batches, to be kill -9'd and respawned
+    subscribers: int = 0
+    slow_subscriber: bool = True
+    # per-batch stall of the slow subscriber: decisively past the soak's
+    # 1.5 s subscription.shed-timeout, so the shed fires whenever its queue
+    # is full — independent of the host's commit rate
+    sub_slow_sleep_s: float = 2.5
+    sub_verify_every: int = 8  # fold==scan check cadence (batches)
+    subscriber_procs: int = 0
+    kill_subscriber: bool = True  # SIGKILL the subscriber process once
     # resilience (False = seed-like config: first fault aborts, no CAS retry)
     resilient: bool = True
     table_options: dict = field(default_factory=dict)
@@ -264,9 +279,18 @@ class SoakHarness:
             "gets_shed_untyped": 0,  # anything else (timeouts = failures)
             "ryw_rounds": 0,
             "ryw_misses": 0,
+            "sub_batches": 0,  # ChangelogBatches received across subscribers
+            "sub_rows": 0,
+            "sub_verifies": 0,  # fold == pinned-scan checks performed
+            "sub_mismatches": 0,
+            "sub_shed_typed": 0,  # SubscriberShedError (slow consumer shed)
+            "sub_shed_untyped": 0,  # anything else severing a subscriber
+            "sub_resumes": 0,  # consumer-id resumes after a typed shed
+            "subproc_kills": 0,  # SIGKILLs of the subscriber OS process
         }
         self._table = None
         self._controller = None
+        self._sub_hub = None
 
     # ---- setup ---------------------------------------------------------
     def _table_options(self) -> dict:
@@ -281,6 +305,19 @@ class SoakHarness:
             "snapshot.num-retained.max": "30",
             "commit.retry-backoff": "2 ms",
         }
+        if cfg.subscribers or cfg.subscriber_procs:
+            # subscription storm knobs: a shallow queue + short shed timeout
+            # so the deliberately-slow subscriber actually gets shed, and a
+            # fast heartbeat so durable progress (and the expiry pin) tracks
+            # consumption closely
+            opts.update(
+                {
+                    "subscription.queue-depth": "4",
+                    "subscription.shed-timeout": "1500 ms",
+                    "subscription.heartbeat-interval": "1 s",
+                    "subscription.poll-backoff": "20 ms",
+                }
+            )
         if cfg.resilient:
             opts.update(
                 {
@@ -317,6 +354,12 @@ class SoakHarness:
                 block_timeout_ms=self.cfg.block_timeout_ms,
                 max_pending_flushes=self.cfg.max_pending_flushes,
             )
+        if self.cfg.subscribers:
+            # ONE hub: every subscriber thread rides the same decode-once
+            # tailer (the subscriber process has its own, in its own process)
+            from ..service.subscription import SubscriptionHub
+
+            self._sub_hub = SubscriptionHub(self._table.with_user("soak-subhub"))
         return self._table
 
     def _handle(self, user: str):
@@ -691,6 +734,251 @@ class SoakHarness:
             except Exception:
                 pass
 
+    # ---- CDC subscribers (ISSUE 14) ------------------------------------
+    def _sub_scan_at(self, table, sid: int):
+        """Pinned scan at sid as {key: full row tuple} — the truth a
+        subscriber's fold is checked against (one retry for the rare
+        full-retry-budget fault exhaustion, like the reader loop)."""
+        from ..fs.testing import ArtificialException
+
+        try:
+            batch = self._read_at(table, sid)
+        except ArtificialException:
+            batch = self._read_at(table, sid)
+        ks = batch.column("k").values.tolist()
+        vs = batch.column("v").values.tolist()
+        return {(k,): (k, v) for k, v in zip(ks, vs)}
+
+    def _subscriber_loop(self, sidx: int, deadline: float) -> None:
+        """One subscriber on the shared decode-once hub: fold every received
+        batch (sid-deduped, so at-least-once replays after a shed/resume are
+        harmless) and periodically assert fold == pinned scan at the
+        checkpoint. Subscriber 0 (slow_subscriber) stalls per batch until the
+        hub sheds it with the typed protocol, then resumes from its
+        consumer-id — losslessly."""
+        from ..service.subscription import SubscriberShedError
+
+        cfg = self.cfg
+        slow = cfg.slow_subscriber and sidx == 0
+        table = self._handle(f"soak-sub{sidx}")
+        consumer = f"soak-sub-{sidx}"
+        received: dict[int, object] = {}  # sid -> ChangelogBatch (last wins)
+
+        def fold_up_to(sid: int) -> dict:
+            from ..service.subscription import fold_changelog
+
+            state: dict = {}
+            for s in sorted(received):
+                if s <= sid:
+                    fold_changelog(state, received[s], ["k"])
+            return state
+
+        def verify(sid: int) -> None:
+            with self._lock:
+                self.counts["sub_verifies"] += 1
+            expected = self._sub_scan_at(table, sid)
+            got = fold_up_to(sid)
+            if got != expected:
+                with self._lock:
+                    self.counts["sub_mismatches"] += 1
+                missing = [k for k in expected if k not in got]
+                extra = [k for k in got if k not in expected]
+                self.inconsistencies.append(
+                    {
+                        "kind": "sub-fold-mismatch",
+                        "subscriber": sidx,
+                        "snapshot": sid,
+                        "missing": len(missing),
+                        "extra": len(extra),
+                        "sample": (missing[:3], extra[:3]),
+                    }
+                )
+
+        sub = None
+        since_verify = 0
+        try:
+            while not self.stop.is_set():
+                draining = time.monotonic() >= deadline
+                try:
+                    if sub is None:
+                        sub = self._sub_hub.subscribe(consumer_id=consumer, from_snapshot=1)
+                    batch = sub.poll(timeout=1.0)
+                except SubscriberShedError:
+                    with self._lock:
+                        self.counts["sub_shed_typed"] += 1
+                        self.counts["sub_resumes"] += 1
+                    sub = None  # resume from the durable consumer position
+                    continue
+                except Exception as exc:
+                    if draining:
+                        break
+                    with self._lock:
+                        self.counts["sub_shed_untyped"] += 1
+                        self.errors.append(f"subscriber {sidx}: {exc!r}")
+                    time.sleep(0.2)
+                    continue
+                if batch is None:
+                    if draining:
+                        break  # queue drained after the writer deadline
+                    continue
+                received[batch.snapshot_id] = batch
+                since_verify += 1
+                with self._lock:
+                    self.counts["sub_batches"] += 1
+                    self.counts["sub_rows"] += batch.num_rows
+                if slow and not draining:
+                    time.sleep(cfg.sub_slow_sleep_s)
+                if since_verify >= cfg.sub_verify_every and not draining:
+                    since_verify = 0
+                    try:
+                        verify(batch.snapshot_id)
+                    except Exception as exc:
+                        with self._lock:
+                            self.errors.append(f"subscriber {sidx} verify @ {batch.snapshot_id}: {exc!r}")
+            # final oracle: the fold of EVERYTHING received must equal the
+            # pinned scan at the final checkpoint, for every subscriber
+            if received:
+                try:
+                    verify(max(received))
+                except Exception as exc:
+                    with self._lock:
+                        self.errors.append(f"subscriber {sidx} final verify: {exc!r}")
+        finally:
+            if sub is not None:
+                try:
+                    sub.close()
+                except Exception:
+                    pass
+
+    def _subscriber_proc_loop(self, deadline: float) -> None:
+        """Subscriber as an OS process (the kill -9 half of the oracle): a
+        child subscribes with a durable consumer-id and journals every batch
+        (fsync per line). Mid-soak the supervisor SIGKILLs it and respawns
+        it with the SAME consumer-id; the respawn resumes from the recorded
+        position. _verify folds the journal (sid-deduped) and asserts it
+        equals the pinned scan at the journal's checkpoint."""
+        import signal
+        import subprocess
+        import sys
+
+        cfg = self.cfg
+        self._subproc_journal = os.path.join(self.base_dir, "subscriber_proc.journal")
+        consumer = "soak-subproc"
+
+        def spawn() -> subprocess.Popen:
+            remaining = max(deadline - time.monotonic(), 1.0)
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            return subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "paimon_tpu.service.subscription",
+                    "--table",
+                    self.path,
+                    "--consumer",
+                    consumer,
+                    "--journal",
+                    self._subproc_journal,
+                    "--duration",
+                    str(remaining + 5.0),
+                    "--from-snapshot",
+                    "1",
+                ],
+                env=env,
+            )
+
+        proc = spawn()
+        kill_at = time.monotonic() + max((deadline - time.monotonic()) * 0.45, 2.0)
+        killed = False
+        try:
+            while time.monotonic() < deadline and not self.stop.is_set():
+                if cfg.kill_subscriber and not killed and time.monotonic() >= kill_at:
+                    try:
+                        os.kill(proc.pid, signal.SIGKILL)
+                        proc.wait(timeout=30)
+                    except Exception:
+                        pass
+                    killed = True
+                    with self._lock:
+                        self.counts["subproc_kills"] += 1
+                    proc = spawn()  # same consumer-id: durable resume
+                if proc.poll() is not None and time.monotonic() < deadline - 3.0:
+                    # premature death is a failure unless we just killed it
+                    with self._lock:
+                        self.errors.append(
+                            f"subscriber process exited early rc={proc.returncode}"
+                        )
+                    return
+                time.sleep(0.2)
+            try:
+                proc.wait(timeout=60 + cfg.duration_s)
+            except Exception:
+                proc.kill()
+                with self._lock:
+                    self.errors.append("subscriber process failed to drain; killed")
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    def _verify_subproc_journal(self) -> None:
+        """Fold the subscriber process's journal and assert it equals the
+        pinned-snapshot scan at its checkpoint — across the kill -9."""
+        import json as _json
+
+        path = getattr(self, "_subproc_journal", None)
+        if path is None or not os.path.exists(path):
+            self.errors.append("subscriber process journal missing")
+            return
+        from ..types import RowKind
+
+        by_sid: dict[int, tuple[list, list]] = {}
+        done = None
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue  # torn tail from the SIGKILL
+                if "sid" in rec:
+                    by_sid[rec["sid"]] = (rec["rows"], rec["kinds"])
+                elif rec.get("done"):
+                    done = rec.get("checkpoint")
+        if not by_sid:
+            self.errors.append("subscriber process journal recorded no batches")
+            return
+        checkpoint = max(by_sid)
+        state: dict = {}
+        for sid in sorted(by_sid):
+            rows, kinds = by_sid[sid]
+            for row, kind in zip(rows, kinds):
+                k = RowKind(int(kind))
+                if k in (RowKind.INSERT, RowKind.UPDATE_AFTER):
+                    state[(row[0],)] = tuple(row)
+                elif k == RowKind.DELETE:
+                    state.pop((row[0],), None)
+        table = self._handle("soak-subproc-verify")
+        expected = self._sub_scan_at(table, checkpoint)
+        self.counts["sub_verifies"] += 1
+        if state != expected:
+            self.counts["sub_mismatches"] += 1
+            missing = [k for k in expected if k not in state]
+            extra = [k for k in state if k not in expected]
+            self.inconsistencies.append(
+                {
+                    "kind": "subproc-journal-mismatch",
+                    "checkpoint": checkpoint,
+                    "done_marker": done,
+                    "missing": len(missing),
+                    "extra": len(extra),
+                    "sample": (missing[:3], extra[:3]),
+                }
+            )
+
     # ---- churn ---------------------------------------------------------
     def _compactor_loop(self, deadline: float) -> None:
         from ..core.commit import BATCH_COMMIT_IDENTIFIER, CommitConflictError, CommitGiveUpError
@@ -802,6 +1090,12 @@ class SoakHarness:
             threads.append(self._spawn("soak-ryw", self._ryw_loop, deadline))
         if cfg.getters and cfg.get_server:
             threads.append(self._spawn("soak-get-overload", self._get_overload_loop, deadline))
+        threads += [
+            self._spawn(f"soak-sub-{s}", self._subscriber_loop, s, deadline)
+            for s in range(cfg.subscribers)
+        ]
+        if cfg.subscriber_procs:
+            threads.append(self._spawn("soak-subproc-super", self._subscriber_proc_loop, deadline))
         threads.append(self._spawn("soak-compactor", self._compactor_loop, deadline))
         threads.append(self._spawn("soak-expirer", self._expirer_loop, deadline))
         for t in threads:
@@ -812,6 +1106,8 @@ class SoakHarness:
             for t in threads:
                 t.join(timeout=60.0)
             self.errors.append(f"threads failed to drain in time: {alive}")
+        if self._sub_hub is not None:
+            self._sub_hub.close()
         wall_s = time.monotonic() - t_start
         FailingFileIO.reset(self.domain, 0, 0)  # faults off for verification
         report = self._verify(wall_s)
@@ -912,6 +1208,11 @@ class SoakHarness:
         from ..metrics import soak_metrics
 
         g = soak_metrics()
+        if self.cfg.subscriber_procs:
+            try:
+                self._verify_subproc_journal()
+            except Exception:
+                self.errors.append(f"subproc journal verification crashed:\n{traceback.format_exc()}")
         consistent = (
             not self.inconsistencies
             and not self.errors
@@ -919,6 +1220,8 @@ class SoakHarness:
             and dup == 0
             and wrong == 0
             and self.counts["gets_shed_untyped"] == 0  # overload must shed TYPED
+            and self.counts["sub_shed_untyped"] == 0  # slow consumers shed TYPED
+            and self.counts["sub_mismatches"] == 0  # every fold == pinned scan
             and (total_record_count is None or total_record_count == len(self.oracle.expected_final()))
         )
         report = {
@@ -962,6 +1265,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--writers", type=int, default=3)
     ap.add_argument("--readers", type=int, default=2)
     ap.add_argument("--getters", type=int, default=0, help="batched point-get storm threads")
+    ap.add_argument("--subscribers", type=int, default=0, help="CDC subscription storm threads")
+    ap.add_argument("--subscriber-procs", type=int, default=0, help="subscriber OS processes (kill -9 + resume)")
     ap.add_argument("--fault-possibility", type=int, default=20, help="1/N ops fail (20 = 5%%)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mesh", action="store_true")
@@ -975,6 +1280,8 @@ def main(argv: list[str] | None = None) -> int:
         writers=args.writers,
         readers=args.readers,
         getters=args.getters,
+        subscribers=args.subscribers,
+        subscriber_procs=args.subscriber_procs,
         fault_possibility=args.fault_possibility,
         seed=args.seed,
         mesh=args.mesh,

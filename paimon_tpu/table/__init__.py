@@ -179,6 +179,18 @@ class FileStoreTable(Table):
     def to_pandas(self, predicate=None, projection=None):
         return self.to_arrow(predicate=predicate, projection=projection).to_pandas()
 
+    def subscribe(self, consumer_id: str | None = None, from_snapshot: int | None = None):
+        """Live changelog subscription (service/subscription.py): an iterator
+        of decoded ChangelogBatch fed by the table's shared decode-once
+        tailer. `consumer_id` makes progress durable (resume + expiry
+        pinning); `from_snapshot` replays history through the data-file
+        cache before going live."""
+        from ..service.subscription import SubscriptionHub
+
+        return SubscriptionHub.for_table(self).subscribe(
+            consumer_id=consumer_id, from_snapshot=from_snapshot
+        )
+
     def remove_orphan_files(self, older_than_millis: int | None = None, dry_run: bool = False) -> list[str]:
         """Crash recovery: delete files unreachable from every live snapshot/
         changelog/tag/branch plus torn .tmp.* residue (resilience/orphan.py);
@@ -195,7 +207,10 @@ class FileStoreTable(Table):
 
         from ..options import CoreOptions
 
-        cm = ConsumerManager(self.file_io, self.path)
+        # consumer IO routes through the retrying wrapper: a transient
+        # blip during expiry must retry (or abort expiry), never read as
+        # "no consumers" and unpin a live subscriber's snapshots
+        cm = ConsumerManager(self.store.file_io, self.path)
         ttl = self.options.options.get(CoreOptions.CONSUMER_EXPIRATION_TIME_MS)
         if ttl is not None:
             cm.expire_stale(ttl)  # abandoned readers stop pinning snapshots

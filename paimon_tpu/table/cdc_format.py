@@ -18,7 +18,20 @@ from typing import Any, Callable, Iterable, Iterator, Mapping
 from ..core.commit import BATCH_COMMIT_IDENTIFIER
 from .cdc import CdcRecord, CdcTableWrite
 
-__all__ = ["parse_debezium", "parse_canal", "parse_maxwell", "parse_json", "get_cdc_parser", "CdcStream"]
+__all__ = [
+    "parse_debezium",
+    "parse_canal",
+    "parse_maxwell",
+    "parse_json",
+    "get_cdc_parser",
+    "format_debezium",
+    "format_canal",
+    "format_maxwell",
+    "format_json",
+    "get_cdc_formatter",
+    "encode_changelog",
+    "CdcStream",
+]
 
 
 def _loads(message: str | bytes | Mapping | None):
@@ -102,6 +115,129 @@ def parse_maxwell(message: str | bytes | Mapping) -> list[CdcRecord]:
 def parse_json(message: str | bytes | Mapping) -> list[CdcRecord]:
     """Plain JSON records: each message is one +I row."""
     return [CdcRecord(_loads(message), "+I")]
+
+
+# ---------------------------------------------------------------------------
+# wire formatters: the encode half of each parser. The subscription service
+# (service/subscription.py + the Flight subscribe endpoint) emits change
+# events in any of these formats; the invariant, pinned by tests, is
+# parse(format(events)) == events bit-identically — -U/+U pairs fold into one
+# UPDATE wire message and come back out as the same pair.
+# ---------------------------------------------------------------------------
+
+
+def _pair_events(events: Iterable[tuple[str, Mapping]]) -> Iterator[tuple[str, Mapping, Mapping | None]]:
+    """Group a changelog event stream into wire units: ('+I', row, None),
+    ('-D', row, None), or ('U', after, before) for a -U immediately followed
+    by its +U (the changelog producers always emit the pair adjacently)."""
+    pending_before: Mapping | None = None
+    for kind, row in events:
+        if pending_before is not None:
+            if kind != "+U":
+                raise ValueError(f"-U not followed by +U (got {kind!r})")
+            yield "U", row, pending_before
+            pending_before = None
+        elif kind == "-U":
+            pending_before = row
+        elif kind in ("+I", "+U"):
+            # a lone +U (e.g. dedup dropped its -U) wires as an insert-style
+            # upsert: the parsers return it as +I, which folds identically
+            yield "+I", row, None
+        elif kind == "-D":
+            yield "-D", row, None
+        else:
+            raise ValueError(f"unknown row kind {kind!r}")
+    if pending_before is not None:
+        raise ValueError("dangling -U at end of stream")
+
+
+def format_debezium(events: Iterable[tuple[str, Mapping]]) -> list[str]:
+    """Changelog events -> debezium JSON messages (op c/u/d with
+    before/after), the inverse of parse_debezium."""
+    out = []
+    for unit, after, before in _pair_events(events):
+        if unit == "+I":
+            node = {"op": "c", "before": None, "after": dict(after)}
+        elif unit == "U":
+            node = {"op": "u", "before": dict(before), "after": dict(after)}
+        else:
+            node = {"op": "d", "before": dict(after), "after": None}
+        out.append(json.dumps(node))
+    return out
+
+
+def format_canal(events: Iterable[tuple[str, Mapping]]) -> list[str]:
+    """Changelog events -> canal JSON (type INSERT/UPDATE/DELETE with data[]
+    and old[]). old[] carries the FULL pre-image so parse_canal's
+    {**row, **old} reconstruction returns it bit-identically."""
+    out = []
+    for unit, after, before in _pair_events(events):
+        if unit == "+I":
+            node = {"type": "INSERT", "data": [dict(after)], "old": None}
+        elif unit == "U":
+            node = {"type": "UPDATE", "data": [dict(after)], "old": [dict(before)]}
+        else:
+            node = {"type": "DELETE", "data": [dict(after)], "old": None}
+        out.append(json.dumps(node))
+    return out
+
+
+def format_maxwell(events: Iterable[tuple[str, Mapping]]) -> list[str]:
+    """Changelog events -> maxwell JSON (type insert/update/delete with
+    data/old; old carries the full pre-image for bit-identical roundtrip)."""
+    out = []
+    for unit, after, before in _pair_events(events):
+        if unit == "+I":
+            node = {"type": "insert", "data": dict(after)}
+        elif unit == "U":
+            node = {"type": "update", "data": dict(after), "old": dict(before)}
+        else:
+            node = {"type": "delete", "data": dict(after)}
+        out.append(json.dumps(node))
+    return out
+
+
+def format_json(events: Iterable[tuple[str, Mapping]]) -> list[str]:
+    """Insert-only plain JSON: one row per message. Retractions cannot be
+    expressed in this format — encoding them is an error, not silent loss."""
+    out = []
+    for kind, row in events:
+        if kind != "+I":
+            raise ValueError(f"plain json cannot encode {kind!r} rows")
+        out.append(json.dumps(dict(row)))
+    return out
+
+
+def encode_changelog(data, kinds, fmt: str) -> list[str]:
+    """ColumnBatch + RowKind vector -> wire messages in `fmt`. Values
+    materialize per row via to_pylist (code-backed/dictionary columns expand
+    lazily here and nowhere earlier — the decoded batch itself stays in the
+    code domain for every other consumer)."""
+    from ..types import RowKind
+
+    names = data.schema.field_names
+    events = [
+        (RowKind(int(k)).short_string, dict(zip(names, row)))
+        for row, k in zip(data.to_pylist(), kinds.tolist())
+    ]
+    return get_cdc_formatter(fmt)(events)
+
+
+_FORMATTERS: dict[str, Callable[[Iterable[tuple[str, Mapping]]], list[str]]] = {
+    "debezium-json": format_debezium,
+    "debezium": format_debezium,
+    "canal-json": format_canal,
+    "canal": format_canal,
+    "maxwell-json": format_maxwell,
+    "maxwell": format_maxwell,
+    "json": format_json,
+}
+
+
+def get_cdc_formatter(fmt: str) -> Callable[[Iterable[tuple[str, Mapping]]], list[str]]:
+    if fmt not in _FORMATTERS:
+        raise ValueError(f"unknown cdc format {fmt!r}; known: {sorted(_FORMATTERS)}")
+    return _FORMATTERS[fmt]
 
 
 _PARSERS: dict[str, Callable[[Any], list[CdcRecord]]] = {

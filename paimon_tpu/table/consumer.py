@@ -22,10 +22,17 @@ class ConsumerManager:
         return f"{self.consumer_dir}/consumer-{consumer_id}"
 
     def consumer(self, consumer_id: str) -> int | None:
+        """The consumer's next-snapshot position, or None when no such
+        consumer EXISTS. Only a missing file (ENOENT) maps to None: a
+        transient IO error must propagate (into the resilience retry policy
+        when the FileIO is the store's retrying wrapper) — treating it as
+        "no consumer" would let min_next_snapshot() unpin a live subscriber
+        and expiry delete snapshots it still needs."""
         try:
-            return loads(self.file_io.read_bytes(self._path(consumer_id)))["nextSnapshot"]
-        except Exception:
+            raw = self.file_io.read_bytes(self._path(consumer_id))
+        except FileNotFoundError:
             return None
+        return loads(raw)["nextSnapshot"]
 
     def record(self, consumer_id: str, next_snapshot: int) -> None:
         self.file_io.try_overwrite(self._path(consumer_id), dumps({"nextSnapshot": next_snapshot}).encode())

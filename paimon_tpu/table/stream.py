@@ -48,7 +48,7 @@ class StreamTableScan:
         self._next: int | None = None  # next snapshot id to read
         self._started = False
         if self.consumer_id and not opts.get(CoreOptions.CONSUMER_IGNORE_PROGRESS):
-            saved = ConsumerManager(table.file_io, table.path).consumer(self.consumer_id)
+            saved = ConsumerManager(table.store.file_io, table.path).consumer(self.consumer_id)
             if saved is not None:
                 self._next = saved
                 self._started = True  # consumer progress wins over startup mode
@@ -71,7 +71,7 @@ class StreamTableScan:
     def notify_checkpoint_complete(self) -> None:
         cp = getattr(self, "_last_checkpoint", None)
         if self.consumer_id and cp is not None:
-            ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, cp)
+            ConsumerManager(self.table.store.file_io, self.table.path).record(self.consumer_id, cp)
 
     # ---- planning ------------------------------------------------------
     def plan_aligned(self, timeout_seconds: float = 60.0, poll_seconds: float | None = None) -> list[DataSplit] | None:
@@ -155,7 +155,7 @@ class StreamTableScan:
             # PLANNED snapshot, not past it: a crash between plan and
             # processing replays this snapshot (at-least-once), and expiry
             # keeps protecting it while a reader may still be on it
-            ConsumerManager(self.table.file_io, self.table.path).record(self.consumer_id, planned)
+            ConsumerManager(self.table.store.file_io, self.table.path).record(self.consumer_id, planned)
         return splits
 
     def _starting_plan(self) -> list[DataSplit] | None:

@@ -82,6 +82,19 @@
 #                              forced 1, then 0 — so gets prove identical
 #                              with and without bloom key indexes on every
 #                              written file.
+#   scripts/verify.sh subscribe  CDC subscription stage: the subscription
+#                              suite (decode-once fan-out, consumer-fix
+#                              regression, expiry-pinning e2e, cdc wire
+#                              roundtrips over Flight, typed shed + resume)
+#                              plus a ~45 s deterministic subscriber soak —
+#                              2 writers at 5% faults, 4 subscribers incl.
+#                              one deliberately slow (typed shed +
+#                              consumer-id resume), 1 subscriber OS process
+#                              kill -9'd and respawned — asserting every
+#                              subscriber's folded changelog stream ==
+#                              pinned-snapshot scan at its checkpoint, 0
+#                              lost/duplicated rows, 0 untyped sheds, and
+#                              the conftest thread/process-leak checks.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -209,6 +222,14 @@ if [ "${1:-}" = "get" ]; then
       -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
   done
   exit 0
+fi
+
+if [ "${1:-}" = "subscribe" ]; then
+  # no -m filter: this stage INCLUDES the slow-marked ~45 s subscriber soak
+  # and the subscriber-process kill -9 test
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_SOAK_DURATION=45 PAIMON_TPU_SOAK_SEED=0 \
+    timeout -k 10 600 python -m pytest tests/test_subscription.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
 if [ "${1:-}" = "encode" ]; then

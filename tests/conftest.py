@@ -53,7 +53,9 @@ def _no_worker_thread_leaks():
             for t in threading.enumerate()
             if t.is_alive()
             and not t.daemon
-            and t.name.startswith(("paimon-pipeline", "paimon-flush", "paimon-compactor"))
+            and t.name.startswith(
+                ("paimon-pipeline", "paimon-flush", "paimon-compactor", "paimon-subtail", "paimon-subhb")
+            )
         ]
 
     if leaked():

@@ -1,0 +1,728 @@
+"""Streaming CDC subscription service (service/subscription.py + the Flight
+subscribe surface): decode-once fan-out, durable consumer resume, typed
+shedding, expiry pinning, cdc wire-format roundtrips, and the subscriber
+soak (thread + process grain)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.metrics import registry, sub_metrics
+from paimon_tpu.service.subscription import (
+    SubscriberShedError,
+    SubscriptionHub,
+    fold_changelog,
+)
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowKind, RowType
+
+SCHEMA = RowType.of(("k", BIGINT()), ("v", DOUBLE()))
+STR_SCHEMA = RowType.of(("k", BIGINT()), ("s", STRING()), ("v", DOUBLE()))
+
+
+@pytest.fixture
+def catalog(tmp_warehouse):
+    return FileSystemCatalog(tmp_warehouse, commit_user="subs")
+
+
+@pytest.fixture(autouse=True)
+def _hubs_down():
+    yield
+    SubscriptionHub.shutdown_all()
+
+
+def write(t, data, kinds=None):
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write(data, kinds)
+    wb.new_commit().commit(w.prepare_commit())
+
+
+def scan_rows(t, sid=None):
+    tt = t.copy({"scan.snapshot-id": str(sid)}) if sid is not None else t
+    rb = tt.new_read_builder()
+    batch = rb.new_read().read_all(rb.new_scan().plan())
+    names = batch.schema.field_names
+    return {row[0]: tuple(row) for row in (tuple(r) for r in batch.to_pylist())}
+
+
+def fold_sub(batches):
+    state = {}
+    for b in sorted(batches, key=lambda b: b.snapshot_id):
+        fold_changelog(state, b, ["k"])
+    return {k[0]: v for k, v in state.items()}
+
+
+def drain(sub, timeout=10.0, idle=0.4):
+    """Poll until the stream goes idle; returns the received batches."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        b = sub.poll(timeout=idle)
+        if b is None:
+            if out:
+                return out
+            continue
+        out.append(b)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hub basics
+# ---------------------------------------------------------------------------
+
+
+def test_subscribe_fold_equals_scan(catalog):
+    t = catalog.create_table("db.basic", SCHEMA, primary_keys=["k"], options={"bucket": "2"})
+    write(t, {"k": [1, 2], "v": [1.0, 2.0]})
+    write(t, {"k": [2, 3], "v": [22.0, 3.0]})
+    sub = t.subscribe(consumer_id="c1", from_snapshot=1)
+    try:
+        batches = drain(sub)
+        assert [b.snapshot_id for b in batches] == [1, 2]
+        assert sub.checkpoint == 3
+        assert fold_sub(batches) == scan_rows(t)
+        # live commit reaches the open subscription
+        write(t, {"k": [4], "v": [4.0]})
+        b = sub.poll(timeout=10.0)
+        assert b is not None and b.snapshot_id == 3
+        batches.append(b)
+        assert fold_sub(batches) == scan_rows(t)
+    finally:
+        sub.close()
+
+
+def test_changelog_kinds_delivered(catalog):
+    t = catalog.create_table(
+        "db.kinds", SCHEMA, primary_keys=["k"],
+        options={"bucket": "1", "changelog-producer": "input"},
+    )
+    write(t, {"k": [1], "v": [1.0]})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1], "v": [1.0]}, kinds=["-U"])
+    w.write({"k": [1], "v": [11.0]}, kinds=["+U"])
+    w.write({"k": [2], "v": [2.0]}, kinds=["-D"])
+    wb.new_commit().commit(w.prepare_commit())
+    sub = t.subscribe(consumer_id="ck", from_snapshot=1)
+    try:
+        batches = drain(sub)
+        events = [e for b in batches for e in b.events()]
+        assert ("+I", 1, 1.0) in events
+        assert ("-U", 1, 1.0) in events and ("+U", 1, 11.0) in events
+        assert ("-D", 2, 2.0) in events
+        assert fold_sub(batches) == scan_rows(t)
+    finally:
+        sub.close()
+
+
+def test_decode_once_fanout(catalog):
+    """N subscribers receive the SAME decoded batch objects — decode work is
+    flat in subscriber count (the live half of the decode-once contract)."""
+    t = catalog.create_table("db.fan", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    hub = SubscriptionHub.for_table(t)
+    subs = [hub.subscribe(consumer_id=f"f{i}", from_snapshot=1) for i in range(4)]
+    registry.groups.pop(("sub", ()), None)
+    write(t, {"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]})
+    got = [s.poll(timeout=10.0) for s in subs]
+    try:
+        assert all(b is not None and b.snapshot_id == 1 for b in got)
+        # identity, not equality: one decode fanned to every queue
+        assert all(b.data is got[0].data for b in got[1:])
+        g = sub_metrics()
+        assert g.counter("decode_reuse_hits").count >= 3
+        assert g.counter("batches_fanned").count >= 4
+        assert g.counter("rows_fanned").count >= 12
+    finally:
+        for s in subs:
+            s.close()
+
+
+def test_catchup_rides_data_file_cache(catalog):
+    """A late joiner replays history through the data-file cache the tailer
+    populated: its catch-up reads count decode_reuse_hits."""
+    t = catalog.create_table("db.late", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    first = t.subscribe(consumer_id="early", from_snapshot=1)
+    try:
+        write(t, {"k": [1], "v": [1.0]})
+        write(t, {"k": [2], "v": [2.0]})
+        assert len(drain(first)) == 2
+        registry.groups.pop(("sub", ()), None)
+        late = t.subscribe(consumer_id="late", from_snapshot=1)
+        try:
+            batches = drain(late)
+            assert [b.snapshot_id for b in batches] == [1, 2]
+            assert all(b.is_catchup for b in batches)
+            assert sub_metrics().counter("decode_reuse_hits").count >= 2
+            assert fold_sub(batches) == scan_rows(t)
+        finally:
+            late.close()
+    finally:
+        first.close()
+
+
+def test_resume_from_consumer_id(catalog):
+    """Progress is durable: a closed subscription resumes from its recorded
+    position, not from scratch and not past unprocessed snapshots."""
+    t = catalog.create_table("db.resume", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    write(t, {"k": [1], "v": [1.0]})
+    write(t, {"k": [2], "v": [2.0]})
+    sub = t.subscribe(consumer_id="r1", from_snapshot=1)
+    b = sub.poll(timeout=10.0)
+    assert b.snapshot_id == 1
+    sub.close()  # records progress = last handed (at-least-once)
+    write(t, {"k": [3], "v": [3.0]})
+    sub2 = t.subscribe(consumer_id="r1")
+    try:
+        batches = drain(sub2)
+        # resumes AT the last handed snapshot (replay) and runs to the tip
+        assert batches[0].snapshot_id == 1
+        assert batches[-1].snapshot_id == 3
+        assert fold_sub(batches) == scan_rows(t)
+    finally:
+        sub2.close()
+
+
+def test_max_subscribers_typed_busy(catalog):
+    t = catalog.create_table(
+        "db.cap", SCHEMA, primary_keys=["k"],
+        options={"bucket": "1", "subscription.max-subscribers": "1"},
+    )
+    sub = t.subscribe(consumer_id="one")
+    try:
+        with pytest.raises(SubscriberShedError) as exc:
+            t.subscribe(consumer_id="two")
+        assert exc.value.payload["state"] == "busy-subscribers"
+        assert exc.value.retry_after_ms > 0
+    finally:
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# flow control: slow consumer shed + lossless resume
+# ---------------------------------------------------------------------------
+
+
+def test_slow_consumer_shed_typed_then_resume(catalog):
+    t = catalog.create_table(
+        "db.slow", SCHEMA, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "subscription.queue-depth": "2",
+            "subscription.shed-timeout": "300 ms",
+            "subscription.poll-backoff": "10 ms",
+        },
+    )
+    hub = SubscriptionHub.for_table(t)
+    slow = hub.subscribe(consumer_id="slow", from_snapshot=1)
+    peer = hub.subscribe(consumer_id="peer", from_snapshot=1)
+    peer_batches = []
+    stop = threading.Event()
+
+    def peer_loop():
+        while not stop.is_set():
+            b = peer.poll(timeout=0.2)
+            if b is not None:
+                peer_batches.append(b)
+
+    pt = threading.Thread(target=peer_loop)
+    pt.start()
+    try:
+        # the slow consumer handles exactly one batch, then stalls: the
+        # tailer must shed IT and keep feeding the peer
+        for i in range(8):
+            write(t, {"k": [i], "v": [float(i)]})
+        first = slow.poll(timeout=10.0)
+        assert first is not None
+        deadline = time.monotonic() + 20.0
+        shed = None
+        while shed is None and time.monotonic() < deadline:
+            try:
+                time.sleep(0.1)
+                if slow.is_shed:
+                    slow.poll(timeout=0.1)
+            except SubscriberShedError as exc:
+                shed = exc
+        assert shed is not None, "slow consumer was never shed"
+        assert shed.payload["consumer_id"] == "slow"
+        assert shed.next_snapshot is not None
+        assert sub_metrics().counter("shed_subscribers").count >= 1
+        # resume from the consumer-id: the replay is lossless
+        resumed = hub.subscribe(consumer_id="slow")
+        try:
+            batches = [first] + drain(resumed)
+            assert fold_sub(batches) == scan_rows(t)
+        finally:
+            resumed.close()
+        # the peer was never stalled out of the stream
+        stop.set()
+        pt.join(timeout=10.0)
+        assert fold_sub(peer_batches) == scan_rows(t)
+        assert not peer.is_shed
+    finally:
+        stop.set()
+        pt.join(timeout=10.0)
+        slow.close()
+        peer.close()
+
+
+# ---------------------------------------------------------------------------
+# ConsumerManager: only ENOENT maps to None (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_consumer_enoent_is_none_transient_raises(tmp_path):
+    from paimon_tpu.core.schema import SchemaManager
+    from paimon_tpu.fs import get_file_io
+    from paimon_tpu.fs.testing import ArtificialException, FailingFileIO, FaultRule
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.table.consumer import ConsumerManager
+
+    local = str(tmp_path / "ct")
+    path = f"fail://cmfix{local}"
+    FailingFileIO.reset("cmfix", 0, 0)
+    io = get_file_io(path)
+    ts = SchemaManager(io, path).create_table(
+        SCHEMA, primary_keys=["k"], options={"bucket": "1", "fs.retry.max-attempts": "1"}
+    )
+    t = FileStoreTable(io, path, ts, commit_user="cm")
+    cm = ConsumerManager(t.store.file_io, path)
+    # ENOENT: genuinely no consumer -> None
+    assert cm.consumer("nope") is None
+    cm.record("c1", 7)
+    assert cm.consumer("c1") == 7
+    # a transient read fault must PROPAGATE (retries are off), never read as
+    # "no consumer": that verdict would unpin a live subscriber
+    FailingFileIO.schedule("cmfix", FaultRule("read", "consumer-c1"))
+    with pytest.raises(ArtificialException):
+        cm.consumer("c1")
+    # with the PR 3 retry budget the same blip is absorbed
+    t2 = t.copy({"fs.retry.max-attempts": "4", "fs.retry.initial-backoff": "1 ms"})
+    cm2 = ConsumerManager(t2.store.file_io, path)
+    FailingFileIO.schedule("cmfix", FaultRule("read", "consumer-c1"))
+    assert cm2.consumer("c1") == 7
+    FailingFileIO.reset("cmfix", 0, 0)
+
+
+def test_expiry_aborts_on_consumer_read_fault_keeps_pin(tmp_path):
+    """A transient fault while expiry reads consumer files must abort the
+    expiry run (pin intact), not unpin the subscriber and delete snapshots
+    it still needs — the regression the old `except Exception: None` had."""
+    from paimon_tpu.core.schema import SchemaManager
+    from paimon_tpu.fs import get_file_io
+    from paimon_tpu.fs.testing import ArtificialException, FailingFileIO, FaultRule
+    from paimon_tpu.table import FileStoreTable
+    from paimon_tpu.table.consumer import ConsumerManager
+
+    local = str(tmp_path / "et")
+    path = f"fail://cmexp{local}"
+    FailingFileIO.reset("cmexp", 0, 0)
+    io = get_file_io(path)
+    ts = SchemaManager(io, path).create_table(
+        SCHEMA,
+        primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "fs.retry.max-attempts": "1",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "2",
+        },
+    )
+    t = FileStoreTable(io, path, ts, commit_user="exp")
+    write(t, {"k": [0], "v": [0.0]})
+    sm = t.store.snapshot_manager
+    # a reader pinned at snapshot 1, registered BEFORE retention could trim
+    ConsumerManager(t.store.file_io, path).record("pinned-reader", 1)
+    for i in range(1, 6):
+        write(t, {"k": [i], "v": [float(i)]})
+    assert sm.snapshot_exists(1), "the pin did not hold through commit-time expiry"
+    FailingFileIO.schedule("cmexp", FaultRule("read", "consumer-pinned-reader", count=0))
+    with pytest.raises(ArtificialException):
+        t.expire_snapshots()
+    FailingFileIO.reset("cmexp", 0, 0)
+    assert sm.snapshot_exists(1), "expiry unpinned a live consumer on a transient fault"
+    # healthy expiry honors the pin too
+    t.expire_snapshots()
+    assert sm.snapshot_exists(1)
+
+
+# ---------------------------------------------------------------------------
+# expiry safety e2e (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_lagging_subscriber_never_sees_missing_snapshot(catalog):
+    """Aggressive retention + periodic expiry: a registered subscriber
+    lagging many snapshots behind still replays the full history (its pin
+    holds), and the pin advances as it consumes."""
+    t = catalog.create_table(
+        "db.lag", SCHEMA, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "2",
+            "subscription.heartbeat-interval": "200 ms",
+        },
+    )
+    write(t, {"k": [0], "v": [0.0]})
+    sub = t.subscribe(consumer_id="laggard", from_snapshot=1)
+    try:
+        # the subscriber does NOT poll while 10 more commits land and expiry
+        # runs after each — retention alone would keep only 2 snapshots
+        for i in range(1, 11):
+            write(t, {"k": [i], "v": [float(i)]})
+            t.expire_snapshots()
+        sm = t.store.snapshot_manager
+        assert sm.earliest_snapshot_id() == 1, "expiry outran the registered subscriber"
+        batches = drain(sub, timeout=30.0)
+        # one batch per write commit (inline compaction snapshots carry no
+        # changes and interleave freely), no missing-snapshot error anywhere
+        assert len(batches) == 11
+        assert [b.snapshot_id for b in batches] == sorted(b.snapshot_id for b in batches)
+        assert fold_sub(batches) == scan_rows(t)
+        # once consumed (and heartbeated), the pin advances and expiry trims
+        time.sleep(0.5)  # a heartbeat records the advanced position
+        t.expire_snapshots()
+        assert sm.earliest_snapshot_id() > 1, "consumed snapshots stayed pinned"
+    finally:
+        sub.close()
+
+
+def test_expire_stale_releases_abandoned_pin_heartbeat_keeps_live(catalog):
+    t = catalog.create_table(
+        "db.stale", SCHEMA, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "snapshot.num-retained.min": "1",
+            "snapshot.num-retained.max": "2",
+            "consumer.expiration-time": "700 ms",
+            "subscription.heartbeat-interval": "150 ms",
+        },
+    )
+    from paimon_tpu.table.consumer import ConsumerManager
+
+    write(t, {"k": [0], "v": [0.0]})
+    cm = ConsumerManager(t.store.file_io, t.path)
+    cm.record("abandoned", 1)  # a reader that will never heartbeat
+    sub = t.subscribe(consumer_id="alive", from_snapshot=1)
+    try:
+        assert drain(sub)  # consume snapshot 1; heartbeats keep recording
+        time.sleep(1.0)  # past the consumer TTL: only the heartbeat refreshes
+        for i in range(1, 6):
+            write(t, {"k": [i], "v": [float(i)]})
+        t.expire_snapshots()  # runs expire_stale first
+        assert cm.consumer("abandoned") is None, "stale consumer kept its pin"
+        assert cm.consumer("alive") is not None, "heartbeat failed to keep the live pin"
+        batches = drain(sub, timeout=30.0)
+        assert batches, "live subscriber lost its stream after expire_stale"
+    finally:
+        sub.close()
+
+
+# ---------------------------------------------------------------------------
+# cdc wire-format roundtrips (satellite 3)
+# ---------------------------------------------------------------------------
+
+EVENTS = [
+    ("+I", {"k": 1, "s": "a", "v": 1.0}),
+    ("+I", {"k": 2, "s": "b", "v": 2.0}),
+    ("-U", {"k": 1, "s": "a", "v": 1.0}),
+    ("+U", {"k": 1, "s": "a2", "v": 1.5}),
+    ("-D", {"k": 2, "s": "b", "v": 2.0}),
+]
+
+
+@pytest.mark.parametrize("fmt", ["debezium-json", "canal-json", "maxwell-json"])
+def test_cdc_format_roundtrip_pure(fmt):
+    from paimon_tpu.table.cdc_format import get_cdc_formatter, get_cdc_parser
+
+    messages = get_cdc_formatter(fmt)(EVENTS)
+    back = [(r.kind, dict(r)) for m in messages for r in get_cdc_parser(fmt)(m)]
+    assert back == EVENTS
+
+
+def test_cdc_format_json_insert_only():
+    from paimon_tpu.table.cdc_format import format_json, parse_json
+
+    inserts = [e for e in EVENTS if e[0] == "+I"]
+    back = [(r.kind, dict(r)) for m in format_json(inserts) for r in parse_json(m)]
+    assert back == inserts
+    with pytest.raises(ValueError):
+        format_json(EVENTS)
+
+
+@pytest.mark.parametrize("fmt", ["debezium-json", "canal-json", "maxwell-json"])
+def test_cdc_roundtrip_over_flight_dict_domain(catalog, fmt):
+    """The Flight subscription path emits each cdc format and the parser
+    reconstructs the exact event stream — including DELETE/UPDATE_BEFORE/
+    UPDATE_AFTER rows and dict-backed (code-domain) string columns."""
+    pytest.importorskip("pyarrow.flight")
+    from paimon_tpu.service.flight import PaimonFlightServer, flight_subscribe_poll
+    from paimon_tpu.table.cdc_format import get_cdc_parser
+
+    name = f"cdc{fmt.split('-')[0]}"
+    t = catalog.create_table(
+        f"db.{name}", STR_SCHEMA, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "changelog-producer": "input",
+            "format.parquet.decoder": "native",
+            "merge.dict-domain": "true",
+        },
+    )
+    write(t, {"k": [1, 2], "s": ["a", "b"], "v": [1.0, 2.0]})
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"k": [1], "s": ["a"], "v": [1.0]}, kinds=["-U"])
+    w.write({"k": [1], "s": ["a2"], "v": [1.5]}, kinds=["+U"])
+    w.write({"k": [2], "s": ["b"], "v": [2.0]}, kinds=["-D"])
+    wb.new_commit().commit(w.prepare_commit())
+    # ground truth straight off the changelog files
+    scan = t.new_read_builder().new_stream_scan()
+    read = t.new_read_builder().new_read()
+    scan.restore(1)
+    truth = []
+    while True:
+        splits = scan.plan()
+        if splits is None:
+            break
+        for s in splits:
+            data, kinds = read.read_with_kinds(s)
+            names = data.schema.field_names
+            for row, kk in zip(data.to_pylist(), kinds.tolist()):
+                truth.append((RowKind(int(kk)).short_string, dict(zip(names, row))))
+    srv = PaimonFlightServer(catalog.warehouse)
+    srv.start()
+    try:
+        batches, nxt = flight_subscribe_poll(
+            srv.location, f"db.{name}", f"c-{fmt}", next_snapshot=1, fmt=fmt, timeout_ms=5_000
+        )
+        parser = get_cdc_parser(fmt)
+        got = [
+            (r.kind, dict(r))
+            for b in batches
+            for m in b["messages"]
+            for r in parser(m)
+        ]
+        assert got == truth
+        assert nxt == 3
+    finally:
+        srv.shutdown()
+
+
+def test_flight_subscribe_arrow_and_rows(catalog):
+    pytest.importorskip("pyarrow.flight")
+    from paimon_tpu.service.flight import (
+        PaimonFlightServer,
+        flight_subscribe,
+        flight_subscribe_poll,
+    )
+
+    t = catalog.create_table("db.fa", SCHEMA, primary_keys=["k"], options={"bucket": "1"})
+    write(t, {"k": [1, 2], "v": [1.0, 2.0]})
+    write(t, {"k": [3], "v": [3.0]})
+    srv = PaimonFlightServer(catalog.warehouse)
+    srv.start()
+    try:
+        at, nxt = flight_subscribe(srv.location, "db.fa", "ar", next_snapshot=1, timeout_ms=5_000)
+        assert nxt == 3
+        d = at.to_pydict()
+        assert sorted(zip(d["k"], d["__snapshot_id"])) == [(1, 1), (2, 1), (3, 2)]
+        assert set(d["__row_kind"]) == {int(RowKind.INSERT)}
+        # an empty window still advances/holds the resume token
+        at2, nxt2 = flight_subscribe(srv.location, "db.fa", "ar", timeout_ms=200)
+        assert at2.num_rows == 0 and nxt2 == 3
+        rows, nxt3 = flight_subscribe_poll(srv.location, "db.fa", "rj", next_snapshot=2, timeout_ms=5_000)
+        assert nxt3 == 3
+        assert rows == [{"snapshot": 2, "rows": [[3, 3.0]], "kinds": [0]}]
+    finally:
+        srv.shutdown()
+
+
+def test_flight_shed_is_typed_busy(catalog):
+    """A remote consumer that stops polling long enough to be shed gets a
+    typed FlightBusyError carrying the restart offset — and the next poll
+    resumes from it losslessly."""
+    pytest.importorskip("pyarrow.flight")
+    from paimon_tpu.service.flight import (
+        FlightBusyError,
+        PaimonFlightServer,
+        flight_subscribe_poll,
+    )
+
+    t = catalog.create_table(
+        "db.fshed", SCHEMA, primary_keys=["k"],
+        options={
+            "bucket": "1",
+            "subscription.queue-depth": "1",
+            "subscription.shed-timeout": "200 ms",
+            "subscription.poll-backoff": "10 ms",
+        },
+    )
+    write(t, {"k": [0], "v": [0.0]})
+    srv = PaimonFlightServer(catalog.warehouse)
+    srv.start()
+    try:
+        batches, nxt = flight_subscribe_poll(
+            srv.location, "db.fshed", "rc", next_snapshot=1, timeout_ms=3_000
+        )
+        assert batches
+        # the server-side subscription stays registered between polls; these
+        # commits overflow its depth-1 queue and the tailer sheds it
+        for i in range(1, 7):
+            write(t, {"k": [i], "v": [float(i)]})
+        deadline = time.monotonic() + 20.0
+        shed = None
+        while shed is None and time.monotonic() < deadline:
+            # sleep well past the shed timeout between slow 1-batch polls, so
+            # the stalled consumer's queue stays full long enough to shed
+            time.sleep(0.5)
+            try:
+                got, nxt = flight_subscribe_poll(
+                    srv.location, "db.fshed", "rc", max_batches=1, timeout_ms=50
+                )
+                batches.extend(got)
+            except FlightBusyError as exc:
+                shed = exc
+        assert shed is not None, "server never shed the stalled remote consumer"
+        assert shed.payload.get("consumer_id") == "rc"
+        # resume: polling again re-subscribes from the durable offset
+        state = {}
+        deadline = time.monotonic() + 20.0
+        nxt = None
+        while time.monotonic() < deadline:
+            got, nxt = flight_subscribe_poll(srv.location, "db.fshed", "rc", timeout_ms=300)
+            batches.extend(got)
+            if nxt == 8:
+                break
+        by_sid = {}
+        for b in batches:
+            by_sid[b["snapshot"]] = b
+        for sid in sorted(by_sid):
+            b = by_sid[sid]
+            for row, kind in zip(b["rows"], b["kinds"]):
+                if RowKind(kind) in (RowKind.INSERT, RowKind.UPDATE_AFTER):
+                    state[row[0]] = tuple(row)
+                elif RowKind(kind) == RowKind.DELETE:
+                    state.pop(row[0], None)
+        assert state == scan_rows(t)
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# subscriber OS process: kill -9 + durable resume (stage-soak ingredient)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_subscriber_process_kill9_resume(tmp_path):
+    import json
+    import signal
+    import subprocess
+    import sys
+
+    from paimon_tpu.core.schema import SchemaManager
+    from paimon_tpu.fs import get_file_io
+    from paimon_tpu.table import FileStoreTable
+
+    local = str(tmp_path / "pk")
+    io = get_file_io(local)
+    ts = SchemaManager(io, local).create_table(SCHEMA, primary_keys=["k"], options={"bucket": "2"})
+    t = FileStoreTable(io, local, ts, commit_user="pk")
+    journal = str(tmp_path / "sub.journal")
+
+    def spawn(duration):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "paimon_tpu.service.subscription",
+                "--table", local, "--consumer", "pksub", "--journal", journal,
+                "--duration", str(duration), "--from-snapshot", "1",
+            ],
+            env=env,
+        )
+
+    proc = spawn(60.0)
+    try:
+        for i in range(10):
+            write(t, {"k": [i, i + 100], "v": [float(i), float(i)]})
+            time.sleep(0.1)
+        # wait until the journal proves the child is mid-stream, then kill -9
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if os.path.exists(journal) and os.path.getsize(journal) > 0:
+                break
+            time.sleep(0.2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        for i in range(10, 16):
+            write(t, {"k": [i], "v": [float(i)]})
+        proc = spawn(6.0)  # same consumer-id: resumes from the recorded position
+        assert proc.wait(timeout=120) == 0
+        by_sid = {}
+        with open(journal, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if "sid" in rec:
+                    by_sid[rec["sid"]] = rec
+        state = {}
+        for sid in sorted(by_sid):
+            rec = by_sid[sid]
+            for row, kind in zip(rec["rows"], rec["kinds"]):
+                if RowKind(kind) in (RowKind.INSERT, RowKind.UPDATE_AFTER):
+                    state[row[0]] = tuple(row)
+                elif RowKind(kind) == RowKind.DELETE:
+                    state.pop(row[0], None)
+        assert state == scan_rows(t), "journal fold across kill -9 != table scan"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# the verify.sh subscribe stage soak
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_subscription_stage_soak(tmp_path):
+    """The `scripts/verify.sh subscribe` stage: ~45 s deterministic soak —
+    2 writers under 5% faults, 4 subscribers (subscriber 0 deliberately
+    slow: typed shed + consumer-id resume), 1 subscriber OS process
+    kill -9'd and respawned — asserting every subscriber's folded changelog
+    stream == pinned-snapshot scan at its checkpoint, 0 lost/duplicated
+    rows, 0 untyped sheds, 0 leaked files (and, via conftest, 0 leaked
+    threads/processes), while expiry churns underneath."""
+    from paimon_tpu.service.soak import SoakConfig, run_soak
+
+    duration = float(os.environ.get("PAIMON_TPU_SOAK_DURATION", "45"))
+    seed = int(os.environ.get("PAIMON_TPU_SOAK_SEED", "0"))
+    cfg = SoakConfig(
+        duration_s=duration,
+        writers=2,
+        readers=1,
+        subscribers=4,
+        slow_subscriber=True,
+        subscriber_procs=1,
+        kill_subscriber=True,
+        fault_possibility=20,  # the 5% headline rate
+        seed=seed,
+    )
+    report = run_soak(str(tmp_path), cfg, domain=f"subsoak{seed}")
+    assert report["consistent"], report
+    assert report["lost_rows"] == 0 and report["duplicated_rows"] == 0
+    assert report["sub_batches"] > 0 and report["sub_verifies"] > 0
+    assert report["sub_mismatches"] == 0
+    assert report["sub_shed_typed"] > 0, "the slow subscriber was never shed"
+    assert report["sub_shed_untyped"] == 0
+    assert report["sub_resumes"] > 0
+    assert report["subproc_kills"] == 1
+    assert report["leaked_file_count"] == 0
