@@ -317,6 +317,40 @@ class CommitMessage:
             or self.new_index_files
         )
 
+    def to_dict(self) -> dict:
+        """Wire form for shipping to a remote committer (the cluster
+        coordinator commits on behalf of its workers — the reference's
+        serializable sink/CommitMessage crossing the Flink network stack)."""
+        return {
+            "partition": list(self.partition),
+            "bucket": self.bucket,
+            "totalBuckets": self.total_buckets,
+            "newFiles": [f.to_dict() for f in self.new_files],
+            "compactBefore": [f.to_dict() for f in self.compact_before],
+            "compactAfter": [f.to_dict() for f in self.compact_after],
+            "changelogFiles": [f.to_dict() for f in self.changelog_files],
+            "compactChangelogFiles": [f.to_dict() for f in self.compact_changelog_files],
+            "newIndexFiles": [e.to_dict() for e in self.new_index_files],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "CommitMessage":
+        from .deletionvectors import IndexFileEntry
+
+        return CommitMessage(
+            partition=tuple(d["partition"]),
+            bucket=d["bucket"],
+            total_buckets=d["totalBuckets"],
+            new_files=[DataFileMeta.from_dict(f) for f in d.get("newFiles", ())],
+            compact_before=[DataFileMeta.from_dict(f) for f in d.get("compactBefore", ())],
+            compact_after=[DataFileMeta.from_dict(f) for f in d.get("compactAfter", ())],
+            changelog_files=[DataFileMeta.from_dict(f) for f in d.get("changelogFiles", ())],
+            compact_changelog_files=[
+                DataFileMeta.from_dict(f) for f in d.get("compactChangelogFiles", ())
+            ],
+            new_index_files=[IndexFileEntry.from_dict(e) for e in d.get("newIndexFiles", ())],
+        )
+
 
 @dataclass
 class ManifestCommittable:

@@ -116,12 +116,22 @@ class SchemaManager:
         self.file_io = file_io
         self.table_path = table_path
         self.schema_dir = f"{table_path}/schema"
+        # schema-N files are immutable once written (evolution only ever
+        # adds schema-(N+1)), so decoded schemas memoize per manager — the
+        # read path resolves every data file's schema_id for evolution
+        # mapping, and without this each read_all paid store RTTs re-reading
+        # bytes that cannot have changed
+        self._decoded: dict[int, TableSchema] = {}
 
     def schema_path(self, schema_id: int) -> str:
         return f"{self.schema_dir}/schema-{schema_id}"
 
     def schema(self, schema_id: int) -> TableSchema:
-        return TableSchema.from_json(self.file_io.read_bytes(self.schema_path(schema_id)))
+        out = self._decoded.get(schema_id)
+        if out is None:
+            out = TableSchema.from_json(self.file_io.read_bytes(self.schema_path(schema_id)))
+            self._decoded[schema_id] = out
+        return out
 
     def _listed_ids(self) -> list[int]:
         out = []

@@ -328,6 +328,29 @@ def io_metrics() -> MetricGroup:
     return registry.group("io")
 
 
+def cluster_metrics() -> MetricGroup:
+    """The cluster{...} group (coordinator/worker mesh execution,
+    paimon_tpu.service.cluster). Canonical members — counters:
+    workers_registered (worker registrations, respawned incarnations
+    included), rounds_committed (ingest rounds the coordinator committed on
+    behalf of workers), commits_rejected_stale (shipped CommitMessages
+    refused because a bucket's assignment epoch advanced past the shipper's
+    — the reassignment fence that prevents double-apply), reassignments
+    (bucket ownership moves after a missed-heartbeat death), compact_tasks
+    (compaction decisions dispatched to owning workers),
+    compact_commits (worker-executed compaction results the coordinator
+    committed), compact_conflicts (shipped compaction results abandoned to
+    a rival commit), admit_denied (worker admit RPCs answered not-admitted
+    because a target bucket sat at/over the read-amp ceiling — the
+    cluster-wide debt gate), charges_released (in-flight debt charges
+    dropped when their owning worker died), serve_gets (get_batch requests
+    served by worker serving planes), serve_subscribe_polls (subscribe
+    long-polls served by workers), join_parts_served (distributed join
+    partitions executed on workers). Gauges: workers_live, buckets_assigned.
+    Resolved per call so registry.reset() in tests swaps the group out."""
+    return registry.group("cluster")
+
+
 def sub_metrics() -> MetricGroup:
     """The sub{...} group (streaming CDC subscription service,
     paimon_tpu.service.subscription). Canonical members — gauges:

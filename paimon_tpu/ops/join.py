@@ -69,9 +69,35 @@ __all__ = [
     "join_batches",
     "materialize_join",
     "resolve_join_engine",
+    "partition_executor",
 ]
 
 _STRING_ROOTS = (TypeRoot.CHAR, TypeRoot.VARCHAR, TypeRoot.BINARY, TypeRoot.VARBINARY)
+
+# distributed-partition seam (ISSUE 15, declared PR 12 follow-up): when an
+# executor is installed, the skew-planned per-partition kernels run through
+# it — the cluster client routes partition i to the worker owning bucket
+# (i % num_buckets), so the JSPIM split spans worker processes. The executor
+# receives [(probe_lanes, build_lanes, algorithm, engine), ...] and returns
+# the per-partition (left_take, right_take) index pairs, which compose into
+# a JoinResult bit-identical to the local loop (partition order preserved).
+import contextlib
+import contextvars
+
+_PART_EXECUTOR: "contextvars.ContextVar" = contextvars.ContextVar(
+    "paimon_tpu_join_part_executor", default=None
+)
+
+
+@contextlib.contextmanager
+def partition_executor(fn):
+    """Install `fn([(ll, rl, algorithm, engine), ...]) -> [(lt, rt), ...]`
+    as the join-partition executor for the calling context."""
+    token = _PART_EXECUTOR.set(fn)
+    try:
+        yield
+    finally:
+        _PART_EXECUTOR.reset(token)
 
 
 class JoinError(ValueError):
@@ -555,10 +581,19 @@ def join_batches(
     if num_parts > 1:
         plan_p = _plan_partitions(ll, rl, enc.left_live, enc.right_live, num_parts, skew_factor)
         lt_all, rt_all = [], []
-        for probe_idx, build_idx in plan_p.parts:
-            lt, rt = _join_part(ll[probe_idx], rl[build_idx], algorithm, engine)
-            lt_all.append(probe_idx[lt])
-            rt_all.append(build_idx[rt])
+        part_exec = _PART_EXECUTOR.get()
+        if part_exec is not None:
+            pairs = part_exec(
+                [(ll[pi], rl[bi], algorithm, engine) for pi, bi in plan_p.parts]
+            )
+            for (probe_idx, build_idx), (lt, rt) in zip(plan_p.parts, pairs):
+                lt_all.append(probe_idx[lt])
+                rt_all.append(build_idx[rt])
+        else:
+            for probe_idx, build_idx in plan_p.parts:
+                lt, rt = _join_part(ll[probe_idx], rl[build_idx], algorithm, engine)
+                lt_all.append(probe_idx[lt])
+                rt_all.append(build_idx[rt])
         lt_g = np.concatenate(lt_all) if lt_all else np.empty(0, np.int64)
         rt_g = np.concatenate(rt_all) if rt_all else np.empty(0, np.int64)
         skew_keys, skew_rows = plan_p.skew_keys, plan_p.skew_split_rows

@@ -559,6 +559,58 @@ class CoreOptions:
         "a final sweep at threshold 0 runs after the drain regardless). "
         "0 = final sweep only.",
     )
+    CLUSTER_WORKERS = ConfigOption.int_(
+        "cluster.workers",
+        2,
+        "Cluster service (service.cluster): number of worker OS processes "
+        "the supervisor spawns. The coordinator splits the table's buckets "
+        "into contiguous ranges, one per worker; each worker runs its local "
+        "merge.engine=mesh executor over its shard and ships CommitMessages "
+        "back — only the coordinator commits (the reference's "
+        "single-parallelism committer).",
+    )
+    CLUSTER_DEVICES_PER_WORKER = ConfigOption.int_(
+        "cluster.devices-per-worker",
+        2,
+        "Cluster service: virtual (forced-host) or real devices each worker "
+        "process spans with its local mesh executor "
+        "(--xla_force_host_platform_device_count in the spawned child).",
+    )
+    CLUSTER_HEARTBEAT_INTERVAL = ConfigOption.duration(
+        "cluster.heartbeat-interval",
+        "500 ms",
+        "Cluster service: cadence of each worker's background heartbeat to "
+        "the coordinator (also how it learns of assignment epoch changes).",
+    )
+    CLUSTER_HEARTBEAT_TIMEOUT = ConfigOption.duration(
+        "cluster.heartbeat-timeout",
+        "4 s",
+        "Cluster service: a worker silent for this long is declared dead — "
+        "its bucket range is reassigned (exactly once) to live workers, its "
+        "in-flight debt-gate charges are released, and any CommitMessage it "
+        "later ships for a reassigned bucket is rejected as stale.",
+    )
+    CLUSTER_ROUND_ROWS = ConfigOption.int_(
+        "cluster.round-rows",
+        256,
+        "Cluster service soak/bench workers: rows per ingest round per "
+        "owned bucket.",
+    )
+    CLUSTER_ADMIT_TIMEOUT = ConfigOption.duration(
+        "cluster.admit-timeout",
+        "30 s",
+        "Cluster service: how long a worker keeps retrying the "
+        "coordinator's debt-admission gate (read-amp ceiling enforced "
+        "cluster-wide) before giving up on an ingest round.",
+    )
+    CLUSTER_COMPACTION_ENABLED = ConfigOption.bool_(
+        "cluster.compaction.enabled",
+        True,
+        "Cluster service: run the coordinator-scheduled, worker-executed "
+        "adaptive compaction drain (table.compactor policy deciding, the "
+        "bucket's owning worker rewriting, the coordinator committing). "
+        "Off = ingest only (read amplification unbounded).",
+    )
     ORPHAN_CLEAN_OLDER_THAN = ConfigOption.duration(
         "orphan.clean.older-than",
         "1 d",

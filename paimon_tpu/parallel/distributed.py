@@ -7,15 +7,37 @@ placing collectives on ICI within a slice / DCN across slices. The commit
 protocol needs no changes: it is a filesystem CAS, and only the coordinator
 (process_index 0) runs commits — exactly the reference's single-parallelism
 committer operator.
+
+`init_worker_runtime` is the cluster-service entry (service/cluster.py):
+a worker process either joins a real jax.distributed group (multi-host mode:
+coordinator address + process id provided) or falls back to its own
+single-process device set (forced-host virtual devices on CPU, the local
+chips on TPU) — the same mesh/executor code runs either way. The cluster
+role rides in PAIMON_TPU_CLUSTER_ROLE so `is_commit_coordinator` stays
+truthful even when jax.distributed was never initialized: a cluster worker
+must NEVER commit, no matter what process_index says in its private
+single-process runtime.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 
 from .mesh import make_mesh
 
-__all__ = ["init_multi_host", "is_commit_coordinator", "global_mesh"]
+__all__ = [
+    "init_multi_host",
+    "init_worker_runtime",
+    "is_commit_coordinator",
+    "global_mesh",
+    "ROLE_ENV",
+]
+
+# "coordinator" | "worker" — set by service/cluster.py in its children; when
+# absent the jax process index decides (single-process runs are coordinator)
+ROLE_ENV = "PAIMON_TPU_CLUSTER_ROLE"
 
 
 def init_multi_host(
@@ -32,9 +54,39 @@ def init_multi_host(
     )
 
 
+def init_worker_runtime(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+):
+    """Cluster-worker device runtime: join the jax.distributed group when a
+    multi-host topology is configured, else the single-process fallback (the
+    worker's own devices — virtual forced-host devices on CPU). Returns the
+    (bucket, key) mesh the worker's mesh executor should span.
+
+    The fallback is the production path for the OS-process cluster on one
+    host (service/cluster.py): each worker owns a private XLA runtime sized
+    by --xla_force_host_platform_device_count, and cross-worker exchange
+    rides the table protocol (CommitMessages to the coordinator), not
+    collectives — exactly the reference's task-manager topology."""
+    if num_processes is not None and num_processes > 1:
+        init_multi_host(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return global_mesh()
+
+
 def is_commit_coordinator() -> bool:
     """Only one process commits (the reference's single-parallelism
-    CommitterOperator); everyone else ships CommitMessages to it."""
+    CommitterOperator); everyone else ships CommitMessages to it. The
+    cluster role env wins over process_index: a cluster worker running its
+    own single-process jax runtime reports process_index 0, but it still
+    must ship, not commit."""
+    role = os.environ.get(ROLE_ENV)
+    if role:
+        return role == "coordinator"
     return jax.process_index() == 0
 
 

@@ -70,6 +70,7 @@ __all__ = [
     "disarm_crash_points",
     "COMMIT_CRASH_POINTS",
     "WRITER_CRASH_POINTS",
+    "CLUSTER_CRASH_POINTS",
     "ALL_CRASH_POINTS",
     "KILL_EXIT_CODE",
 ]
@@ -87,7 +88,15 @@ WRITER_CRASH_POINTS = (
     "flush:files-written",
 )
 
-ALL_CRASH_POINTS = COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS
+# the cluster-worker points instrumented in service/cluster.py: a worker
+# dying mid-compaction (rewrite executed, CommitMessage never shipped) and
+# one dying between prepare_commit and shipping its ingest round
+CLUSTER_CRASH_POINTS = (
+    "cluster:compact-executing",
+    "cluster:before-ship",
+)
+
+ALL_CRASH_POINTS = COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS + CLUSTER_CRASH_POINTS
 
 # 128 + SIGKILL: a hard death at a crash point reports like a kill -9 victim
 KILL_EXIT_CODE = 137

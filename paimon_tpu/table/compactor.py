@@ -343,7 +343,20 @@ class AdaptiveCompactorService:
 
     THREAD_PREFIX = "paimon-compactor"
 
-    def __init__(self, table: "FileStoreTable", policy: AdaptiveCompactionPolicy | None = None):
+    def __init__(
+        self,
+        table: "FileStoreTable",
+        policy: AdaptiveCompactionPolicy | None = None,
+        execute_group: "callable | None" = None,
+    ):
+        """`execute_group(group, deep) -> int`: pluggable execution seam.
+        None = the local path (_compact_group: rewrite + commit in this
+        process). The cluster coordinator (service/cluster.py) plugs in a
+        dispatcher that ships each decision to the worker OWNING that
+        bucket; the worker rewrites through its local mesh engine and ships
+        the CommitMessage back, and only the coordinator commits — the
+        observation, policy, pacing loop, and debt-admission gate here stay
+        identical, now enforced cluster-wide."""
         opts = table.options.options
         base = table.copy({"write-only": "false"}) if table.options.write_only else table
         if policy is None:
@@ -363,6 +376,7 @@ class AdaptiveCompactorService:
         )
         self.interval_s = opts.get(CoreOptions.COMPACTION_ADAPTIVE_INTERVAL) / 1000.0
         self.parallelism = max(1, opts.get(CoreOptions.COMPACTION_ADAPTIVE_PARALLELISM))
+        self._execute_group = execute_group
         self._pool = None
         self._prev: dict[tuple, tuple[int, float]] = {}  # (p, b) -> (max_seq, t)
         self._rate: dict[tuple, float] = {}
@@ -379,6 +393,10 @@ class AdaptiveCompactorService:
         self._runs_cond = threading.Condition()
         self._runs: dict[tuple, int] = {}
         self._inflight: dict[tuple, int] = {}
+        # owner -> charged bucket keys (with multiplicity): the cluster
+        # coordinator tags each worker's admissions so a worker killed
+        # mid-commit or mid-compaction releases exactly its own charges
+        self._owner_charges: dict[object, list[tuple]] = {}
 
     # ---- observation ---------------------------------------------------
     def observe(self) -> list[BucketShape]:
@@ -444,7 +462,9 @@ class AdaptiveCompactorService:
     def _projected(self, key) -> int:
         return self._runs.get(key, 0) + self._inflight.get(key, 0)
 
-    def admit(self, buckets=None, timeout_s: float = 30.0, project: bool = True) -> bool:
+    def admit(
+        self, buckets=None, timeout_s: float = 30.0, project: bool = True, owner=None
+    ) -> bool:
         """Admission for one ingest commit against the compaction-debt
         budget: blocks while any target bucket's PROJECTED sorted-run count
         (last observed runs + in-flight admitted commits) sits at/over the
@@ -481,13 +501,15 @@ class AdaptiveCompactorService:
             if admitted and project and targets is not None:
                 for k in targets:
                     self._inflight[k] = self._inflight.get(k, 0) + 1
+                if owner is not None:
+                    self._owner_charges.setdefault(owner, []).extend(targets)
         if waited:
             from ..metrics import compaction_metrics
 
             compaction_metrics().counter("admission_waits").inc()
         return admitted
 
-    def settle(self, buckets, landed: bool = True) -> None:
+    def settle(self, buckets, landed: bool = True, owner=None) -> None:
         """Release admit()'s in-flight charge after the commit landed or
         aborted (call from a finally:). A landed commit's charge moves into
         the observed half immediately — the next observation replaces it
@@ -496,14 +518,36 @@ class AdaptiveCompactorService:
         with self._runs_cond:
             for b in buckets:
                 for k in self._keys_for(b):
-                    cur = self._inflight.get(k, 0)
-                    if cur <= 1:
-                        self._inflight.pop(k, None)
-                    else:
-                        self._inflight[k] = cur - 1
-                    if landed:
-                        self._runs[k] = self._runs.get(k, 0) + 1
+                    self._settle_key(k, landed)
+                    if owner is not None:
+                        ledger = self._owner_charges.get(owner)
+                        if ledger is not None and k in ledger:
+                            ledger.remove(k)
+                            if not ledger:
+                                self._owner_charges.pop(owner, None)
             self._runs_cond.notify_all()
+
+    def _settle_key(self, k: tuple, landed: bool) -> None:
+        cur = self._inflight.get(k, 0)
+        if cur <= 1:
+            self._inflight.pop(k, None)
+        else:
+            self._inflight[k] = cur - 1
+        if landed:
+            self._runs[k] = self._runs.get(k, 0) + 1
+
+    def release_owner(self, owner) -> int:
+        """Drop every in-flight charge `owner` still holds — nothing of a
+        kill -9'd worker's un-shipped rounds will ever land, so its charges
+        must not keep blocking rival admissions at the ceiling. Returns the
+        number of charges released."""
+        with self._runs_cond:
+            ledger = self._owner_charges.pop(owner, None) or []
+            for k in ledger:
+                self._settle_key(k, landed=False)
+            if ledger:
+                self._runs_cond.notify_all()
+            return len(ledger)
 
     @staticmethod
     def _publish(shapes: list[BucketShape]) -> None:
@@ -544,6 +588,13 @@ class AdaptiveCompactorService:
         finally:
             tw.close()
         g.counter("adaptive_runs").inc(len(group))
+        self.note_compaction_landed(group)
+        return len(group)
+
+    def note_compaction_landed(self, group: list[CompactionDecision]) -> None:
+        """Bookkeeping after a group's COMPACT commit landed — shared by the
+        local path and a remote executor (the cluster coordinator calls this
+        when a worker's shipped compaction result commits)."""
         for d in group:
             self.policy.note_compacted(d.partition, d.bucket)
             if d.deep:
@@ -557,7 +608,6 @@ class AdaptiveCompactorService:
                     cur = self._runs.get(key, d.runs)
                     self._runs[key] = max(1, cur - d.runs + 1)
                     self._runs_cond.notify_all()
-        return len(group)
 
     def run_round(self) -> int:
         """One observe -> decide -> execute round; returns #buckets
@@ -573,6 +623,14 @@ class AdaptiveCompactorService:
         deep_group = [d for d in decisions if d.deep]
         shallow_group = [d for d in decisions if not d.deep]
         groups = [(grp, deep) for grp, deep in ((deep_group, True), (shallow_group, False)) if grp]
+        if self._execute_group is not None:
+            # remote execution seam (cluster coordinator): dispatch is the
+            # executor's business — it may be asynchronous (results commit
+            # when workers ship them), so no pool fan-out here
+            done = sum(self._execute_group(grp, deep) for grp, deep in groups)
+            self.rounds += 1
+            self.compactions += done
+            return done
         if len(groups) > 1 and self.parallelism > 1:
             # the two groups commit independently (snapshot CAS absorbs the
             # interleaving): fan them over the worker pool so deep drains

@@ -22,6 +22,7 @@ indexes (cache-friendly under sustained commit churn).
 
 from __future__ import annotations
 
+import threading
 from typing import TYPE_CHECKING, Sequence
 
 from ..lookup import LookupFileCache, LookupLevels
@@ -64,6 +65,10 @@ class LocalTableQuery:
         self._delta_indexes: dict[tuple, tuple] = {}  # (pb) -> (file names, BucketGetIndex)
         self._write: "TableWrite | None" = None
         self._snapshot_id: int | None = None
+        self._follow_thread: threading.Thread | None = None
+        self._follow_stop: threading.Event | None = None
+        self._follow_sub = None
+        self._follow_lock: threading.Lock | None = None
         self.refresh()
 
     def attach_write(self, table_write: "TableWrite | None") -> "LocalTableQuery":
@@ -125,6 +130,79 @@ class LocalTableQuery:
                 del self._levels[pb]
                 self._get_indexes.pop(pb, None)
                 self._bucket_sigs.pop(pb, None)
+
+    # ---- subscription-driven refresh ------------------------------------
+    def follow(self, hub=None, lock: "threading.Lock | None" = None) -> "LocalTableQuery":
+        """Subscription-driven refresh (the PR 13/14 declared follow-up):
+        instead of callers invoking refresh() per request, a hub
+        subscription (one shared decode-once tailer per table —
+        service.subscription.SubscriptionHub) signals every new snapshot
+        and refresh()'s existing per-bucket diff invalidates/rebuilds ONLY
+        the touched buckets. Compaction-only snapshots carry no changelog
+        rows, so the follower also compares the latest snapshot id on each
+        poll timeout — refresh() no-ops when nothing advanced.
+
+        `lock` (optional) serializes refresh against concurrent gets; pass
+        the same lock the serving layer wraps get_batch with (the cluster
+        worker serving plane and KvQueryServer do). Stop with unfollow()."""
+        if self._follow_thread is not None:
+            return self
+        from ..service.subscription import SubscriptionHub
+        from ..utils import new_file_name
+
+        hub = hub if hub is not None else SubscriptionHub.for_table(self.table)
+        self._follow_lock = lock if lock is not None else threading.Lock()
+        self._follow_stop = threading.Event()
+        # ephemeral consumer id, deleted on unfollow: a refresher must not
+        # pin snapshot expiry after it is gone
+        self._follow_sub = hub.subscribe(consumer_id=f"qryref-{new_file_name('c')}")
+        stop, sub, flock = self._follow_stop, self._follow_sub, self._follow_lock
+
+        def _loop():
+            while not stop.is_set():
+                advanced = False
+                try:
+                    batch = sub.poll(timeout=0.2)
+                    advanced = batch is not None
+                except Exception:
+                    # shed or hub teardown: fall back to snapshot-id polling
+                    # (refresh() keeps working without the signal)
+                    stop.wait(0.2)
+                try:
+                    if advanced:
+                        with flock:
+                            self.refresh()
+                    elif (
+                        self.store.snapshot_manager.latest_snapshot_id() != self._snapshot_id
+                    ):
+                        with flock:
+                            self.refresh()
+                except Exception:
+                    pass  # transient plan/IO failure: retried next poll
+
+        self._follow_thread = threading.Thread(
+            target=_loop, name=f"paimon-qryref-{id(self) & 0xFFFF:x}", daemon=False
+        )
+        self._follow_thread.start()
+        return self
+
+    def unfollow(self) -> None:
+        """Stop the subscription-driven refresher and release its consumer
+        pin. Safe to call when follow() was never started."""
+        t, self._follow_thread = self._follow_thread, None
+        if self._follow_stop is not None:
+            self._follow_stop.set()
+        if t is not None:
+            t.join(timeout=30.0)
+        sub, self._follow_sub = self._follow_sub, None
+        if sub is not None:
+            try:
+                sub.close(delete_consumer=True)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self.unfollow()
 
     # ---- batched path ---------------------------------------------------
     def get_batch(self, keys, partition: tuple = ()) -> GetResult:

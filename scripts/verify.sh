@@ -95,6 +95,22 @@
 #                              pinned-snapshot scan at its checkpoint, 0
 #                              lost/duplicated rows, 0 untyped sheds, and
 #                              the conftest thread/process-leak checks.
+#   scripts/verify.sh cluster  cluster-service stage: the coordinator/worker
+#                              suite (epoch fencing, reassigned-exactly-once,
+#                              debt-charge release on death, routed gets +
+#                              subscriptions, distributed join partitions,
+#                              subscription-driven query refresh), then a
+#                              ~45 s DETERMINISTIC cluster soak — 2 worker
+#                              OS processes x 2 virtual devices each running
+#                              merge.engine=mesh over their bucket ranges,
+#                              the coordinator as the only committer, the
+#                              cluster compaction service draining debt,
+#                              scripted kill -9 deaths (one mid-ingest-flush,
+#                              one MID-COMPACTION, one between prepare_commit
+#                              and the ship RPC) plus seeded random SIGKILLs
+#                              — asserting >= 2 kills survived, fold == final
+#                              scan, 0 lost/dup rows, 0 leaked files, and
+#                              sampled read-amp p99 <= the adaptive ceiling.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -230,6 +246,16 @@ if [ "${1:-}" = "subscribe" ]; then
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_SOAK_DURATION=45 PAIMON_TPU_SOAK_SEED=0 \
     timeout -k 10 600 python -m pytest tests/test_subscription.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "cluster" ]; then
+  env JAX_PLATFORMS=cpu \
+    timeout -k 10 400 python -m pytest tests/test_cluster.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  exec env JAX_PLATFORMS=cpu timeout -k 10 240 python -m paimon_tpu.service.cluster \
+    --duration 45 --workers 2 --readers 1 --seed 0 \
+    --scripted-kills "flush:files-written:2:kill,cluster:compact-executing:1:kill,cluster:before-ship:2:kill" \
+    --kill-period 10 --sweep-period 15 --min-kills 2
 fi
 
 if [ "${1:-}" = "encode" ]; then

@@ -1,0 +1,581 @@
+"""Cluster service: coordinator/worker mesh execution (ISSUE 15).
+
+In-process tests drive ClusterCoordinator.handle() and ClusterWorkerAgent
+directly (the TCP layer is a thin shim over both), so the failover edges —
+reassigned-exactly-once, stale-epoch commit fencing, debt-charge release on
+death — are deterministic. One bounded multi-process mini soak proves the
+whole topology end to end: worker OS processes with their own jax runtimes,
+kill -9 at a scripted crash point, journal recovery, and the proc-soak
+consistency oracle (fold == final scan, zero lost/dup/leaked).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paimon_tpu.core.manifest import CommitMessage, ManifestCommittable
+from paimon_tpu.core.schema import SchemaManager
+from paimon_tpu.fs import get_file_io
+from paimon_tpu.metrics import cluster_metrics
+from paimon_tpu.service.cluster import (
+    ClusterClient,
+    ClusterConfig,
+    ClusterCoordinator,
+    ClusterWorkerAgent,
+    bucket_key_pools,
+    run_cluster_soak,
+)
+from paimon_tpu.service.soak import SCHEMA
+from paimon_tpu.table import load_table
+
+
+def _mk_table(root: str, buckets: int = 4, **extra) -> None:
+    opts = {
+        "bucket": str(buckets),
+        "write-only": "true",
+        "merge.engine": "mesh",
+        "write-buffer-rows": "128",
+        "compaction.adaptive.read-amp-ceiling": "10",
+        "compaction.adaptive.interval": "200 ms",
+    }
+    opts.update(extra)
+    SchemaManager(get_file_io(root), root).create_table(SCHEMA, primary_keys=["k"], options=opts)
+
+
+@pytest.fixture
+def cluster_table(tmp_path):
+    root = str(tmp_path / "t")
+    _mk_table(root)
+    return root
+
+
+def _coordinator(root, workers=2, compaction=True, **kw) -> ClusterCoordinator:
+    cfg = ClusterConfig(workers=workers, buckets=4, compaction=compaction, **kw)
+    return ClusterCoordinator(root, cfg).start()
+
+
+def _agent(root, coord, wid, tmp_path=None, serve=False, **kw) -> ClusterWorkerAgent:
+    t = load_table(root, commit_user=f"cluster-w{wid}")
+    journal = str(tmp_path / f"journal-{wid}.jsonl") if tmp_path is not None else None
+    a = ClusterWorkerAgent(
+        wid, t, coord.host, coord.port, journal_path=journal, serve=serve,
+        round_rows=48, heartbeat_interval_s=0.1, **kw,
+    )
+    a.register()
+    return a
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+def test_commit_message_wire_roundtrip(cluster_table):
+    from paimon_tpu.table.write import TableWrite
+
+    t = load_table(cluster_table, commit_user="w")
+    tw = TableWrite(t)
+    tw.write({"k": list(range(64)), "v": [float(i) for i in range(64)]})
+    msgs = tw.prepare_commit()
+    tw.close()
+    assert msgs
+    for m in msgs:
+        rt = CommitMessage.from_dict(json_roundtrip(m.to_dict()))
+        assert rt.partition == m.partition and rt.bucket == m.bucket
+        assert [f.to_dict() for f in rt.new_files] == [f.to_dict() for f in m.new_files]
+        assert rt.total_buckets == m.total_buckets
+    # the wire form must actually commit
+    t.store.new_commit().commit(
+        ManifestCommittable(1, messages=[CommitMessage.from_dict(m.to_dict()) for m in msgs])
+    )
+    rb = t.new_read_builder()
+    assert rb.new_read().read_all(rb.new_scan().plan()).num_rows == 64
+
+
+def json_roundtrip(d):
+    import json
+
+    return json.loads(json.dumps(d))
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: worker startup path through parallel/distributed.py
+# ---------------------------------------------------------------------------
+def test_init_worker_runtime_single_process_fallback():
+    import jax
+
+    from paimon_tpu.parallel import distributed
+
+    mesh = distributed.init_worker_runtime()  # no topology: fallback
+    assert mesh.devices.size == len(jax.devices())
+    assert set(mesh.axis_names) == {"bucket", "key"}
+
+
+def test_cluster_role_env_overrides_commit_coordinator(monkeypatch):
+    from paimon_tpu.parallel import distributed
+
+    monkeypatch.delenv(distributed.ROLE_ENV, raising=False)
+    assert distributed.is_commit_coordinator()  # process_index 0 fallback
+    monkeypatch.setenv(distributed.ROLE_ENV, "worker")
+    assert not distributed.is_commit_coordinator()
+    monkeypatch.setenv(distributed.ROLE_ENV, "coordinator")
+    assert distributed.is_commit_coordinator()
+
+
+# ---------------------------------------------------------------------------
+# assignment + failover edges
+# ---------------------------------------------------------------------------
+def test_home_ranges_cover_and_registration_grants(cluster_table):
+    coord = _coordinator(cluster_table, workers=2, compaction=False)
+    try:
+        r0 = coord.handle("register", {"worker": 0, "incarnation": 0})
+        r1 = coord.handle("register", {"worker": 1, "incarnation": 0})
+        assert sorted(r0["buckets"] + r1["buckets"]) == [0, 1, 2, 3]
+        assert not set(r0["buckets"]) & set(r1["buckets"])
+        assert r1["epoch"] > r0["epoch"]
+    finally:
+        coord.close()
+
+
+def test_reassign_exactly_once_on_missed_heartbeat(cluster_table):
+    g = cluster_metrics()
+    before = g.counter("reassignments").count
+    coord = _coordinator(
+        cluster_table, workers=2, compaction=False, heartbeat_timeout_s=0.4
+    )
+    try:
+        coord.handle("register", {"worker": 0, "incarnation": 0})
+        coord.handle("register", {"worker": 1, "incarnation": 0})
+        w0_buckets = set(coord.assignment_of(0)[1])
+        assert w0_buckets
+        # w1 keeps heartbeating, w0 goes silent -> the reaper reassigns
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            coord.handle("heartbeat", {"worker": 1, "epoch": 0})
+            if set(coord.assignment_of(1)[1]) >= w0_buckets:
+                break
+            time.sleep(0.05)
+        _, w1_buckets = coord.assignment_of(1)
+        assert w0_buckets <= set(w1_buckets), (w0_buckets, w1_buckets)
+        assert coord.assignment_of(0)[1] == []
+        # each orphaned bucket moved EXACTLY once
+        assert g.counter("reassignments").count - before == len(w0_buckets)
+        # further reaper passes must not re-reassign (w1 keeps beating)
+        until = time.monotonic() + 0.8
+        while time.monotonic() < until:
+            coord.handle("heartbeat", {"worker": 1, "epoch": 0})
+            time.sleep(0.05)
+        assert g.counter("reassignments").count - before == len(w0_buckets)
+        # the declared-dead worker is told to re-register on its next beat
+        assert coord.handle("heartbeat", {"worker": 0, "epoch": 0}).get("reregister")
+    finally:
+        coord.close()
+
+
+def test_stale_commit_rejected_not_double_applied(cluster_table, tmp_path):
+    """Failover edge: a worker killed (or merely slow), its bucket range
+    reassigned, then heard from again — its late CommitMessage must be
+    rejected by the epoch fence, never double-applied."""
+    coord = _coordinator(cluster_table, workers=2, compaction=False)
+    try:
+        a0 = _agent(cluster_table, coord, 0, tmp_path)
+        coord.handle("register", {"worker": 1, "incarnation": 0})
+        epoch0, owned0 = a0.assignment()
+        assert a0.ingest_round()  # a normal round lands
+        store = load_table(cluster_table, commit_user="check").store
+        sid_before = store.snapshot_manager.latest_snapshot_id()
+        # build a full round's messages but DO NOT ship yet
+        from paimon_tpu.data.batch import ColumnBatch
+        from paimon_tpu.table.write import TableWrite
+
+        fresh, _, _ = a0.keygen.take(set(owned0), 16)
+        ks = [k for b in owned0 for k in fresh[b]]
+        tw = TableWrite(a0.table)
+        tw.write(ColumnBatch.from_pydict(SCHEMA, {"k": ks, "v": [1.0] * len(ks)}))
+        msgs = [m.to_dict() for m in tw.prepare_commit()]
+        tw.close()
+        # reassign w0's range while the ship is "in flight"
+        with coord._lock:
+            coord._reassign_dead(coord._slots[0])
+        r = coord.handle(
+            "ship_commit",
+            {"worker": 0, "epoch": epoch0, "ident": 99, "kind": "append", "messages": msgs},
+        )
+        assert r["stale"] and r["sid"] is None
+        assert store.snapshot_manager.latest_snapshot_id() == sid_before
+        a0.close()
+    finally:
+        coord.close()
+
+
+def test_killed_worker_releases_debt_charges(cluster_table):
+    """Failover edge: a worker killed mid-round (admitted, never shipped)
+    must not leave its debt-gate charges blocking rivals at the ceiling."""
+    g = cluster_metrics()
+    coord = _coordinator(cluster_table, workers=2, compaction=True)
+    try:
+        coord.handle("register", {"worker": 0, "incarnation": 0})
+        coord.handle("register", {"worker": 1, "incarnation": 0})
+        assert coord.handle("admit", {"worker": 0, "ident": 1, "buckets": [0, 1]})["admitted"]
+        svc = coord.compaction
+        with svc._runs_cond:
+            assert svc._inflight  # charges held
+        before = g.counter("charges_released").count
+        with coord._lock:
+            coord._reassign_dead(coord._slots[0])
+        with svc._runs_cond:
+            assert not svc._inflight  # released with the death
+        assert g.counter("charges_released").count - before == 2
+        assert (0, 1) not in coord._admit_charges
+    finally:
+        coord.close()
+
+
+def test_worker_killed_mid_compaction_releases_task_marks(cluster_table, tmp_path):
+    """A compaction decision dispatched to a worker that dies must be
+    re-dispatchable after the death (inflight mark dropped), and the dead
+    worker's queued tasks vanish."""
+    from paimon_tpu.table.compactor import CompactionDecision
+
+    coord = _coordinator(cluster_table, workers=2, compaction=True)
+    try:
+        coord.handle("register", {"worker": 0, "incarnation": 0})
+        coord.handle("register", {"worker": 1, "incarnation": 0})
+        wid = coord._owner[0]
+        d = CompactionDecision((), 0, False, "hot", 3)
+        assert coord._dispatch_group([d], False) == 1
+        assert coord._compact_inflight  # marked in flight
+        assert coord._dispatch_group([d], False) == 0  # no double dispatch
+        with coord._lock:
+            coord._reassign_dead(coord._slots[wid])
+        assert not coord._compact_inflight
+        # the bucket has a live owner again: re-decidable
+        assert coord._dispatch_group([d], False) == 1
+    finally:
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# ingest + compaction + recovery (in-process agents)
+# ---------------------------------------------------------------------------
+def test_ingest_rounds_commit_through_coordinator(cluster_table, tmp_path):
+    coord = _coordinator(cluster_table, workers=2)
+    agents = []
+    try:
+        agents = [_agent(cluster_table, coord, w, tmp_path) for w in range(2)]
+        for a in agents:
+            a.start_heartbeats()
+        for _ in range(3):
+            for a in agents:
+                assert a.ingest_round()
+            for a in agents:
+                a.poll_and_compact()
+        store = load_table(cluster_table, commit_user="check").store
+        latest = store.snapshot_manager.latest_snapshot()
+        assert latest is not None
+        # every APPEND snapshot was committed by the coordinator's
+        # per-worker handle, none by a worker process itself
+        rb = load_table(cluster_table, commit_user="check").new_read_builder()
+        out = rb.new_read().read_all(rb.new_scan().plan())
+        expect = {k for a in agents for ks in a.landed_by_bucket.values() for k in ks}
+        assert set(out.column("k").values.tolist()) == expect
+    finally:
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+def test_journal_recovery_resolves_landed_unacked(cluster_table, tmp_path):
+    """Kill between the coordinator's commit and the worker's ack: the next
+    incarnation must adopt the landed round from the snapshot chain (a
+    `recovered` journal record), never replay it."""
+    from paimon_tpu.service.proc_soak import WriterJournal
+
+    coord = _coordinator(cluster_table, workers=1, compaction=False)
+    try:
+        a0 = _agent(cluster_table, coord, 0, tmp_path)
+        assert a0.ingest_round()
+        epoch, owned = a0.assignment()
+        # round 2: ship lands at the coordinator, but the "worker" dies
+        # before journaling the ack — simulate by writing the intent and
+        # shipping, then dropping the ack on the floor
+        from paimon_tpu.data.batch import ColumnBatch
+        from paimon_tpu.table.write import TableWrite
+
+        ident = a0.next_ident
+        fresh, start, span = a0.keygen.take(set(owned), 8)
+        rows = {k: 7.0 for b in owned for k in fresh[b]}
+        a0.journal.intent(ident, start, span, rows)
+        tw = TableWrite(a0.table)
+        tw.write(ColumnBatch.from_pydict(SCHEMA, {"k": list(rows), "v": list(rows.values())}))
+        msgs = [m.to_dict() for m in tw.prepare_commit()]
+        tw.close()
+        r = coord.handle(
+            "ship_commit",
+            {"worker": 0, "epoch": epoch, "ident": ident, "kind": "append", "messages": msgs},
+        )
+        assert r["sid"] is not None
+        a0.close()  # no ack written: the incarnation is gone
+        # next incarnation recovers the landed round from the chain
+        a1 = _agent(cluster_table, coord, 0, tmp_path, incarnation=1)
+        assert a1.recovered == 1
+        events = WriterJournal.read(str(tmp_path / "journal-0.jsonl"))
+        assert any(e["t"] == "recovered" and e["ident"] == ident for e in events)
+        # the adopted keys are update candidates, not re-minted
+        assert set(rows) <= {k for ks in a1.landed_by_bucket.values() for k in ks}
+        assert a1.next_ident == ident + 1
+        a1.close()
+    finally:
+        coord.close()
+
+
+def test_cluster_compaction_drains_read_amp(cluster_table, tmp_path):
+    """Coordinator-scheduled, worker-executed drain: sustained write-only
+    ingest piles L0 runs; the dispatched compactions must bring every
+    bucket's sorted-run count back under the ceiling."""
+    coord = _coordinator(cluster_table, workers=1, compaction=True)
+    a0 = None
+    try:
+        a0 = _agent(cluster_table, coord, 0, tmp_path)
+        a0.start_heartbeats()
+        for _ in range(8):
+            assert a0.ingest_round()
+            a0.poll_and_compact()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            a0.poll_and_compact()
+            shapes = coord.compaction.observe()
+            if shapes and max(s.runs for s in shapes) <= 3:
+                break
+            time.sleep(0.2)
+        assert shapes and max(s.runs for s in shapes) <= coord.compaction.policy.read_amp_ceiling
+        assert cluster_metrics().counter("compact_commits").count > 0
+    finally:
+        if a0 is not None:
+            a0.close()
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# serving plane: routed gets, routed subscriptions, distributed joins
+# ---------------------------------------------------------------------------
+def test_routed_get_batch_and_subscribe_parity(cluster_table, tmp_path):
+    from paimon_tpu.table.query import LocalTableQuery
+
+    coord = _coordinator(cluster_table, workers=2, compaction=False)
+    agents, cli = [], None
+    try:
+        agents = [_agent(cluster_table, coord, w, tmp_path, serve=True) for w in range(2)]
+        for a in agents:
+            a.start_heartbeats()
+        for _ in range(2):
+            for a in agents:
+                assert a.ingest_round()
+        cli = ClusterClient(load_table(cluster_table, commit_user="cli"), coord.host, coord.port)
+        keys = [k for a in agents for ks in a.landed_by_bucket.values() for k in ks[:4]]
+        keys.append(10**9)  # absent
+        oracle = LocalTableQuery(load_table(cluster_table, commit_user="oracle"))
+        want = []
+        for k in keys:
+            d = oracle.lookup((), (k,))
+            want.append(None if d is None else tuple(d.to_pylist()[0]))
+        # serving is refresh-driven (the worker's followed query catches the
+        # last commit through its subscription): poll until converged
+        deadline = time.monotonic() + 20.0
+        rows = cli.get_batch(keys)
+        while rows != want and time.monotonic() < deadline:
+            time.sleep(0.2)
+            rows = cli.get_batch(keys)
+        assert rows == want
+        assert cluster_metrics().counter("serve_gets").count > 0
+        # routed subscription: per-worker bucket-filtered folds union to the scan
+        rb = load_table(cluster_table, commit_user="scan").new_read_builder()
+        out = rb.new_read().read_all(rb.new_scan().plan())
+        scan = dict(zip(out.column("k").values.tolist(), out.column("v").values.tolist()))
+        subs = cli.subscribe(from_snapshot=1)
+        assert len(subs) == 2  # one per owning worker
+        fold = {}
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline and set(fold) != set(scan):
+            for _wid, h in subs:
+                for kind, k, v in h.poll(timeout_ms=250).get("rows", []):
+                    if kind in ("+I", "+U"):
+                        fold[k] = v
+                    elif kind == "-D":
+                        fold.pop(k, None)
+        assert fold == scan
+        for _wid, h in subs:
+            h.close()
+    finally:
+        if cli is not None:
+            cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+def test_distributed_join_partitions_parity(cluster_table):
+    """Satellite (PR 12 follow-up): the JSPIM skew split spans workers —
+    partition kernels route through the worker/bucket assignment and the
+    result is bit-identical to the single-process join."""
+    from paimon_tpu.data.batch import ColumnBatch
+    from paimon_tpu.ops.join import join_batches, partition_executor
+    from paimon_tpu.types import BIGINT, RowType
+
+    coord = _coordinator(cluster_table, workers=2, compaction=False)
+    agents, cli = [], None
+    try:
+        agents = [_agent(cluster_table, coord, w, serve=True) for w in range(2)]
+        rng = np.random.default_rng(11)
+        n, m = 6000, 800
+        lk = rng.integers(0, 900, n).astype(np.int64)
+        lk[: n // 2] = 17  # heavy hitter
+        left = ColumnBatch.from_pydict(
+            RowType.of(("id", BIGINT()), ("x", BIGINT())),
+            {"id": lk, "x": np.arange(n, dtype=np.int64)},
+        )
+        right = ColumnBatch.from_pydict(
+            RowType.of(("id", BIGINT()), ("y", BIGINT())),
+            {"id": np.arange(m, dtype=np.int64), "y": np.arange(m, dtype=np.int64) * 3},
+        )
+        opts = {"join.partitions": 4, "join.skew-factor": 0.3}
+        local = join_batches(left, right, ["id"], ["id"], options=opts)
+        cli = ClusterClient(load_table(cluster_table, commit_user="cli"), coord.host, coord.port)
+        before = cluster_metrics().counter("join_parts_served").count
+        with partition_executor(cli.partition_executor()):
+            dist = join_batches(left, right, ["id"], ["id"], options=opts)
+        assert np.array_equal(local.left_take, dist.left_take)
+        assert np.array_equal(local.right_take, dist.right_take)
+        assert dist.stats["skew_keys"] >= 1  # the split really spanned workers
+        assert cluster_metrics().counter("join_parts_served").count - before == 4
+    finally:
+        if cli is not None:
+            cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: subscription-driven refresh of LocalTableQuery
+# ---------------------------------------------------------------------------
+def test_follow_refresh_matches_manual_refresh(cluster_table):
+    from paimon_tpu.core.manifest import ManifestCommittable
+    from paimon_tpu.table.query import LocalTableQuery
+    from paimon_tpu.table.write import TableWrite
+
+    t = load_table(cluster_table, commit_user="w")
+
+    def commit(ident, ks):
+        tw = TableWrite(t)
+        tw.write({"k": ks, "v": [float(k) * 2 for k in ks]})
+        msgs = tw.prepare_commit()
+        tw.close()
+        t.store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+
+    commit(1, list(range(200)))
+    from paimon_tpu.service.subscription import SubscriptionHub
+
+    hub = SubscriptionHub.for_table(t)
+    followed = LocalTableQuery(t).follow(hub=hub)
+    try:
+        commit(2, list(range(200, 260)))
+        deadline = time.monotonic() + 15.0
+        served = None
+        while time.monotonic() < deadline:
+            served = followed.get_batch([(205,)]).to_pylist()[0]
+            if served is not None:
+                break
+            time.sleep(0.1)
+        manual = LocalTableQuery(t)  # fresh build == manual refresh
+        assert served == manual.get_batch([(205,)]).to_pylist()[0] == (205, 410.0)
+    finally:
+        followed.unfollow()
+        hub.close()
+
+
+def test_follow_refresh_rebuilds_only_touched_buckets(cluster_table):
+    """The follower rides refresh()'s per-bucket diff: a commit touching one
+    bucket must leave the other buckets' probe indexes untouched (object
+    identity), while the touched bucket rebuilds."""
+    from paimon_tpu.core.manifest import ManifestCommittable
+    from paimon_tpu.service.subscription import SubscriptionHub
+    from paimon_tpu.table.query import LocalTableQuery
+    from paimon_tpu.table.write import TableWrite
+
+    t = load_table(cluster_table, commit_user="w")
+    tw = TableWrite(t)
+    tw.write({"k": list(range(400)), "v": [0.0] * 400})
+    t.store.new_commit().commit(ManifestCommittable(1, messages=tw.prepare_commit()))
+    tw.close()
+    hub = SubscriptionHub.for_table(t)
+    q = LocalTableQuery(t).follow(hub=hub)
+    try:
+        ids_before = {pb: id(ix) for pb, ix in q._get_indexes.items()}
+        assert len(ids_before) == 4
+        # find keys of exactly one bucket and commit only them
+        pools = bucket_key_pools(4, 1000, 32)
+        target_keys = pools[2].tolist()
+        tw = TableWrite(t)
+        tw.write({"k": target_keys, "v": [9.0] * len(target_keys)})
+        t.store.new_commit().commit(ManifestCommittable(2, messages=tw.prepare_commit()))
+        tw.close()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if q.get_batch([(target_keys[0],)]).to_pylist()[0] is not None:
+                break
+            time.sleep(0.1)
+        ids_after = {pb: id(ix) for pb, ix in q._get_indexes.items()}
+        assert ids_after[((), 2)] != ids_before[((), 2)]  # touched: rebuilt
+        for pb in ids_before:
+            if pb != ((), 2):
+                assert ids_after[pb] == ids_before[pb]  # untouched: kept warm
+    finally:
+        q.unfollow()
+        hub.close()
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def test_bucket_key_pools_deterministic_and_routed(cluster_table):
+    from paimon_tpu.data.batch import ColumnBatch
+    from paimon_tpu.table.bucket import bucket_ids
+    from paimon_tpu.types import BIGINT, RowType
+
+    a = bucket_key_pools(4, 0, 50)
+    b = bucket_key_pools(4, 0, 50)
+    rt = RowType.of(("k", BIGINT()))
+    for bucket in range(4):
+        assert np.array_equal(a[bucket], b[bucket])
+        assert len(a[bucket]) == 50
+        routed = bucket_ids(
+            ColumnBatch.from_pydict(rt, {"k": a[bucket]}), ["k"], 4
+        )
+        assert (routed == bucket).all()
+
+
+# ---------------------------------------------------------------------------
+# the multi-process mini soak (bounded; the 45 s stage soak lives in
+# scripts/verify.sh cluster)
+# ---------------------------------------------------------------------------
+def test_cluster_mini_soak_multiprocess(tmp_path):
+    cfg = ClusterConfig(
+        workers=2,
+        devices_per_worker=2,
+        buckets=4,
+        duration_s=10.0,
+        readers=1,
+        round_rows=48,
+        scripted_kills=("flush:files-written:2:kill",),
+        kill_period_s=0.0,  # scripted only: deterministic and bounded
+        sweep_period_s=0.0,
+        seed=3,
+    )
+    report = run_cluster_soak(str(tmp_path), cfg)
+    assert report["consistent"], report
+    assert report["procs_killed"] >= 1, report
+    assert report["accepted_commits"] > 0
+    assert report["lost_rows"] == 0 and report["duplicated_rows"] == 0
+    assert report["leaked_file_count"] == 0
+    assert report["read_errors"] == 0
