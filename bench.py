@@ -473,6 +473,22 @@ def bench_mesh() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_sql_cluster() -> list:
+    """Distributed SQL spot-check (benchmarks/sql_cluster_bench.py is the
+    dedicated 1/2/4-worker sweep with the >=3x headline): scatter-gather
+    aggregate queries against serve-mode worker OS processes behind a
+    latency-shaped store, every timed pass asserting the distributed result
+    bit-identical to the single-process evaluator and that partial
+    aggregates really reduced on workers (sql{rows_reduced_device})."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "sql_cluster_bench.py")
+    spec = importlib.util.spec_from_file_location("_sql_cluster_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_resilience() -> dict:
     """Commit resilience spot-check (benchmarks/resilience_bench.py is the
     dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
@@ -541,6 +557,7 @@ def main():
         pipeline_rows = bench_pipeline()
         encode_rows = bench_encode()
         mesh_rows = bench_mesh()
+        sql_cluster_rows = bench_sql_cluster()
         resilience_row = bench_resilience()
         soak_row = bench_soak()
         row = {
@@ -594,6 +611,8 @@ def main():
             print(json.dumps(dict(erow, platform=_PLATFORM)))
         for mrow in mesh_rows:
             print(json.dumps(dict(mrow, platform=_PLATFORM)))
+        for qrow in sql_cluster_rows:
+            print(json.dumps(dict(qrow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
         print(json.dumps(dict(soak_row, platform=_PLATFORM)))
     finally:

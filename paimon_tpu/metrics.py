@@ -34,6 +34,7 @@ __all__ = [
     "pallas_metrics",
     "pipeline_metrics",
     "soak_metrics",
+    "sql_metrics",
     "sub_metrics",
 ]
 
@@ -349,6 +350,25 @@ def cluster_metrics() -> MetricGroup:
     partitions executed on workers). Gauges: workers_live, buckets_assigned.
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("cluster")
+
+
+def sql_metrics() -> MetricGroup:
+    """The sql{...} group (distributed SQL scatter-gather,
+    paimon_tpu.sql.cluster + the shared GROUP BY segment-reduce in
+    sql.select / ops.aggregates). Canonical members — counters: fragments
+    (per-worker scan fragments dispatched), fragments_retried (fragments
+    re-dispatched after a worker death or connection loss),
+    partials_combined (worker partial-aggregate payloads folded at the
+    coordinator), rows_reduced_device (input rows reduced by the jitted
+    segment-reduce kernel — single-process GROUP BY and worker partials
+    both count; the numpy twin does not), code_domain_groups (groups whose
+    keys travelled coordinator-ward as dictionary codes + pruned pools,
+    never expanded), rows_streamed (non-aggregate rows gathered back
+    Arrow-encoded); histograms: scatter_ms (dispatch + worker execution +
+    gather wall millis per query), combine_ms (coordinator-side code-domain
+    combine wall millis per aggregate query). Resolved per call so
+    registry.reset() in tests swaps the group out."""
+    return registry.group("sql")
 
 
 def sub_metrics() -> MetricGroup:

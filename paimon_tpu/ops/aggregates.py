@@ -29,7 +29,13 @@ from ..data.batch import Column
 from ..types import RowKind
 from .merge import MergePlan, pad_to
 
-__all__ = ["AggregateSpec", "aggregate_merge", "AGGREGATORS"]
+__all__ = [
+    "AggregateSpec",
+    "aggregate_merge",
+    "AGGREGATORS",
+    "segment_reduce",
+    "segment_reduce_np",
+]
 
 AGGREGATORS = (
     "sum",
@@ -435,6 +441,162 @@ def _gather_column(column: Column, src: np.ndarray) -> Column:
     if column.values.dtype != np.dtype(object):
         vals = np.where(validity, vals, np.zeros((), column.values.dtype))
     return Column(vals, validity if not validity.all() else None)
+
+
+# ---- GROUP BY segment-reduce (ISSUE 16) ---------------------------------
+#
+# The SQL group-by primitive: group keys arrive as uint32 lanes (dictionary
+# codes or narrowed fixed-width values), value columns reduce per segment in
+# ONE fused sort+reduce kernel through the same sorted_segments seam the
+# merge path uses — pallas/xla/lane-compression all inherit it. Unlike
+# aggregate_merge there is no sequence dimension and no retract handling:
+# every row contributes, and the caller additionally gets each group's
+# minimum input position so first-appearance output order (and distributed
+# combines keyed on global row position) stay exact.
+
+_SEGMENT_REDUCE_FNS = ("sum", "count", "min", "max")
+
+
+@functools.lru_cache(maxsize=None)
+def _segment_reduce_fn(num_lanes: int, col_fns: tuple[str, ...], engine: str = "xla"):
+    from .merge import pack_selected, sorted_segments
+
+    @jax.jit
+    def f(key_lanes, pad_flag, pos, values, valids):
+        m = pad_flag.shape[0]
+        pad_sorted, perm, seg_start, _keep_last, seg_id = sorted_segments(
+            num_lanes, 0, key_lanes, [], pad_flag, engine=engine
+        )
+        outs = []
+        anyv = []
+        for i, fn in enumerate(col_fns):
+            v = values[i][perm]
+            ok = valids[i][perm]
+            if fn in ("sum", "count"):
+                contrib = jnp.where(ok, v, jnp.zeros((), v.dtype))
+                agg = jax.ops.segment_sum(contrib, seg_id, num_segments=m)
+            else:
+                is_max = fn == "max"
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    fill = jnp.finfo(v.dtype).min if is_max else jnp.finfo(v.dtype).max
+                else:
+                    fill = jnp.iinfo(v.dtype).min if is_max else jnp.iinfo(v.dtype).max
+                masked = jnp.where(ok, v, fill)
+                agg = (
+                    jax.ops.segment_max(masked, seg_id, num_segments=m)
+                    if is_max
+                    else jax.ops.segment_min(masked, seg_id, num_segments=m)
+                )
+            outs.append(agg)
+            anyv.append(jax.ops.segment_max(ok.astype(jnp.int32), seg_id, num_segments=m) > 0)
+        first_pos = jax.ops.segment_min(pos[perm], seg_id, num_segments=m)
+        packed, count = pack_selected(seg_start & (pad_sorted == 0), perm)
+        return tuple(outs), tuple(anyv), first_pos, packed, count
+
+    return f
+
+
+def segment_reduce(
+    key_lanes: np.ndarray,  # (n, K) uint32
+    columns: list[tuple[np.ndarray, np.ndarray | None]],  # (values, valid) per column
+    fns: tuple[str, ...],  # sum|count|min|max per column
+    pos: np.ndarray | None = None,  # (n,) int64 global row positions
+    engine: str = "xla",
+    compress: bool | None = None,
+):
+    """Segment-reduce `columns` over groups keyed by `key_lanes` rows.
+
+    Returns ``(rep, outs, anyv, first_pos)`` with groups in KEY order:
+    ``rep[g]`` is the input index of one representative row of group g,
+    ``outs[i][g]`` the reduction of column i over group g (masked rows
+    contribute identity), ``anyv[i][g]`` whether any row of group g was
+    valid for column i, and ``first_pos[g]`` the minimum `pos` over the
+    group (first-appearance ordering / distributed combine key).
+
+    Engines: "numpy" routes to the exact host twin; f64 columns leave the
+    device on TPU backends (no native f64, same rule as aggregate_merge);
+    fully constant key lanes (k == 0 after compression) take the twin too —
+    a single group is not worth a device round trip."""
+    from .merge import prepare_lanes_planned
+
+    n = int(key_lanes.shape[0])
+    if pos is None:
+        pos = np.arange(n, dtype=np.int64)
+    vals = [(v, np.ones(n, np.bool_) if ok is None else ok) for v, ok in columns]
+    if (
+        engine == "numpy"
+        or n == 0
+        or (
+            _f64_on_device_unsupported()
+            and any(v.dtype == np.float64 for v, _ in vals)
+        )
+    ):
+        return segment_reduce_np(key_lanes, vals, fns, pos)
+    klp, slp, pad, _n, k, s, m, _plan = prepare_lanes_planned(key_lanes, None, compress=compress)
+    if k == 0:
+        return segment_reduce_np(key_lanes, vals, fns, pos)
+    from ..metrics import sql_metrics
+
+    sql_metrics().counter("rows_reduced_device").inc(n)
+    if engine == "pallas":
+        from .pallas_kernels import note_dispatch
+
+        note_dispatch(m, 1 + k)
+    big = np.iinfo(np.int64).max
+    outs, anyv, first_pos, packed, count = _segment_reduce_fn(k, tuple(fns), engine)(
+        klp,
+        pad,
+        jnp.asarray(pad_to(pos.astype(np.int64, copy=False), m, big)),
+        tuple(jnp.asarray(pad_to(v, m, 0)) for v, _ in vals),
+        tuple(jnp.asarray(pad_to(ok, m, False)) for _, ok in vals),
+    )
+    g = int(count)
+    return (
+        np.asarray(packed[:g]),
+        [np.asarray(o[:g]).astype(v.dtype, copy=False) for o, (v, _) in zip(outs, vals)],
+        [np.asarray(a[:g]) for a in anyv],
+        np.asarray(first_pos[:g]),
+    )
+
+
+def segment_reduce_np(
+    key_lanes: np.ndarray,
+    columns: list[tuple[np.ndarray, np.ndarray]],
+    fns: tuple[str, ...],
+    pos: np.ndarray,
+):
+    """Exact numpy twin of segment_reduce: lexsort + reduceat, identical
+    output contract (groups in key order)."""
+    n = int(key_lanes.shape[0])
+    if n == 0:
+        return (
+            np.zeros(0, np.int64),
+            [np.zeros(0, v.dtype) for v, _ in columns],
+            [np.zeros(0, np.bool_) for _ in columns],
+            np.zeros(0, np.int64),
+        )
+    kk = key_lanes.shape[1]
+    order = np.lexsort(tuple(key_lanes[:, i] for i in range(kk - 1, -1, -1)))
+    sk = key_lanes[order]
+    neq = (sk[1:] != sk[:-1]).any(axis=1) if n > 1 else np.zeros(0, np.bool_)
+    starts = np.flatnonzero(np.concatenate([[True], neq]))
+    outs = []
+    anyv = []
+    for (v, ok), fn in zip(columns, fns):
+        vs = v[order]
+        oks = ok[order]
+        if fn in ("sum", "count"):
+            contrib = np.where(oks, vs, np.zeros((), v.dtype))
+            outs.append(np.add.reduceat(contrib, starts))
+        elif fn == "max":
+            fill = np.finfo(v.dtype).min if v.dtype.kind == "f" else np.iinfo(v.dtype).min
+            outs.append(np.maximum.reduceat(np.where(oks, vs, fill), starts))
+        else:
+            fill = np.finfo(v.dtype).max if v.dtype.kind == "f" else np.iinfo(v.dtype).max
+            outs.append(np.minimum.reduceat(np.where(oks, vs, fill), starts))
+        anyv.append(np.maximum.reduceat(oks.astype(np.int8), starts) > 0)
+    first_pos = np.minimum.reduceat(pos[order], starts)
+    return order[starts], outs, anyv, first_pos
 
 
 def _host_aggregate(plan: MergePlan, values, valid, spec: AggregateSpec, row_kind) -> Column:

@@ -50,6 +50,7 @@ __all__ = [
     "unify_columns",
     "prune_pool",
     "cache_usable",
+    "encode_column",
 ]
 
 DEFAULT_POOL_LIMIT = 1 << 20  # codes stay far inside uint32/int32 range
@@ -245,6 +246,46 @@ def unify_columns(cols: Sequence, validity: np.ndarray | None, limit: int | None
         [remap_codes(r, c.dict_cache[1]) for r, c in zip(remaps, cols)]
     )
     return Column.from_codes(unified, codes, validity)
+
+
+def encode_column(col) -> tuple[np.ndarray, np.ndarray]:
+    """One Column → (sorted pool, uint32 codes) with NULL rows encoded as the
+    sentinel code ``len(pool)`` — the GROUP-BY key currency (ISSUE 16).
+
+    Code-backed columns stay in the compressed domain: their cached pool is
+    pruned to the referenced entries and the cached codes re-rank without a
+    value ever materializing. Expanded columns encode via np.unique over the
+    valid subset (fixed-width pools keep their native dtype, strings
+    normalize to object); a mixed-type object column that numpy cannot sort
+    falls back to a first-seen dict walk — the pool may then be unsorted,
+    which is fine for grouping (equality is all that matters) and unify_pools
+    re-sorts the concatenation anyway."""
+    n = len(col)
+    valid = col.valid_mask()
+    if cache_usable(col):
+        pool, codes = col.dict_cache
+        pool, codes = prune_pool(pool, codes, None if valid.all() else valid)
+        codes = codes.astype(np.uint32, copy=True)
+        codes[~valid] = len(pool)
+        return pool, codes
+    values = col.values
+    live = values[valid]
+    codes = np.empty(n, dtype=np.uint32)
+    try:
+        pool, inv = np.unique(live, return_inverse=True)
+        if pool.dtype != np.dtype(object) and values.dtype == np.dtype(object):
+            pool = pool.astype(object)
+    except TypeError:
+        seen: dict = {}
+        inv = np.empty(len(live), dtype=np.uint32)
+        for i, v in enumerate(live):
+            inv[i] = seen.setdefault(v, len(seen))
+        pool = np.empty(len(seen), dtype=object)
+        for v, c in seen.items():
+            pool[c] = v
+    codes[valid] = inv.astype(np.uint32, copy=False)
+    codes[~valid] = len(pool)
+    return pool, codes
 
 
 def prune_pool(

@@ -611,6 +611,33 @@ class CoreOptions:
         "bucket's owning worker rewriting, the coordinator committing). "
         "Off = ingest only (read amplification unbounded).",
     )
+    SQL_CLUSTER_CODE_DOMAIN = ConfigOption.bool_(
+        "sql.cluster.code-domain",
+        True,
+        "Distributed SQL (sql.cluster): ship GROUP BY keys coordinator-ward "
+        "as (pruned dictionary pool, uint32 codes) and combine partials in "
+        "the code domain via pool unification — no group key string ever "
+        "expands on the wire or at the coordinator. Off = workers expand "
+        "group key values and the coordinator re-encodes them. The "
+        "PAIMON_TPU_SQL_CODE_DOMAIN env var overrides in either direction "
+        "(the verify stage forces both paths).",
+    )
+    SQL_CLUSTER_SCAN_MAX_INFLIGHT = ConfigOption.int_(
+        "sql.cluster.scan.max-inflight",
+        4,
+        "Distributed SQL: concurrent scan_frag fragments a worker serving "
+        "plane executes before answering a typed BUSY (retry_after_ms) — "
+        "a scan storm must not starve get_batch/subscribe serving. Shed "
+        "fragments count into soak{shed_requests} beside every other "
+        "serving-plane BUSY.",
+    )
+    SQL_CLUSTER_RETRY_TIMEOUT = ConfigOption.duration(
+        "sql.cluster.retry-timeout",
+        "30 s",
+        "Distributed SQL: how long the coordinator keeps re-dispatching a "
+        "query's unfinished fragments across route refreshes (worker "
+        "deaths, reassignments, BUSY sheds) before the query fails.",
+    )
     ORPHAN_CLEAN_OLDER_THAN = ConfigOption.duration(
         "orphan.clean.older-than",
         "1 d",

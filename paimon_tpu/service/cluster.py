@@ -673,12 +673,19 @@ class _WorkerServer:
     exactly its shard's changelog."""
 
     def __init__(self, table, owned: "callable", host: str = "127.0.0.1", port: int = 0):
+        from ..options import CoreOptions
         from ..table.query import LocalTableQuery
         from .subscription import SubscriptionHub
 
         self.table = table
         self._owned = owned  # () -> set[int], the worker's live bucket set
         self._lock = threading.Lock()
+        # scan_frag admission (ISSUE 16, the PR 13 semaphore + retry_after
+        # pattern): a scan storm sheds typed-BUSY instead of starving the
+        # get/subscribe serving this plane exists for
+        self._scan_slots = threading.BoundedSemaphore(
+            max(1, int(table.store.options.options.get(CoreOptions.SQL_CLUSTER_SCAN_MAX_INFLIGHT)))
+        )
         # one hub per worker process: the refresher AND every routed
         # subscription share its decode-once tailer; the server owns its
         # lifecycle (for_table hubs outlive their subscribers by design)
@@ -744,7 +751,31 @@ class _WorkerServer:
             return {}
         if method == "join_part":
             return self._join_part(req)
+        if method == "scan_frag":
+            return self._scan_frag(req)
         raise ValueError(f"unknown method {method!r}")
+
+    def _scan_frag(self, req: dict) -> dict:
+        """One distributed-SQL scan fragment (ISSUE 16): rebuild the shipped
+        splits, scan + reduce locally (table.query.execute_scan_fragment),
+        ship the partial back. Admission is typed-BUSY under
+        sql.cluster.scan.max-inflight; sheds count into soak{shed_requests}
+        beside every other serving-plane BUSY."""
+        if not self._scan_slots.acquire(blocking=False):
+            from ..metrics import soak_metrics
+
+            soak_metrics().counter("shed_requests").inc()
+            return {"busy": True, "retry_after_ms": 50}
+        try:
+            from ..sql.cluster import decode_fragment, encode_partial
+            from ..table.query import execute_scan_fragment
+
+            frag = decode_fragment(req["frag"])
+            part = execute_scan_fragment(self.table, frag)
+            self._metrics().counter("scan_frags_served").inc()
+            return {"partial": encode_partial(part, code_domain=bool(frag.get("code_domain", True)))}
+        finally:
+            self._scan_slots.release()
 
     def _subscribe_poll(self, req: dict) -> dict:
         from ..types import RowKind
@@ -1106,6 +1137,15 @@ class ClusterWorkerAgent:
         return r.get("sid") is not None
 
     # ---- loops ----------------------------------------------------------
+    def run_serve(self) -> None:
+        """Serve-only loop (distributed SQL workers): register, heartbeat,
+        answer get_batch / subscribe / join_part / scan_frag until told to
+        stop. No ingest — the table is whatever the store already holds."""
+        self.register()
+        self.start_heartbeats()
+        while not self._stop.wait(0.2):
+            pass
+
     def run_soak(self) -> None:
         self.register()
         self.start_heartbeats()
@@ -1198,6 +1238,28 @@ class ClusterClient:
         if bucket not in self._route:
             self.refresh_route()
         return self._route[bucket]
+
+    def drop_conn(self, wid: int) -> None:
+        """Forget a worker's cached connection (the failover path: the next
+        fragment for its buckets reconnects through a refreshed route)."""
+        conn = self._conns.pop(wid, None)
+        if conn is not None:
+            conn.close()
+
+    # ---- distributed SQL scan fragments (ISSUE 16) ----------------------
+    def scan_frag(self, wid: int, frag: dict, busy_wait_s: float = 10.0) -> dict:
+        """Execute one wire-encoded scan fragment on worker `wid`, absorbing
+        typed-BUSY sheds with the server-advertised retry_after backoff.
+        Raises ConnectionError/RuntimeError like every other worker call —
+        the planner's failover loop owns re-dispatch."""
+        deadline = time.monotonic() + busy_wait_s
+        while True:
+            r = self._conn(wid).call("scan_frag", frag=frag)
+            if not r.get("busy"):
+                return r["partial"]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"worker {wid} still BUSY after {busy_wait_s}s")
+            time.sleep(float(r.get("retry_after_ms", 50)) / 1000.0)
 
     # ---- batched gets ---------------------------------------------------
     def get_batch(self, keys, partition: tuple = ()) -> list:
@@ -1758,6 +1820,8 @@ def worker_main(args) -> int:
     try:
         if args.mode == "soak":
             agent.run_soak()
+        elif args.mode == "serve":
+            agent.run_serve()
         else:
             _run_bench_worker(agent, args)
     finally:
@@ -1873,7 +1937,7 @@ def _worker_args(argv):
     ap.add_argument("--admit-timeout", type=float, default=30.0, dest="admit_timeout")
     ap.add_argument("--heartbeat-interval", type=float, default=0.5, dest="heartbeat_interval")
     ap.add_argument("--no-serve", action="store_false", dest="serve")
-    ap.add_argument("--mode", choices=("soak", "bench"), default="soak")
+    ap.add_argument("--mode", choices=("soak", "bench", "serve"), default="soak")
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--read-iters", type=int, default=4, dest="read_iters")
     ap.add_argument("--expected-workers", type=int, default=1, dest="expected_workers")

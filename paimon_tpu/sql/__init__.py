@@ -28,8 +28,8 @@ from typing import TYPE_CHECKING, Any, Callable
 if TYPE_CHECKING:
     from ..catalog import Catalog
 
-__all__ = ["call", "parse_call", "procedures", "query", "execute",
-           "execute_script", "split_statements"]
+__all__ = ["call", "parse_call", "procedures", "query", "cluster_query",
+           "execute", "execute_script", "split_statements"]
 
 _CALL_RE = re.compile(r"^\s*CALL\s+(?:`?sys`?\.)?`?(\w+)`?\s*\((.*)\)\s*;?\s*$", re.I | re.S)
 
@@ -773,6 +773,16 @@ def query(catalog: "Catalog", statement: str):
     from .select import query as _query
 
     return _query(catalog, statement)
+
+
+def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float = 10.0):
+    """Execute one SELECT across cluster-service workers (scatter-gather
+    scan fragments with code-domain partial aggregation; see sql.cluster).
+    `client` is a service.cluster.ClusterClient; results are bit-identical
+    to :func:`query` on the same catalog."""
+    from .cluster import cluster_query as _cquery
+
+    return _cquery(catalog, statement, client, busy_wait_s=busy_wait_s)
 
 
 def split_statements(script: str) -> list[str]:

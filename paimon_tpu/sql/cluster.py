@@ -1,0 +1,523 @@
+"""Distributed SQL (ISSUE 16): scatter-gather scans with compressed-domain
+partial aggregation on the cluster-service workers.
+
+The coordinator side of the fragment protocol. One SELECT plans exactly like
+the local evaluator (sql.select.parse_select — every semantic decision is
+shared), then the scan splits scatter to the workers owning their buckets
+over the cluster-service wire (service.cluster `scan_frag` beside
+get_batch/join_part). Each worker scans its splits with predicate +
+projection pushdown and:
+
+* aggregate queries — segment-reduces the fragment into ONE partial
+  aggregate per group on device (ops.aggregates.segment_reduce keyed on
+  dictionary codes), shipping the group keys back as (pruned pool, uint32
+  codes, partial rows). The coordinator combines in the code domain:
+  ops.dicts.unify_pools merges the per-worker pools, remap_codes re-ranks
+  the codes, and a second segment_reduce over the partial rows composes
+  counts/sums by addition and min/max by min/max (_KERNEL_COMBINE). Row
+  positions are global (split seq << 40 + row), so the combined
+  first-appearance order is exactly the single-process one — results are
+  bit-identical to the local oracle by construction.
+* non-aggregate queries — streams the row batches back Arrow-encoded; the
+  coordinator reassembles them in global split order and runs the same
+  ORDER/LIMIT/projection tail.
+
+`sql.cluster.code-domain` (or PAIMON_TPU_SQL_CODE_DOMAIN) toggles the
+compressed combine: off, workers expand group-key values on the wire and
+the coordinator re-encodes them through the identical ops.dicts path — the
+verify stage forces both and asserts equal results.
+
+Failover: a fragment whose worker dies (ConnectionError) returns its splits
+to the pending pool; the coordinator refreshes the route (the cluster
+coordinator reassigns the dead worker's buckets on missed heartbeats) and
+re-dispatches to the new owners until `sql.cluster.retry-timeout` expires.
+Typed-BUSY sheds (`sql.cluster.scan.max-inflight`) retry inside
+ClusterClient.scan_frag with the server-advertised backoff.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from .expr import ExprError, parse_expr, to_predicate
+from .select import (
+    _KERNEL_COMBINE,
+    QueryError,
+    _agg_kernel_plan,
+    _assemble_group_batch,
+    _engine_for,
+    _finish,
+    _order_cols,
+    parse_select,
+    query,
+)
+
+if TYPE_CHECKING:
+    from ..catalog import Catalog
+
+__all__ = [
+    "cluster_query",
+    "resolve_code_domain",
+    "encode_fragment",
+    "decode_fragment",
+    "encode_partial",
+    "decode_partial",
+]
+
+
+def resolve_code_domain(enabled) -> bool:
+    """One resolution order (the ops.dicts.resolve_dict_domain shape): the
+    PAIMON_TPU_SQL_CODE_DOMAIN env var (verify forces both paths) beats the
+    sql.cluster.code-domain option value, which beats the default (on)."""
+    env = os.environ.get("PAIMON_TPU_SQL_CODE_DOMAIN", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    if enabled is None:
+        return True
+    if isinstance(enabled, str):
+        return enabled.strip().lower() in ("1", "on", "true")
+    return bool(enabled)
+
+
+# ---------------------------------------------------------------------------
+# wire codecs: fragments coordinator->worker, partials worker->coordinator
+# (length-prefixed JSON transport: arrays ride base64, row batches Arrow IPC)
+# ---------------------------------------------------------------------------
+def _b64(arr: np.ndarray) -> dict:
+    a = np.ascontiguousarray(arr)
+    return {"d": base64.b64encode(a.tobytes()).decode(), "t": str(a.dtype), "s": list(a.shape)}
+
+
+def _unb64(d: dict) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(d["d"]), dtype=np.dtype(d["t"])).reshape(d["s"])
+
+
+def _encode_pool(pool: np.ndarray) -> dict:
+    if pool.dtype == np.dtype(object):
+        return {"obj": pool.tolist()}
+    return {"arr": _b64(pool)}
+
+
+def _decode_pool(d: dict) -> np.ndarray:
+    if "obj" in d:
+        pool = np.empty(len(d["obj"]), dtype=object)
+        for i, v in enumerate(d["obj"]):
+            pool[i] = v
+        return pool
+    return _unb64(d["arr"])
+
+
+def encode_fragment(frag: dict) -> dict:
+    """Fragment -> JSON-safe wire dict (splits are already DataSplit.to_dict
+    payloads; kern tuples flatten to lists)."""
+    wire = dict(frag)
+    if wire.get("kern") is not None:
+        wire["kern"] = [list(k) for k in wire["kern"]]
+    return wire
+
+
+def decode_fragment(d: dict) -> dict:
+    """Wire dict -> fragment (table.query.execute_scan_fragment re-tuples
+    kern and rebuilds the DataSplits itself)."""
+    return dict(d)
+
+
+def encode_partial(part: dict, code_domain: bool = True) -> dict:
+    """Worker-side: numpy-level partial -> wire dict. Aggregate partials
+    ship pools+codes in the code domain (or expanded values when the toggle
+    is off); row partials ship per-split Arrow IPC streams."""
+    if part["mode"] == "rows":
+        import pyarrow as pa
+
+        batches = []
+        for seq, b in part["batches"]:
+            at = b.to_arrow()
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, at.schema) as w:
+                w.write_table(at)
+            batches.append([int(seq), base64.b64encode(sink.getvalue().to_pybytes()).decode()])
+        return {"mode": "rows", "rows": int(part["rows"]), "batches": batches}
+    enc = {
+        "mode": "agg",
+        "rows": int(part["rows"]),
+        "rows_reduced_device": int(part.get("rows_reduced_device", 0)),
+        "outs": [_b64(np.asarray(o)) for o in part["outs"]],
+        "anyv": [_b64(np.asarray(a)) for a in part["anyv"]],
+        "first_pos": _b64(part["first_pos"]),
+    }
+    if code_domain:
+        enc["pools"] = [_encode_pool(p) for p in part["pools"]]
+        enc["group_codes"] = [_b64(c) for c in part["group_codes"]]
+    else:
+        vals = []
+        for pool, codes in zip(part["pools"], part["group_codes"]):
+            sent = len(pool)
+            col = []
+            for c in codes.tolist():
+                if c == sent:
+                    col.append(None)
+                else:
+                    v = pool[c]
+                    col.append(v.item() if hasattr(v, "item") else v)
+            vals.append(col)
+        enc["vals"] = vals
+    return enc
+
+
+def decode_partial(d: dict, schema, group_cols=()) -> dict:
+    """Coordinator-side: wire dict -> numpy-level partial. Expanded group
+    keys (code-domain off) re-encode through the SAME ops.dicts.encode_column
+    path the workers use, so the combine below is identical either way."""
+    if d["mode"] == "rows":
+        import pyarrow as pa
+
+        from ..data.batch import ColumnBatch
+
+        batches = []
+        for seq, blob in d["batches"]:
+            at = pa.ipc.open_stream(pa.BufferReader(base64.b64decode(blob))).read_all()
+            batches.append((int(seq), ColumnBatch.from_arrow(at, schema)))
+        return {"mode": "rows", "rows": int(d["rows"]), "batches": batches}
+    out = {
+        "mode": "agg",
+        "rows": int(d["rows"]),
+        "rows_reduced_device": int(d.get("rows_reduced_device", 0)),
+        "outs": [_unb64(o) for o in d["outs"]],
+        "anyv": [_unb64(a) for a in d["anyv"]],
+        "first_pos": _unb64(d["first_pos"]),
+    }
+    if "vals" in d:
+        from ..data.batch import Column
+        from ..ops.dicts import encode_column
+
+        pools, group_codes = [], []
+        for g, vs in zip(group_cols, d["vals"]):
+            pool, codes = encode_column(Column.from_pylist(vs, schema.field(g).type))
+            pools.append(pool)
+            group_codes.append(codes)
+        out["pools"], out["group_codes"] = pools, group_codes
+    else:
+        out["pools"] = [_decode_pool(p) for p in d.get("pools", [])]
+        out["group_codes"] = [
+            _unb64(c).astype(np.uint32, copy=False) for c in d.get("group_codes", [])
+        ]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# coordinator: plan -> scatter -> combine
+# ---------------------------------------------------------------------------
+class _LocalFallback(Exception):
+    """Raised mid-plan when a query shape cannot route through fragments
+    (non-numeric aggregate argument: the host reduceat path owns it) — the
+    caller falls back to the single-process evaluator."""
+
+
+def _scatter(client, pending: dict, template: dict, retry_ms: int, busy_wait_s: float):
+    """Dispatch one fragment per owning worker, failover on dead
+    connections: failed fragments' splits return to the pool, the route
+    refreshes (the coordinator reassigns dead workers' buckets) and the
+    splits regroup under their new owners until retry_ms expires."""
+    from ..metrics import sql_metrics
+
+    g = sql_metrics()
+    deadline = time.monotonic() + retry_ms / 1000.0
+    results: list[dict] = []
+    round_no = 0
+    while pending:
+        g.counter("fragments").inc(len(pending))
+        if round_no:
+            g.counter("fragments_retried").inc(len(pending))
+        round_no += 1
+        with ThreadPoolExecutor(max_workers=max(len(pending), 1)) as ex:
+            futs = {
+                wid: ex.submit(
+                    client.scan_frag,
+                    wid,
+                    encode_fragment(dict(template, splits=items)),
+                    busy_wait_s,
+                )
+                for wid, items in pending.items()
+            }
+            failed: list = []
+            for wid, fut in futs.items():
+                try:
+                    results.append(fut.result())
+                except (ConnectionError, OSError, TimeoutError):
+                    failed.extend(pending[wid])
+                    client.drop_conn(wid)
+        if not failed:
+            break
+        # regroup under the refreshed route; the dead worker's buckets move
+        # once the coordinator times out its heartbeats, so keep trying
+        while True:
+            if time.monotonic() >= deadline:
+                raise QueryError(
+                    f"scan fragments undeliverable after {retry_ms} ms "
+                    f"({len(failed)} splits pending)"
+                )
+            time.sleep(0.05)
+            try:
+                client.refresh_route()
+                regrouped: dict = {}
+                for seq, sd in failed:
+                    wid = client.owner_of(int(sd["bucket"]))
+                    regrouped.setdefault(wid, []).append((seq, sd))
+                pending = regrouped
+                break
+            except (KeyError, ConnectionError, OSError):
+                continue
+    return results
+
+
+def _sentinel_remap(remap, pool_len: int, unified_len: int) -> np.ndarray:
+    """Extend a unify_pools gather table with the NULL sentinel: input code
+    `pool_len` (NULL) maps to unified code `unified_len`."""
+    base = remap if remap is not None else np.arange(pool_len, dtype=np.int64)
+    return np.concatenate([np.asarray(base, dtype=np.int64), [unified_len]]).astype(np.uint32)
+
+
+def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float = 10.0):
+    """Execute one SELECT across the cluster-service workers; returns the
+    result ColumnBatch, bit-identical to sql.select.query on the same
+    catalog. Falls back to the single-process evaluator for shapes the
+    fragment protocol does not cover (system tables, per-query OPTIONS
+    hints / time travel, a table the client does not serve, non-numeric
+    aggregate arguments). JOIN queries distribute through the ops.join
+    partition-executor seam (worker-side join_part kernels) instead."""
+    from ..data.batch import ColumnBatch, concat_batches
+    from ..metrics import sql_metrics
+    from ..options import CoreOptions
+
+    p = parse_select(statement)
+    if p.is_join:
+        from ..ops.join import partition_executor
+
+        with partition_executor(client.partition_executor()):
+            return query(catalog, statement)
+    fm = p.from_match
+    if fm.group("hints") or fm.group("tt_kind"):
+        return query(catalog, statement)
+    t = catalog.get_table(p.table_name)
+    if not hasattr(t, "new_read_builder") or t.path != client.table.path:
+        return query(catalog, statement)
+
+    opts = t.store.options.options
+    code_domain = resolve_code_domain(opts.get(CoreOptions.SQL_CLUSTER_CODE_DOMAIN))
+    retry_ms = int(opts.get(CoreOptions.SQL_CLUSTER_RETRY_TIMEOUT))
+    engine = _engine_for(t)
+    g = sql_metrics()
+    if p.where_text:  # surface parse errors before any RPC, like query()
+        try:
+            to_predicate(parse_expr(p.where_text), p.where_text)
+        except ExprError as e:
+            raise QueryError(str(e)) from e
+
+    def _plan_frags(projection, limit_push):
+        rb = t.new_read_builder()
+        if p.where_text:
+            rb = rb.with_filter(to_predicate(parse_expr(p.where_text), p.where_text))
+        if projection is not None:
+            for n in projection:
+                if n not in t.row_type:
+                    raise QueryError(f"unknown column {n!r} in {p.table_name}")
+            rb = rb.with_projection(list(projection))
+        if limit_push is not None:
+            rb = rb.with_limit(limit_push)
+        by_wid: dict = {}
+        for seq, sp in enumerate(rb.new_scan().plan()):
+            by_wid.setdefault(client.owner_of(int(sp.bucket)), []).append((seq, sp.to_dict()))
+        return by_wid
+
+    def _kern_or_fallback(aggs2):
+        kern, imap = _agg_kernel_plan(aggs2)
+        for fn, col in kern:
+            if fn == "count" and col == "*":
+                continue
+            if col not in t.row_type:
+                raise QueryError(f"unknown column {col!r} in {p.table_name}")
+            if fn != "count" and np.dtype(t.row_type.field(col).type.numpy_dtype()).kind not in "iuf":
+                raise _LocalFallback
+        return kern, imap
+
+    def _gather_agg(projection, group_cols, kern):
+        template = {
+            "mode": "agg",
+            "where": p.where_text,
+            "projection": projection,
+            "group_cols": group_cols,
+            "kern": kern,
+            "engine": engine,
+            "code_domain": code_domain,
+        }
+        t0 = time.perf_counter()
+        raw = _scatter(client, _plan_frags(projection, None), template, retry_ms, busy_wait_s)
+        g.histogram("scatter_ms").update((time.perf_counter() - t0) * 1000)
+        schema = t.row_type.project(projection)
+        parts = [decode_partial(r, schema, group_cols) for r in raw]
+        parts = [q for q in parts if q["rows"]]
+        for q in parts:
+            g.counter("rows_reduced_device").inc(q["rows_reduced_device"])
+        return schema, parts
+
+    def _combine(parts, group_cols, kern):
+        """Second-stage reduce over the partial rows, keyed on the UNIFIED
+        code domain; returns (pools, codes, outs, anyv, first_pos) in the
+        _assemble_group_batch contract."""
+        from ..ops.aggregates import segment_reduce
+        from ..ops.dicts import remap_codes, unify_pools
+
+        pools_f, codes_f = [], []
+        for gi in range(len(group_cols)):
+            unified, remaps = unify_pools([q["pools"][gi] for q in parts])
+            mapped = [
+                remap_codes(
+                    _sentinel_remap(rm, len(q["pools"][gi]), len(unified)),
+                    q["group_codes"][gi],
+                )
+                for q, rm in zip(parts, remaps)
+            ]
+            pools_f.append(unified)
+            codes_f.append(np.concatenate(mapped).astype(np.uint32, copy=False))
+        rows = sum(len(q["first_pos"]) for q in parts)
+        lanes = np.column_stack(codes_f) if group_cols else np.zeros((rows, 1), np.uint32)
+        cols2 = [
+            (
+                np.concatenate([q["outs"][ki] for q in parts]),
+                np.concatenate([q["anyv"][ki] for q in parts]),
+            )
+            for ki in range(len(kern))
+        ]
+        fns2 = tuple(_KERNEL_COMBINE[fn] for fn, _ in kern)
+        pos = np.concatenate([q["first_pos"] for q in parts])
+        rep, outs, anyv, first_pos = segment_reduce(lanes, cols2, fns2, pos=pos, engine=engine)
+        g.counter("partials_combined").inc(len(parts))
+        if code_domain and group_cols:
+            g.counter("code_domain_groups").inc(rows)
+        return pools_f, [c[rep] for c in codes_f], outs, anyv, first_pos
+
+    def group_reduce(items2, aggs2):
+        from .select import _group_aggregate
+
+        for gc in p.group_cols:
+            if gc not in t.row_type:
+                raise QueryError(f"unknown GROUP BY column {gc!r}")
+        kern, imap = _kern_or_fallback(aggs2)
+        projection = list(
+            dict.fromkeys(p.group_cols + [c for fn, c in kern if c != "*"])
+        )
+        schema, parts = _gather_agg(projection, p.group_cols, kern)
+        if not parts:
+            return _group_aggregate(
+                ColumnBatch.empty(schema), items2, aggs2, p.group_cols, engine=engine
+            )
+        t1 = time.perf_counter()
+        pools, codes, outs, anyv, first_pos = _combine(parts, p.group_cols, kern)
+        out = _assemble_group_batch(
+            t.row_type, items2, aggs2, imap, p.group_cols, pools, codes, outs, anyv, first_pos
+        )
+        g.histogram("combine_ms").update((time.perf_counter() - t1) * 1000)
+        return out
+
+    def scalar_reduce(items, aggs):
+        from .select import _aggregate
+
+        from ..types import BIGINT, DOUBLE, DataField, RowType
+
+        kern, imap = _kern_or_fallback(aggs)
+        projection = list(dict.fromkeys(c for _, c in kern if c != "*"))
+        if not projection:
+            projection = [t.row_type.field_names[0]]
+        schema, parts = _gather_agg(projection, [], kern)
+        if not parts:
+            return _aggregate(ColumnBatch.empty(schema), items, aggs)
+        t1 = time.perf_counter()
+        _, _, outs, anyv, _ = _combine(parts, [], kern)
+        # reproduce sql.select._aggregate's scalar semantics exactly: one
+        # row always; an aggregate with no valid input is NULL typed DOUBLE
+        names, types, values = [], [], []
+        for item, agg, spec in zip(items, aggs, imap):
+            label = re.sub(r"\s+", "", item).lower()
+            if spec[0] == "count":
+                v, ty = int(outs[spec[1]][0]), BIGINT()
+            elif spec[0] == "avg":
+                c = outs[spec[2]][0]
+                v = float(outs[spec[1]][0] / c) if c else None
+                ty = DOUBLE()
+            else:
+                ki = spec[1]
+                if bool(anyv[ki][0]):
+                    v, ty = outs[ki][0].item(), t.row_type.field(agg[1]).type
+                else:
+                    v, ty = None, DOUBLE()
+            names.append(label)
+            types.append(ty)
+            values.append(v)
+        rt = RowType(
+            tuple(DataField(i, nm, ty) for i, (nm, ty) in enumerate(zip(names, types)))
+        )
+        out = ColumnBatch.from_pydict(rt, {nm: [v] for nm, v in zip(names, values)})
+        g.histogram("combine_ms").update((time.perf_counter() - t1) * 1000)
+        return out
+
+    if p.group_cols or p.is_agg:
+        try:
+            return _finish(
+                None,
+                p.items,
+                p.aggs,
+                p.is_agg,
+                p.group_cols,
+                p.order_text,
+                p.limit,
+                p.cols_text,
+                having_text=p.having_text,
+                engine=engine,
+                group_reduce=group_reduce if p.group_cols else None,
+                scalar_reduce=scalar_reduce if not p.group_cols else None,
+            )
+        except _LocalFallback:
+            return query(catalog, statement)
+
+    # ---- non-aggregate: stream row batches back, finish at the coordinator
+    projection = None
+    if p.cols_text != "*":
+        names = [i.strip("`") for i in p.items]
+        for n in names:
+            if n not in t.row_type:
+                raise QueryError(f"unknown column {n!r} in {p.table_name}")
+        projection = list(dict.fromkeys(names + _order_cols(p.order_text)))
+    limit_push = p.limit if p.order_text is None else None
+    template = {
+        "mode": "rows",
+        "where": p.where_text,
+        "projection": projection,
+        "limit": limit_push,
+        "engine": engine,
+    }
+    t0 = time.perf_counter()
+    raw = _scatter(client, _plan_frags(projection, limit_push), template, retry_ms, busy_wait_s)
+    g.histogram("scatter_ms").update((time.perf_counter() - t0) * 1000)
+    schema = t.row_type.project(projection) if projection is not None else t.row_type
+    t1 = time.perf_counter()
+    batches: list = []
+    total = 0
+    for r in raw:
+        dec = decode_partial(r, schema)
+        batches.extend(dec["batches"])
+        total += dec["rows"]
+    batches.sort(key=lambda sb: sb[0])  # global row order = split seq order
+    out = concat_batches([b for _, b in batches]) if batches else ColumnBatch.empty(schema)
+    g.counter("rows_streamed").inc(total)
+    out = _finish(out, p.items, p.aggs, False, [], p.order_text, p.limit, p.cols_text, engine=engine)
+    g.histogram("combine_ms").update((time.perf_counter() - t1) * 1000)
+    return out

@@ -32,6 +32,7 @@ three-valued logic.
 from __future__ import annotations
 
 import re
+from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any
 
 import numpy as np
@@ -42,7 +43,7 @@ if TYPE_CHECKING:
     from ..catalog import Catalog
     from ..data.batch import ColumnBatch
 
-__all__ = ["query", "QueryError"]
+__all__ = ["query", "QueryError", "SelectPlan", "parse_select"]
 
 
 class QueryError(ValueError):
@@ -53,6 +54,7 @@ _SELECT_RE = re.compile(
     r"^\s*SELECT\s+(?:(?P<distinct>DISTINCT)\s+)?(?P<cols>.*?)\s+FROM\s+(?P<from>.*?)"
     r"(?:\s+WHERE\s+(?P<where>.*?))?"
     r"(?:\s+GROUP\s+BY\s+(?P<group>.*?))?"
+    r"(?:\s+HAVING\s+(?P<having>.*?))?"
     r"(?:\s+ORDER\s+BY\s+(?P<order>.*?))?"
     r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
     re.I | re.S,
@@ -162,8 +164,35 @@ def _resolve_table(catalog: "Catalog", name: str, hints, tt_kind, tt_val):
     return t
 
 
-def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
-    """Execute one SELECT statement; returns the result as a ColumnBatch."""
+@dataclass
+class SelectPlan:
+    """One parsed SELECT, clause by clause — shared by the local evaluator
+    (query) and the distributed planner (sql.cluster.cluster_query), so both
+    paths agree on every semantic decision before a scan is planned."""
+
+    items: list[str]
+    aggs: list
+    is_agg: bool
+    group_cols: list[str]
+    order_text: str | None
+    limit: int | None
+    where_text: str | None
+    having_text: str | None
+    cols_text: str
+    from_match: Any = field(repr=False)
+
+    @property
+    def table_name(self) -> str:
+        return self.from_match.group("table").strip("`")
+
+    @property
+    def is_join(self) -> bool:
+        return self.from_match.group("jtable") is not None
+
+
+def parse_select(statement: str) -> SelectPlan:
+    """Parse one SELECT statement into a SelectPlan (clause validation
+    included); raises QueryError on anything the grammar does not cover."""
     m = _SELECT_RE.match(statement)
     if not m:
         raise QueryError(f"not a SELECT statement: {statement!r}")
@@ -190,23 +219,87 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
             raise QueryError(f"non-aggregate select items must appear in GROUP BY: {bad}")
     elif is_agg and not all(a is not None for a in aggs):
         raise QueryError("cannot mix aggregate and plain columns without GROUP BY")
+    if m.group("having") and not group_cols:
+        raise QueryError("HAVING requires GROUP BY")
 
-    order_text = m.group("order")
-    limit = int(m.group("limit")) if m.group("limit") else None
-    where_text = m.group("where")
+    return SelectPlan(
+        items=items,
+        aggs=aggs,
+        is_agg=is_agg,
+        group_cols=group_cols,
+        order_text=m.group("order"),
+        limit=int(m.group("limit")) if m.group("limit") else None,
+        where_text=m.group("where"),
+        having_text=m.group("having"),
+        cols_text=cols_text,
+        from_match=fm,
+    )
 
-    if fm.group("jtable"):
-        return _join_query(catalog, m, fm, items, aggs, is_agg, group_cols,
-                           order_text, limit, cols_text)
+
+def _engine_for(table) -> str:
+    """Engine for the SQL segment-reduce: an explicit sort-engine choice
+    (table option or PAIMON_TPU_SORT_ENGINE) is honored; with no explicit
+    choice the jitted XLA kernel runs everywhere — unlike the 1M-row merge
+    sort, the group-by reduce's operands are a handful of uint32 lanes, so
+    the CPU-adaptive lexsort default of effective_sort_engine would only
+    forfeit the device path the distributed plane is built around."""
+    import os
+
+    try:
+        from ..options import CoreOptions
+
+        opts = table.store.options
+        if opts.options.contains(CoreOptions.SORT_ENGINE):
+            name = str(opts.sort_engine).lower()
+        else:
+            name = os.environ.get("PAIMON_TPU_SORT_ENGINE", "").strip().lower() or "xla"
+    except Exception:
+        name = "xla"
+    if "pallas" in name:
+        return "pallas"
+    if "numpy" in name:
+        return "numpy"
+    return "xla"
+
+
+def agg_projection(p: SelectPlan, row_type) -> list[str] | None:
+    """Columns an aggregate-only SELECT actually reads (projection pruning
+    before the scan is planned): group keys, aggregate arguments, ORDER BY
+    keys. A pure count(*) reads a single cheap column — merged row count is
+    projection-independent. None = the plan is not aggregate-shaped."""
+    if p.group_cols:
+        needed = list(
+            dict.fromkeys(
+                p.group_cols
+                + [a[1] for a in p.aggs if a is not None and a[1] != "*"]
+                + _having_cols(p.having_text)
+                + [c for c in _order_cols(p.order_text) if c in row_type]
+            )
+        )
+    elif p.is_agg:
+        needed = list(dict.fromkeys(a[1] for a in p.aggs if a[1] != "*"))
+        if not needed:
+            needed = [row_type.field_names[0]]
+    else:
+        return None
+    return needed
+
+
+def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
+    """Execute one SELECT statement; returns the result as a ColumnBatch."""
+    p = parse_select(statement)
+    if p.is_join:
+        return _join_query(catalog, p)
+    fm = p.from_match
 
     t = _resolve_table(
         catalog, fm.group("table"), fm.group("hints"), fm.group("tt_kind"), fm.group("tt_val")
     )
-    table_name = fm.group("table").strip("`")
+    table_name = p.table_name
     pred = None
-    if where_text:
+    if p.where_text:
         try:
-            pred = to_predicate(parse_expr(where_text), where_text)
+            pred = to_predicate(parse_expr(p.where_text), p.where_text)
         except ExprError as e:
             raise QueryError(str(e)) from e
 
@@ -218,55 +311,82 @@ def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
             mask = pred.eval(out)
             if not mask.all():
                 out = out.filter(mask)
+        engine = "xla"
     else:
         rb = t.new_read_builder()
         if pred is not None:
             rb = rb.with_filter(pred)
-        if group_cols:
+        needed = agg_projection(p, t.row_type)
+        if needed is not None:
             # decode only what the aggregation consumes
-            needed = list(dict.fromkeys(
-                group_cols
-                + [a[1] for a in aggs if a is not None and a[1] != "*"]
-                + [c for c in _order_cols(order_text) if c in t.row_type]
-            ))
             for n in needed:
                 if n not in t.row_type:
                     raise QueryError(f"unknown column {n!r} in {table_name}")
             rb = rb.with_projection(needed)
-        elif not is_agg:
-            if cols_text != "*":
-                names = [i.strip("`") for i in items]
+        elif not p.is_agg:
+            if p.cols_text != "*":
+                names = [i.strip("`") for i in p.items]
                 for n in names:
                     if n not in t.row_type:
                         raise QueryError(f"unknown column {n!r} in {table_name}")
                 # ORDER BY columns must survive until after the sort
-                order_cols = _order_cols(order_text)
+                order_cols = _order_cols(p.order_text)
                 rb = rb.with_projection(list(dict.fromkeys(names + order_cols)))
-            if limit is not None and order_text is None:
-                rb = rb.with_limit(limit)
+            if p.limit is not None and p.order_text is None:
+                rb = rb.with_limit(p.limit)
         out = rb.new_read().read_all(rb.new_scan().plan())
+        engine = _engine_for(t)
 
-    return _finish(out, items, aggs, is_agg, group_cols, order_text, limit, cols_text)
+    return _finish(out, p.items, p.aggs, p.is_agg, p.group_cols, p.order_text,
+                   p.limit, p.cols_text, having_text=p.having_text, engine=engine)
 
 
-def _finish(out, items, aggs, is_agg, group_cols, order_text, limit, cols_text):
-    """The engine-independent tail: GROUP BY / aggregates / ORDER BY /
-    LIMIT / final projection over an already-scanned (or joined) batch."""
+def _finish(out, items, aggs, is_agg, group_cols, order_text, limit, cols_text,
+            having_text=None, engine="xla", group_reduce=None, scalar_reduce=None):
+    """The engine-independent tail: GROUP BY / aggregates / HAVING /
+    ORDER BY / LIMIT / final projection over an already-scanned (or joined,
+    or distributed-combined) batch.
+
+    `group_reduce(items, aggs)` / `scalar_reduce(items, aggs)` replace the
+    local aggregation step (sql.cluster's scatter-gather combine plugs in
+    here): they receive the FULL item list — select items plus the hidden
+    ORDER BY / HAVING columns this tail derives — so distributed plans
+    compute exactly what the local evaluator would."""
     if group_cols:
         # ORDER BY may reference group columns outside the select list: carry
-        # them as hidden output columns through the sort, then project away
+        # them as hidden output columns through the sort, then project away.
+        # HAVING likewise: its aggregate calls and group-column refs compute
+        # as hidden items, filter after grouping, then project away.
         labels = [i.strip("`") if a is None else re.sub(r"\s+", "", i).lower()
                   for i, a in zip(items, aggs)]
-        hidden = [c for c in _order_cols(order_text)
-                  if c in group_cols and c not in [i.strip("`") for i, a in zip(items, aggs) if a is None]]
-        out = _group_aggregate(out, items + hidden, aggs + [None] * len(hidden), group_cols)
+        plain = [i.strip("`") for i, a in zip(items, aggs) if a is None]
+        hidden_items: list[str] = []
+        hidden_aggs: list = []
+        for c in _order_cols(order_text):
+            if c in group_cols and c not in plain and c not in hidden_items:
+                hidden_items.append(c)
+                hidden_aggs.append(None)
+        having_node, pmap = None, {}
+        if having_text:
+            having_node, pmap, extra_items, extra_aggs = _rewrite_having(
+                having_text, labels, group_cols, plain + hidden_items
+            )
+            hidden_items += extra_items
+            hidden_aggs += extra_aggs
+        if group_reduce is not None:
+            out = group_reduce(items + hidden_items, aggs + hidden_aggs)
+        else:
+            out = _group_aggregate(out, items + hidden_items, aggs + hidden_aggs,
+                                   group_cols, engine=engine)
+        if having_node is not None:
+            out = _apply_having(out, having_node, pmap)
         if order_text:
             out = out.take(_order_index(out, order_text))
         if limit is not None:
             out = out.slice(0, min(limit, out.num_rows))
-        return out.select(labels) if hidden else out
+        return out.select(labels) if hidden_items else out
     if is_agg:
-        return _aggregate(out, items, aggs)
+        return scalar_reduce(items, aggs) if scalar_reduce is not None else _aggregate(out, items, aggs)
 
     if order_text:
         idx = _order_index(out, order_text)
@@ -384,10 +504,13 @@ def _key_prune_predicate(batch, src_col: str, target_col: str, in_limit: int):
     return P.between(target_col, vals[0], vals[-1])
 
 
-def _join_query(catalog, m, fm, items, aggs, is_agg, group_cols, order_text, limit, cols_text):
+def _join_query(catalog, p: SelectPlan):
     from ..data import predicate as P
     from ..ops.join import JoinError, join_batches, materialize_join
 
+    fm = p.from_match
+    items, aggs, is_agg = p.items, p.aggs, p.is_agg
+    group_cols, order_text, limit, cols_text = p.group_cols, p.order_text, p.limit, p.cols_text
     how = "left" if (fm.group("jtype") or "").strip().upper().startswith("LEFT") else "inner"
     t_l = _resolve_table(
         catalog, fm.group("table"), fm.group("hints"), fm.group("tt_kind"), fm.group("tt_val")
@@ -420,7 +543,7 @@ def _join_query(catalog, m, fm, items, aggs, is_agg, group_cols, order_text, lim
         right_keys.append(pair[1])
 
     # ---- WHERE: single-side conjuncts push into that side's scan ---------
-    where_text = m.group("where")
+    where_text = p.where_text
     side_preds: list[list] = [[], []]
     residual: list = []
     if where_text:
@@ -566,7 +689,103 @@ def _join_query(catalog, m, fm, items, aggs, is_agg, group_cols, order_text, lim
         if not mask.all():
             joined = joined.filter(mask)
 
-    return _finish(joined, items, aggs, is_agg, group_cols, order_text, limit, cols_text)
+    # HAVING refs lower onto the joined batch's canonical naming: aggregate
+    # arguments resolve through the scope exactly like select items do
+    having_text = p.having_text
+    if having_text:
+        def _canon_call(mo):
+            fn = mo.group(1)
+            if fn.lower() not in _AGG_FNS:
+                return mo.group(0)
+            arg = mo.group(2)
+            if arg == "*":
+                return re.sub(r"\s+", "", mo.group(0)).lower()
+            side, col = scope.resolve_tok(arg)
+            return f"{fn.lower()}({scope.canonical(side, col)})"
+
+        having_text = _AGG_CALL_RE.sub(_canon_call, having_text)
+
+    return _finish(joined, items, aggs, is_agg, group_cols, order_text, limit, cols_text,
+                   having_text=having_text, engine=_engine_for(t_l))
+
+
+_AGG_CALL_RE = re.compile(r"(\w+)\s*\(\s*(\*|`?[\w.]+`?)\s*\)")
+
+
+def _having_cols(having_text: str | None) -> list[str]:
+    """Table columns a HAVING clause's aggregate calls read (its bare column
+    refs must be group columns, which the projection already carries)."""
+    if not having_text:
+        return []
+    return [
+        mo.group(2).strip("`")
+        for mo in _AGG_CALL_RE.finditer(having_text)
+        if mo.group(1).lower() in _AGG_FNS and mo.group(2) != "*"
+    ]
+
+
+def _rewrite_having(having_text, labels, group_cols, present):
+    """Lower HAVING onto the grouped batch: each aggregate call becomes a
+    placeholder column (an existing select-item label when the same call is
+    already selected, a hidden extra aggregate otherwise) and bare refs are
+    checked against the GROUP BY list. Returns (expr node, placeholder →
+    label map, extra hidden items, extra hidden aggs). Refs must use the
+    output's canonical naming (join queries: the same names the select list
+    resolves to)."""
+    pmap: dict[str, str] = {}
+    extra_items: list[str] = []
+    extra_aggs: list = []
+
+    def repl(mo):
+        if mo.group(1).lower() not in _AGG_FNS:
+            return mo.group(0)
+        norm = re.sub(r"\s+", "", mo.group(0)).lower().replace("`", "")
+        for ph, label in pmap.items():
+            if label == norm:
+                return ph
+        ph = f"__h{len(pmap)}"
+        pmap[ph] = norm
+        if norm not in labels and norm not in extra_items:
+            agg = _parse_agg(norm)
+            if agg is None:
+                raise QueryError(f"unsupported aggregate in HAVING: {mo.group(0)!r}")
+            extra_items.append(norm)
+            extra_aggs.append(agg)
+        return ph
+
+    rewritten = _AGG_CALL_RE.sub(repl, having_text)
+    try:
+        node = parse_expr(rewritten)
+    except ExprError as e:
+        raise QueryError(f"cannot parse HAVING: {e}") from e
+    for ref in _col_nodes(node, []):
+        name = f"{ref[1]}.{ref[2]}" if ref[1] else ref[2].strip("`")
+        if name.startswith("__h"):
+            continue
+        if name not in group_cols:
+            raise QueryError(f"HAVING references non-grouped column {name!r}")
+        if name not in present and name not in extra_items:
+            extra_items.append(name)
+            extra_aggs.append(None)
+    return node, pmap, extra_items, extra_aggs
+
+
+def _apply_having(out, node, pmap):
+    """Evaluate a rewritten HAVING over the grouped batch (SQL three-valued
+    logic via eval_mask: a NULL comparison drops the group)."""
+    def resolve(alias, name):
+        label = f"{alias}.{name}" if alias else name
+        label = pmap.get(label, label)
+        if label not in out.schema:
+            raise QueryError(f"HAVING references unknown column {label!r}")
+        c = out.column(label)
+        return np.asarray(c.values), c.validity
+
+    try:
+        mask = eval_mask(node, resolve, out.num_rows)
+    except ExprError as e:
+        raise QueryError(str(e)) from e
+    return out if mask.all() else out.filter(mask)
 
 
 def _order_cols(order_text: str | None) -> list[str]:
@@ -639,11 +858,162 @@ def _aggregate(batch: "ColumnBatch", items: list[str], aggs) -> "ColumnBatch":
     schema = RowType(tuple(DataField(i, n, ty) for i, (n, ty) in enumerate(zip(names, types))))
     return ColumnBatch.from_pydict(schema, {n: [v] for n, v in zip(names, values)})
 
-def _group_aggregate(batch: "ColumnBatch", items, aggs, group_cols) -> "ColumnBatch":
-    """Vectorized GROUP BY: per-column inverse codes combined into one group
-    id, then reduceat over the group-sorted rows (sum/min/max/count; avg =
-    sum/count). Output rows are in first-appearance order of each group's
-    key, matching a streaming aggregator."""
+# ---------------------------------------------------------------------------
+# GROUP BY kernel plan (ISSUE 16): shared by the single-process evaluator and
+# the distributed scatter-gather path — both reduce through the SAME
+# ops.aggregates.segment_reduce call, so their per-group results are
+# parity-pinned by construction.
+# ---------------------------------------------------------------------------
+
+# how a partial aggregate re-reduces at the coordinator: counts and sums add,
+# min/min and max/max compose
+_KERNEL_COMBINE = {"count": "sum", "sum": "sum", "sum_f64": "sum", "min": "min", "max": "max"}
+
+
+def _agg_kernel_plan(aggs):
+    """(kern, imap): `kern` is the deduplicated list of (fn, col) reductions
+    the segment-reduce kernel computes (fn in sum|sum_f64|count — avg splits
+    into a float64 sum plus a count); `imap` says how each select item
+    assembles from kernel outputs."""
+    kern: list[tuple[str, str]] = []
+    imap: list[tuple] = []
+
+    def _add(fn, col):
+        spec = (fn, col)
+        if spec in kern:
+            return kern.index(spec)
+        kern.append(spec)
+        return len(kern) - 1
+
+    for a in aggs:
+        if a is None:
+            imap.append(("group",))
+            continue
+        fn, col = a
+        if fn == "count":
+            imap.append(("count", _add("count", col)))
+        elif fn == "avg":
+            if col == "*":
+                raise QueryError("avg(*) is not valid")
+            imap.append(("avg", _add("sum_f64", col), _add("count", col)))
+        else:
+            if col == "*":
+                raise QueryError(f"{fn}(*) is not valid")
+            imap.append((fn, _add(fn, col)))
+    return kern, imap
+
+
+def _kernel_routable(batch, kern) -> bool:
+    """True when every reduced column is numeric (count only reads validity,
+    so its argument may be any type); object/bool columns keep the host
+    fallback, zero rows produce zero groups without a kernel."""
+    if batch.num_rows == 0:
+        return False
+    for fn, col in kern:
+        if fn == "count":
+            continue
+        if np.asarray(batch.column(col).values).dtype.kind not in "iuf":
+            return False
+    return True
+
+
+def _kernel_columns(batch, kern):
+    """Materialize kern specs against a batch: (values, valid) pairs plus
+    the segment_reduce fn per column."""
+    n = batch.num_rows
+    cols, fns = [], []
+    for fn, col in kern:
+        if fn == "count":
+            valid = None if col == "*" else batch.column(col).validity
+            cols.append((np.ones(n, np.int64), valid))
+            fns.append("sum")
+        else:
+            c = batch.column(col)
+            v = np.asarray(c.values)
+            if fn == "sum_f64":
+                v = v.astype(np.float64, copy=False)
+            cols.append((v, c.validity))
+            fns.append("sum" if fn == "sum_f64" else fn)
+    return cols, tuple(fns)
+
+
+def _encode_group_lanes(batch, group_cols):
+    """Group keys → uint32 code lanes (ops.dicts.encode_column: code-backed
+    columns stay compressed, NULL rows carry the sentinel code)."""
+    from ..ops.dicts import encode_column
+
+    pools, codes_list = [], []
+    for g in group_cols:
+        pool, codes = encode_column(batch.column(g))
+        pools.append(pool)
+        codes_list.append(codes)
+    return pools, codes_list, np.column_stack(codes_list)
+
+
+def _assemble_group_batch(schema, items, aggs, imap, group_cols, pools, group_codes,
+                          outs, anyv, first_pos) -> "ColumnBatch":
+    """Kernel outputs → the grouped result batch, rows in first-appearance
+    order (argsort of each group's minimum input position — for distributed
+    partials the positions are GLOBAL row numbers, so the combined output
+    ordering is exactly the single-process one)."""
+    from ..data.batch import ColumnBatch
+    from ..types import BIGINT, DOUBLE, DataField, RowType
+
+    order = np.argsort(first_pos, kind="stable")
+    names, types, columns = [], [], []
+    for item, agg, spec in zip(items, aggs, imap):
+        if spec[0] == "group":
+            name = item.strip("`")
+            gi = group_cols.index(name)
+            pool = pools[gi]
+            sent = len(pool)
+            vals = [
+                None if c == sent else (pool[c].item() if hasattr(pool[c], "item") else pool[c])
+                for c in group_codes[gi][order].tolist()
+            ]
+            names.append(name)
+            types.append(schema.field(name).type)
+            columns.append(vals)
+            continue
+        label = re.sub(r"\s+", "", item).lower()
+        if spec[0] == "count":
+            names.append(label)
+            types.append(BIGINT())
+            columns.append(outs[spec[1]][order].astype(np.int64).tolist())
+        elif spec[0] == "avg":
+            s = outs[spec[1]][order]
+            c = outs[spec[2]][order]
+            names.append(label)
+            types.append(DOUBLE())
+            columns.append([float(s[j] / c[j]) if c[j] else None for j in range(len(c))])
+        else:  # sum / min / max
+            o = outs[spec[1]][order].tolist()
+            av = anyv[spec[1]][order]
+            names.append(label)
+            types.append(schema.field(agg[1]).type)
+            columns.append([o[j] if av[j] else None for j in range(len(o))])
+    rt = RowType(tuple(DataField(i, nm, ty) for i, (nm, ty) in enumerate(zip(names, types))))
+    return ColumnBatch.from_pydict(rt, dict(zip(names, columns)))
+
+
+def _device_group_aggregate(batch, items, aggs, group_cols, kern, imap, engine):
+    from ..ops.aggregates import segment_reduce
+
+    pools, codes_list, lanes = _encode_group_lanes(batch, group_cols)
+    cols, fns = _kernel_columns(batch, kern)
+    rep, outs, anyv, first_pos = segment_reduce(lanes, cols, fns, engine=engine)
+    group_codes = [c[rep] for c in codes_list]
+    return _assemble_group_batch(batch.schema, items, aggs, imap, group_cols,
+                                 pools, group_codes, outs, anyv, first_pos)
+
+
+def _group_aggregate(batch: "ColumnBatch", items, aggs, group_cols, engine="xla") -> "ColumnBatch":
+    """Vectorized GROUP BY. The main path encodes group keys as uint32 code
+    lanes and reduces on device via ops.aggregates.segment_reduce (ISSUE 16:
+    the same kernel the cluster workers run for partial aggregates); object
+    or bool aggregate arguments and empty inputs keep the host reduceat
+    path. Output rows are in first-appearance order of each group's key,
+    matching a streaming aggregator."""
     from ..data.batch import ColumnBatch
     from ..types import BIGINT, DOUBLE, DataField, RowType
 
@@ -651,6 +1021,9 @@ def _group_aggregate(batch: "ColumnBatch", items, aggs, group_cols) -> "ColumnBa
     for g in group_cols:
         if g not in batch.schema:
             raise QueryError(f"unknown GROUP BY column {g!r}")
+    kern, imap = _agg_kernel_plan(aggs)
+    if _kernel_routable(batch, kern):
+        return _device_group_aggregate(batch, items, aggs, group_cols, kern, imap, engine)
 
     def _codes(col):
         """Dense group codes for one column, null-aware: NULL rows form their

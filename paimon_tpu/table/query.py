@@ -32,7 +32,108 @@ if TYPE_CHECKING:
     from . import FileStoreTable
     from .write import TableWrite
 
-__all__ = ["LocalTableQuery"]
+__all__ = ["LocalTableQuery", "execute_scan_fragment"]
+
+
+def execute_scan_fragment(table: "FileStoreTable", frag: dict) -> dict:
+    """Execute one distributed-SQL scan fragment (the sql.cluster protocol)
+    against a local table: rebuild the shipped DataSplits, scan them with
+    predicate + projection pushdown, then either stream row batches back per
+    split (mode "rows") or segment-reduce the fragment into ONE partial
+    aggregate on device (mode "agg" — ops.aggregates.segment_reduce keyed on
+    dictionary codes, row positions offset by each split's global sequence
+    number so the coordinator's combine reconstructs first-appearance order
+    exactly). Returns a numpy-level payload; sql.cluster owns wire encoding.
+
+    Fragment fields: splits [(seq, DataSplit.to_dict())...], projection,
+    where (SQL text, re-lowered through the predicate algebra), mode,
+    group_cols, kern (the shared _agg_kernel_plan output), limit, engine."""
+    import numpy as np
+
+    from ..sql.expr import parse_expr, to_predicate
+    from .read import DataSplit
+
+    splits = sorted(
+        ((int(seq), DataSplit.from_dict(d)) for seq, d in frag["splits"]),
+        key=lambda p: p[0],
+    )
+    rb = table.new_read_builder()
+    if frag.get("where"):
+        rb = rb.with_filter(to_predicate(parse_expr(frag["where"]), frag["where"]))
+    if frag.get("projection") is not None:
+        rb = rb.with_projection(list(frag["projection"]))
+    read = rb.new_read()
+
+    if frag.get("mode") != "agg":
+        # non-aggregate: per-split row batches, Arrow-encoded by the caller.
+        # A cumulative per-fragment LIMIT trim is safe: a row's global index
+        # is never smaller than its fragment-local index.
+        limit = frag.get("limit")
+        out = []
+        total = 0
+        for seq, sp in splits:
+            if limit is not None and total >= limit:
+                break
+            b = read.read_all([sp])
+            if limit is not None and total + b.num_rows > limit:
+                b = b.slice(0, limit - total)
+            total += b.num_rows
+            out.append((seq, b))
+        return {"mode": "rows", "batches": out, "rows": total}
+
+    from ..data.batch import concat_batches
+    from ..metrics import sql_metrics
+    from ..ops.aggregates import segment_reduce
+    from ..sql import select as _sel
+
+    batches = []
+    positions = []
+    for seq, sp in splits:
+        b = read.read_all([sp])
+        batches.append(b)
+        # 2^40 rows per split keeps positions int64-exact and globally ordered
+        positions.append(np.arange(b.num_rows, dtype=np.int64) + (seq << 40))
+    batch = concat_batches(batches) if batches else None
+    n = batch.num_rows if batch is not None else 0
+    pos = (
+        np.concatenate(positions)
+        if positions
+        else np.zeros(0, np.int64)
+    )
+    group_cols = list(frag.get("group_cols") or [])
+    kern = [tuple(k) for k in frag.get("kern") or []]
+    if n == 0:
+        return {
+            "mode": "agg",
+            "pools": [np.empty(0, dtype=object) for _ in group_cols],
+            "group_codes": [np.zeros(0, np.uint32) for _ in group_cols],
+            "outs": [],
+            "anyv": [],
+            "first_pos": np.zeros(0, np.int64),
+            "rows": 0,
+            "rows_reduced_device": 0,
+        }
+    if group_cols:
+        pools, codes_list, lanes = _sel._encode_group_lanes(batch, group_cols)
+    else:
+        # no GROUP BY: one synthetic constant lane — the whole fragment is
+        # a single group and the coordinator combines the singletons
+        pools, codes_list = [], []
+        lanes = np.zeros((n, 1), np.uint32)
+    cols, fns = _sel._kernel_columns(batch, kern)
+    counter = sql_metrics().counter("rows_reduced_device")
+    before = counter.count
+    rep, outs, anyv, first_pos = segment_reduce(lanes, cols, fns, pos=pos, engine=frag.get("engine", "xla"))
+    return {
+        "mode": "agg",
+        "pools": pools,
+        "group_codes": [c[rep] for c in codes_list],
+        "outs": outs,
+        "anyv": anyv,
+        "first_pos": first_pos,
+        "rows": n,
+        "rows_reduced_device": counter.count - before,
+    }
 
 
 class LocalTableQuery:

@@ -125,6 +125,17 @@
 #                              xla-segmented — so the fused pallas kernels
 #                              and the stock XLA path both prove
 #                              bit-identical merge output end to end.
+#   scripts/verify.sh sql-cluster  distributed-SQL parity stage: the
+#                              tests/test_sql_cluster.py suite (scatter-
+#                              gather fragments at 1/2/4 workers vs the
+#                              single-process evaluator vs pandas, worker
+#                              kill mid-query incl. the slow SIGKILL OS-
+#                              process test, typed-BUSY admission) run
+#                              TWICE — PAIMON_TPU_SQL_CODE_DOMAIN forced 1
+#                              (partials combined as dictionary codes),
+#                              then 0 (expanded values on the wire) — so
+#                              both combine currencies prove bit-identical
+#                              distributed results.
 #
 # Exits non-zero on test failure/timeout; tier-1 prints DOTS_PASSED=<n>
 # (count of passing tests) for trend comparison.
@@ -256,6 +267,18 @@ if [ "${1:-}" = "cluster" ]; then
     --duration 45 --workers 2 --readers 1 --seed 0 \
     --scripted-kills "flush:files-written:2:kill,cluster:compact-executing:1:kill,cluster:before-ship:2:kill" \
     --kill-period 10 --sweep-period 15 --min-kills 2
+fi
+
+if [ "${1:-}" = "sql-cluster" ]; then
+  # no -m filter: includes the slow SIGKILL OS-process worker-kill test.
+  # Code-domain combine forced on, then off: distributed aggregation must
+  # be bit-identical to the single-process evaluator in both currencies
+  for cd in 1 0; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_SQL_CODE_DOMAIN=$cd \
+      timeout -k 10 600 python -m pytest tests/test_sql_cluster.py tests/test_sql_select.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
 fi
 
 if [ "${1:-}" = "encode" ]; then

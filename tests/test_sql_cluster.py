@@ -1,0 +1,352 @@
+"""Distributed SQL (ISSUE 16): scatter-gather scan fragments with
+code-domain partial aggregation must be BIT-IDENTICAL to the single-process
+evaluator (and both to a pandas oracle) across query shapes, worker counts,
+the code-domain toggle, and mid-query worker death.
+
+The column values are chosen exactly-representable (multiples of 0.25), so
+float sums are order-independent and bit-equality is a fair assertion."""
+
+import contextlib
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.metrics import soak_metrics, sql_metrics
+from paimon_tpu.service.cluster import (
+    ClusterClient,
+    ClusterConfig,
+    ClusterCoordinator,
+    ClusterWorkerAgent,
+)
+from paimon_tpu.sql import cluster_query, query
+from paimon_tpu.table import load_table
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+N = 2_000
+BUCKETS = 4
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    """One read-only warehouse shared by every cluster in this module:
+    a 4-bucket fact table (three overlapping commits — queries see MERGED
+    rows), a dimension table for JOIN, and the pandas oracle frame."""
+    wh = str(tmp_path_factory.mktemp("sqlcluster"))
+    cat = FileSystemCatalog(wh, commit_user="rig")
+    t = cat.create_table(
+        "db.r",
+        RowType.of(("k", BIGINT(False)), ("a", BIGINT()), ("b", DOUBLE()), ("g", STRING())),
+        primary_keys=["k"],
+        options={"bucket": str(BUCKETS), "write-only": "true"},
+    )
+    rng = np.random.default_rng(99)
+    for r in range(3):
+        ks = rng.choice(2 * N, size=N, replace=False)
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write({
+            "k": ks.tolist(),
+            # a: None every 11th key — null-aware aggregation must agree
+            "a": [None if x % 11 == 0 else int(x * (r + 1) % 1000) for x in ks.tolist()],
+            "b": (ks * 0.25 + r).tolist(),  # exactly-representable doubles
+            "g": [f"g{int(x) % 5}" for x in ks.tolist()],
+        })
+        wb.new_commit().commit(w.prepare_commit())
+    d = cat.create_table(
+        "db.d",
+        RowType.of(("id", BIGINT(False)), ("name", STRING())),
+        primary_keys=["id"],
+        options={"bucket": "1", "write-only": "true"},
+    )
+    wb = d.new_batch_write_builder()
+    w = wb.new_write()
+    w.write({"id": list(range(5)), "name": [f"name{i}" for i in range(5)]})
+    wb.new_commit().commit(w.prepare_commit())
+    merged = query(cat, "SELECT k, a, b, g FROM db.r").to_pylist()
+    df = pd.DataFrame(merged, columns=["k", "a", "b", "g"])
+    return cat, t.path, df
+
+
+@contextlib.contextmanager
+def _cluster(root, workers, heartbeat_timeout_s=4.0):
+    coord = ClusterCoordinator(
+        root,
+        ClusterConfig(
+            workers=workers, buckets=BUCKETS, compaction=False,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+        ),
+    ).start()
+    agents, cli = [], None
+    try:
+        for wid in range(workers):
+            a = ClusterWorkerAgent(
+                wid, load_table(root, commit_user=f"sqlw{wid}"), coord.host, coord.port,
+                serve=True, heartbeat_interval_s=0.1,
+            )
+            a.register()
+            a.start_heartbeats()
+            agents.append(a)
+        cli = ClusterClient(load_table(root, commit_user="sqlcli"), coord.host, coord.port)
+        yield cli, agents, coord
+    finally:
+        if cli is not None:
+            cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+QUERIES = [
+    # scalar aggregates (incl. null-aware count/sum over `a`)
+    "SELECT count(*), count(a), sum(a), min(b), max(b), avg(b) FROM db.r",
+    "SELECT sum(b), avg(a) FROM db.r WHERE k < 1500",
+    "SELECT count(*) FROM db.r WHERE a >= 990",  # near-empty
+    "SELECT sum(a) FROM db.r WHERE k > 999999",  # empty scan
+    # GROUP BY string key
+    "SELECT g, count(*), count(a), sum(a), min(b), max(b), avg(a) FROM db.r GROUP BY g ORDER BY g",
+    # GROUP BY fixed-width key + multi-key
+    "SELECT a, count(*) FROM db.r GROUP BY a ORDER BY a LIMIT 30",
+    "SELECT a, g, sum(b) FROM db.r GROUP BY a, g ORDER BY a, g LIMIT 50",
+    # HAVING + hidden aggregates + ORDER BY on an aggregate
+    "SELECT g, sum(b) FROM db.r GROUP BY g HAVING count(*) > 10 AND min(b) >= 0.0 ORDER BY sum(b) DESC",
+    # DISTINCT = GROUP BY with no aggregates
+    "SELECT DISTINCT g FROM db.r ORDER BY g",
+    # non-aggregate streams
+    "SELECT k, b FROM db.r WHERE k >= 140 ORDER BY k DESC LIMIT 13",
+    "SELECT k FROM db.r LIMIT 7",
+    "SELECT * FROM db.r WHERE g = 'g1' ORDER BY k LIMIT 25",
+]
+
+
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_cluster_query_parity_matrix(rig, workers):
+    cat, root, _df = rig
+    with _cluster(root, workers) as (cli, _agents, _coord):
+        for q in QUERIES:
+            want = query(cat, q)
+            got = cluster_query(cat, q, cli)
+            assert want.schema.field_names == got.schema.field_names, q
+            assert want.to_pylist() == got.to_pylist(), q
+        assert sql_metrics().counter("rows_reduced_device").count > 0
+        assert sql_metrics().counter("fragments").count > 0
+
+
+def test_cluster_query_matches_pandas_oracle(rig):
+    cat, root, df = rig
+    rng = np.random.default_rng(7)
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        for v in rng.integers(0, 900, size=4).tolist():
+            got = cluster_query(
+                cat,
+                f"SELECT g, count(*), sum(a), min(b), max(b) FROM db.r "
+                f"WHERE k >= {v} GROUP BY g ORDER BY g",
+                cli,
+            ).to_pylist()
+            sub = df[df.k >= v]
+            want = (
+                sub.groupby("g")
+                .agg(n=("g", "size"), sa=("a", "sum"), mnb=("b", "min"), mxb=("b", "max"))
+                .reset_index()
+                .sort_values("g")
+            )
+            assert [r[0] for r in got] == want.g.tolist()
+            for row, (_, w) in zip(got, want.iterrows()):
+                assert row[1] == w.n and row[2] == int(w.sa)
+                assert row[3] == w.mnb and row[4] == w.mxb
+            # scalar shape against the same slice
+            (srow,) = cluster_query(
+                cat, f"SELECT count(*), sum(b) FROM db.r WHERE k >= {v}", cli
+            ).to_pylist()
+            assert srow[0] == len(sub) and srow[1] == sub.b.sum()
+
+
+def test_cluster_join_group_by_parity(rig):
+    """JOIN + GROUP BY distributes through the worker join_part seam and
+    the shared _finish tail — identical to the local evaluator."""
+    cat, root, _df = rig
+    q = (
+        "SELECT d.name, count(*), sum(f.b) FROM db.r f JOIN db.d d "
+        "ON f.a = d.id GROUP BY d.name ORDER BY d.name"
+    )
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        want = query(cat, q)
+        got = cluster_query(cat, q, cli)
+        assert want.to_pylist() == got.to_pylist()
+
+
+def test_code_domain_toggle_parity(rig, monkeypatch):
+    """Code-domain combine ON ships (pool, codes); OFF ships expanded values
+    the coordinator re-encodes — identical results, and the
+    sql{code_domain_groups} metric fires only when ON."""
+    cat, root, _df = rig
+    q = "SELECT g, count(*), sum(b) FROM db.r GROUP BY g ORDER BY g"
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        monkeypatch.setenv("PAIMON_TPU_SQL_CODE_DOMAIN", "1")
+        before = sql_metrics().counter("code_domain_groups").count
+        on = cluster_query(cat, q, cli)
+        assert sql_metrics().counter("code_domain_groups").count > before
+        monkeypatch.setenv("PAIMON_TPU_SQL_CODE_DOMAIN", "0")
+        before = sql_metrics().counter("code_domain_groups").count
+        off = cluster_query(cat, q, cli)
+        assert sql_metrics().counter("code_domain_groups").count == before
+        assert on.to_pylist() == off.to_pylist() == query(cat, q).to_pylist()
+
+
+def test_cluster_query_dict_string_group_keys(rig, tmp_path):
+    """GROUP BY over dict-domain (code-backed) string columns: the worker's
+    pruned pools ride the wire and unify at the coordinator."""
+    cat, root, _df = rig
+    dd = FileSystemCatalog(str(tmp_path / "ddwh"), commit_user="dd")
+    t = dd.create_table(
+        "db.s",
+        RowType.of(("k", BIGINT(False)), ("v", DOUBLE()), ("g", STRING())),
+        primary_keys=["k"],
+        options={"bucket": str(BUCKETS), "write-only": "true", "merge.dict-domain": "true"},
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    ks = np.arange(1200, dtype=np.int64)
+    w.write({
+        "k": ks.tolist(),
+        "v": (ks * 0.5).tolist(),
+        "g": [f"city{int(x) % 7}" for x in ks.tolist()],
+    })
+    wb.new_commit().commit(w.prepare_commit())
+    q = "SELECT g, count(*), sum(v) FROM db.s GROUP BY g ORDER BY g"
+    with _cluster(t.path, 2) as (cli, _agents, _coord):
+        assert cluster_query(dd, q, cli).to_pylist() == query(dd, q).to_pylist()
+
+
+def test_worker_death_mid_query_fragments_retried(rig):
+    """Kill a worker under the query: its fragments fail, the coordinator
+    reassigns the buckets on missed heartbeats, the route refreshes and the
+    splits re-dispatch to the survivor — exact result, retries counted."""
+    cat, root, _df = rig
+    q = "SELECT g, count(*), sum(b) FROM db.r GROUP BY g ORDER BY g"
+    want = query(cat, q).to_pylist()
+    with _cluster(root, 2, heartbeat_timeout_s=1.0) as (cli, agents, _coord):
+        before = sql_metrics().counter("fragments_retried").count
+        agents[1].close()  # dies with its buckets still routed to it
+        got = cluster_query(cat, q, cli)
+        assert got.to_pylist() == want
+        assert sql_metrics().counter("fragments_retried").count > before
+
+
+def test_scan_frag_busy_shed_and_client_backoff(rig):
+    """Admission: a worker with no free scan slots answers a typed BUSY
+    (counted in soak{shed_requests}); ClusterClient.scan_frag absorbs the
+    shed with the server-advertised backoff and succeeds once a slot frees."""
+    cat, root, _df = rig
+    with _cluster(root, 1) as (cli, agents, _coord):
+        server = agents[0].server
+        slots = server._scan_slots
+        grabbed = 0
+        while slots.acquire(blocking=False):
+            grabbed += 1
+        before = soak_metrics().counter("shed_requests").count
+        r = server._dispatch("scan_frag", {"frag": {"splits": []}})
+        assert r.get("busy") and r["retry_after_ms"] > 0
+        assert soak_metrics().counter("shed_requests").count == before + 1
+
+        def _release_soon():
+            time.sleep(0.3)
+            for _ in range(grabbed):
+                slots.release()
+
+        threading.Thread(target=_release_soon, daemon=True).start()
+        out = cluster_query(cat, "SELECT count(*) FROM db.r", cli)
+        assert out.to_pylist() == query(cat, "SELECT count(*) FROM db.r").to_pylist()
+
+
+def test_cluster_query_local_fallbacks(rig, tmp_path):
+    """Shapes the fragment protocol does not cover run through the local
+    evaluator unchanged: system tables, OPTIONS hints, foreign tables."""
+    cat, root, _df = rig
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        assert (
+            cluster_query(cat, "SELECT snapshot_id FROM db.r$snapshots", cli).num_rows
+            == query(cat, "SELECT snapshot_id FROM db.r$snapshots").num_rows
+        )
+        q = "SELECT k FROM db.r /*+ OPTIONS('merge-read-batch-rows'='64') */ LIMIT 3"
+        assert cluster_query(cat, q, cli).num_rows == 3
+        # a table this client does not serve
+        q2 = "SELECT count(*) FROM db.d"
+        assert cluster_query(cat, q2, cli).to_pylist() == query(cat, q2).to_pylist()
+
+
+@pytest.mark.slow
+def test_cluster_query_sigkill_worker_multiprocess(rig, tmp_path):
+    """The acceptance kill test: OS-process serve-mode workers behind a
+    latency-shaped store, SIGKILL one mid-query — the fragment retries on
+    the reassigned owner and the result is exact."""
+    cat, root, df = rig
+    run = tmp_path / "run"
+    run.mkdir()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PAIMON_TPU_CLUSTER_ROLE"] = "worker"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + (os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    coord = ClusterCoordinator(
+        root, ClusterConfig(workers=2, buckets=BUCKETS, compaction=False, heartbeat_timeout_s=1.0)
+    ).start()
+    procs = []
+    cli = None
+    try:
+        for wid in range(2):
+            log = open(run / f"w{wid}.log", "wb")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "paimon_tpu.service.cluster", "worker",
+                 "--table", root, "--wid", str(wid),
+                 "--coordinator", f"{coord.host}:{coord.port}",
+                 "--mode", "serve", "--heartbeat-interval", "0.1",
+                 "--rtt-read-ms", "25"],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+            ))
+            log.close()
+        deadline = time.monotonic() + 60
+        cli = None
+        while time.monotonic() < deadline:
+            try:
+                cli = ClusterClient(load_table(root, commit_user="cli"), coord.host, coord.port)
+                if len({cli.owner_of(b) for b in range(BUCKETS)}) == 2:
+                    break
+                cli.close()
+                cli = None
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert cli is not None, "workers never registered serve ports"
+        q = "SELECT g, count(*), sum(b) FROM db.r GROUP BY g ORDER BY g"
+        want = query(cat, q).to_pylist()
+        result, errs = [], []
+
+        def _run():
+            try:
+                result.append(cluster_query(cat, q, cli).to_pylist())
+            except Exception as e:  # surfaced below
+                errs.append(e)
+
+        th = threading.Thread(target=_run)
+        th.start()
+        time.sleep(0.1)  # let fragments dispatch into the latency-shaped reads
+        os.kill(procs[1].pid, signal.SIGKILL)
+        th.join(timeout=120)
+        assert not th.is_alive() and not errs, errs
+        assert result[0] == want
+    finally:
+        if cli is not None:
+            cli.close()
+        for p in procs:
+            with contextlib.suppress(Exception):
+                p.kill()
+                p.wait(timeout=10)
+        coord.close()

@@ -194,3 +194,58 @@ def test_select_distinct(cat):
         query(cat, "SELECT DISTINCT count(*) FROM db.t")
     with pytest.raises(QueryError, match="column list"):
         query(cat, "SELECT DISTINCT * FROM db.t")
+
+
+def test_select_having_filters_groups(cat):
+    # s cycles g0/g1/g2 over 150 merged keys: g0 gets 50, g1 gets 50, g2 gets 50
+    out = query(cat, "SELECT s, count(*) FROM db.t GROUP BY s HAVING count(*) >= 50 ORDER BY s")
+    assert [r[0] for r in out.to_pylist()] == ["g0", "g1", "g2"]
+    # discriminating predicate: only groups whose min key is below the cut
+    out = query(cat, "SELECT s, min(k) FROM db.t GROUP BY s HAVING min(k) < 2 ORDER BY s")
+    assert [tuple(r) for r in out.to_pylist()] == [("g0", 0), ("g1", 1)]
+    # HAVING over an aggregate NOT in the select list (hidden extra aggregate)
+    out = query(cat, "SELECT s FROM db.t GROUP BY s HAVING max(k) = 149")
+    assert [r[0] for r in out.to_pylist()] == ["g2"]
+    assert out.schema.field_names == ["s"]
+    # bare group-column refs combine with aggregate calls
+    out = query(cat, "SELECT s, count(*) FROM db.t GROUP BY s HAVING s <> 'g1' AND count(*) > 0 ORDER BY s")
+    assert [r[0] for r in out.to_pylist()] == ["g0", "g2"]
+    # repeated call of a selected aggregate reuses the select item's column
+    out = query(
+        cat,
+        "SELECT s, sum(v) FROM db.t GROUP BY s HAVING sum(v) > 0 ORDER BY sum(v) DESC LIMIT 1",
+    )
+    assert len(out.to_pylist()) == 1
+
+
+def test_select_having_errors(cat):
+    with pytest.raises(QueryError, match="HAVING requires GROUP BY"):
+        query(cat, "SELECT count(*) FROM db.t HAVING count(*) > 1")
+    with pytest.raises(QueryError):
+        # non-grouped bare column ref in HAVING
+        query(cat, "SELECT s, count(*) FROM db.t GROUP BY s HAVING v > 3")
+
+
+def test_agg_projection_pruning():
+    from paimon_tpu.sql.select import agg_projection, parse_select
+
+    rt = RowType.of(("k", BIGINT(False)), ("v", BIGINT()), ("x", DOUBLE()), ("s", STRING()))
+    # pure count(*): any single cheap column satisfies the scan
+    assert agg_projection(parse_select("SELECT count(*) FROM db.t"), rt) == ["k"]
+    # scalar aggregates read exactly their arguments, deduplicated
+    assert agg_projection(
+        parse_select("SELECT sum(v), min(v), max(x) FROM db.t"), rt
+    ) == ["v", "x"]
+    # GROUP BY adds keys first, then agg args, HAVING args, ORDER BY cols
+    assert agg_projection(
+        parse_select(
+            "SELECT s, sum(v) FROM db.t GROUP BY s HAVING min(x) < 9 ORDER BY s"
+        ),
+        rt,
+    ) == ["s", "v", "x"]
+    # ORDER BY on an aggregate alias is not a table column: not projected
+    assert agg_projection(
+        parse_select("SELECT s, count(*) FROM db.t GROUP BY s ORDER BY count(*) DESC"), rt
+    ) == ["s"]
+    # non-aggregate plans opt out of pruning
+    assert agg_projection(parse_select("SELECT k, v FROM db.t"), rt) is None
