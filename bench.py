@@ -489,6 +489,21 @@ def bench_sql_cluster() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_gateway() -> list:
+    """Gateway hedged-read spot-check (benchmarks/gateway_bench.py is the
+    dedicated rig): one latency-shamed worker in a 2-worker cluster, the
+    same probe sequence through an unhedged and a hedged Gateway, results
+    asserted bit-identical to the formula oracle and the hedge budget
+    (gateway.hedge.max-fraction) asserted respected."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "gateway_bench.py")
+    spec = importlib.util.spec_from_file_location("_gateway_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_resilience() -> dict:
     """Commit resilience spot-check (benchmarks/resilience_bench.py is the
     dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
@@ -558,6 +573,7 @@ def main():
         encode_rows = bench_encode()
         mesh_rows = bench_mesh()
         sql_cluster_rows = bench_sql_cluster()
+        gateway_rows = bench_gateway()
         resilience_row = bench_resilience()
         soak_row = bench_soak()
         row = {
@@ -613,6 +629,8 @@ def main():
             print(json.dumps(dict(mrow, platform=_PLATFORM)))
         for qrow in sql_cluster_rows:
             print(json.dumps(dict(qrow, platform=_PLATFORM)))
+        for grow in gateway_rows:
+            print(json.dumps(dict(grow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
         print(json.dumps(dict(soak_row, platform=_PLATFORM)))
     finally:

@@ -96,6 +96,7 @@ class BucketGetIndex:
         key_names: Sequence[str],
         deletion_vectors: dict | None = None,
         bloom_prune: bool = True,
+        warm_from: "BucketGetIndex | None" = None,
     ):
         self.files = list(files)
         self.reader_factory = reader_factory
@@ -104,6 +105,33 @@ class BucketGetIndex:
         self.bloom_prune = bloom_prune
         self._indexes: dict[str, FileProbeIndex] = {}
         self._payloads: dict[str, object] = {}  # file -> FileIndexPredicate|None
+        if warm_from is not None:
+            # carry warm state for files that persist across the snapshot
+            # advance: an ordinary L0 append changes one file in the bucket,
+            # and without the carry every built probe index is discarded and
+            # the next get re-reads the whole bucket. Probe indexes bake in
+            # deletion vectors, so a file is carried only when neither side
+            # has a DV for it; PTIX predicates are DV-independent.
+            names = {f.file_name for f in self.files}
+            for name, idx in warm_from._indexes.items():
+                if (
+                    name in names
+                    and name not in self.deletion_vectors
+                    and name not in warm_from.deletion_vectors
+                ):
+                    self._indexes[name] = idx
+            for name, pred in warm_from._payloads.items():
+                if name in names:
+                    self._payloads[name] = pred
+
+    def prewarm(self) -> None:
+        """Eagerly build the probe index for every file not already warm.
+        Servers call this off the serving path (the follower refresh builds
+        staged state outside the serving lock) so a snapshot advance never
+        makes the first unlucky get pay the whole bucket's read cost."""
+        for meta in self.files:
+            if meta.file_name not in self._indexes:
+                self._file_index(meta)
 
     # ---- pruning (no data IO) ------------------------------------------
     def _index_predicate(self, meta: DataFileMeta):

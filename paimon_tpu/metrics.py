@@ -26,6 +26,7 @@ __all__ = [
     "decode_metrics",
     "dict_metrics",
     "encode_metrics",
+    "gateway_metrics",
     "get_metrics",
     "io_metrics",
     "join_metrics",
@@ -364,11 +365,31 @@ def sql_metrics() -> MetricGroup:
     both count; the numpy twin does not), code_domain_groups (groups whose
     keys travelled coordinator-ward as dictionary codes + pruned pools,
     never expanded), rows_streamed (non-aggregate rows gathered back
-    Arrow-encoded); histograms: scatter_ms (dispatch + worker execution +
-    gather wall millis per query), combine_ms (coordinator-side code-domain
-    combine wall millis per aggregate query). Resolved per call so
-    registry.reset() in tests swaps the group out."""
+    Arrow-encoded), fragment_cache_hits (aggregate queries answered from
+    the coordinator's fragment-result cache — same snapshot, same fragment
+    signature — without any worker RPC); histograms: scatter_ms (dispatch +
+    worker execution + gather wall millis per query), combine_ms
+    (coordinator-side code-domain combine wall millis per aggregate query).
+    Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("sql")
+
+
+def gateway_metrics() -> MetricGroup:
+    """The gateway{...} group (multi-tenant front door,
+    paimon_tpu.service.gateway). Canonical members — counters: requests
+    (every request entering the gateway, any kind), admitted (requests that
+    passed per-tenant QoS admission), sheds_typed (requests refused with a
+    canonical ShedInfo — tenant budget, write backpressure, subscriber
+    shed), sheds_untyped (client-observed failures under pressure that were
+    NOT a typed shed; the storm harness counts these and asserts ZERO),
+    hedges_issued (read RPCs re-issued to a secondary worker past
+    gateway.hedge.deadline-ms), hedges_won (hedges where the secondary's
+    answer was used), hedges_cancelled (loser attempts aborted after a
+    winner returned); histograms: put_ms / get_batch_ms / subscribe_ms /
+    sql_ms (per-kind gateway wall millis, all tenants mixed — the
+    per-tenant decayed percentiles live in Gateway.slo()). Resolved per
+    call so registry.reset() in tests swaps the group out."""
+    return registry.group("gateway")
 
 
 def sub_metrics() -> MetricGroup:

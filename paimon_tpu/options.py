@@ -638,6 +638,93 @@ class CoreOptions:
         "query's unfinished fragments across route refreshes (worker "
         "deaths, reassignments, BUSY sheds) before the query fails.",
     )
+    SQL_CLUSTER_FRAGMENT_CACHE = ConfigOption.bool_(
+        "sql.cluster.fragment-cache",
+        True,
+        "Distributed SQL: cache aggregate fragment partials at the "
+        "coordinator keyed on (snapshot id, fragment signature — semantic "
+        "template plus every planned split). A repeated aggregate over an "
+        "unchanged table answers without any worker RPC "
+        "(sql{fragment_cache_hits}); any plan at a newer snapshot purges "
+        "the table's stale entries.",
+    )
+    GATEWAY_MAX_INFLIGHT = ConfigOption.int_(
+        "gateway.max-inflight",
+        64,
+        "Multi-tenant gateway: default concurrent in-flight requests a "
+        "tenant may hold before the gateway sheds with a typed "
+        "'busy-inflight' ShedInfo (retry_after_ms hinted). Overridable "
+        "per tenant via gateway.tenant.<id>.max-inflight.",
+    )
+    GATEWAY_BYTES_PER_SEC = ConfigOption.memory(
+        "gateway.bytes-per-sec",
+        "0 b",
+        "Multi-tenant gateway: total request-byte budget per second shared "
+        "weighted-fair across tenants (tenant i receives rate * w_i / sum "
+        "of all configured weights, further capped by its own "
+        "gateway.tenant.<id>.bytes-per-sec). 0 = unlimited; a tenant whose "
+        "token bucket runs dry is shed with a typed 'throttling-bytes' "
+        "ShedInfo whose retry_after_ms is the exact refill deadline.",
+    )
+    GATEWAY_TENANT_WEIGHT = ConfigOption.float_(
+        "gateway.tenant.<id>.weight",
+        1.0,
+        "Multi-tenant gateway (templated key): tenant <id>'s weighted-fair "
+        "share of gateway.bytes-per-sec. Untagged traffic lands in the "
+        "'default' tenant with weight 1.0.",
+    )
+    GATEWAY_TENANT_MAX_INFLIGHT = ConfigOption.int_(
+        "gateway.tenant.<id>.max-inflight",
+        None,
+        "Multi-tenant gateway (templated key): tenant <id>'s concurrent "
+        "in-flight request cap, overriding gateway.max-inflight.",
+    )
+    GATEWAY_TENANT_BYTES_PER_SEC = ConfigOption.memory(
+        "gateway.tenant.<id>.bytes-per-sec",
+        "0 b",
+        "Multi-tenant gateway (templated key): hard per-second byte cap for "
+        "tenant <id>, applied on top of its weighted-fair share of the "
+        "global gateway.bytes-per-sec budget. 0 = no per-tenant cap.",
+    )
+    GATEWAY_HEDGE_ENABLED = ConfigOption.bool_(
+        "gateway.hedge.enabled",
+        True,
+        "Multi-tenant gateway: re-issue a point-get or scan fragment whose "
+        "primary (owning worker) misses gateway.hedge.deadline-ms to a "
+        "secondary live non-owner worker serving the same committed "
+        "snapshot from the shared filesystem — first non-BUSY answer wins, "
+        "the loser is cancelled and counted (gateway{hedges_cancelled}).",
+    )
+    GATEWAY_HEDGE_DEADLINE = ConfigOption.int_(
+        "gateway.hedge.deadline-ms",
+        50,
+        "Multi-tenant gateway: milliseconds the primary worker gets before "
+        "the gateway hedges the read to a secondary. Tail-latency armor — "
+        "set near the healthy-path p99 so only stragglers pay the second "
+        "RPC.",
+    )
+    GATEWAY_HEDGE_MAX_FRACTION = ConfigOption.float_(
+        "gateway.hedge.max-fraction",
+        0.25,
+        "Multi-tenant gateway: upper bound on hedged requests as a fraction "
+        "of all hedgeable requests — a cluster-wide brownout must not "
+        "double every read. Beyond the bound the gateway waits out the "
+        "primary instead of hedging.",
+    )
+    GATEWAY_SLO_DECAY_WINDOW = ConfigOption.duration(
+        "gateway.slo.decay-window",
+        "30 s",
+        "Multi-tenant gateway: exponential-decay time constant of the SLO "
+        "surface's latency histograms (gateway.slo() p50/p99 per tenant "
+        "and request kind). Old samples fade with exp(-age/window) so the "
+        "surface tracks current behavior, not the run's whole history.",
+    )
+    GATEWAY_RETRY_AFTER = ConfigOption.int_(
+        "gateway.retry-after-ms",
+        25,
+        "Multi-tenant gateway: backoff hint stamped into inflight-cap sheds "
+        "(byte-budget sheds compute their exact refill deadline instead).",
+    )
     ORPHAN_CLEAN_OLDER_THAN = ConfigOption.duration(
         "orphan.clean.older-than",
         "1 d",

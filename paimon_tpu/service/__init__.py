@@ -38,10 +38,12 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import TYPE_CHECKING
 
 from ..fs import FileIO
 from ..utils import dumps, loads
+from .shed import ShedError, ShedInfo
 
 if TYPE_CHECKING:
     from ..table import FileStoreTable
@@ -49,15 +51,17 @@ if TYPE_CHECKING:
 __all__ = ["KvQueryServer", "KvQueryClient", "KvBusyError", "ServiceManager"]
 
 
-class KvBusyError(RuntimeError):
+class KvBusyError(ShedError):
     """The server shed a get_batch with a typed BUSY (lookup.get.max-inflight
-    saturated). Carries the payload and the server's retry-after hint — the
-    read-side twin of the ingest path's FlightBusyError."""
+    saturated). A serialization of service.shed.ShedInfo (kind="get_batch"):
+    carries the payload and the server's retry-after hint — the read-side
+    twin of the ingest path's FlightBusyError — plus the canonical
+    ``shed_info`` record for shed-kind-generic callers (the gateway)."""
 
-    def __init__(self, payload: dict):
-        super().__init__(f"get shed by server: {payload}")
-        self.payload = payload
-        self.retry_after_ms = int(payload.get("retry_after_ms", 0))
+    default_kind = "get_batch"
+
+    def __init__(self, payload: "dict | ShedInfo"):
+        super().__init__(payload, message=f"get shed by server: {payload}")
 
 
 def _send(sock: socket.socket, obj: dict) -> None:
@@ -117,6 +121,7 @@ class KvQueryServer:
         health_provider=None,
         table_write=None,
         max_inflight_gets: int | None = None,
+        gateway=None,
     ):
         """`health_provider`: an optional zero-arg callable returning the
         flow-control dict to serve on the `health` method — typically
@@ -130,7 +135,13 @@ class KvQueryServer:
 
         `max_inflight_gets`: get_batch admission depth (default from
         lookup.get.max-inflight); the request past the cap is answered with
-        a typed busy response, not queued."""
+        a typed busy response, not queued.
+
+        `gateway`: an optional service.gateway.Gateway. With one, get_batch
+        requests carrying a `tenant` field run through the gateway's
+        per-tenant admission (weighted-fair byte/inflight budgets) BEFORE
+        the local inflight gate, their latency lands on the gateway's SLO
+        surface, and the `slo` method serves gateway.slo()."""
         from ..options import CoreOptions
         from ..table.query import LocalTableQuery
 
@@ -139,6 +150,7 @@ class KvQueryServer:
         if table_write is not None:
             self.query.attach_write(table_write)
         self.health_provider = health_provider
+        self.gateway = gateway
         if max_inflight_gets is None:
             max_inflight_gets = int(table.options.options.get(CoreOptions.LOOKUP_GET_MAX_INFLIGHT))
         self._get_gate = threading.BoundedSemaphore(max(int(max_inflight_gets), 1))
@@ -165,6 +177,9 @@ class KvQueryServer:
                                 else {"state": "ok"}
                             )
                             _send(self.request, {"id": rid, "ok": True, "health": h})
+                        elif method == "slo":
+                            s = outer.gateway.slo() if outer.gateway is not None else {}
+                            _send(self.request, {"id": rid, "ok": True, "slo": s})
                         elif method == "refresh":
                             with lock:
                                 query.refresh()
@@ -177,25 +192,42 @@ class KvQueryServer:
                                 {"id": rid, "ok": True, "row": None if row is None else list(row.to_pylist()[0])},
                             )
                         elif method == "get_batch":
+                            gw_tenant = None
+                            if outer.gateway is not None:
+                                gw_tenant, shed = outer.gateway.admit(
+                                    req.get("tenant"), "get_batch"
+                                )
+                                if shed is not None:
+                                    from ..metrics import soak_metrics
+
+                                    soak_metrics().counter("shed_requests").inc()
+                                    _send(
+                                        self.request,
+                                        {"id": rid, "ok": False, **shed.to_payload()},
+                                    )
+                                    continue
                             if not outer._get_gate.acquire(blocking=False):
                                 # typed BUSY: the admission depth is
                                 # saturated — shed NOW, never queue the
                                 # client into a timeout
                                 from ..metrics import get_metrics, soak_metrics
 
+                                if gw_tenant is not None:
+                                    outer.gateway.release(gw_tenant)
                                 get_metrics().counter("busy_rejected").inc()
                                 soak_metrics().counter("shed_requests").inc()
+                                info = ShedInfo(
+                                    kind="get_batch",
+                                    state="busy-reads",
+                                    tenant=gw_tenant,
+                                    retry_after_ms=25,
+                                )
                                 _send(
                                     self.request,
-                                    {
-                                        "id": rid,
-                                        "ok": False,
-                                        "busy": True,
-                                        "state": "busy-reads",
-                                        "retry_after_ms": 25,
-                                    },
+                                    {"id": rid, "ok": False, **info.to_payload()},
                                 )
                                 continue
+                            t0 = time.perf_counter()
                             try:
                                 ks = [tuple(k) if isinstance(k, list) else (k,) for k in req["keys"]]
                                 with lock:
@@ -203,6 +235,9 @@ class KvQueryServer:
                                 rows = [None if r is None else list(r) for r in res.to_pylist()]
                             finally:
                                 outer._get_gate.release()
+                                if gw_tenant is not None:
+                                    outer.gateway.release(gw_tenant)
+                                    outer.gateway.observe(gw_tenant, "get_batch", t0)
                             _send(self.request, {"id": rid, "ok": True, "rows": rows})
                         else:
                             _send(self.request, {"id": rid, "ok": False, "error": f"unknown method {method}"})
@@ -274,13 +309,23 @@ class KvQueryClient:
         row = self._call("lookup", partition=list(partition), key=list(key)).get("row")
         return None if row is None else tuple(row)
 
-    def get_batch(self, keys, partition: tuple = ()) -> list:
+    def get_batch(self, keys, partition: tuple = (), tenant: str | None = None) -> list:
         """Batched gets: list[tuple | None] aligned with `keys`. Raises
         KvBusyError (typed, with retry_after_ms) when the server shed the
-        request under read overload — callers back off, never time out."""
+        request under read overload — callers back off, never time out.
+        `tenant` tags the request for a gateway-fronted server's per-tenant
+        admission (untagged rides the "default" tenant budget)."""
         ks = [list(k) if isinstance(k, (tuple, list)) else [k] for k in keys]
-        rows = self._call("get_batch", partition=list(partition), keys=ks)["rows"]
+        kw = {"partition": list(partition), "keys": ks}
+        if tenant is not None:
+            kw["tenant"] = tenant
+        rows = self._call("get_batch", **kw)["rows"]
         return [None if r is None else tuple(r) for r in rows]
+
+    def slo(self) -> dict:
+        """The gateway SLO surface of a gateway-fronted server (empty dict
+        when the server has no gateway attached)."""
+        return self._call("slo")["slo"]
 
     def close(self) -> None:
         self._sock.close()

@@ -629,6 +629,21 @@ class _RpcConn:
         except OSError:
             pass
 
+    def cancel(self) -> None:
+        """Abort an in-flight call from ANOTHER thread. shutdown() unblocks
+        a peer stuck in recv (close() alone need not), so the blocked call
+        raises ConnectionError — the gateway's hedge-loser teardown.
+
+        Deliberately NOT close(): the blocked caller still owns this fd.
+        Closing here frees the fd number for reuse while that caller may be
+        an instruction away from recv()ing on it — it would then block
+        forever stealing a brand-new connection's replies. The caller's
+        error path discards (closes) the connection itself."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
 
 def _row_buckets(table, batch) -> np.ndarray:
     """(n,) int32 bucket id per row of a value batch (fixed-bucket route)."""
@@ -672,7 +687,14 @@ class _WorkerServer:
     each fanned batch to the requested buckets so a routed client folds
     exactly its shard's changelog."""
 
-    def __init__(self, table, owned: "callable", host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        table,
+        owned: "callable",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        delay_ms: "float | None" = None,
+    ):
         from ..options import CoreOptions
         from ..table.query import LocalTableQuery
         from .subscription import SubscriptionHub
@@ -680,6 +702,12 @@ class _WorkerServer:
         self.table = table
         self._owned = owned  # () -> set[int], the worker's live bucket set
         self._lock = threading.Lock()
+        # injected straggler latency on the read plane (get_batch/scan_frag):
+        # the gateway bench/storm latency-shame one worker to measure hedging
+        if delay_ms is None:
+            delay_ms = float(os.environ.get("PAIMON_TPU_WORKER_SERVE_DELAY_MS", "0"))
+        self._delay_ms = float(delay_ms)
+        self._closed = False
         # scan_frag admission (ISSUE 16, the PR 13 semaphore + retry_after
         # pattern): a scan storm sheds typed-BUSY instead of starving the
         # get/subscribe serving this plane exists for
@@ -725,6 +753,15 @@ class _WorkerServer:
     def _dispatch(self, method: str, req: dict) -> dict:
         if method == "ping":
             return {"buckets": sorted(self._owned())}
+        if self._closed and method in ("get_batch", "subscribe_open", "scan_frag"):
+            # shutdown race (ISSUE 17 bugfix hunt): a request landing while
+            # close() tears down the hub must answer a TYPED shed, not leak
+            # a fresh hub/tailer out of a re-created subscription
+            from .shed import ShedInfo
+
+            return ShedInfo(kind="request", state="shutting-down", retry_after_ms=100).to_payload()
+        if method in ("get_batch", "scan_frag") and self._delay_ms > 0:
+            time.sleep(self._delay_ms / 1000.0)
         if method == "get_batch":
             ks = [tuple(k) if isinstance(k, list) else (k,) for k in req["keys"]]
             with self._lock:
@@ -732,8 +769,12 @@ class _WorkerServer:
             self._metrics().counter("serve_gets").inc(len(ks))
             return {"rows": [None if r is None else list(r) for r in res.to_pylist()]}
         if method == "subscribe_open":
-            self._sub_seq += 1
-            sub_id = f"s{self._sub_seq}"
+            # _sub_seq increments under the lock: two concurrent opens in
+            # separate handler threads must never mint the same sub_id (the
+            # shadowed Subscription would leak its consumer slot)
+            with self._lock:
+                self._sub_seq += 1
+                sub_id = f"s{self._sub_seq}"
             self._subs[sub_id] = (
                 self._hub.subscribe(
                     consumer_id=req.get("consumer_id"),
@@ -763,9 +804,10 @@ class _WorkerServer:
         beside every other serving-plane BUSY."""
         if not self._scan_slots.acquire(blocking=False):
             from ..metrics import soak_metrics
+            from .shed import ShedInfo
 
             soak_metrics().counter("shed_requests").inc()
-            return {"busy": True, "retry_after_ms": 50}
+            return ShedInfo(kind="sql", state="busy-scan", retry_after_ms=50).to_payload()
         try:
             from ..sql.cluster import decode_fragment, encode_partial
             from ..table.query import execute_scan_fragment
@@ -815,6 +857,7 @@ class _WorkerServer:
         return {"lt": _b64(np.asarray(lt, dtype=np.int64)), "rt": _b64(np.asarray(rt, dtype=np.int64))}
 
     def close(self) -> None:
+        self._closed = True
         for sub_id in list(self._subs):
             sub, _ = self._subs.pop(sub_id, (None, None))
             if sub is not None:
@@ -887,6 +930,7 @@ class ClusterWorkerAgent:
         admit_timeout_s: float = 30.0,
         heartbeat_interval_s: float = 0.5,
         seed: int = 0,
+        serve_delay_ms: "float | None" = None,
     ):
         from .proc_soak import WriterJournal
 
@@ -903,7 +947,7 @@ class ClusterWorkerAgent:
         self.conn = _RpcConn(coord_host, coord_port)
         self.server: _WorkerServer | None = None
         if serve:
-            self.server = _WorkerServer(table, self._owned_set)
+            self.server = _WorkerServer(table, self._owned_set, delay_ms=serve_delay_ms)
         self._assign_lock = threading.Lock()
         self._epoch = 0
         self._buckets: set[int] = set()
@@ -1245,6 +1289,15 @@ class ClusterClient:
         conn = self._conns.pop(wid, None)
         if conn is not None:
             conn.close()
+
+    def live_workers(self) -> list[int]:
+        """Worker ids with a serving address under the current route — the
+        gateway's hedge-secondary candidate pool (any live worker serves
+        get_batch/scan_frag from the shared filesystem, owner or not)."""
+        return sorted(self._addrs)
+
+    def addr_of(self, wid: int) -> "tuple[str, int]":
+        return self._addrs[wid]
 
     # ---- distributed SQL scan fragments (ISSUE 16) ----------------------
     def scan_frag(self, wid: int, frag: dict, busy_wait_s: float = 10.0) -> dict:

@@ -37,6 +37,8 @@ import threading
 import time
 from typing import TYPE_CHECKING
 
+from .shed import ShedError, ShedInfo
+
 if TYPE_CHECKING:
     from ..table import FileStoreTable
 
@@ -62,15 +64,19 @@ def _require_flight():
     return flight
 
 
-class FlightBusyError(RuntimeError):
+class FlightBusyError(ShedError):
     """The server shed this request with a typed BUSY (writer admission is
-    throttling/rejecting). Carries the server's flow-control snapshot and
-    its retry-after hint — the client-side twin of WriterBackpressureError."""
+    throttling/rejecting, reads saturated, or a subscriber shed). A
+    serialization of service.shed.ShedInfo: carries the server's
+    flow-control snapshot and its retry-after hint — the client-side twin
+    of WriterBackpressureError — plus the canonical ``shed_info`` record.
+    The payload's own ``kind`` wins; an untyped legacy payload defaults to
+    the ingest kind ("put")."""
 
-    def __init__(self, payload: dict):
-        super().__init__(f"ingest shed by server: {payload}")
-        self.payload = payload
-        self.retry_after_ms = int(payload.get("retry_after_ms", 0))
+    default_kind = "put"
+
+    def __init__(self, payload: "dict | ShedInfo"):
+        super().__init__(payload, message=f"ingest shed by server: {payload}")
 
 
 def _parse_busy(exc: BaseException) -> dict | None:
@@ -103,6 +109,7 @@ class PaimonFlightServer:
         host: str = "127.0.0.1",
         port: int = 0,
         ingest_controller=None,
+        gateway=None,
     ):
         flight = _require_flight()
         outer = self
@@ -160,12 +167,16 @@ class PaimonFlightServer:
                         'long-poll changelog subscription; body = {"table", "consumer", '
                         '"nextSnapshot"?, "format"?, "maxBatches"?, "timeoutMs"?} JSON',
                     ),
+                    ("slo", "gateway per-tenant SLO surface (empty when no gateway attached)"),
                     ("ping", "liveness"),
                 ]
 
             def do_action(self, context, action):
                 if action.type == "ping":
                     return [flight.Result(b"{}")]
+                if action.type == "slo":
+                    s = outer._gateway.slo() if outer._gateway is not None else {}
+                    return [flight.Result(json.dumps(s).encode())]
                 if action.type == "health":
                     ident = action.body.to_pybytes().decode() if action.body else ""
                     return [
@@ -182,6 +193,9 @@ class PaimonFlightServer:
         self.warehouse = warehouse
         self._host = host
         self._ingest_controller = ingest_controller
+        # optional service.gateway.Gateway: serves the `slo` action and
+        # runs tenant-tagged get_batch actions through per-tenant admission
+        self._gateway = gateway
         self._controllers: dict[str, object] = {}
         self._ctl_lock = threading.Lock()
         # batched get serving: one LocalTableQuery per table, behind the
@@ -197,6 +211,7 @@ class PaimonFlightServer:
         self._hubs: dict[str, object] = {}
         self._flight_subs: dict[tuple[str, str], object] = {}
         self._sub_lock = threading.Lock()
+        self._shutdown_flag = False  # set under _sub_lock; late polls shed typed
         self._server = _Server()
         self._thread = None
         self._cat = None
@@ -270,14 +285,30 @@ class PaimonFlightServer:
 
         ident = req["table"]
         q, lock = self._query(ident)
+        gw_tenant = None
+        if self._gateway is not None:
+            gw_tenant, shed = self._gateway.admit(req.get("tenant"), "get_batch")
+            if shed is not None:
+                self._shed(flight, shed.to_payload())
         cap = int(q.table.options.options.get(CoreOptions.LOOKUP_GET_MAX_INFLIGHT))
         with self._get_lock:
             if self._get_inflight >= cap:
+                if gw_tenant is not None:
+                    self._gateway.release(gw_tenant)
                 get_metrics().counter("busy_rejected").inc()
                 # the same typed-BUSY wire shape as the ingest side: the
                 # client backs off retry_after_ms instead of timing out
-                self._shed(flight, {"state": "busy-reads", "retry_after_ms": 25})
+                self._shed(
+                    flight,
+                    ShedInfo(
+                        kind="get_batch",
+                        state="busy-reads",
+                        tenant=gw_tenant,
+                        retry_after_ms=25,
+                    ).to_payload(),
+                )
             self._get_inflight += 1
+        t0 = time.perf_counter()
         try:
             keys = [tuple(k) if isinstance(k, list) else (k,) for k in req["keys"]]
             with lock:
@@ -287,6 +318,9 @@ class PaimonFlightServer:
         finally:
             with self._get_lock:
                 self._get_inflight -= 1
+            if gw_tenant is not None:
+                self._gateway.release(gw_tenant)
+                self._gateway.observe(gw_tenant, "get_batch", t0)
 
     # ---- changelog subscriptions ----------------------------------------
     def _subscription(self, ident: str, consumer: str, next_snapshot: int | None):
@@ -295,10 +329,22 @@ class PaimonFlightServer:
         A client presenting a different nextSnapshot than the subscription's
         checkpoint re-anchors it (close + resubscribe; the durable consumer
         position still wins when it is older — at-least-once replay)."""
-        from .subscription import SubscriptionHub
+        from .subscription import SubscriberShedError, SubscriptionHub
 
         key = (ident, consumer)
         with self._sub_lock:
+            if self._shutdown_flag:
+                # racing shutdown(): re-creating the hub here would leak its
+                # non-daemon tailer/heartbeat threads past server teardown —
+                # answer a typed shed instead
+                raise SubscriberShedError(
+                    ShedInfo(
+                        kind="subscribe",
+                        state="shutting-down",
+                        retry_after_ms=100,
+                        extras={"consumer_id": consumer},
+                    )
+                )
             hub = self._hubs.get(ident)
             if hub is None:
                 hub = self._hubs[ident] = SubscriptionHub(self._table(ident))
@@ -328,7 +374,15 @@ class PaimonFlightServer:
         nxt = req.get("nextSnapshot")
         timeout_s = int(req.get("timeoutMs", 1_000)) / 1000.0
         max_batches = int(req.get("maxBatches", 64))
-        sub = self._subscription(ident, consumer, nxt)
+        try:
+            # inside the try: hub.subscribe itself sheds (max-subscribers,
+            # a hub racing close) and must answer the SAME typed BUSY as a
+            # mid-poll shed, never an untyped server error
+            sub = self._subscription(ident, consumer, nxt)
+        except SubscriberShedError as exc:
+            payload = dict(exc.payload)
+            payload.setdefault("retry_after_ms", 25)
+            self._shed(flight, payload)
         batches = []
         deadline = time.monotonic() + timeout_s
         try:
@@ -434,7 +488,15 @@ class PaimonFlightServer:
                 tw.write(batch)
                 msgs = tw.prepare_commit()
             finally:
-                tw.close()
+                try:
+                    tw.close()
+                except WriterBackpressureError:
+                    # teardown flush hitting admission must not REPLACE the
+                    # in-flight typed signal (or a success) during unwind —
+                    # a close-time reject would otherwise unwind untyped
+                    # through the finally and reach the client as a generic
+                    # stream error
+                    pass
             table.new_batch_write_builder().new_commit().commit(msgs)
         except WriterBackpressureError:
             # admission rejected mid-stream: nothing was buffered for the
@@ -457,6 +519,7 @@ class PaimonFlightServer:
 
     def shutdown(self) -> None:
         with self._sub_lock:
+            self._shutdown_flag = True  # late polls shed typed, never re-create a hub
             subs = list(self._flight_subs.values())
             hubs = list(self._hubs.values())
             self._flight_subs.clear()

@@ -1,0 +1,655 @@
+"""Unified multi-tenant gateway: one front door for every request kind.
+
+The service plane grew organically — the KV server, Flight
+do_put/do_get/do_action, subscription long-polls, the cluster client, SQL
+scatter-gather — each with its own typed BUSY and no notion of *who* is
+calling. ``Gateway`` is the consolidation (ROADMAP item 4): put, get_batch,
+subscribe poll, and SQL (local ``sql.select.query`` and distributed
+``sql.cluster.cluster_query``) all enter through one object that
+
+  1. ADMITS through shared per-tenant QoS (service.qos): token/byte budgets
+     with weighted-fair refill (`gateway.tenant.<id>.{weight,max-inflight,
+     bytes-per-sec}`; untagged traffic lands in the "default" tenant), the
+     PR 8 WriteBufferController idea generalized from memtable bytes to
+     request bytes. A refusal is ALWAYS one canonical typed shed
+     (service.shed.ShedInfo carried by GatewayShedError) — the legacy
+     KvBusyError / FlightBusyError / SubscriberShedError are serializations
+     of the same record.
+  2. HEDGES the read path: a point-get or scan-fragment whose primary
+     (owning worker, PR 15/16 routing) misses `gateway.hedge.deadline-ms`
+     is re-issued to a secondary live non-owner worker, which serves the
+     same committed snapshot from the shared filesystem through its
+     existing LocalTableQuery / scan_frag path (snapshot-pinned, so the
+     answers are bit-identical). First non-BUSY answer wins; the loser's
+     dedicated connection is cancelled (socket shutdown aborts its blocked
+     recv) and counted. Hedges are bounded by `gateway.hedge.max-fraction`
+     of hedgeable requests so a cluster-wide brownout cannot double every
+     read.
+  3. OBSERVES everything: the gateway{...} metric group plus the per-tenant
+     SLO surface ``Gateway.slo()`` (p50/p99 per request kind from decayed
+     histograms, admitted/shed/hedged counts, budget utilization,
+     retry_after hints) that the KV and Flight servers expose as a
+     health-style "slo" action.
+
+Full replica *ownership* (a hot bucket with a second writer) stays ROADMAP
+item 2 — hedging needs only the shared-FS read path that already exists.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutTimeout
+from concurrent.futures import wait as _fut_wait
+
+from .qos import DEFAULT_TENANT, QosController, SloTracker
+from .shed import GatewayShedError, ShedInfo
+
+__all__ = ["Gateway", "GatewayShedError"]
+
+
+class _HedgeAttempt:
+    """One in-flight RPC attempt on a dedicated connection: the conn is
+    registered under a lock so a canceller in another thread can abort the
+    blocked recv (conn.cancel()) without racing the happy-path checkin."""
+
+    __slots__ = ("future", "conn", "cancelled", "lock", "wid")
+
+    def __init__(self, wid: int):
+        self.future = None
+        self.conn = None
+        self.cancelled = False
+        self.lock = threading.Lock()
+        self.wid = wid
+
+
+class _ConnPool:
+    """Per-worker stacks of DEDICATED _RpcConn connections for hedged
+    calls. Dedicated (never the ClusterClient's shared conns) because
+    cancellation closes the socket mid-call — poisoning a shared routing
+    connection would fail unrelated traffic."""
+
+    def __init__(self, addr_of):
+        self._addr_of = addr_of  # wid -> (host, port)
+        self._lock = threading.Lock()
+        self._free: dict[int, list] = {}
+
+    def checkout(self, wid: int):
+        from .cluster import _RpcConn
+
+        with self._lock:
+            stack = self._free.get(wid)
+            if stack:
+                return stack.pop()
+        return _RpcConn(*self._addr_of(wid))
+
+    def checkin(self, wid: int, conn) -> None:
+        with self._lock:
+            self._free.setdefault(wid, []).append(conn)
+
+    def discard(self, conn) -> None:
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            conns = [c for stack in self._free.values() for c in stack]
+            self._free.clear()
+        for c in conns:
+            c.close()
+
+
+class Gateway:
+    """The front door for one table (and its catalog / cluster route).
+
+    ``client`` is an optional service.cluster.ClusterClient: with it,
+    get_batch routes to owning workers (hedged) and SQL scatters through
+    cluster_query with hedged scan fragments; without it, both serve
+    locally. Every public method takes ``tenant=`` (None = "default") and
+    either returns the answer or raises GatewayShedError carrying the
+    canonical ShedInfo."""
+
+    def __init__(self, table, catalog=None, client=None, options=None):
+        from ..core.admission import WriteBufferController
+        from ..options import CoreOptions
+
+        self._table = table
+        self._catalog = catalog
+        self._client = client
+        opts = table.store.options.options.copy()
+        if options is not None:
+            opts.update(options)
+        self._options = opts
+        self._qos = QosController(opts)
+        tau_ms = int(opts.get(CoreOptions.GATEWAY_SLO_DECAY_WINDOW))
+        self._slo = SloTracker(tau_s=max(tau_ms, 1) / 1000.0)
+        self._hedge_enabled = bool(opts.get(CoreOptions.GATEWAY_HEDGE_ENABLED))
+        self._hedge_deadline_ms = int(opts.get(CoreOptions.GATEWAY_HEDGE_DEADLINE))
+        self._hedge_max_fraction = float(opts.get(CoreOptions.GATEWAY_HEDGE_MAX_FRACTION))
+        # put plane: one shared admission controller under one commit lock
+        # (single-committer discipline, the flight server's do_put shape)
+        self._write_ctrl = WriteBufferController.from_options(table.store.options)
+        self._put_lock = threading.Lock()
+        # local read plane (no cluster route)
+        self._query = None
+        self._query_lock = threading.Lock()
+        # subscriptions
+        self._hub = None
+        self._own_hub = False
+        self._subs: dict[str, object] = {}
+        self._subs_lock = threading.Lock()
+        self._sub_seq = 0
+        # hedging
+        self._pool = _ConnPool(client.addr_of) if client is not None else None
+        # RPC attempts are blocked-on-socket, not CPU: the pool must cover
+        # the full admitted concurrency (tenant inflight caps gate demand
+        # upstream). A CPU-sized pool queues primaries, the queue wait eats
+        # the hedge deadline, and every queued request then hedges into the
+        # same saturated pool — a self-amplifying collapse under fan-in.
+        self._executor = ThreadPoolExecutor(max_workers=256, thread_name_prefix="paimon-gw")
+        self._hedge_lock = threading.Lock()
+        self._hedge_requests = 0
+        self._hedges_issued = 0
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # shared admission plumbing
+    def _metrics(self):
+        from ..metrics import gateway_metrics
+
+        return gateway_metrics()
+
+    def _admit(self, tenant: "str | None", kind: str, nbytes: int = 0) -> str:
+        g = self._metrics()
+        g.counter("requests").inc()
+        name, shed = self._qos.admit(tenant, kind, nbytes)
+        if shed is not None:
+            g.counter("sheds_typed").inc()
+            self._slo.record_shed(name, kind)
+            raise GatewayShedError(shed)
+        g.counter("admitted").inc()
+        return name
+
+    def _record(self, tenant: str, kind: str, t0: float, hedged: bool = False) -> None:
+        ms = (time.perf_counter() - t0) * 1000.0
+        self._slo.record(tenant, kind, ms, hedged=hedged)
+        self._metrics().histogram(f"{kind}_ms").update(ms)
+
+    def _count_untyped(self, exc: BaseException) -> None:
+        """The acceptance invariant gateway{sheds_untyped} == 0: a pressure
+        signal escaping the gateway in any shape other than GatewayShedError
+        — a raw legacy ShedError the conversion missed, or an infra error
+        (timeout / dead connection) standing in for a shed — is an untyped
+        shed. User errors (bad SQL, unknown sub id) are not sheds."""
+        from .shed import ShedError
+
+        if isinstance(exc, (GatewayShedError, FileNotFoundError)):
+            # FileNotFoundError is a user error (missing table/path), not
+            # pressure — despite being an OSError
+            return
+        if isinstance(exc, (ShedError, TimeoutError, ConnectionError, OSError)):
+            self._metrics().counter("sheds_untyped").inc()
+
+    # ------------------------------------------------------------------
+    # embedding-server seam: the KV/Flight front doors share this gateway's
+    # tenant budgets and SLO surface for requests that never enter the
+    # in-process put/get_batch paths
+    def admit(self, tenant: "str | None", kind: str, nbytes: int = 0):
+        """Non-raising admission for an embedding server: returns
+        (resolved_tenant, ShedInfo | None), counted into gateway{...}
+        exactly like the in-process paths. Pair every admitted request
+        with release(tenant); observe(tenant, kind, t0) records latency."""
+        try:
+            return self._admit(tenant, kind, nbytes), None
+        except GatewayShedError as e:
+            return e.shed_info.tenant or DEFAULT_TENANT, e.shed_info
+
+    def release(self, tenant: "str | None") -> None:
+        self._qos.release(tenant)
+
+    def observe(self, tenant: str, kind: str, t0: float, hedged: bool = False) -> None:
+        self._record(tenant, kind, t0, hedged=hedged)
+
+    # ------------------------------------------------------------------
+    # put
+    def put(self, data, kinds=None, tenant: "str | None" = None):
+        """Write one batch and commit it. Backpressure from the shared
+        write-buffer controller surfaces as a typed GatewayShedError (never
+        an untyped unwind, even when close() re-raises during teardown)."""
+        from ..core.admission import WriterBackpressureError
+        from ..data.batch import ColumnBatch
+        from ..table.write import TableWrite
+
+        if isinstance(data, dict):
+            data = ColumnBatch.from_pydict(self._table.row_type, data)
+        name = self._admit(tenant, "put", data.byte_size())
+        t0 = time.perf_counter()
+        try:
+            with self._put_lock:
+                tw = TableWrite(self._table, buffer_controller=self._write_ctrl)
+                try:
+                    tw.write(data, kinds)
+                    msgs = tw.prepare_commit()
+                finally:
+                    try:
+                        tw.close()
+                    except WriterBackpressureError:
+                        # teardown must not replace the typed shed already
+                        # unwinding (ISSUE 17 bugfix hunt, the do_put shape)
+                        pass
+                self._table.new_batch_write_builder().new_commit().commit(msgs)
+        except WriterBackpressureError as e:
+            health = self._write_ctrl.health_dict() if self._write_ctrl else {}
+            self._metrics().counter("sheds_typed").inc()
+            self._slo.record_shed(name, "put")
+            raise GatewayShedError(
+                ShedInfo(
+                    kind="put",
+                    state=health.get("state", "rejecting"),
+                    tenant=name,
+                    retry_after_ms=int(health.get("retry_after_ms") or 25),
+                )
+            ) from e
+        except BaseException as e:
+            self._count_untyped(e)
+            raise
+        finally:
+            self._qos.release(name)
+        self._record(name, "put", t0)
+        return len(data)
+
+    # ------------------------------------------------------------------
+    # get_batch
+    def get_batch(self, keys, partition: tuple = (), tenant: "str | None" = None) -> list:
+        """list[tuple | None] aligned with `keys` — served by the owning
+        workers (hedged past the deadline) or a local LocalTableQuery."""
+        ks = [k if isinstance(k, tuple) else (k,) for k in keys]
+        name = self._admit(tenant, "get_batch", len(ks) * 64)
+        t0 = time.perf_counter()
+        hedged_before = self._hedges_for_kind()
+        try:
+            if self._client is None:
+                out = self._local_get(ks, partition)
+            else:
+                out = self._routed_get(ks, partition)
+        except BaseException as e:
+            self._count_untyped(e)
+            raise
+        finally:
+            self._qos.release(name)
+        self._record(name, "get_batch", t0, hedged=self._hedges_for_kind() > hedged_before)
+        return out
+
+    def _hedges_for_kind(self) -> int:
+        with self._hedge_lock:
+            return self._hedges_issued
+
+    def _local_get(self, ks, partition) -> list:
+        from ..table.query import LocalTableQuery
+
+        with self._query_lock:
+            if self._query is None:
+                self._query = LocalTableQuery(self._table)
+            self._query.refresh()
+            res = self._query.get_batch(ks, tuple(partition))
+        return [None if r is None else tuple(r) for r in res.to_pylist()]
+
+    def _routed_get(self, ks, partition) -> list:
+        from ..data.batch import ColumnBatch
+        from ..table.bucket import bucket_ids
+
+        client = self._client
+        store = self._table.store
+        key_schema = store.value_schema.project(store.key_names)
+        probe = ColumnBatch.from_pydict(
+            key_schema,
+            {name: [k[i] for k in ks] for i, name in enumerate(store.key_names)},
+        )
+        buckets = bucket_ids(probe, self._table.schema.bucket_keys, client.num_buckets)
+        out: list = [None] * len(ks)
+        by_wid: dict[int, list[int]] = {}
+        for i, b in enumerate(buckets.tolist()):
+            by_wid.setdefault(client.owner_of(int(b)), []).append(i)
+        for wid, idxs in by_wid.items():
+            r = self._hedged_rpc(
+                wid,
+                "get_batch",
+                keys=[list(ks[i]) for i in idxs],
+                partition=list(partition),
+            )
+            if r.get("busy"):
+                raise GatewayShedError(ShedInfo.from_payload(r, kind="get_batch"))
+            for i, row in zip(idxs, r["rows"]):
+                out[i] = None if row is None else tuple(row)
+        return out
+
+    # ------------------------------------------------------------------
+    # subscribe
+    def _hub_acquire(self):
+        from .subscription import SubscriptionHub
+
+        if self._hub is None or self._hub._stop.is_set():
+            path = self._table.store.table_path
+            with SubscriptionHub._hubs_lock:
+                existing = SubscriptionHub._hubs.get(path)
+            # only close on teardown what this gateway actually created — a
+            # colocated worker server may own the process-wide hub
+            self._own_hub = existing is None or existing._stop.is_set()
+            self._hub = SubscriptionHub.for_table(self._table)
+        return self._hub
+
+    def subscribe_open(
+        self,
+        consumer_id: "str | None" = None,
+        from_snapshot: "int | None" = None,
+        tenant: "str | None" = None,
+    ) -> str:
+        """Open a changelog subscription; returns the gateway sub id."""
+        from .subscription import SubscriberShedError
+
+        name = self._admit(tenant, "subscribe")
+        try:
+            try:
+                sub = self._hub_acquire().subscribe(
+                    consumer_id=consumer_id, from_snapshot=from_snapshot
+                )
+            except SubscriberShedError as e:
+                self._metrics().counter("sheds_typed").inc()
+                self._slo.record_shed(name, "subscribe")
+                info = ShedInfo.from_payload(e.payload, kind="subscribe")
+                info.tenant = name
+                raise GatewayShedError(info) from e
+            with self._subs_lock:
+                self._sub_seq += 1
+                sid = f"g{self._sub_seq}"
+                self._subs[sid] = sub
+            return sid
+        except BaseException as e:
+            self._count_untyped(e)
+            raise
+        finally:
+            self._qos.release(name)
+
+    def subscribe_poll(
+        self, sub_id: str, timeout_ms: int = 1000, tenant: "str | None" = None
+    ) -> dict:
+        """One long-poll: {rows, snapshot_id, checkpoint} (rows prefixed
+        with the RowKind short string, the worker-server wire shape). A shed
+        subscriber surfaces as GatewayShedError carrying restart_offset —
+        the durable resume position."""
+        from ..types import RowKind
+        from .subscription import SubscriberShedError
+
+        with self._subs_lock:
+            sub = self._subs.get(sub_id)
+        if sub is None:
+            raise ValueError(f"unknown subscription {sub_id!r}")
+        name = self._admit(tenant, "subscribe")
+        t0 = time.perf_counter()
+        try:
+            try:
+                batch = sub.poll(timeout=float(timeout_ms) / 1000.0)
+            except SubscriberShedError as e:
+                with self._subs_lock:
+                    self._subs.pop(sub_id, None)
+                self._metrics().counter("sheds_typed").inc()
+                self._slo.record_shed(name, "subscribe")
+                info = ShedInfo.from_payload(e.payload, kind="subscribe")
+                info.tenant = name
+                raise GatewayShedError(info) from e
+        except BaseException as e:
+            self._count_untyped(e)
+            raise
+        finally:
+            self._qos.release(name)
+        self._record(name, "subscribe", t0)
+        if batch is None:
+            return {"rows": [], "snapshot_id": None, "checkpoint": sub.checkpoint}
+        rows = [
+            [RowKind(int(k)).short_string, *r]
+            for r, k in zip(batch.data.to_pylist(), batch.kinds.tolist())
+        ]
+        return {"rows": rows, "snapshot_id": batch.snapshot_id, "checkpoint": sub.checkpoint}
+
+    def subscribe_close(self, sub_id: str, delete_consumer: bool = False) -> None:
+        with self._subs_lock:
+            sub = self._subs.pop(sub_id, None)
+        if sub is not None:
+            sub.close(delete_consumer=delete_consumer)
+
+    # ------------------------------------------------------------------
+    # SQL
+    def sql(self, statement: str, tenant: "str | None" = None):
+        """Execute one SELECT (or EXPLAIN SELECT) — distributed through the
+        cluster route when a client is attached (scan fragments hedged),
+        locally otherwise. Returns the result ColumnBatch."""
+        if self._catalog is None:
+            raise ValueError("gateway has no catalog: SQL routing needs one")
+        name = self._admit(tenant, "sql", len(statement))
+        t0 = time.perf_counter()
+        hedged_before = self._hedges_for_kind()
+        try:
+            if self._client is not None:
+                from ..sql.cluster import cluster_query
+
+                out = cluster_query(
+                    self._catalog,
+                    statement,
+                    self._client,
+                    scan_frag_fn=self.hedged_scan_frag,
+                )
+            else:
+                from ..sql.select import query
+
+                out = query(self._catalog, statement)
+        except BaseException as e:
+            self._count_untyped(e)
+            raise
+        finally:
+            self._qos.release(name)
+        self._record(name, "sql", t0, hedged=self._hedges_for_kind() > hedged_before)
+        return out
+
+    # ------------------------------------------------------------------
+    # hedging
+    def _secondary_for(self, primary: int) -> "int | None":
+        candidates = [w for w in self._client.live_workers() if w != primary]
+        if not candidates:
+            return None
+        # deterministic: the next live worker after the primary, cyclically
+        later = [w for w in candidates if w > primary]
+        return (later or candidates)[0]
+
+    def _submit(self, wid: int, method: str, kw: dict) -> _HedgeAttempt:
+        task = _HedgeAttempt(wid)
+        pool = self._pool
+
+        def run():
+            conn = pool.checkout(wid)
+            with task.lock:
+                if task.cancelled:
+                    pool.discard(conn)
+                    raise ConnectionError("hedge attempt cancelled before dispatch")
+                task.conn = conn
+            try:
+                r = conn.call(method, **kw)
+            except BaseException:
+                # clear ownership under the lock BEFORE closing: _cancel
+                # shuts down whatever task.conn points at, and this fd is
+                # about to be freed for reuse
+                with task.lock:
+                    task.conn = None
+                pool.discard(conn)
+                raise
+            with task.lock:
+                task.conn = None
+                if task.cancelled:
+                    # cancel raced the reply: the socket may already be
+                    # half-shut — never return it to the pool
+                    pool.discard(conn)
+                else:
+                    pool.checkin(wid, conn)
+            return r
+
+        with self._inflight_cond:
+            self._inflight += 1
+        task.future = self._executor.submit(run)
+        task.future.add_done_callback(self._attempt_done)
+        return task
+
+    def _attempt_done(self, fut) -> None:
+        fut.exception()  # consume, never let a cancelled loser warn
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    def _cancel(self, task: _HedgeAttempt) -> None:
+        with task.lock:
+            task.cancelled = True
+            if task.conn is not None:
+                # under task.lock: the attempt thread clears task.conn
+                # (under this same lock) before it discards or checks the
+                # connection in, so a non-None conn here still owns its fd —
+                # shutdown is safe, unblocks its recv, and the attempt
+                # thread does the close
+                task.conn.cancel()
+        self._metrics().counter("hedges_cancelled").inc()
+
+    def hedge_inflight(self) -> int:
+        """In-flight hedge-pool RPC attempts (winners and losers) — drains
+        to 0 once every loser's teardown completed."""
+        with self._inflight_cond:
+            return self._inflight
+
+    def wait_hedges_drained(self, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+            return True
+
+    def _hedged_rpc(self, primary_wid: int, method: str, **kw) -> dict:
+        """One worker RPC with tail-latency hedging. Returns the first
+        non-BUSY response; a BUSY payload only when every attempt answered
+        BUSY. Raises like _RpcConn.call when all attempts fail."""
+        g = self._metrics()
+        with self._hedge_lock:
+            self._hedge_requests += 1
+        primary = self._submit(primary_wid, method, kw)
+        if not self._hedge_enabled:
+            return primary.future.result()
+        try:
+            return primary.future.result(timeout=self._hedge_deadline_ms / 1000.0)
+        except _FutTimeout:
+            pass
+        except Exception:
+            raise
+        secondary_wid = self._secondary_for(primary_wid)
+        allowed = False
+        if secondary_wid is not None:
+            with self._hedge_lock:
+                if self._hedges_issued + 1 <= self._hedge_max_fraction * self._hedge_requests:
+                    self._hedges_issued += 1
+                    allowed = True
+        if not allowed:
+            return primary.future.result()
+        g.counter("hedges_issued").inc()
+        secondary = self._submit(secondary_wid, method, kw)
+        attempts = (primary, secondary)
+        while True:
+            for task, other in ((primary, secondary), (secondary, primary)):
+                f = task.future
+                if not f.done():
+                    continue
+                try:
+                    r = f.result()
+                except Exception:
+                    continue
+                if not r.get("busy"):
+                    self._cancel(other)
+                    if task is secondary:
+                        g.counter("hedges_won").inc()
+                    return r
+            if primary.future.done() and secondary.future.done():
+                # no winner: both BUSY and/or failed — a BUSY payload beats
+                # an exception (the caller's retry loop owns the backoff)
+                for task in attempts:
+                    try:
+                        return task.future.result()
+                    except Exception:
+                        continue
+                return primary.future.result()  # re-raises the primary error
+            _fut_wait(
+                [t.future for t in attempts if not t.future.done()],
+                return_when=FIRST_COMPLETED,
+            )
+
+    def hedged_scan_frag(self, wid: int, frag: dict, busy_wait_s: float = 10.0) -> dict:
+        """ClusterClient.scan_frag's contract (BUSY absorbed with the
+        server-advertised backoff) over the hedged RPC path — the
+        scan_frag_fn seam sql.cluster._scatter dispatches through."""
+        deadline = time.monotonic() + busy_wait_s
+        while True:
+            r = self._hedged_rpc(wid, "scan_frag", frag=frag)
+            if not r.get("busy"):
+                return r["partial"]
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"worker {wid} still BUSY after {busy_wait_s}s")
+            time.sleep(float(r.get("retry_after_ms", 50)) / 1000.0)
+
+    # ------------------------------------------------------------------
+    # SLO surface
+    def slo(self) -> dict:
+        """The per-tenant SLO surface: {tenants: {tenant: {kinds: {kind:
+        {p50_ms, p99_ms, samples, admitted, shed, hedged}}, budget: {...}}},
+        hedge: {...}} — also exported by the KV/Flight servers as the 'slo'
+        health-style action."""
+        with self._hedge_lock:
+            hedge = {
+                "enabled": self._hedge_enabled,
+                "deadline_ms": self._hedge_deadline_ms,
+                "max_fraction": self._hedge_max_fraction,
+                "hedgeable_requests": self._hedge_requests,
+                "hedges_issued": self._hedges_issued,
+            }
+        hedge["inflight"] = self.hedge_inflight()
+        return {"tenants": self._slo.slo(self._qos), "hedge": hedge}
+
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._subs_lock:
+            subs = list(self._subs.values())
+            self._subs.clear()
+        for sub in subs:
+            try:
+                sub.close()
+            except Exception:
+                pass
+        if self._hub is not None and self._own_hub:
+            try:
+                self._hub.close()
+            except Exception:
+                pass
+        self._hub = None
+        if self._query is not None:
+            try:
+                self._query.unfollow()
+            except Exception:
+                pass
+        self._executor.shutdown(wait=True)
+        if self._pool is not None:
+            self._pool.close()
+
+    def __enter__(self) -> "Gateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,117 @@
+"""Canonical typed-shed protocol (ISSUE 17): one wire shape for every BUSY.
+
+The service plane grew three independent typed-BUSY dialects — the KV
+server's ``{"busy": true, "state": ..., "retry_after_ms": ...}`` JSON
+response (KvBusyError), the Flight server's ``BUSY{...}`` message payload
+(FlightBusyError), and the subscription hub's shed payload carrying a
+durable restart offset (SubscriberShedError). They agreed on spirit
+(typed, parseable, retry-after-hinted, never a queue-into-timeout) but not
+on shape, so nothing above them could reason about load generically.
+
+``ShedInfo`` is the one canonical record all three serialize:
+
+    kind            what was shed: put | get_batch | subscribe | sql | request
+    state           why: the admission health state ("throttling",
+                    "rejecting", "busy-reads", "queue-full",
+                    "buffer-exhausted", "busy-subscribers", "busy-inflight",
+                    "throttling-bytes", "shutting-down", ...)
+    tenant          who (gateway multi-tenant admission; None = untagged)
+    retry_after_ms  the server's backoff hint
+    restart_offset  durable resume position for stateful kinds (a shed
+                    subscriber's next snapshot); None elsewhere
+    extras          any legacy payload fields that ride along unharmed
+
+``to_payload()`` emits the flat wire dict every legacy client already
+parses (``busy``/``state``/``retry_after_ms`` plus the subscription's
+``consumer_id``/``next_snapshot`` aliases), so the three legacy exception
+types become thin serializations of ShedInfo — their constructor and
+attribute contracts are unchanged, old clients keep working, and new code
+reads ``exc.shed_info`` for the canonical record.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["ShedInfo", "ShedError", "GatewayShedError"]
+
+# payload keys owned by the canonical record (everything else is extras)
+_CORE_KEYS = frozenset(
+    {"busy", "kind", "state", "tenant", "retry_after_ms", "restart_offset", "next_snapshot"}
+)
+
+
+@dataclass
+class ShedInfo:
+    """One typed shed, serializable to the flat wire payload every legacy
+    BUSY client already understands."""
+
+    kind: str = "request"
+    state: str | None = None
+    tenant: str | None = None
+    retry_after_ms: int = 0
+    restart_offset: int | None = None
+    extras: dict = field(default_factory=dict)
+
+    def to_payload(self) -> dict:
+        """Flat wire dict: the legacy BUSY shape plus the canonical fields.
+        ``next_snapshot`` mirrors ``restart_offset`` for the subscription
+        dialect's existing consumers."""
+        out = dict(self.extras)
+        out["busy"] = True
+        out["kind"] = self.kind
+        out["state"] = self.state
+        out["retry_after_ms"] = int(self.retry_after_ms)
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.restart_offset is not None:
+            out["restart_offset"] = int(self.restart_offset)
+            out.setdefault("next_snapshot", int(self.restart_offset))
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: dict, kind: str | None = None) -> "ShedInfo":
+        """Parse any of the three legacy payload dialects (or a canonical
+        one) back into the record. Unknown fields land in extras."""
+        restart = payload.get("restart_offset")
+        if restart is None:
+            restart = payload.get("next_snapshot")
+        return cls(
+            kind=kind or payload.get("kind") or "request",
+            state=payload.get("state"),
+            tenant=payload.get("tenant"),
+            retry_after_ms=int(payload.get("retry_after_ms") or 0),
+            restart_offset=None if restart is None else int(restart),
+            extras={k: v for k, v in payload.items() if k not in _CORE_KEYS},
+        )
+
+
+class ShedError(RuntimeError):
+    """Base of every typed-shed exception: constructed from either a legacy
+    payload dict or a ShedInfo, it exposes BOTH contracts — the canonical
+    ``shed_info`` record and the legacy ``payload``/``retry_after_ms``
+    attributes the existing clients and tests rely on."""
+
+    default_kind = "request"
+
+    def __init__(self, payload: "dict | ShedInfo", message: str | None = None):
+        info = (
+            payload
+            if isinstance(payload, ShedInfo)
+            # the payload's own kind wins; default_kind covers untyped
+            # legacy payloads that never carried one
+            else ShedInfo.from_payload(payload, kind=payload.get("kind") or self.default_kind)
+        )
+        self.shed_info = info
+        self.payload = info.to_payload()
+        self.retry_after_ms = info.retry_after_ms
+        super().__init__(message or f"shed by server: {self.payload}")
+
+
+class GatewayShedError(ShedError):
+    """The gateway's per-tenant admission (or a downstream server whose shed
+    it converted) refused this request. Carries the canonical ShedInfo; the
+    legacy exception types are serializations of the same record, so a
+    caller that only knows GatewayShedError still sees every shed kind."""
+
+    default_kind = "request"

@@ -64,6 +64,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .shed import ShedError, ShedInfo
+
 __all__ = [
     "ChangelogBatch",
     "SubscriberShedError",
@@ -73,20 +75,21 @@ __all__ = [
 ]
 
 
-class SubscriberShedError(RuntimeError):
+class SubscriberShedError(ShedError):
     """The hub shed this subscriber with a typed BUSY: its queue stayed full
     (or the shared buffer budget stayed exhausted) past
-    ``subscription.shed-timeout``. Carries the durable restart offset — the
-    consumer-id's recorded position — so the caller can resume losslessly
-    with ``subscribe(consumer_id=...)``. The streaming twin of
+    ``subscription.shed-timeout``. A serialization of service.shed.ShedInfo
+    (kind="subscribe", restart_offset = the consumer-id's recorded durable
+    position) — so the caller can resume losslessly with
+    ``subscribe(consumer_id=...)``. The streaming twin of
     WriterBackpressureError / KvBusyError / FlightBusyError."""
 
-    def __init__(self, payload: dict):
-        super().__init__(f"subscriber shed: {payload}")
-        self.payload = payload
-        self.consumer_id = payload.get("consumer_id")
-        self.next_snapshot = payload.get("next_snapshot")
-        self.retry_after_ms = int(payload.get("retry_after_ms", 0))
+    default_kind = "subscribe"
+
+    def __init__(self, payload: "dict | ShedInfo"):
+        super().__init__(payload, message=f"subscriber shed: {payload}")
+        self.consumer_id = self.payload.get("consumer_id")
+        self.next_snapshot = self.payload.get("next_snapshot")
 
 
 @dataclass(frozen=True)
@@ -503,6 +506,17 @@ class SubscriptionHub:
         from ..table.stream import StreamTableScan
 
         with self._cond:
+            if self._stop.is_set():
+                # racing close(): a typed shed, never a half-registered
+                # subscriber on a hub whose tailer already exited
+                raise SubscriberShedError(
+                    ShedInfo(
+                        kind="subscribe",
+                        state="shutting-down",
+                        retry_after_ms=max(1, self.shed_timeout_ms // 2),
+                        extras={"consumer_id": consumer_id},
+                    )
+                )
             if len(self._subs) >= self.max_subscribers:
                 self._metrics().counter("shed_subscribers").inc()
                 raise SubscriberShedError(

@@ -775,14 +775,17 @@ def query(catalog: "Catalog", statement: str):
     return _query(catalog, statement)
 
 
-def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float = 10.0):
+def cluster_query(
+    catalog: "Catalog", statement: str, client, busy_wait_s: float = 10.0, scan_frag_fn=None
+):
     """Execute one SELECT across cluster-service workers (scatter-gather
     scan fragments with code-domain partial aggregation; see sql.cluster).
     `client` is a service.cluster.ClusterClient; results are bit-identical
-    to :func:`query` on the same catalog."""
+    to :func:`query` on the same catalog. `scan_frag_fn` swaps the
+    per-fragment RPC (the gateway's hedged variant rides this seam)."""
     from .cluster import cluster_query as _cquery
 
-    return _cquery(catalog, statement, client, busy_wait_s=busy_wait_s)
+    return _cquery(catalog, statement, client, busy_wait_s=busy_wait_s, scan_frag_fn=scan_frag_fn)
 
 
 def split_statements(script: str) -> list[str]:
@@ -840,7 +843,7 @@ def execute_script(catalog: "Catalog", script: str) -> list[Any]:
 def execute(catalog: "Catalog", statement: str) -> Any:
     """One string entry point: SELECT -> ColumnBatch, CALL -> procedure
     dict, DDL (CREATE/DROP/SHOW/DESCRIBE) -> dict | ColumnBatch | str."""
-    if re.match(r"^\s*SELECT\b", statement, re.I):
+    if re.match(r"^\s*(EXPLAIN\s+)?SELECT\b", statement, re.I):
         return query(catalog, statement)
     if re.match(r"^\s*(CREATE|DROP|ALTER|SHOW|DESC(RIBE)?|ANALYZE)\b", statement, re.I):
         from .ddl import ddl as _ddl

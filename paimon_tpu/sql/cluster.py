@@ -38,8 +38,11 @@ ClusterClient.scan_frag with the server-advertised backoff.
 from __future__ import annotations
 
 import base64
+import hashlib
+import json
 import os
 import re
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING
@@ -48,6 +51,7 @@ import numpy as np
 
 from .expr import ExprError, parse_expr, to_predicate
 from .select import (
+    _EXPLAIN_RE,
     _KERNEL_COMBINE,
     QueryError,
     _agg_kernel_plan,
@@ -55,7 +59,9 @@ from .select import (
     _engine_for,
     _finish,
     _order_cols,
+    explain_plan,
     parse_select,
+    plan_batch,
     query,
 )
 
@@ -64,6 +70,7 @@ if TYPE_CHECKING:
 
 __all__ = [
     "cluster_query",
+    "clear_fragment_cache",
     "resolve_code_domain",
     "encode_fragment",
     "decode_fragment",
@@ -222,14 +229,27 @@ class _LocalFallback(Exception):
     caller falls back to the single-process evaluator."""
 
 
-def _scatter(client, pending: dict, template: dict, retry_ms: int, busy_wait_s: float):
+def _scatter(
+    client,
+    pending: dict,
+    template: dict,
+    retry_ms: int,
+    busy_wait_s: float,
+    scan_frag_fn=None,
+):
     """Dispatch one fragment per owning worker, failover on dead
     connections: failed fragments' splits return to the pool, the route
     refreshes (the coordinator reassigns dead workers' buckets) and the
-    splits regroup under their new owners until retry_ms expires."""
+    splits regroup under their new owners until retry_ms expires.
+
+    `scan_frag_fn` swaps the per-fragment RPC (same (wid, frag,
+    busy_wait_s) contract as ClusterClient.scan_frag) — the gateway
+    threads its hedged variant through here so scan fragments race a
+    secondary worker past the hedge deadline."""
     from ..metrics import sql_metrics
 
     g = sql_metrics()
+    call = scan_frag_fn if scan_frag_fn is not None else client.scan_frag
     deadline = time.monotonic() + retry_ms / 1000.0
     results: list[dict] = []
     round_no = 0
@@ -241,7 +261,7 @@ def _scatter(client, pending: dict, template: dict, retry_ms: int, busy_wait_s: 
         with ThreadPoolExecutor(max_workers=max(len(pending), 1)) as ex:
             futs = {
                 wid: ex.submit(
-                    client.scan_frag,
+                    call,
                     wid,
                     encode_fragment(dict(template, splits=items)),
                     busy_wait_s,
@@ -286,7 +306,125 @@ def _sentinel_remap(remap, pool_len: int, unified_len: int) -> np.ndarray:
     return np.concatenate([np.asarray(base, dtype=np.int64), [unified_len]]).astype(np.uint32)
 
 
-def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float = 10.0):
+# ---------------------------------------------------------------------------
+# fragment result cache: aggregate partials are immutable once the snapshot
+# they scanned is pinned, so repeated aggregates over an unchanged table skip
+# the scatter entirely. Keyed per table path on (snapshot_id, signature);
+# any plan at a NEWER snapshot purges the table's older entries.
+# ---------------------------------------------------------------------------
+_FRAG_CACHE_LOCK = threading.Lock()
+_FRAG_CACHE: dict[str, tuple[int, dict[str, list]]] = {}
+
+
+def clear_fragment_cache() -> None:
+    """Drop every cached partial (tests / manual invalidation)."""
+    with _FRAG_CACHE_LOCK:
+        _FRAG_CACHE.clear()
+
+
+def _fragment_signature(template: dict, by_wid: dict):
+    """(snapshot_id, sha1) identity of one aggregate scatter: the template's
+    semantic core plus every planned split (seq, partition, bucket, files).
+    Returns None when any split carries no snapshot pin — nothing stable to
+    key on — so unpinned plans always scatter."""
+    snaps: set = set()
+    ids: list = []
+    for wid in sorted(by_wid):
+        for seq, sd in by_wid[wid]:
+            snap = sd.get("snapshotId")
+            if snap is None:
+                return None
+            snaps.add(int(snap))
+            ids.append(
+                [
+                    int(seq),
+                    list(sd.get("partition") or []),
+                    int(sd["bucket"]),
+                    sorted(
+                        json.dumps(f, sort_keys=True, default=str)
+                        for f in sd.get("files", [])
+                    ),
+                ]
+            )
+    if not snaps:
+        return None
+    core = {
+        k: template.get(k)
+        for k in ("mode", "where", "projection", "group_cols", "kern", "engine", "code_domain")
+    }
+    blob = json.dumps([core, ids], sort_keys=True, default=str)
+    return max(snaps), hashlib.sha1(blob.encode()).hexdigest()
+
+
+def _frag_cache_get(path: str, key):
+    if key is None:
+        return None
+    snap, sig = key
+    with _FRAG_CACHE_LOCK:
+        ent = _FRAG_CACHE.get(path)
+        if ent is not None and ent[0] == snap:
+            return ent[1].get(sig)
+    return None
+
+
+def _frag_cache_put(path: str, key, raw: list) -> None:
+    if key is None:
+        return
+    snap, sig = key
+    with _FRAG_CACHE_LOCK:
+        ent = _FRAG_CACHE.get(path)
+        if ent is None or ent[0] < snap:  # snapshot advanced: purge stale partials
+            ent = (snap, {})
+            _FRAG_CACHE[path] = ent
+        if ent[0] == snap:
+            ent[1][sig] = raw
+
+
+def _explain_cluster(catalog: "Catalog", statement: str, client):
+    """EXPLAIN through the cluster planner: the local explain lines (files
+    pruned, pushed predicates/projection/LIMIT) plus the fragment -> worker
+    assignment under the current route and the code-domain toggle."""
+    from ..options import CoreOptions
+
+    plan, t, lines, splits = explain_plan(catalog, statement)
+    lines = list(lines)
+    fm = plan.from_match
+    if (
+        plan.is_join
+        or t is None
+        or fm is None
+        or fm.group("hints")
+        or fm.group("tt_kind")
+        or not hasattr(t, "new_read_builder")
+        or t.path != client.table.path
+    ):
+        lines.append("cluster: local fallback (shape not served by the fragment protocol)")
+        return plan_batch(lines)
+    opts = t.store.options.options
+    code_domain = resolve_code_domain(opts.get(CoreOptions.SQL_CLUSTER_CODE_DOMAIN))
+    lines.append(f"cluster: code-domain {'on' if code_domain else 'off'}")
+    by_wid: dict = {}
+    for sp in splits or []:
+        by_wid.setdefault(client.owner_of(int(sp.bucket)), []).append(sp)
+    if not by_wid:
+        lines.append("cluster: no splits to scatter")
+    for wid in sorted(by_wid):
+        sps = by_wid[wid]
+        files = sum(len(sp.files) for sp in sps)
+        buckets = ", ".join(str(b) for b in sorted({int(sp.bucket) for sp in sps}))
+        lines.append(
+            f"fragment -> worker {wid}: {len(sps)} splits, {files} files (buckets {buckets})"
+        )
+    return plan_batch(lines)
+
+
+def cluster_query(
+    catalog: "Catalog",
+    statement: str,
+    client,
+    busy_wait_s: float = 10.0,
+    scan_frag_fn=None,
+):
     """Execute one SELECT across the cluster-service workers; returns the
     result ColumnBatch, bit-identical to sql.select.query on the same
     catalog. Falls back to the single-process evaluator for shapes the
@@ -298,6 +436,9 @@ def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float
     from ..metrics import sql_metrics
     from ..options import CoreOptions
 
+    m = _EXPLAIN_RE.match(statement)
+    if m:
+        return _explain_cluster(catalog, statement[m.end():], client)
     p = parse_select(statement)
     if p.is_join:
         from ..ops.join import partition_executor
@@ -314,6 +455,7 @@ def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float
     opts = t.store.options.options
     code_domain = resolve_code_domain(opts.get(CoreOptions.SQL_CLUSTER_CODE_DOMAIN))
     retry_ms = int(opts.get(CoreOptions.SQL_CLUSTER_RETRY_TIMEOUT))
+    frag_cache = bool(opts.get(CoreOptions.SQL_CLUSTER_FRAGMENT_CACHE))
     engine = _engine_for(t)
     g = sql_metrics()
     if p.where_text:  # surface parse errors before any RPC, like query()
@@ -359,9 +501,16 @@ def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float
             "engine": engine,
             "code_domain": code_domain,
         }
-        t0 = time.perf_counter()
-        raw = _scatter(client, _plan_frags(projection, None), template, retry_ms, busy_wait_s)
-        g.histogram("scatter_ms").update((time.perf_counter() - t0) * 1000)
+        by_wid = _plan_frags(projection, None)
+        key = _fragment_signature(template, by_wid) if frag_cache else None
+        raw = _frag_cache_get(str(t.path), key)
+        if raw is not None:
+            g.counter("fragment_cache_hits").inc(1)
+        else:
+            t0 = time.perf_counter()
+            raw = _scatter(client, by_wid, template, retry_ms, busy_wait_s, scan_frag_fn)
+            g.histogram("scatter_ms").update((time.perf_counter() - t0) * 1000)
+            _frag_cache_put(str(t.path), key, raw)
         schema = t.row_type.project(projection)
         parts = [decode_partial(r, schema, group_cols) for r in raw]
         parts = [q for q in parts if q["rows"]]
@@ -505,7 +654,9 @@ def cluster_query(catalog: "Catalog", statement: str, client, busy_wait_s: float
         "engine": engine,
     }
     t0 = time.perf_counter()
-    raw = _scatter(client, _plan_frags(projection, limit_push), template, retry_ms, busy_wait_s)
+    raw = _scatter(
+        client, _plan_frags(projection, limit_push), template, retry_ms, busy_wait_s, scan_frag_fn
+    )
     g.histogram("scatter_ms").update((time.perf_counter() - t0) * 1000)
     schema = t.row_type.project(projection) if projection is not None else t.row_type
     t1 = time.perf_counter()

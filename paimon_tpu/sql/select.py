@@ -43,11 +43,14 @@ if TYPE_CHECKING:
     from ..catalog import Catalog
     from ..data.batch import ColumnBatch
 
-__all__ = ["query", "QueryError", "SelectPlan", "parse_select"]
+__all__ = ["query", "explain", "QueryError", "SelectPlan", "parse_select"]
 
 
 class QueryError(ValueError):
     pass
+
+
+_EXPLAIN_RE = re.compile(r"^\s*EXPLAIN\s+", re.I)
 
 
 _SELECT_RE = re.compile(
@@ -285,8 +288,103 @@ def agg_projection(p: SelectPlan, row_type) -> list[str] | None:
     return needed
 
 
+def explain_plan(catalog: "Catalog", statement: str):
+    """Plan facts for one SELECT without executing it: (SelectPlan, table,
+    display lines, pushed-down splits). The shared EXPLAIN body — the local
+    evaluator renders the lines as-is; sql.cluster appends the
+    fragment->worker assignment and the code-domain toggle."""
+    p = parse_select(statement)
+    if p.is_join:
+        jt = p.from_match.group("jtable").strip("`")
+        return p, None, [
+            f"join query: {p.table_name} JOIN {jt}",
+            "plan: per-side WHERE/projection pushdown, join-key stats prune "
+            "the bigger side, device join kernel (ops.join.join_batches)",
+        ], None
+    fm = p.from_match
+    t = _resolve_table(
+        catalog, fm.group("table"), fm.group("hints"), fm.group("tt_kind"), fm.group("tt_val")
+    )
+    shape = (
+        f"grouped aggregate (group by: {', '.join(p.group_cols)})"
+        if p.group_cols
+        else "scalar aggregate" if p.is_agg else "rows"
+    )
+    lines = [f"table: {p.table_name}", f"shape: {shape}"]
+    if not hasattr(t, "new_read_builder"):
+        lines.append("source: system table (static batch; no scan pushdown)")
+        return p, t, lines, None
+    pred = None
+    if p.where_text:
+        try:
+            pred = to_predicate(parse_expr(p.where_text), p.where_text)
+        except ExprError as e:
+            raise QueryError(str(e)) from e
+    needed = agg_projection(p, t.row_type)
+    if needed is None and not p.is_agg and p.cols_text != "*":
+        names = [i.strip("`") for i in p.items]
+        needed = list(dict.fromkeys(names + _order_cols(p.order_text)))
+    if needed is not None:
+        for n in needed:
+            if n not in t.row_type:
+                raise QueryError(f"unknown column {n!r} in {p.table_name}")
+    limit_push = (
+        p.limit if (not p.is_agg and not p.group_cols and p.order_text is None) else None
+    )
+    lines.append(f"engine: {_engine_for(t)}")
+    lines.append(f"where (pushed): {p.where_text.strip()}" if p.where_text else "where: none")
+    lines.append(
+        f"projection (pushed): [{', '.join(needed)}]"
+        if needed is not None
+        else "projection: * (full row)"
+    )
+    if limit_push is not None:
+        lines.append(f"limit (pushed): {limit_push}")
+    elif p.limit is not None:
+        lines.append(f"limit: {p.limit} (applied after ORDER BY)")
+    if p.order_text:
+        lines.append(f"order by: {p.order_text.strip()}")
+    if p.having_text:
+        lines.append(f"having: {p.having_text.strip()}")
+    all_splits = t.new_read_builder().new_scan().plan()
+    rb = t.new_read_builder()
+    if pred is not None:
+        rb = rb.with_filter(pred)
+    if needed is not None:
+        rb = rb.with_projection(list(needed))
+    if limit_push is not None:
+        rb = rb.with_limit(limit_push)
+    splits = rb.new_scan().plan()
+    total_files = sum(len(sp.files) for sp in all_splits)
+    files = sum(len(sp.files) for sp in splits)
+    lines.append(
+        f"splits: {len(splits)} (files {files} of {total_files}, "
+        f"{total_files - files} pruned)"
+    )
+    return p, t, lines, splits
+
+
+def plan_batch(lines: list) -> "ColumnBatch":
+    """EXPLAIN wire shape: one STRING column named 'plan', one line per row."""
+    from ..data.batch import ColumnBatch
+    from ..types import STRING, RowType
+
+    return ColumnBatch.from_pydict(RowType.of(("plan", STRING())), {"plan": list(lines)})
+
+
+def explain(catalog: "Catalog", statement: str) -> "ColumnBatch":
+    """EXPLAIN SELECT ...: the local plan — files pruned, pushed predicates
+    / projection / LIMIT, engine, result shape — as a one-column batch."""
+    _, _, lines, _ = explain_plan(catalog, statement)
+    return plan_batch(lines)
+
+
 def query(catalog: "Catalog", statement: str) -> "ColumnBatch":
-    """Execute one SELECT statement; returns the result as a ColumnBatch."""
+    """Execute one SELECT statement; returns the result as a ColumnBatch.
+    ``EXPLAIN SELECT ...`` returns the plan instead (see :func:`explain`)."""
+    m = _EXPLAIN_RE.match(statement)
+    if m:
+        return explain(catalog, statement[m.end():])
     p = parse_select(statement)
     if p.is_join:
         return _join_query(catalog, p)

@@ -180,20 +180,28 @@ class LocalTableQuery:
         self._delta_indexes.clear()
         return self
 
-    def refresh(self) -> None:
+    def refresh(self, swap_lock: "threading.Lock | None" = None) -> None:
         """Re-plan against the latest snapshot (reference: file-change
         monitoring feeds refresh in the query service). Per-bucket diff:
         buckets whose file set + DV index are unchanged keep their built
-        LookupLevels and BucketGetIndex."""
+        LookupLevels and BucketGetIndex; changed buckets carry the warm
+        per-file probe indexes of files that persist.
+
+        `swap_lock` is the serving-plane two-phase mode: the replacement
+        state is built AND prewarmed without the lock — gets keep serving
+        the previous snapshot — and only the dict swap happens under it.
+        Without it (one-shot/constructor use) nothing is prewarmed: a
+        non-serving query should only ever read the files it probes."""
         plan = self.store.new_scan().plan()
         sid = plan.snapshot.id if plan.snapshot else None
         if sid == self._snapshot_id:
             return
-        self._snapshot_id = sid
         from ..core.deletionvectors import DeletionVectorsIndexFile
 
         dv_io = DeletionVectorsIndexFile(self.table.file_io, self.table.path)
         seen: set[tuple] = set()
+        staged: dict[tuple, tuple] = {}  # pb -> (levels, get_index, sig)
+        stale_cache: list[str] = []
         for partition, buckets in plan.grouped().items():
             for bucket, files in buckets.items():
                 pb = (partition, bucket)
@@ -203,9 +211,8 @@ class LocalTableQuery:
                 if self._bucket_sigs.get(pb) == sig:
                     continue  # unchanged bucket: keep the warm state
                 dvs = dv_io.read_all(dv_index) if dv_index else {}
-                for name in dvs:
-                    self.cache.invalidate(name)  # DV changed: cached rows stale
-                self._levels[pb] = LookupLevels(
+                stale_cache += list(dvs)  # DV changed: cached rows stale
+                levels = LookupLevels(
                     files,
                     self.store.reader_factory(partition, bucket),
                     self.store.key_names,
@@ -218,19 +225,32 @@ class LocalTableQuery:
                     max_disk_bytes=self._max_disk_bytes,
                     file_retention_millis=self._file_retention_ms,
                 )
-                self._get_indexes[pb] = BucketGetIndex(
+                get_index = BucketGetIndex(
                     files,
                     self.store.reader_factory(partition, bucket),
                     self.store.key_names,
                     deletion_vectors=dvs,
                     bloom_prune=self._bloom_prune,
+                    warm_from=self._get_indexes.get(pb),
                 )
+                if swap_lock is not None:
+                    get_index.prewarm()
+                staged[pb] = (levels, get_index, sig)
+        import contextlib
+
+        with swap_lock if swap_lock is not None else contextlib.nullcontext():
+            for name in stale_cache:
+                self.cache.invalidate(name)
+            for pb, (levels, get_index, sig) in staged.items():
+                self._levels[pb] = levels
+                self._get_indexes[pb] = get_index
                 self._bucket_sigs[pb] = sig
-        for pb in list(self._levels):
-            if pb not in seen:
-                del self._levels[pb]
-                self._get_indexes.pop(pb, None)
-                self._bucket_sigs.pop(pb, None)
+            for pb in list(self._levels):
+                if pb not in seen:
+                    del self._levels[pb]
+                    self._get_indexes.pop(pb, None)
+                    self._bucket_sigs.pop(pb, None)
+            self._snapshot_id = sid
 
     # ---- subscription-driven refresh ------------------------------------
     def follow(self, hub=None, lock: "threading.Lock | None" = None) -> "LocalTableQuery":
@@ -270,14 +290,13 @@ class LocalTableQuery:
                     # (refresh() keeps working without the signal)
                     stop.wait(0.2)
                 try:
-                    if advanced:
-                        with flock:
-                            self.refresh()
-                    elif (
+                    if advanced or (
                         self.store.snapshot_manager.latest_snapshot_id() != self._snapshot_id
                     ):
-                        with flock:
-                            self.refresh()
+                        # two-phase: build + prewarm outside the serving
+                        # lock, swap under it — a snapshot advance must not
+                        # head-of-line-block the gets it races with
+                        self.refresh(swap_lock=flock)
                 except Exception:
                     pass  # transient plan/IO failure: retried next poll
 

@@ -125,6 +125,22 @@
 #                              xla-segmented — so the fused pallas kernels
 #                              and the stock XLA path both prove
 #                              bit-identical merge output end to end.
+#   scripts/verify.sh gateway  multi-tenant gateway stage: the gateway
+#                              suite (per-tenant admission, typed-shed
+#                              canonicalization, hedged reads + loser
+#                              cancellation, SLO surface) INCLUDING the
+#                              slow-marked ~45 s DETERMINISTIC mixed-kind
+#                              storm — 64 closed-loop clients across 4
+#                              tenants (one deliberately greedy) against
+#                              a 2-worker cluster with one latency-shamed
+#                              worker, fixed seed — asserting the greedy
+#                              tenant sheds TYPED (retry_after set, 0
+#                              untyped sheds), the quiet tenant's latency
+#                              stays bounded relative to its solo
+#                              baseline, hedges stay within the
+#                              max-fraction budget, and every hedge
+#                              attempt drains (no orphaned RPC, no
+#                              leaked "paimon-gw" thread via conftest).
 #   scripts/verify.sh sql-cluster  distributed-SQL parity stage: the
 #                              tests/test_sql_cluster.py suite (scatter-
 #                              gather fragments at 1/2/4 workers vs the
@@ -267,6 +283,14 @@ if [ "${1:-}" = "cluster" ]; then
     --duration 45 --workers 2 --readers 1 --seed 0 \
     --scripted-kills "flush:files-written:2:kill,cluster:compact-executing:1:kill,cluster:before-ship:2:kill" \
     --kill-period 10 --sweep-period 15 --min-kills 2
+fi
+
+if [ "${1:-}" = "gateway" ]; then
+  # no -m filter: this stage INCLUDES the slow-marked ~45 s seeded
+  # mixed-kind tenant-isolation storm
+  exec env JAX_PLATFORMS=cpu PAIMON_TPU_SOAK_DURATION=45 PAIMON_TPU_SOAK_SEED=0 \
+    timeout -k 10 600 python -m pytest tests/test_gateway.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
 if [ "${1:-}" = "sql-cluster" ]; then

@@ -34,6 +34,19 @@ import pytest
 
 
 @pytest.fixture(autouse=True)
+def _fresh_fragment_cache():
+    """Tests are independent: the distributed-SQL fragment-result cache is
+    process-global (keyed on table path + snapshot), so a test repeating an
+    aggregate another test already ran would silently skip the scatter it
+    means to exercise. Clear it around every test."""
+    from paimon_tpu.sql.cluster import clear_fragment_cache
+
+    clear_fragment_cache()
+    yield
+    clear_fragment_cache()
+
+
+@pytest.fixture(autouse=True)
 def _no_worker_thread_leaks():
     """Fail any test that leaves the pipelined scheduler's non-daemon worker
     threads alive (paimon-pipeline-* stage pools, paimon-flush writer
@@ -54,7 +67,7 @@ def _no_worker_thread_leaks():
             if t.is_alive()
             and not t.daemon
             and t.name.startswith(
-                ("paimon-pipeline", "paimon-flush", "paimon-compactor", "paimon-subtail", "paimon-subhb", "paimon-qryref")
+                ("paimon-pipeline", "paimon-flush", "paimon-compactor", "paimon-subtail", "paimon-subhb", "paimon-qryref", "paimon-gw")
             )
         ]
 
