@@ -555,6 +555,45 @@ def bench_soak() -> dict:
     }
 
 
+def bench_mega() -> dict:
+    """Mega-soak spot-check (benchmarks/mega_soak_bench.py is the dedicated
+    full-matrix >=10 min run): one scenario cell — dynamic buckets, every
+    plane live (gateway writers, getters, subscribers, SQL, churn) — on the
+    composed chaos store with the scripted kill schedule armed. The one
+    verdict must stay consistent:true with 0 untyped sheds."""
+    from paimon_tpu.service.mega_soak import DEFAULT_MATRIX, MegaConfig, run_mega_soak
+
+    cell = tuple(s for s in DEFAULT_MATRIX if s.name == "dict-dynamic")
+    # expiry knobs scaled to the short cell: the decoy-consumer check needs
+    # consumer_expire_ms + an expiry pass to fit inside the duration
+    cfg = MegaConfig(
+        duration_s=12.0,
+        seed=0,
+        scenarios=cell,
+        kill_period_s=6.0,
+        expire_period_s=3.0,
+        consumer_expire_ms=4_000,
+    )
+    tmp = tempfile.mkdtemp(prefix="paimon_tpu_bench_mega_")
+    try:
+        report = run_mega_soak(tmp, cfg)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    c = report["cells"][0]
+    return {
+        "metric": "mega-soak spot-check (12 s, dict-dynamic cell, chaos store + kill schedule)",
+        "consistent": report["consistent"],
+        "kills": report["kills_total"],
+        "accepted_commits": c.get("accepted_commits"),
+        "final_rows": c.get("final_rows"),
+        "lost_rows": c.get("lost_rows"),
+        "duplicated_rows": c.get("duplicated_rows"),
+        "gw_sheds_untyped": c.get("gw_sheds_untyped"),
+        "leaked_files": c.get("leaked_file_count"),
+        "unit": "counters",
+    }
+
+
 def main():
     tmp = tempfile.mkdtemp(prefix="paimon_tpu_bench_")
     try:
@@ -576,6 +615,7 @@ def main():
         gateway_rows = bench_gateway()
         resilience_row = bench_resilience()
         soak_row = bench_soak()
+        mega_row = bench_mega()
         row = {
             "metric": "merge-read throughput (1M-row PK table, 4 sorted runs, parquet, 1 bucket)",
             "value": round(rows_per_sec, 1),
@@ -633,6 +673,7 @@ def main():
             print(json.dumps(dict(grow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
         print(json.dumps(dict(soak_row, platform=_PLATFORM)))
+        print(json.dumps(dict(mega_row, platform=_PLATFORM)))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -68,6 +68,15 @@ class FileSystemCatalog(Catalog):
         self.warehouse = warehouse.rstrip("/")
         self.file_io: FileIO = get_file_io(warehouse)
         self.commit_user = commit_user
+        # catalog metadata probes (get_table schema reads, listings) run
+        # BEFORE any table's store exists to supply its fs.retry budget —
+        # give them the default budget so a transient store blip resolves
+        # a table instead of failing the lookup. Tables themselves still
+        # receive the RAW io: the store re-wraps per its own options.
+        from ..options import CoreOptions
+        from ..resilience.fileio import wrap_file_io
+
+        self._meta_io: FileIO = wrap_file_io(self.file_io, CoreOptions())
 
     # ---- databases -----------------------------------------------------
     def _db_path(self, name: str) -> str:
@@ -75,7 +84,7 @@ class FileSystemCatalog(Catalog):
 
     def list_databases(self) -> list[str]:
         out = []
-        for st in self.file_io.list_status(self.warehouse):
+        for st in self._meta_io.list_status(self.warehouse):
             base = st.path.rsplit("/", 1)[-1]
             if st.is_dir and base.endswith(self.DB_SUFFIX):
                 out.append(base[: -len(self.DB_SUFFIX)])
@@ -106,8 +115,8 @@ class FileSystemCatalog(Catalog):
 
     def list_tables(self, database: str) -> list[str]:
         out = []
-        for st in self.file_io.list_status(self._db_path(database)):
-            if st.is_dir and self.file_io.exists(f"{st.path}/schema"):
+        for st in self._meta_io.list_status(self._db_path(database)):
+            if st.is_dir and self._meta_io.exists(f"{st.path}/schema"):
                 out.append(st.path.rsplit("/", 1)[-1])
         return sorted(out)
 
@@ -172,7 +181,7 @@ class FileSystemCatalog(Catalog):
 
             return system_table(data_table, sys_name)
         path = self.table_path(ident)
-        sm = SchemaManager(self.file_io, path)
+        sm = SchemaManager(self._meta_io, path)
         schema = sm.latest()
         if schema is None:
             raise FileNotFoundError(f"table {ident} does not exist")

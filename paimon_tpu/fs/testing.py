@@ -9,11 +9,31 @@ Capability parity with the reference test infrastructure:
 
 from __future__ import annotations
 
+import os
 import random
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from . import FileIO, FileStatus, LocalFileIO, register_file_io, split_scheme
+
+
+def _posix_backed(io: FileIO) -> bool:
+    """Walk a wrapper chain's `_inner` links: does this stack bottom out on
+    plain POSIX rename (LocalFileIO)? Wrappers are composable (chaos =
+    faults over latency over local), so the decision "decompose
+    try_atomic_write into write+rename with crash-realistic injection" vs
+    "delegate to an overriding commit primitive (object-store conditional
+    PUT)" must look through every layer, not just the immediate inner."""
+    seen: set[int] = set()
+    while not isinstance(io, LocalFileIO):
+        if id(io) in seen:
+            return False
+        seen.add(id(io))
+        nxt = getattr(io, "_inner", None)
+        if nxt is None:
+            return False
+        io = nxt
+    return True
 
 
 class ArtificialException(IOError):
@@ -127,6 +147,11 @@ class FailingFileIO(FileIO):
         return fn()
 
     def _strip(self, path: str) -> tuple[_FailState | None, str]:
+        if "://" not in path:
+            # already a bare inner path (e.g. a FileStatus.path handed back
+            # by a caller) — stripping would eat its first segment as a
+            # phantom domain name
+            return None, path
         scheme, rest = split_scheme(path)
         # path layout: fail://<name><abs-path>
         name, sep, tail = rest.lstrip("/").partition("/")
@@ -171,7 +196,14 @@ class FailingFileIO(FileIO):
 
     def list_status(self, path: str) -> list[FileStatus]:
         _, local = self._strip(path)
-        return self._inner.list_status(local)
+        children = self._inner.list_status(local)
+        if "://" not in path:
+            return children
+        # re-prefix children so round-trips (exists/get_table on a listed
+        # path) keep the scheme + domain and stay under fault injection
+        scheme, rest = split_scheme(path)
+        name, _, _ = rest.lstrip("/").partition("/")
+        return [replace(st, path=f"{scheme}://{name}{st.path}") for st in children]
 
     def get_status(self, path: str) -> FileStatus:
         _, local = self._strip(path)
@@ -182,7 +214,7 @@ class FailingFileIO(FileIO):
 
     def try_atomic_write(self, path: str, data: bytes) -> bool:
         st, local = self._strip(path)
-        if not isinstance(self._inner, LocalFileIO):
+        if not _posix_backed(self._inner):
             # inner overrides the commit primitive (object store: conditional
             # PUT, no rename) — delegate so the oracle exercises THAT protocol
             if st is not None:
@@ -211,7 +243,7 @@ class FailingFileIO(FileIO):
         return ok
 
     def try_overwrite(self, path: str, data: bytes) -> bool:
-        if isinstance(self._inner, LocalFileIO):
+        if _posix_backed(self._inner):
             return super().try_overwrite(path, data)
         st, local = self._strip(path)
         if st is not None:
@@ -236,11 +268,26 @@ class LatencyFileIO(FileIO):
         cls.read_ms = read_ms
         cls.write_ms = write_ms
 
-    def __init__(self):
-        self._inner = LocalFileIO()
+    def __init__(self, inner: FileIO | None = None):
+        self._inner = inner or LocalFileIO()
+        # capability flags shine through, same contract as FailingFileIO —
+        # latency over an object store must still engage that store's
+        # commit protocol (conditional PUT / catalog lock)
+        self.atomic_write_supported = getattr(self._inner, "atomic_write_supported", True)
+        self.exclusive_create_supported = getattr(self._inner, "exclusive_create_supported", True)
 
     def _p(self, path: str) -> str:
         return split_scheme(path)[1]
+
+    def try_atomic_write(self, path: str, data: bytes) -> bool:
+        # POSIX bottom: the base temp+rename decomposition routes through
+        # self.write_bytes/self.rename, so the write nap is paid exactly once
+        # (rename is metadata-only — no first-byte latency on a real store
+        # either). Non-POSIX bottom: delegate the overriding commit primitive.
+        if _posix_backed(self._inner):
+            return super().try_atomic_write(path, data)
+        self._nap(LatencyFileIO.write_ms)
+        return self._inner.try_atomic_write(self._p(path), data)
 
     def _nap(self, ms: float) -> None:
         if ms > 0:
@@ -337,6 +384,64 @@ class TraceableFileIO(FileIO):
         return self._inner.get_status(self._p(path))
 
 
+CHAOS_ENV = "PAIMON_TPU_CHAOS"
+
+
+def apply_chaos_env(spec: str | None = None) -> None:
+    """Parse a chaos spec — ``read_ms=40,write_ms=15,domain=mega0,
+    possibility=150,max_fails=100000,seed=7`` — and shape this process's
+    chaos stack: class-level latency plus a probabilistic fault domain.
+    Reads PAIMON_TPU_CHAOS when `spec` is None, so OS-process children of a
+    soak supervisor inherit the exact same store shape with no code
+    handshake (the crash-point env idiom applied to IO). The fault domain
+    is created only if absent: re-entering the factory mid-run must not
+    reset injected-fault counters."""
+    if spec is None:
+        spec = os.environ.get(CHAOS_ENV, "")
+    if not spec:
+        return
+    cfg = dict(kv.split("=", 1) for kv in spec.split(",") if kv)
+    LatencyFileIO.configure(
+        read_ms=float(cfg.get("read_ms", 0)), write_ms=float(cfg.get("write_ms", 0))
+    )
+    domain = cfg.get("domain")
+    if domain and domain not in FailingFileIO._states:
+        FailingFileIO.reset(
+            domain,
+            max_fails=int(cfg.get("max_fails", 1 << 30)),
+            possibility=int(cfg.get("possibility", 0)),
+            seed=int(cfg.get("seed", 0)),
+        )
+
+
+def chaos_spec(
+    domain: str,
+    read_ms: float = 0.0,
+    write_ms: float = 0.0,
+    possibility: int = 0,
+    max_fails: int = 1 << 30,
+    seed: int = 0,
+) -> str:
+    """Build the PAIMON_TPU_CHAOS value for `apply_chaos_env` — the
+    supervisor composes this once, exports it to every child, and applies
+    it locally; paths then use ``chaos://<domain><abs-path>``."""
+    return (
+        f"domain={domain},read_ms={read_ms},write_ms={write_ms},"
+        f"possibility={possibility},max_fails={max_fails},seed={seed}"
+    )
+
+
+def _chaos() -> FailingFileIO:
+    """The composed chaos store: scripted/probabilistic faults layered over
+    latency shaping over local disk, in ONE FileIO stack. Faults are
+    checked before the latency nap (a failed op never reaches the store, so
+    it must not pay first-byte latency), and try_atomic_write keeps the
+    decomposed POSIX crash semantics — a rename-phase fault leaves the torn
+    tmp sibling on disk THROUGH the latency layer."""
+    apply_chaos_env()
+    return FailingFileIO(inner=LatencyFileIO())
+
+
 def _fail_s3() -> FailingFileIO:
     from .object_store import ObjectStoreFileIO
 
@@ -351,6 +456,7 @@ def _fail_s3_legacy() -> FailingFileIO:
 
 register_file_io("fail", FailingFileIO)
 register_file_io("latency", LatencyFileIO)
+register_file_io("chaos", _chaos)
 register_file_io("fail-s3", _fail_s3)
 register_file_io("fail-s3-legacy", _fail_s3_legacy)
 register_file_io("traceable", TraceableFileIO)

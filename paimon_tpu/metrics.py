@@ -385,7 +385,10 @@ def gateway_metrics() -> MetricGroup:
     hedges_issued (read RPCs re-issued to a secondary worker past
     gateway.hedge.deadline-ms), hedges_won (hedges where the secondary's
     answer was used), hedges_cancelled (loser attempts aborted after a
-    winner returned); histograms: put_ms / get_batch_ms / subscribe_ms /
+    winner returned), route_failovers (RPCs or bucket-owner lookups that
+    fell over to a live secondary because the routed worker was dead or
+    mid-respawn — the mega soak's kill schedule makes these routine; each
+    one is a request SERVED, not shed); histograms: put_ms / get_batch_ms / subscribe_ms /
     sql_ms (per-kind gateway wall millis, all tenants mixed — the
     per-tenant decayed percentiles live in Gateway.slo()). Resolved per
     call so registry.reset() in tests swaps the group out."""

@@ -559,6 +559,46 @@ class CoreOptions:
         "a final sweep at threshold 0 runs after the drain regardless). "
         "0 = final sweep only.",
     )
+    SOAK_MEGA_DURATION = ConfigOption.duration(
+        "soak.mega.duration",
+        "45 s",
+        "Production mega-soak (service.mega_soak): how long each scenario "
+        "cell runs its full process census (cluster mesh, gateway writers, "
+        "getters, subscribers, SQL clients, churn threads) before the drain "
+        "and the multi-plane oracle verdict.",
+    )
+    SOAK_MEGA_CLUSTER_WORKERS = ConfigOption.int_(
+        "soak.mega.cluster-workers",
+        2,
+        "Production mega-soak: worker OS processes in the cluster plane of "
+        "cells that enable it (mesh engine, adaptive compaction on).",
+    )
+    SOAK_MEGA_KILL_PERIOD = ConfigOption.duration(
+        "soak.mega.kill-period",
+        "9 s",
+        "Production mega-soak: mean interval between seeded random SIGKILLs "
+        "across all process kinds, on top of the scripted "
+        "PAIMON_TPU_CRASH_POINT kill schedule. 0 = scripted kills only.",
+    )
+    SOAK_MEGA_CHAOS_READ = ConfigOption.float_(
+        "soak.mega.chaos.read-ms",
+        1.0,
+        "Production mega-soak: mean injected read latency (ms) of the "
+        "composed chaos store the whole warehouse lives on.",
+    )
+    SOAK_MEGA_CHAOS_WRITE = ConfigOption.float_(
+        "soak.mega.chaos.write-ms",
+        0.5,
+        "Production mega-soak: mean injected write latency (ms) of the "
+        "composed chaos store.",
+    )
+    SOAK_MEGA_CHAOS_POSSIBILITY = ConfigOption.int_(
+        "soak.mega.chaos.possibility",
+        200,
+        "Production mega-soak: inject a transient IO fault on 1/N of "
+        "filesystem ops across every plane (absorbed by the fs.retry "
+        "budget; 0 = latency shaping only).",
+    )
     CLUSTER_WORKERS = ConfigOption.int_(
         "cluster.workers",
         2,

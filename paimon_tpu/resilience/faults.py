@@ -71,6 +71,7 @@ __all__ = [
     "COMMIT_CRASH_POINTS",
     "WRITER_CRASH_POINTS",
     "CLUSTER_CRASH_POINTS",
+    "SERVICE_CRASH_POINTS",
     "ALL_CRASH_POINTS",
     "KILL_EXIT_CODE",
 ]
@@ -96,7 +97,19 @@ CLUSTER_CRASH_POINTS = (
     "cluster:before-ship",
 )
 
-ALL_CRASH_POINTS = COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS + CLUSTER_CRASH_POINTS
+# the service-plane points: a gateway writer client dying between its put
+# landing on the wire and journaling the ack (service/mega_soak.py), and a
+# subscriber dying right after fsyncing a received batch into its journal
+# (service/subscription.py) — both leave a landed-but-unacked protocol edge
+# the respawned incarnation must resolve from durable state alone
+SERVICE_CRASH_POINTS = (
+    "gateway:put-sent",
+    "subscriber:batch-journaled",
+)
+
+ALL_CRASH_POINTS = (
+    COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS + CLUSTER_CRASH_POINTS + SERVICE_CRASH_POINTS
+)
 
 # 128 + SIGKILL: a hard death at a crash point reports like a kill -9 victim
 KILL_EXIT_CODE = 137

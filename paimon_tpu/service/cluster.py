@@ -60,7 +60,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from . import _recv, _send
-from .soak import KEYSPACE, SCHEMA, find_landed_append, sweep_and_audit
+from .soak import KEYSPACE, SCHEMA, find_landed_append
 
 __all__ = [
     "ClusterConfig",
@@ -1275,13 +1275,26 @@ class ClusterClient:
     def _conn(self, wid: int) -> _RpcConn:
         conn = self._conns.get(wid)
         if conn is None:
-            conn = self._conns[wid] = _RpcConn(*self._addrs[wid])
+            conn = self._conns[wid] = _RpcConn(*self.addr_of(wid))
         return conn
 
     def owner_of(self, bucket: int) -> int:
+        """The worker serving a bucket's reads. Every consumer (routed
+        gets, scan fragments, subscribe fan-in, join partitions) reads the
+        shared filesystem, so a bucket whose owner died and has not
+        re-registered falls back to any live worker — bit-identical answer,
+        no window where a respawn surfaces as a raw KeyError. With nothing
+        live at all the escape is ConnectionError, which every dispatch
+        failover loop already absorbs."""
         if bucket not in self._route:
             self.refresh_route()
-        return self._route[bucket]
+        wid = self._route.get(bucket)
+        if wid is not None:
+            return wid
+        live = sorted(self._addrs)
+        if live:
+            return live[bucket % len(live)]
+        raise ConnectionError(f"no live worker serves bucket {bucket}")
 
     def drop_conn(self, wid: int) -> None:
         """Forget a worker's cached connection (the failover path: the next
@@ -1297,7 +1310,14 @@ class ClusterClient:
         return sorted(self._addrs)
 
     def addr_of(self, wid: int) -> "tuple[str, int]":
-        return self._addrs[wid]
+        """A worker's serving address. A wid the route advertised a moment
+        ago can vanish under a concurrent refresh (the respawn window) —
+        that is a dead route, ConnectionError, never a KeyError escaping
+        through a dispatch path that only absorbs connection-grain faults."""
+        try:
+            return self._addrs[wid]
+        except KeyError:
+            raise ConnectionError(f"worker {wid} has no serving address") from None
 
     # ---- distributed SQL scan fragments (ISSUE 16) ----------------------
     def scan_frag(self, wid: int, frag: dict, busy_wait_s: float = 10.0) -> dict:
@@ -1639,151 +1659,47 @@ class ClusterSupervisor:
         return self._verify(wall_s)
 
     # ---- verification --------------------------------------------------
-    def _fold_oracle(self, store) -> tuple[dict[int, dict], dict]:
-        from ..core.snapshot import CommitKind
-        from .proc_soak import WriterJournal
-
-        sm = store.snapshot_manager
-        chain: dict[tuple, list[int]] = {}
-        latest, earliest = sm.latest_snapshot_id(), sm.earliest_snapshot_id()
-        if latest is not None and earliest is not None:
-            for sid in range(earliest, latest + 1):
-                if not sm.snapshot_exists(sid):
-                    continue
-                snap = sm.snapshot(sid)
-                if snap.commit_kind == CommitKind.APPEND and snap.commit_user.startswith(
-                    ClusterCoordinator.USER_PREFIX
-                ):
-                    chain.setdefault((snap.commit_user, snap.commit_identifier), []).append(sid)
-        landed: dict[int, dict] = {}
-        stats = {
-            "rounds_intended": 0,
-            "rounds_landed": 0,
-            "rounds_failed": 0,
-            "rounds_ack_lost": 0,
-            "crash_recoveries": 0,
-            "double_applied": [],
-        }
-        seen_pairs = set()
-        for wid in range(self.cfg.workers):
-            user = f"{ClusterCoordinator.USER_PREFIX}{wid}"
-            events = WriterJournal.read(os.path.join(self.run_dir, f"journal-{wid}.jsonl"))
-            acked = {e["ident"] for e in events if e["t"] == "ack"}
-            stats["crash_recoveries"] += sum(1 for e in events if e["t"] == "recovered")
-            for e in events:
-                if e["t"] != "intent":
-                    continue
-                stats["rounds_intended"] += 1
-                sids = chain.get((user, e["ident"]), [])
-                seen_pairs.add((user, e["ident"]))
-                if len(sids) > 1:
-                    stats["double_applied"].append(
-                        {"user": user, "ident": e["ident"], "sids": sids}
-                    )
-                if sids:
-                    stats["rounds_landed"] += 1
-                    if e["ident"] not in acked:
-                        stats["rounds_ack_lost"] += 1
-                    landed[sids[0]] = {int(k): v for k, v in e["rows"].items()}
-                else:
-                    stats["rounds_failed"] += 1
-        for (user, ident), sids in chain.items():
-            if (user, ident) not in seen_pairs:
-                self.inconsistencies.append(
-                    {"kind": "unjournaled-commit", "user": user, "ident": ident, "sids": sids}
-                )
-        return landed, stats
-
-    def _read_reader_logs(self) -> dict:
-        from .proc_soak import WriterJournal
-
-        out = {"reads_ok": 0, "read_errors": 0, "read_error_samples": []}
-        for rid in range(self.cfg.readers):
-            path = os.path.join(self.run_dir, f"reads-{rid}.jsonl")
-            if not os.path.exists(path):
-                continue
-            done = False
-            for e in WriterJournal.read(path):
-                if e.get("t") == "done":
-                    out["reads_ok"] += e["reads_ok"]
-                    out["read_errors"] += e["read_errors"]
-                    done = True
-                elif e.get("t") in ("err", "dup-keys"):
-                    out["read_error_samples"].append(e)
-            if not done:
-                out["read_errors"] += sum(
-                    1 for e in WriterJournal.read(path) if e.get("t") in ("err", "dup-keys")
-                )
-        return out
-
-    def _final_compact(self, table) -> None:
-        from ..core.commit import BATCH_COMMIT_IDENTIFIER
-        from ..core.manifest import ManifestCommittable
-        from ..table.write import TableWrite
-
-        t = table.copy({"write-only": "false"})
-        for _ in range(3):
-            tw = TableWrite(t)
-            try:
-                tw.compact(full=True)
-                msgs = tw.prepare_commit()
-                if not msgs:
-                    return
-                t.store.new_commit().commit(
-                    ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs)
-                )
-                return
-            except Exception:
-                continue
-            finally:
-                tw.close()
-
     def _verify(self, wall_s: float) -> dict:
+        from .oracle import fold_landed_rounds, read_client_logs, verify_table_state
+
         table = self._fresh_table()
-        store = table.store
-        landed, stats = self._fold_oracle(store)
+        landed, stats = fold_landed_rounds(
+            table.store,
+            {
+                f"{ClusterCoordinator.USER_PREFIX}{wid}": os.path.join(
+                    self.run_dir, f"journal-{wid}.jsonl"
+                )
+                for wid in range(self.cfg.workers)
+            },
+            user_prefix=ClusterCoordinator.USER_PREFIX,
+            inconsistencies=self.inconsistencies,
+        )
         expected: dict = {}
         for sid in sorted(landed):
             expected.update(landed[sid])
-        lost = dup = wrong = 0
-        final_rows = total_record_count = None
-        try:
-            self._final_compact(table)
-            latest = store.snapshot_manager.latest_snapshot()
-            if latest is not None:
-                t = table.copy({"scan.snapshot-id": str(latest.id)})
-                rb = t.new_read_builder()
-                batch = rb.new_read().read_all(rb.new_scan().plan())
-                ks = batch.column("k").values.tolist()
-                got = dict(zip(ks, batch.column("v").values.tolist()))
-                final_rows = len(ks)
-                dup = len(ks) - len(got)
-                lost = sum(1 for k in expected if k not in got)
-                wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
-                dup += sum(1 for k in got if k not in expected)
-                total_record_count = store.snapshot_manager.latest_snapshot().total_record_count
-            elif expected:
-                lost = len(expected)
-        except Exception:
-            self.errors.append(f"final verification crashed:\n{traceback.format_exc()}")
-        audit = {"orphans_removed": None, "leaked_files": ["<audit crashed>"]}
-        try:
-            audit = sweep_and_audit(table, self.table_root, older_than_millis=0, sweep=True)
-        except Exception:
-            self.errors.append(f"orphan audit crashed:\n{traceback.format_exc()}")
-        reads = self._read_reader_logs()
+        state = verify_table_state(
+            table,
+            expected,
+            self.table_root,
+            self.errors,
+            self.inconsistencies,
+            force_writable=True,  # lift write-only=true for the final compact
+        )
+        reads = read_client_logs(
+            [os.path.join(self.run_dir, f"reads-{rid}.jsonl") for rid in range(self.cfg.readers)]
+        )
         if stats["double_applied"]:
             self.inconsistencies.append({"kind": "double-applied", "rounds": stats["double_applied"]})
         read_amp_max = max(self.read_amp_samples) if self.read_amp_samples else None
         consistent = (
             not self.errors
             and not self.inconsistencies
-            and lost == 0
-            and dup == 0
-            and wrong == 0
+            and state["lost_rows"] == 0
+            and state["duplicated_rows"] == 0
+            and state["wrong_values"] == 0
             and reads["read_errors"] == 0
-            and (total_record_count is None or total_record_count == len(expected))
-            and len(audit["leaked_files"]) == 0
+            and state["record_count_matches"]
+            and len(state["leaked_files"]) == 0
             and (read_amp_max is None or read_amp_max <= self.cfg.read_amp_ceiling)
         )
         from ..metrics import cluster_metrics
@@ -1808,11 +1724,11 @@ class ClusterSupervisor:
             "consistent": consistent,
             "accepted_commits": len(landed),
             "expected_unique_keys": len(expected),
-            "final_rows": final_rows,
-            "total_record_count": total_record_count,
-            "lost_rows": lost,
-            "duplicated_rows": dup,
-            "wrong_values": wrong,
+            "final_rows": state["final_rows"],
+            "total_record_count": state["total_record_count"],
+            "lost_rows": state["lost_rows"],
+            "duplicated_rows": state["duplicated_rows"],
+            "wrong_values": state["wrong_values"],
             "commits_per_sec": round(len(landed) / wall_s, 2) if wall_s > 0 else None,
             "read_amp_p99_max": read_amp_max,
             "read_amp_ceiling": self.cfg.read_amp_ceiling,
@@ -1820,9 +1736,9 @@ class ClusterSupervisor:
             **self.counts,
             **reads,
             "cluster": cluster_counts,
-            "orphans_removed": audit["orphans_removed"],
-            "leaked_file_count": len(audit["leaked_files"]),
-            "leaked_files": audit["leaked_files"][:10],
+            "orphans_removed": state["orphans_removed"],
+            "leaked_file_count": len(state["leaked_files"]),
+            "leaked_files": state["leaked_files"][:10],
             "inconsistencies": self.inconsistencies[:10],
             "errors": self.errors[:5],
         }
@@ -1843,6 +1759,10 @@ def worker_main(args) -> int:
     from ..parallel import distributed
     from ..table import load_table
 
+    if args.table.startswith(("fail:", "fail-s3", "latency:", "traceable:", "chaos:")):
+        # test-harness schemes register on import (the chaos scheme also
+        # applies PAIMON_TPU_CHAOS, so this worker inherits the store shape)
+        from ..fs import testing as _testing  # noqa: F401
     if args.rtt_read_ms or args.rtt_write_ms:
         from ..fs.testing import LatencyFileIO
 

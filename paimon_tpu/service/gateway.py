@@ -126,10 +126,12 @@ class Gateway:
         self._hedge_enabled = bool(opts.get(CoreOptions.GATEWAY_HEDGE_ENABLED))
         self._hedge_deadline_ms = int(opts.get(CoreOptions.GATEWAY_HEDGE_DEADLINE))
         self._hedge_max_fraction = float(opts.get(CoreOptions.GATEWAY_HEDGE_MAX_FRACTION))
+        self._retry_after_ms = int(opts.get(CoreOptions.GATEWAY_RETRY_AFTER))
         # put plane: one shared admission controller under one commit lock
         # (single-committer discipline, the flight server's do_put shape)
         self._write_ctrl = WriteBufferController.from_options(table.store.options)
         self._put_lock = threading.Lock()
+        self._put_tables: dict[str, object] = {}  # commit_user -> handle
         # local read plane (no cluster route)
         self._query = None
         self._query_lock = threading.Lock()
@@ -214,10 +216,36 @@ class Gateway:
 
     # ------------------------------------------------------------------
     # put
-    def put(self, data, kinds=None, tenant: "str | None" = None):
+    def _put_table(self, user: "str | None"):
+        """The table handle a put commits through: the gateway's own handle
+        by default, or a cached per-`user` handle when the caller supplies a
+        commit identity (journaled writers recover via find_landed_append,
+        which needs the commit_user on the snapshot)."""
+        if user is None:
+            return self._table
+        t = self._put_tables.get(user)
+        if t is None:
+            t = self._put_tables[user] = self._table.with_user(user)
+        return t
+
+    def put(
+        self,
+        data,
+        kinds=None,
+        tenant: "str | None" = None,
+        user: "str | None" = None,
+        identifier: "int | None" = None,
+    ):
         """Write one batch and commit it. Backpressure from the shared
         write-buffer controller surfaces as a typed GatewayShedError (never
-        an untyped unwind, even when close() re-raises during teardown)."""
+        an untyped unwind, even when close() re-raises during teardown).
+
+        `user`/`identifier` give the commit a caller-owned identity: the
+        snapshot records (user, identifier), so an intent/ack-journaled
+        client that loses the response can resolve whether the round landed
+        from the chain alone. With an identifier the return value is the
+        landed APPEND snapshot id (None when nothing committed) instead of
+        the row count."""
         from ..core.admission import WriterBackpressureError
         from ..data.batch import ColumnBatch
         from ..table.write import TableWrite
@@ -226,9 +254,11 @@ class Gateway:
             data = ColumnBatch.from_pydict(self._table.row_type, data)
         name = self._admit(tenant, "put", data.byte_size())
         t0 = time.perf_counter()
+        sid = None
         try:
             with self._put_lock:
-                tw = TableWrite(self._table, buffer_controller=self._write_ctrl)
+                table = self._put_table(user)
+                tw = TableWrite(table, buffer_controller=self._write_ctrl)
                 try:
                     tw.write(data, kinds)
                     msgs = tw.prepare_commit()
@@ -239,7 +269,15 @@ class Gateway:
                         # teardown must not replace the typed shed already
                         # unwinding (ISSUE 17 bugfix hunt, the do_put shape)
                         pass
-                self._table.new_batch_write_builder().new_commit().commit(msgs)
+                if identifier is None:
+                    table.new_batch_write_builder().new_commit().commit(msgs)
+                else:
+                    from ..core.manifest import ManifestCommittable
+
+                    sids = table.store.new_commit().commit(
+                        ManifestCommittable(identifier, messages=msgs)
+                    )
+                    sid = sids[0] if sids else None
         except WriterBackpressureError as e:
             health = self._write_ctrl.health_dict() if self._write_ctrl else {}
             self._metrics().counter("sheds_typed").inc()
@@ -258,7 +296,7 @@ class Gateway:
         finally:
             self._qos.release(name)
         self._record(name, "put", t0)
-        return len(data)
+        return len(data) if identifier is None else sid
 
     # ------------------------------------------------------------------
     # get_batch
@@ -311,9 +349,9 @@ class Gateway:
         out: list = [None] * len(ks)
         by_wid: dict[int, list[int]] = {}
         for i, b in enumerate(buckets.tolist()):
-            by_wid.setdefault(client.owner_of(int(b)), []).append(i)
+            by_wid.setdefault(self._owner_for(int(b)), []).append(i)
         for wid, idxs in by_wid.items():
-            r = self._hedged_rpc(
+            r = self._rpc_failover(
                 wid,
                 "get_batch",
                 keys=[list(ks[i]) for i in idxs],
@@ -434,12 +472,29 @@ class Gateway:
             if self._client is not None:
                 from ..sql.cluster import cluster_query
 
-                out = cluster_query(
-                    self._catalog,
-                    statement,
-                    self._client,
-                    scan_frag_fn=self.hedged_scan_frag,
-                )
+                try:
+                    out = cluster_query(
+                        self._catalog,
+                        statement,
+                        self._client,
+                        scan_frag_fn=self.hedged_scan_frag,
+                    )
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    if isinstance(e, FileNotFoundError):
+                        raise  # user error (missing table/path), not a dead route
+                    # the whole worker pool mid-respawn: fragment planning
+                    # found no live route — pressure, typed like every other
+                    # route escape (the sql client backs off on retry_after)
+                    self._metrics().counter("sheds_typed").inc()
+                    self._slo.record_shed(name, "sql")
+                    raise GatewayShedError(
+                        ShedInfo(
+                            kind="sql",
+                            state="route-respawning",
+                            tenant=name,
+                            retry_after_ms=max(int(self._retry_after_ms), 1),
+                        )
+                    ) from e
             else:
                 from ..sql.select import query
 
@@ -589,13 +644,74 @@ class Gateway:
                 return_when=FIRST_COMPLETED,
             )
 
+    def _owner_for(self, bucket: int) -> int:
+        """The worker a bucket's gets route to. A bucket with no serving
+        owner (its worker was killed and hasn't re-registered) falls back to
+        any live worker — get_batch serves any bucket from the shared
+        filesystem — counting a route_failover; with NO live worker the
+        escape is the typed 'route-respawning' shed, never a raw KeyError."""
+        client = self._client
+        try:
+            return client.owner_of(bucket)
+        except (KeyError, ConnectionError):
+            live = client.live_workers()
+            if live:
+                self._metrics().counter("route_failovers").inc()
+                return live[bucket % len(live)]
+        self._metrics().counter("sheds_typed").inc()
+        raise GatewayShedError(
+            ShedInfo(
+                kind="get_batch",
+                state="route-respawning",
+                retry_after_ms=max(int(self._retry_after_ms), 1),
+            )
+        )
+
+    def _rpc_failover(self, wid: int, method: str, **kw) -> dict:
+        """_hedged_rpc hardened against a dead route: a connection-grain
+        failure (the worker is mid-respawn, so its socket refuses or resets
+        before the hedge deadline even starts) refreshes the route and
+        retries on the next live worker — any live worker serves the same
+        pinned snapshot from the shared filesystem, so the answer is
+        bit-identical. When no worker answers, the escape is a TYPED
+        'route-respawning' shed carrying the configured gateway.retry-after-ms
+        (always positive), never a raw ConnectionError: the acceptance
+        invariant gateway{sheds_untyped} == 0 must hold across respawns."""
+        last: "BaseException | None" = None
+        for _ in range(3):
+            try:
+                return self._hedged_rpc(wid, method, **kw)
+            except FileNotFoundError:
+                raise  # user error (missing table/path), not a dead route
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e
+                self._metrics().counter("route_failovers").inc()
+                try:
+                    self._client.refresh_route()
+                except Exception:
+                    pass
+                # a respawned worker re-registers under the same wid with a
+                # fresh address, so the primary stays a candidate; otherwise
+                # step to the next live worker cyclically
+                alt = self._secondary_for(wid)
+                if alt is not None:
+                    wid = alt
+        self._metrics().counter("sheds_typed").inc()
+        raise GatewayShedError(
+            ShedInfo(
+                kind=method,
+                state="route-respawning",
+                retry_after_ms=max(int(self._retry_after_ms), 1),
+            )
+        ) from last
+
     def hedged_scan_frag(self, wid: int, frag: dict, busy_wait_s: float = 10.0) -> dict:
         """ClusterClient.scan_frag's contract (BUSY absorbed with the
         server-advertised backoff) over the hedged RPC path — the
         scan_frag_fn seam sql.cluster._scatter dispatches through."""
         deadline = time.monotonic() + busy_wait_s
         while True:
-            r = self._hedged_rpc(wid, "scan_frag", frag=frag)
+            r = self._rpc_failover(wid, "scan_frag", frag=frag)
             if not r.get("busy"):
                 return r["partial"]
             if time.monotonic() >= deadline:

@@ -1,0 +1,359 @@
+"""The one cross-plane consistency oracle every soak shares.
+
+Four oracles grew up independently — the thread soak's serialized OracleLog
+fold, the process soaks' journal intent/ack chain walk, the subscriber
+fold==pinned-scan check, and the reachable-closure disk audit — and the
+proc-soak and cluster supervisors each carried a near-verbatim copy of the
+end-of-run verification. This module is the single home for all of them:
+
+  OracleLog            serialized landed-commit log (thread-grain soaks)
+  find_landed_append   snapshot-chain probe: did (user, identifier) land?
+  fold_landed_rounds   journal ∩ snapshot-chain fold → {append sid: rows}
+  sweep_and_audit      orphan sweep + independent disk walk vs closure
+  scan_rows            pinned scan at a snapshot → {key: value}, row count
+  compare_final        expected-vs-scanned → (lost, duplicated, wrong)
+  final_full_compact   quiesced 3-retry full compaction before the scan
+  read_client_logs     torn-tolerant reader/getter JSONL log fold
+  verify_table_state   the whole end-of-run gate the supervisors share
+
+The verdict every caller derives from these pieces is the same sentence:
+the fold of landed rounds in snapshot-id order EQUALS the final scan, the
+physical row count equals the unique-key count (a double-applied replay
+cannot hide), and after the threshold-0 sweep the on-disk file set is
+EXACTLY the reachable closure plus table metadata.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+__all__ = [
+    "OracleLog",
+    "find_landed_append",
+    "fold_landed_rounds",
+    "sweep_and_audit",
+    "scan_rows",
+    "compare_final",
+    "final_full_compact",
+    "read_client_logs",
+    "verify_table_state",
+]
+
+
+class OracleLog:
+    """Serialized log of landed commits: (append snapshot id -> rows).
+    The single source of truth every concurrent read is verified against."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._events: dict[int, dict] = {}  # snapshot id -> {key: value}
+
+    def record(self, snapshot_id: int, rows: dict) -> None:
+        with self._cond:
+            self._events[snapshot_id] = dict(rows)
+            self._cond.notify_all()
+
+    def covers(self, needed: set[int]) -> bool:
+        with self._cond:
+            return needed <= self._events.keys()
+
+    def wait_covers(self, needed: set[int], timeout_s: float) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: needed <= self._events.keys(), timeout_s)
+
+    def expected_at(self, snapshot_id: int) -> dict:
+        """Fold of all recorded events with id <= snapshot_id, in id order —
+        the exact row set a consistent read of that snapshot must return."""
+        with self._cond:
+            items = sorted((sid, rows) for sid, rows in self._events.items() if sid <= snapshot_id)
+        out: dict = {}
+        for _, rows in items:
+            out.update(rows)
+        return out
+
+    def expected_final(self) -> dict:
+        return self.expected_at(1 << 62)
+
+    @property
+    def commits(self) -> int:
+        with self._cond:
+            return len(self._events)
+
+    @property
+    def accepted_rows(self) -> int:
+        with self._cond:
+            return sum(len(r) for r in self._events.values())
+
+
+def find_landed_append(store, user: str, identifier: int) -> int | None:
+    """Did this (user, identifier) round's APPEND phase land? A commit that
+    raised (conflict on its COMPACT half, retry exhaustion, an injected
+    fault mid-protocol) may still have published rows — the snapshot chain,
+    not the exception, is the truth the oracle must record."""
+    from ..core.snapshot import CommitKind
+
+    try:
+        for snap in store.snapshot_manager.snapshots_of_user_with_identifier(user, identifier):
+            if snap.commit_kind == CommitKind.APPEND:
+                return snap.id
+    except Exception:
+        return None
+    return None
+
+
+def fold_landed_rounds(
+    store,
+    journals: dict[str, str],
+    user_prefix: str,
+    inconsistencies: list,
+    decode_key=int,
+) -> tuple[dict[int, dict], dict]:
+    """One walk of the snapshot chain (the authority on what LANDED) plus
+    the writers' intent/ack journals (the authority on what each round
+    CONTAINED) → the landed map {append sid: rows} and the protocol
+    bookkeeping counters. `journals` maps commit_user -> journal path;
+    `user_prefix` filters the chain to this soak's writers; journal keys are
+    JSON strings and are decoded back with `decode_key`.
+
+    Two invariants are checked in passing: a (user, identifier) pair landing
+    more than once is double-applied (recorded in stats and escalated by the
+    caller), and a chain commit with no journaled intent violates the
+    intent-fsync-before-commit protocol (appended to `inconsistencies`)."""
+    from ..core.snapshot import CommitKind
+
+    from .proc_soak import WriterJournal
+
+    sm = store.snapshot_manager
+    chain: dict[tuple, list[int]] = {}
+    latest, earliest = sm.latest_snapshot_id(), sm.earliest_snapshot_id()
+    if latest is not None and earliest is not None:
+        for sid in range(earliest, latest + 1):
+            if not sm.snapshot_exists(sid):
+                continue
+            snap = sm.snapshot(sid)
+            if snap.commit_kind == CommitKind.APPEND and snap.commit_user.startswith(user_prefix):
+                chain.setdefault((snap.commit_user, snap.commit_identifier), []).append(sid)
+    landed: dict[int, dict] = {}
+    stats = {
+        "rounds_intended": 0,
+        "rounds_landed": 0,
+        "rounds_failed": 0,  # aborted AND verifiably absent from the chain
+        "rounds_ack_lost": 0,  # landed with no journal ack (probe/chain resolved)
+        "crash_recoveries": 0,
+        "double_applied": [],
+    }
+    seen_pairs = set()
+    for user, path in journals.items():
+        events = WriterJournal.read(path)
+        acked = {e["ident"] for e in events if e["t"] == "ack"}
+        stats["crash_recoveries"] += sum(1 for e in events if e["t"] == "recovered")
+        for e in events:
+            if e["t"] != "intent":
+                continue
+            stats["rounds_intended"] += 1
+            sids = chain.get((user, e["ident"]), [])
+            seen_pairs.add((user, e["ident"]))
+            if len(sids) > 1:
+                stats["double_applied"].append({"user": user, "ident": e["ident"], "sids": sids})
+            if sids:
+                stats["rounds_landed"] += 1
+                if e["ident"] not in acked:
+                    stats["rounds_ack_lost"] += 1
+                landed[sids[0]] = {decode_key(k): v for k, v in e["rows"].items()}
+            else:
+                stats["rounds_failed"] += 1
+    # every soak APPEND snapshot must trace back to a journaled intent
+    # (the intent fsync precedes the commit — an unjournaled commit is
+    # a protocol violation)
+    for (user, ident), sids in chain.items():
+        if (user, ident) not in seen_pairs:
+            inconsistencies.append(
+                {"kind": "unjournaled-commit", "user": user, "ident": ident, "sids": sids}
+            )
+    return landed, stats
+
+
+def sweep_and_audit(
+    table, local_root: str, older_than_millis: int = 0, sweep: bool = True
+) -> dict:
+    """Orphan sweep (optional, threshold `older_than_millis`), then an
+    INDEPENDENT disk walk of `local_root`: the surviving file set must be
+    EXACTLY the reachable closure plus table metadata (snapshots/schemas/
+    hints/markers). `sweep=False` audits without reclaiming — the
+    seed-contrast runs use it to show what a sweep-less build leaks."""
+    from ..resilience.orphan import reachable_files, remove_orphan_files
+
+    removed = remove_orphan_files(table, older_than_millis=older_than_millis) if sweep else None
+    closure = reachable_files(table)
+    meta_names = set().union(*closure["meta"].values()) if closure["meta"] else set()
+    index_names = set().union(*closure["index"].values()) if closure["index"] else set()
+    data_names = {name for (_, name) in closure["data"]}
+    leaked = []
+    for dirpath, _dirs, files in os.walk(local_root):
+        rel = os.path.relpath(dirpath, local_root)
+        parts = [] if rel == "." else rel.split(os.sep)
+        top = parts[0] if parts else ""
+        for f in files:
+            if top == "manifest":
+                ok = f in meta_names
+            elif top == "index":
+                ok = f in index_names
+            elif top in (
+                "snapshot",
+                "schema",
+                "branch",
+                "tag",
+                "consumer",
+                "service",
+                "statistics",
+                "changelog",
+            ):
+                ok = True  # metadata planes: hints, schema history, markers
+            elif any(p.startswith("bucket-") for p in parts):
+                ok = f in data_names
+            else:
+                ok = False
+            if not ok:
+                leaked.append(os.path.join(rel, f))
+    return {
+        "orphans_removed": len(removed) if removed is not None else None,
+        "leaked_files": leaked,
+    }
+
+
+def scan_rows(table, sid: int) -> tuple[dict, int]:
+    """Pinned scan at `sid` → ({key: value}, physical row count). Key is the
+    first schema column; value is the second column for two-column schemas
+    (the k/v soaks) or the tuple of the remaining columns otherwise (the
+    mega matrix's wider shapes). A physical count above len(keys) is a
+    duplicate-key finding the caller turns into `duplicated_rows`."""
+    t = table.copy({"scan.snapshot-id": str(sid)})
+    rb = t.new_read_builder()
+    batch = rb.new_read().read_all(rb.new_scan().plan())
+    rows = batch.to_pylist()
+    got: dict = {}
+    for row in rows:
+        got[row[0]] = row[1] if len(row) == 2 else tuple(row[1:])
+    return got, len(rows)
+
+
+def compare_final(expected: dict, got: dict, physical_rows: int) -> tuple[int, int, int]:
+    """(lost, duplicated, wrong): keys the scan is missing, keys present
+    beyond the expected set (plus physical duplicates the dict collapsed),
+    and keys whose value differs from the fold."""
+    dup = physical_rows - len(got)
+    lost = sum(1 for k in expected if k not in got)
+    wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
+    dup += sum(1 for k in got if k not in expected)
+    return lost, dup, wrong
+
+
+def final_full_compact(table, attempts: int = 3, force_writable: bool = False) -> None:
+    """Quiesced full compaction before the final scan (nothing else runs;
+    retries cover stragglers). `force_writable` lifts a cluster table's
+    write-only=true — the supervisor compacts after the workers are gone."""
+    from ..core.commit import BATCH_COMMIT_IDENTIFIER
+    from ..core.manifest import ManifestCommittable
+    from ..table.write import TableWrite
+
+    t = table.copy({"write-only": "false"}) if force_writable else table
+    for _ in range(attempts):
+        tw = TableWrite(t)
+        try:
+            tw.compact(full=True)
+            msgs = tw.prepare_commit()
+            if not msgs:
+                return
+            t.store.new_commit().commit(
+                ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs)
+            )
+            return
+        except Exception:
+            continue
+        finally:
+            tw.close()
+
+
+def read_client_logs(paths: list[str]) -> dict:
+    """Fold reader/getter client JSONL logs (torn-tail tolerant): sum the
+    'done' summaries, collect err/dup-keys samples, and count every logged
+    error for clients drained by force before they wrote a summary."""
+    from .proc_soak import WriterJournal
+
+    out = {"reads_ok": 0, "read_errors": 0, "read_error_samples": []}
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        done = False
+        events = WriterJournal.read(path)  # same torn-tolerant JSONL parse
+        for e in events:
+            if e.get("t") == "done":
+                out["reads_ok"] += e["reads_ok"]
+                out["read_errors"] += e["read_errors"]
+                done = True
+            elif e.get("t") in ("err", "dup-keys"):
+                out["read_error_samples"].append(e)
+        if not done:
+            # client was drained by force: count its logged errors
+            out["read_errors"] += sum(1 for e in events if e.get("t") in ("err", "dup-keys"))
+    return out
+
+
+def verify_table_state(
+    table,
+    expected: dict,
+    local_root: str,
+    errors: list,
+    inconsistencies: list,
+    *,
+    sweep: bool = True,
+    force_writable: bool = False,
+) -> dict:
+    """The shared end-of-run gate: full-compact, scan the latest snapshot,
+    compare against the fold (`expected`), assert total_record_count ==
+    unique keys, sweep-and-audit at threshold 0, then re-scan and assert the
+    sweep removed nothing a reader can still see. Crashes land in `errors`,
+    findings in `inconsistencies`; the caller folds the returned counters
+    into its consistent verdict."""
+    lost = dup = wrong = 0
+    final_rows = total_record_count = None
+    store = table.store
+    try:
+        final_full_compact(table, force_writable=force_writable)
+        latest = store.snapshot_manager.latest_snapshot()
+        if latest is not None:
+            got, physical = scan_rows(table, latest.id)
+            final_rows = physical
+            lost, dup, wrong = compare_final(expected, got, physical)
+            total_record_count = store.snapshot_manager.latest_snapshot().total_record_count
+        elif expected:
+            lost = len(expected)
+    except Exception:
+        errors.append(f"final verification crashed:\n{traceback.format_exc()}")
+    audit = {"orphans_removed": None, "leaked_files": ["<audit crashed>"]}
+    try:
+        audit = sweep_and_audit(table, local_root, older_than_millis=0, sweep=sweep)
+        if sweep and final_rows is not None:
+            # the sweep must not have removed anything a reader can see
+            latest = store.snapshot_manager.latest_snapshot()
+            _, after = scan_rows(table, latest.id)
+            if after != final_rows:
+                inconsistencies.append(
+                    {"kind": "sweep-removed-live-rows", "before": final_rows, "after": after}
+                )
+    except Exception:
+        errors.append(f"orphan audit crashed:\n{traceback.format_exc()}")
+    return {
+        "lost_rows": lost,
+        "duplicated_rows": dup,
+        "wrong_values": wrong,
+        "final_rows": final_rows,
+        "total_record_count": total_record_count,
+        "record_count_matches": (
+            total_record_count is None or total_record_count == len(expected)
+        ),
+        "orphans_removed": audit["orphans_removed"],
+        "leaked_files": audit["leaked_files"],
+    }

@@ -53,7 +53,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .soak import KEYSPACE, SCHEMA, find_landed_append, sweep_and_audit
+from .soak import KEYSPACE, SCHEMA, find_landed_append
 
 __all__ = [
     "ProcSoakConfig",
@@ -201,6 +201,11 @@ def writer_main(args) -> int:
     from ..table import load_table
     from ..table.write import TableWrite
 
+    if args.table.startswith(("fail:", "fail-s3", "latency:", "traceable:", "chaos:")):
+        # test-harness schemes register on import (the chaos scheme also
+        # applies PAIMON_TPU_CHAOS, so this child inherits the store shape)
+        from ..fs import testing as _testing  # noqa: F401
+
     wid = args.wid
     user = f"psoak-w{wid}"
     rng = np.random.default_rng(args.seed * 7919 + wid * 104729 + args.incarnation)
@@ -305,6 +310,9 @@ def writer_main(args) -> int:
 # ---------------------------------------------------------------------------
 def reader_main(args) -> int:
     from ..table import load_table
+
+    if args.table.startswith(("fail:", "fail-s3", "latency:", "traceable:", "chaos:")):
+        from ..fs import testing as _testing  # noqa: F401
 
     table = load_table(args.table, commit_user=f"psoak-r{args.rid}")
     sm = table.store.snapshot_manager
@@ -564,164 +572,47 @@ class ProcSoakSupervisor:
         return self._verify(wall_s)
 
     # ---- verification --------------------------------------------------
-    def _fold_oracle(self, store) -> tuple[dict[int, dict], dict]:
-        """One walk of the snapshot chain (the authority on what landed) +
-        the journals (the authority on what each round contained) → the
-        landed map {append sid: rows} and the bookkeeping counters."""
-        from ..core.snapshot import CommitKind
-
-        sm = store.snapshot_manager
-        chain: dict[tuple, list[int]] = {}
-        latest = sm.latest_snapshot_id()
-        earliest = sm.earliest_snapshot_id()
-        if latest is not None and earliest is not None:
-            for sid in range(earliest, latest + 1):
-                if not sm.snapshot_exists(sid):
-                    continue
-                snap = sm.snapshot(sid)
-                if snap.commit_kind == CommitKind.APPEND and snap.commit_user.startswith("psoak-w"):
-                    chain.setdefault((snap.commit_user, snap.commit_identifier), []).append(sid)
-        landed: dict[int, dict] = {}
-        stats = {
-            "rounds_intended": 0,
-            "rounds_landed": 0,
-            "rounds_failed": 0,  # aborted AND verifiably absent from the chain
-            "rounds_ack_lost": 0,  # landed with no journal ack (probe/chain resolved)
-            "crash_recoveries": 0,
-            "double_applied": [],
-        }
-        seen_pairs = set()
-        for wid in range(self.cfg.writers):
-            user = f"psoak-w{wid}"
-            events = WriterJournal.read(os.path.join(self.run_dir, f"journal-{wid}.jsonl"))
-            acked = {e["ident"] for e in events if e["t"] == "ack"}
-            stats["crash_recoveries"] += sum(1 for e in events if e["t"] == "recovered")
-            for e in events:
-                if e["t"] != "intent":
-                    continue
-                stats["rounds_intended"] += 1
-                sids = chain.get((user, e["ident"]), [])
-                seen_pairs.add((user, e["ident"]))
-                if len(sids) > 1:
-                    stats["double_applied"].append({"user": user, "ident": e["ident"], "sids": sids})
-                if sids:
-                    stats["rounds_landed"] += 1
-                    if e["ident"] not in acked:
-                        stats["rounds_ack_lost"] += 1
-                    landed[sids[0]] = {int(k): v for k, v in e["rows"].items()}
-                else:
-                    stats["rounds_failed"] += 1
-        # every soak APPEND snapshot must trace back to a journaled intent
-        # (the intent fsync precedes the commit — an unjournaled commit is
-        # a protocol violation)
-        for (user, ident), sids in chain.items():
-            if (user, ident) not in seen_pairs:
-                self.inconsistencies.append(
-                    {"kind": "unjournaled-commit", "user": user, "ident": ident, "sids": sids}
-                )
-        return landed, stats
-
-    def _read_reader_logs(self) -> dict:
-        out = {"reads_ok": 0, "read_errors": 0, "read_error_samples": []}
-        for rid in range(self.cfg.readers):
-            path = os.path.join(self.run_dir, f"reads-{rid}.jsonl")
-            if not os.path.exists(path):
-                continue
-            done = False
-            for e in WriterJournal.read(path):  # same torn-tolerant JSONL parse
-                if e.get("t") == "done":
-                    out["reads_ok"] += e["reads_ok"]
-                    out["read_errors"] += e["read_errors"]
-                    done = True
-                elif e.get("t") in ("err", "dup-keys"):
-                    out["read_error_samples"].append(e)
-            if not done:
-                # reader was drained by force: count its logged errors
-                out["read_errors"] += sum(
-                    1 for e in WriterJournal.read(path) if e.get("t") in ("err", "dup-keys")
-                )
-        return out
-
-    def _final_compact(self, table) -> None:
-        from ..core.commit import BATCH_COMMIT_IDENTIFIER
-        from ..core.manifest import ManifestCommittable
-        from ..table.write import TableWrite
-
-        for _ in range(3):  # nothing else runs; retries cover stragglers
-            tw = TableWrite(table)
-            try:
-                tw.compact(full=True)
-                msgs = tw.prepare_commit()
-                if not msgs:
-                    return
-                table.store.new_commit().commit(
-                    ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs)
-                )
-                return
-            except Exception:
-                continue
-            finally:
-                tw.close()
-
     def _verify(self, wall_s: float) -> dict:
+        from .oracle import fold_landed_rounds, read_client_logs, verify_table_state
+
         table = self._fresh_table()
-        store = table.store
-        landed, stats = self._fold_oracle(store)
+        landed, stats = fold_landed_rounds(
+            table.store,
+            {
+                f"psoak-w{wid}": os.path.join(self.run_dir, f"journal-{wid}.jsonl")
+                for wid in range(self.cfg.writers)
+            },
+            user_prefix="psoak-w",
+            inconsistencies=self.inconsistencies,
+        )
         expected: dict = {}
         for sid in sorted(landed):
             expected.update(landed[sid])
-        lost = dup = wrong = 0
-        final_rows = total_record_count = None
-        try:
-            self._final_compact(table)
-            latest = store.snapshot_manager.latest_snapshot()
-            if latest is not None:
-                t = table.copy({"scan.snapshot-id": str(latest.id)})
-                rb = t.new_read_builder()
-                batch = rb.new_read().read_all(rb.new_scan().plan())
-                ks = batch.column("k").values.tolist()
-                got = dict(zip(ks, batch.column("v").values.tolist()))
-                final_rows = len(ks)
-                dup = len(ks) - len(got)
-                lost = sum(1 for k in expected if k not in got)
-                wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
-                dup += sum(1 for k in got if k not in expected)
-                total_record_count = store.snapshot_manager.latest_snapshot().total_record_count
-            elif expected:
-                lost = len(expected)
-        except Exception:
-            self.errors.append(f"final verification crashed:\n{traceback.format_exc()}")
-        audit = {"orphans_removed": None, "leaked_files": ["<audit crashed>"]}
-        try:
-            # resilient: sweep at threshold 0 then audit (file set must equal
-            # the closure). Seed contrast: audit only — the leak list IS the
-            # result being demonstrated.
-            audit = sweep_and_audit(
-                table, self.table_root, older_than_millis=0, sweep=self.cfg.resilient
-            )
-            if self.cfg.resilient and final_rows is not None:
-                latest = store.snapshot_manager.latest_snapshot()
-                t = table.copy({"scan.snapshot-id": str(latest.id)})
-                rb = t.new_read_builder()
-                after = rb.new_read().read_all(rb.new_scan().plan()).num_rows
-                if after != final_rows:
-                    self.inconsistencies.append(
-                        {"kind": "sweep-removed-live-rows", "before": final_rows, "after": after}
-                    )
-        except Exception:
-            self.errors.append(f"orphan audit crashed:\n{traceback.format_exc()}")
-        reads = self._read_reader_logs()
+        # resilient: sweep at threshold 0 then audit (file set must equal
+        # the closure). Seed contrast: audit only — the leak list IS the
+        # result being demonstrated.
+        state = verify_table_state(
+            table,
+            expected,
+            self.table_root,
+            self.errors,
+            self.inconsistencies,
+            sweep=self.cfg.resilient,
+        )
+        reads = read_client_logs(
+            [os.path.join(self.run_dir, f"reads-{rid}.jsonl") for rid in range(self.cfg.readers)]
+        )
         if stats["double_applied"]:
             self.inconsistencies.append({"kind": "double-applied", "rounds": stats["double_applied"]})
         consistent = (
             not self.errors
             and not self.inconsistencies
-            and lost == 0
-            and dup == 0
-            and wrong == 0
+            and state["lost_rows"] == 0
+            and state["duplicated_rows"] == 0
+            and state["wrong_values"] == 0
             and reads["read_errors"] == 0
-            and (total_record_count is None or total_record_count == len(expected))
-            and (not self.cfg.resilient or len(audit["leaked_files"]) == 0)
+            and state["record_count_matches"]
+            and (not self.cfg.resilient or len(state["leaked_files"]) == 0)
         )
         return {
             "wall_s": round(wall_s, 2),
@@ -729,18 +620,18 @@ class ProcSoakSupervisor:
             "resilient": self.cfg.resilient,
             "accepted_commits": len(landed),
             "expected_unique_keys": len(expected),
-            "final_rows": final_rows,
-            "total_record_count": total_record_count,
-            "lost_rows": lost,
-            "duplicated_rows": dup,
-            "wrong_values": wrong,
+            "final_rows": state["final_rows"],
+            "total_record_count": state["total_record_count"],
+            "lost_rows": state["lost_rows"],
+            "duplicated_rows": state["duplicated_rows"],
+            "wrong_values": state["wrong_values"],
             "commits_per_sec": round(len(landed) / wall_s, 2) if wall_s > 0 else None,
             **stats,
             **self.counts,
             **reads,
-            "orphans_removed": audit["orphans_removed"],
-            "leaked_file_count": len(audit["leaked_files"]),
-            "leaked_files": audit["leaked_files"][:10],
+            "orphans_removed": state["orphans_removed"],
+            "leaked_file_count": len(state["leaked_files"]),
+            "leaked_files": state["leaked_files"][:10],
             "inconsistencies": self.inconsistencies[:10],
             "errors": self.errors[:5],
         }

@@ -47,6 +47,10 @@ import numpy as np
 
 from ..types import BIGINT, DOUBLE, RowType
 
+# the oracle pieces live in service/oracle.py (shared with proc_soak,
+# cluster and mega_soak); re-exported here for back-compat
+from .oracle import OracleLog, find_landed_append, sweep_and_audit
+
 __all__ = [
     "SoakConfig",
     "OracleLog",
@@ -134,115 +138,6 @@ class SoakConfig:
             rows_per_commit=o.get(CoreOptions.SOAK_ROWS_PER_COMMIT),
             compact_every=o.get(CoreOptions.SOAK_COMPACT_EVERY),
         )
-
-
-class OracleLog:
-    """Serialized log of landed commits: (append snapshot id -> rows).
-    The single source of truth every concurrent read is verified against."""
-
-    def __init__(self):
-        self._cond = threading.Condition()
-        self._events: dict[int, dict] = {}  # snapshot id -> {key: value}
-
-    def record(self, snapshot_id: int, rows: dict) -> None:
-        with self._cond:
-            self._events[snapshot_id] = dict(rows)
-            self._cond.notify_all()
-
-    def covers(self, needed: set[int]) -> bool:
-        with self._cond:
-            return needed <= self._events.keys()
-
-    def wait_covers(self, needed: set[int], timeout_s: float) -> bool:
-        with self._cond:
-            return self._cond.wait_for(lambda: needed <= self._events.keys(), timeout_s)
-
-    def expected_at(self, snapshot_id: int) -> dict:
-        """Fold of all recorded events with id <= snapshot_id, in id order —
-        the exact row set a consistent read of that snapshot must return."""
-        with self._cond:
-            items = sorted((sid, rows) for sid, rows in self._events.items() if sid <= snapshot_id)
-        out: dict = {}
-        for _, rows in items:
-            out.update(rows)
-        return out
-
-    def expected_final(self) -> dict:
-        return self.expected_at(1 << 62)
-
-    @property
-    def commits(self) -> int:
-        with self._cond:
-            return len(self._events)
-
-    @property
-    def accepted_rows(self) -> int:
-        with self._cond:
-            return sum(len(r) for r in self._events.values())
-
-
-def find_landed_append(store, user: str, identifier: int) -> int | None:
-    """Did this (user, identifier) round's APPEND phase land? A commit that
-    raised (conflict on its COMPACT half, retry exhaustion, an injected
-    fault mid-protocol) may still have published rows — the snapshot chain,
-    not the exception, is the truth the oracle must record."""
-    from ..core.snapshot import CommitKind
-
-    try:
-        for snap in store.snapshot_manager.snapshots_of_user_with_identifier(user, identifier):
-            if snap.commit_kind == CommitKind.APPEND:
-                return snap.id
-    except Exception:
-        return None
-    return None
-
-
-def sweep_and_audit(
-    table, local_root: str, older_than_millis: int = 0, sweep: bool = True
-) -> dict:
-    """Orphan sweep (optional, threshold `older_than_millis`), then an
-    INDEPENDENT disk walk of `local_root`: the surviving file set must be
-    EXACTLY the reachable closure plus table metadata (snapshots/schemas/
-    hints/markers). `sweep=False` audits without reclaiming — the
-    seed-contrast runs use it to show what a sweep-less build leaks."""
-    from ..resilience.orphan import reachable_files, remove_orphan_files
-
-    removed = remove_orphan_files(table, older_than_millis=older_than_millis) if sweep else None
-    closure = reachable_files(table)
-    meta_names = set().union(*closure["meta"].values()) if closure["meta"] else set()
-    index_names = set().union(*closure["index"].values()) if closure["index"] else set()
-    data_names = {name for (_, name) in closure["data"]}
-    leaked = []
-    for dirpath, _dirs, files in os.walk(local_root):
-        rel = os.path.relpath(dirpath, local_root)
-        parts = [] if rel == "." else rel.split(os.sep)
-        top = parts[0] if parts else ""
-        for f in files:
-            if top == "manifest":
-                ok = f in meta_names
-            elif top == "index":
-                ok = f in index_names
-            elif top in (
-                "snapshot",
-                "schema",
-                "branch",
-                "tag",
-                "consumer",
-                "service",
-                "statistics",
-                "changelog",
-            ):
-                ok = True  # metadata planes: hints, schema history, markers
-            elif any(p.startswith("bucket-") for p in parts):
-                ok = f in data_names
-            else:
-                ok = False
-            if not ok:
-                leaked.append(os.path.join(rel, f))
-    return {
-        "orphans_removed": len(removed) if removed is not None else None,
-        "leaked_files": leaked,
-    }
 
 
 class SoakHarness:
@@ -1142,69 +1037,17 @@ class SoakHarness:
         return report
 
     # ---- post-soak verification ----------------------------------------
-    def _final_compact(self) -> None:
-        from ..core.commit import BATCH_COMMIT_IDENTIFIER
-        from ..core.manifest import ManifestCommittable
-        from ..table.write import TableWrite
-
-        table = self._handle("soak-final")
-        for _ in range(3):  # nothing else is running; retries cover stragglers
-            tw = TableWrite(table)
-            try:
-                tw.compact(full=True)
-                msgs = tw.prepare_commit()
-                if not msgs:
-                    return
-                table.store.new_commit().commit(
-                    ManifestCommittable(BATCH_COMMIT_IDENTIFIER, messages=msgs)
-                )
-                return
-            except Exception:
-                continue
-            finally:
-                tw.close()
-
-    def _sweep_and_audit(self) -> dict:
-        return sweep_and_audit(self._table, self.local_root)
-
     def _verify(self, wall_s: float) -> dict:
-        lost = dup = wrong = 0
-        final_rows = None
-        total_record_count = None
-        try:
-            self._final_compact()
-            table = self._handle("soak-verify")
-            latest = table.store.snapshot_manager.latest_snapshot()
-            sid = latest.id if latest else None
-            expected = self.oracle.expected_final()
-            if sid is not None:
-                batch = self._read_at(table, sid)
-                ks = batch.column("k").values.tolist()
-                got = dict(zip(ks, batch.column("v").values.tolist()))
-                final_rows = len(ks)
-                dup = len(ks) - len(got)
-                lost = sum(1 for k in expected if k not in got)
-                wrong = sum(1 for k in expected if k in got and got[k] != expected[k])
-                dup += sum(1 for k in got if k not in expected)
-                total_record_count = latest.total_record_count
-            elif expected:
-                lost = len(expected)
-        except Exception:
-            self.errors.append(f"final verification crashed:\n{traceback.format_exc()}")
-        audit = {"orphans_removed": None, "leaked_files": ["<sweep crashed>"]}
-        try:
-            audit = self._sweep_and_audit()
-            # the sweep must not have removed anything a reader can see
-            if final_rows is not None:
-                table = self._handle("soak-post-sweep")
-                latest = table.store.snapshot_manager.latest_snapshot()
-                batch = self._read_at(table, latest.id)
-                if batch.num_rows != final_rows:
-                    self.inconsistencies.append(
-                        {"kind": "sweep-removed-live-rows", "before": final_rows, "after": batch.num_rows}
-                    )
-        except Exception:
-            self.errors.append(f"orphan audit crashed:\n{traceback.format_exc()}")
+        from .oracle import verify_table_state
+
+        expected = self.oracle.expected_final()
+        state = verify_table_state(
+            self._handle("soak-verify"),
+            expected,
+            self.local_root,
+            self.errors,
+            self.inconsistencies,
+        )
         from ..metrics import soak_metrics
 
         g = soak_metrics()
@@ -1216,25 +1059,25 @@ class SoakHarness:
         consistent = (
             not self.inconsistencies
             and not self.errors
-            and lost == 0
-            and dup == 0
-            and wrong == 0
+            and state["lost_rows"] == 0
+            and state["duplicated_rows"] == 0
+            and state["wrong_values"] == 0
             and self.counts["gets_shed_untyped"] == 0  # overload must shed TYPED
             and self.counts["sub_shed_untyped"] == 0  # slow consumers shed TYPED
             and self.counts["sub_mismatches"] == 0  # every fold == pinned scan
-            and (total_record_count is None or total_record_count == len(self.oracle.expected_final()))
+            and state["record_count_matches"]
         )
         report = {
             "wall_s": round(wall_s, 2),
             "consistent": consistent,
             "accepted_commits": self.oracle.commits,
             "accepted_rows": self.oracle.accepted_rows,
-            "expected_unique_keys": len(self.oracle.expected_final()),
-            "final_rows": final_rows,
-            "total_record_count": total_record_count,
-            "lost_rows": lost,
-            "duplicated_rows": dup,
-            "wrong_values": wrong,
+            "expected_unique_keys": len(expected),
+            "final_rows": state["final_rows"],
+            "total_record_count": state["total_record_count"],
+            "lost_rows": state["lost_rows"],
+            "duplicated_rows": state["duplicated_rows"],
+            "wrong_values": state["wrong_values"],
             "commits_per_sec": round(self.oracle.commits / wall_s, 2) if wall_s > 0 else None,
             "writes_throttled": g.counter("writes_throttled").count,
             "writes_rejected": g.counter("writes_rejected").count,
@@ -1242,8 +1085,9 @@ class SoakHarness:
             "inconsistencies": self.inconsistencies[:10],
             "errors": self.errors[:5],
             **self.counts,
-            **{"orphans_removed": audit["orphans_removed"], "leaked_files": audit["leaked_files"][:10]},
-            "leaked_file_count": len(audit["leaked_files"]),
+            "orphans_removed": state["orphans_removed"],
+            "leaked_files": state["leaked_files"][:10],
+            "leaked_file_count": len(state["leaked_files"]),
         }
         return report
 

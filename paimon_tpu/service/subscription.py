@@ -768,9 +768,16 @@ def _run_subscriber_process(argv: list[str] | None = None) -> int:
     ap.add_argument("--from-snapshot", type=int, default=1)
     ap.add_argument("--slow-ms", type=float, default=0.0, help="sleep per batch (slow-consumer modeling)")
     ap.add_argument("--idle-exit", type=float, default=2.0, help="exit after deadline once idle this long")
+    ap.add_argument(
+        "--format",
+        default=None,
+        dest="cdc_format",
+        help="CDC wire format: each batch is encoded to this format and "
+        "parsed back before journaling (parse∘format==identity on the wire)",
+    )
     args = ap.parse_args(argv)
 
-    if args.table.startswith(("fail:", "fail-s3", "latency:", "traceable:")):
+    if args.table.startswith(("fail:", "fail-s3", "latency:", "traceable:", "chaos:")):
         # test-harness schemes register on import; a child process spawned
         # onto a fault-injecting warehouse has no reason to know that
         from ..fs import testing as _testing  # noqa: F401
@@ -800,13 +807,34 @@ def _run_subscriber_process(argv: list[str] | None = None) -> int:
                 continue
             if batch is None:
                 continue
+            rows, kinds = batch.data.to_pylist(), batch.kinds.tolist()
+            if args.cdc_format:
+                # ride the wire format both ways: the journal records what a
+                # downstream consumer of THIS format would have decoded, so
+                # the end-of-run fold==scan check covers the codec too
+                from ..table.cdc_format import encode_changelog, get_cdc_parser
+                from ..types import RowKind
+
+                names = batch.data.schema.field_names
+                msgs = encode_changelog(batch.data, batch.kinds, args.cdc_format)
+                parse = get_cdc_parser(args.cdc_format)
+                decoded = [rec for m in msgs for rec in parse(m)]
+                short_to_kind = {k.short_string: int(k) for k in RowKind}
+                rows = [[rec.get(n) for n in names] for rec in decoded]
+                kinds = [short_to_kind[rec.kind] for rec in decoded]
             journal(
                 {
                     "sid": batch.snapshot_id,
-                    "rows": batch.data.to_pylist(),
-                    "kinds": batch.kinds.tolist(),
+                    "rows": rows,
+                    "kinds": kinds,
                 }
             )
+            from ..resilience.faults import crash_point
+
+            # armed by the mega soak: die AFTER the fsync, BEFORE advancing —
+            # the respawn must resume from the durable consumer position and
+            # the journal fold (sid-deduped) must absorb the replay
+            crash_point("subscriber:batch-journaled")
             last_batch = time.monotonic()
             if args.slow_ms > 0:
                 time.sleep(args.slow_ms / 1000.0)

@@ -94,10 +94,21 @@ class FileStoreTable(Table):
         from dataclasses import replace
 
         schema = replace(self.schema, options=merged)
-        return FileStoreTable(self.file_io, self.path, schema, self.store.commit_user)
+        out = FileStoreTable(self.file_io, self.path, schema, self.store.commit_user)
+        return self._carry_store_overrides(out)
 
     def with_user(self, commit_user: str) -> "FileStoreTable":
-        return FileStoreTable(self.file_io, self.path, self.schema, commit_user)
+        out = FileStoreTable(self.file_io, self.path, self.schema, commit_user)
+        return self._carry_store_overrides(out)
+
+    def _carry_store_overrides(self, out: "FileStoreTable") -> "FileStoreTable":
+        """A branch view resolves data files in the MAIN tree via an
+        instance-level bucket_dir override (table.branch.branch_table); a
+        copy/with_user rebuild must keep resolving there or pinned scans on
+        the view 404 on every shared data file."""
+        if "bucket_dir" in self.store.__dict__:
+            out.store.bucket_dir = self.store.__dict__["bucket_dir"]
+        return out
 
     # ---- builders ------------------------------------------------------
     def new_read_builder(self) -> ReadBuilder:

@@ -69,8 +69,10 @@ class TableWrite:
             from ..options import CoreOptions
 
             target = store.options.options.get(CoreOptions.DYNAMIC_BUCKET_TARGET_ROW_NUM)
+            # store.file_io, not table.file_io: the hash index rides the same
+            # fs.retry budget as every other store-level IO path
             self._assigner = SimpleHashBucketAssigner(
-                HashIndexFile(table.file_io, table.path),
+                HashIndexFile(store.file_io, table.path),
                 target,
                 initial_buckets=store.options.options.get(CoreOptions.DYNAMIC_BUCKET_INITIAL_BUCKETS),
                 num_assigners=store.options.options.get(CoreOptions.DYNAMIC_BUCKET_ASSIGNER_PARALLELISM) or 1,
@@ -193,7 +195,7 @@ class TableWrite:
         from ..core.bucket_index import HashIndexFile
 
         plan = self.table.store.new_scan().with_partition_filter(lambda p: p == partition).plan()
-        hif = HashIndexFile(self.table.file_io, self.table.path)
+        hif = HashIndexFile(self.table.store.file_io, self.table.path)
         indexes = {
             e.bucket: hif.read(e.file_name)
             for e in plan.index_entries

@@ -141,6 +141,23 @@
 #                              max-fraction budget, and every hedge
 #                              attempt drains (no orphaned RPC, no
 #                              leaked "paimon-gw" thread via conftest).
+#   scripts/verify.sh mega     production mega-soak stage: the kill-schedule /
+#                              scenario-matrix / chaos-composition suite
+#                              (tests/test_mega_soak.py), then a bounded
+#                              (~90 s) DETERMINISTIC two-cell mega soak —
+#                              flagship (cluster + gateway + branch/tag) and
+#                              dict-dynamic (dynamic buckets + consumer
+#                              expiry) on one composed chaos store, every
+#                              plane (writers, getters, subscribers, SQL,
+#                              expiry/sweep churn) live at once, scripted
+#                              kill -9 deaths at registered crash points plus
+#                              seeded random SIGKILLs — asserting >= 3 kills
+#                              across >= 2 process kinds survived, one
+#                              consistent:true verdict (0 lost/dup rows, 0
+#                              untyped sheds, 0 pinned-read errors, post-
+#                              sweep disk set == reachable closure).
+#                              Nightly-scale knobs live in
+#                              benchmarks/mega_soak_bench.py.
 #   scripts/verify.sh sql-cluster  distributed-SQL parity stage: the
 #                              tests/test_sql_cluster.py suite (scatter-
 #                              gather fragments at 1/2/4 workers vs the
@@ -291,6 +308,15 @@ if [ "${1:-}" = "gateway" ]; then
   exec env JAX_PLATFORMS=cpu PAIMON_TPU_SOAK_DURATION=45 PAIMON_TPU_SOAK_SEED=0 \
     timeout -k 10 600 python -m pytest tests/test_gateway.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+if [ "${1:-}" = "mega" ]; then
+  env JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python -m pytest tests/test_mega_soak.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  exec env JAX_PLATFORMS=cpu timeout -k 10 420 python -m paimon_tpu.service.mega_soak \
+    --cells flagship,dict-dynamic --duration 25 --workers 2 --seed 0 \
+    --kill-period 8 --min-kills 3 --min-kill-kinds 2
 fi
 
 if [ "${1:-}" = "sql-cluster" ]; then

@@ -67,7 +67,7 @@ def _no_worker_thread_leaks():
             if t.is_alive()
             and not t.daemon
             and t.name.startswith(
-                ("paimon-pipeline", "paimon-flush", "paimon-compactor", "paimon-subtail", "paimon-subhb", "paimon-qryref", "paimon-gw")
+                ("paimon-pipeline", "paimon-flush", "paimon-compactor", "paimon-subtail", "paimon-subhb", "paimon-qryref", "paimon-gw", "mega-")
             )
         ]
 

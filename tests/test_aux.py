@@ -51,6 +51,25 @@ def test_branch_create_write_fast_forward(catalog):
     assert bm.list_branches() == []
 
 
+def test_branch_view_copy_keeps_shared_data_files(catalog):
+    """Regression: copy()/with_user() on a branch view must carry the
+    instance-level bucket_dir override (branch_table roots metadata under
+    branch/branch-<name> but resolves pre-branch data files in the MAIN
+    tree). The oracle pins snapshots via table.copy({'scan.snapshot-id':
+    ...}); dropping the override 404s every shared data file."""
+    from paimon_tpu.table import load_table
+    from paimon_tpu.table.branch import BranchManager
+
+    t = catalog.create_table("db.brcopy", SCHEMA, primary_keys=["id"], options={"bucket": "1"})
+    write(t, {"id": [1, 2], "v": [1.0, 2.0]})
+    BranchManager(t.file_io, t.path).create("exp")
+    bt = load_table(t.path, dynamic_options={"branch": "exp"})
+    sid = bt.store.snapshot_manager.latest_snapshot_id()
+    pinned = bt.copy({"scan.snapshot-id": str(sid)})
+    assert sorted(read(pinned).to_pylist()) == [(1, 1.0), (2, 2.0)]
+    assert sorted(read(bt.with_user("other")).to_pylist()) == [(1, 1.0), (2, 2.0)]
+
+
 def test_cdc_schema_evolving_ingestion(catalog):
     from paimon_tpu.table.cdc import CdcTableWrite
 

@@ -83,3 +83,142 @@ def test_failing_file_io_eventually_succeeds(tmp_path):
     FailingFileIO.reset("t2", max_fails=0, possibility=0)  # heal before verify
     assert io.read_text(path) == "ok"
     assert attempts <= 4
+
+
+# ---------------------------------------------------------------------------
+# Composed chaos stack: faults over latency over local, one FileIO.
+# ---------------------------------------------------------------------------
+
+import os
+import time
+
+from paimon_tpu.fs.testing import (
+    CHAOS_ENV,
+    FaultRule,
+    LatencyFileIO,
+    _posix_backed,
+    apply_chaos_env,
+    chaos_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _quiet_latency():
+    yield
+    LatencyFileIO.configure(0.0, 0.0)
+
+
+def test_posix_backed_walks_wrapper_chain():
+    from paimon_tpu.fs.object_store import ObjectStoreFileIO
+
+    assert _posix_backed(LocalFileIO())
+    assert _posix_backed(LatencyFileIO())
+    assert _posix_backed(LatencyFileIO(inner=LocalFileIO()))
+    assert not _posix_backed(ObjectStoreFileIO(conditional_put=True))
+    assert not _posix_backed(LatencyFileIO(inner=ObjectStoreFileIO(conditional_put=True)))
+
+
+def test_chaos_passthrough_no_faults_no_latency(tmp_path):
+    FailingFileIO.reset("cpass", max_fails=0, possibility=0)
+    io = get_file_io("chaos://cpass/x")
+    path = f"chaos://cpass{tmp_path}/f.txt"
+    io.write_text(path, "hello")
+    assert io.read_text(path) == "hello"
+    assert io.exists(path)
+    assert io.get_status(path).size == 5
+    assert io.delete(path)
+    assert not io.exists(path)
+
+
+def test_chaos_fault_fires_before_latency_nap(tmp_path):
+    # a shed/failed op must not pay first-byte latency: the fault check sits
+    # ABOVE the latency layer in the stack
+    LatencyFileIO.configure(read_ms=200.0)
+    FailingFileIO.schedule("cord", FaultRule(op="read", path="f.txt"))
+    io = get_file_io("chaos://cord/x")
+    path = f"chaos://cord{tmp_path}/f.txt"
+    io.write_bytes(path, b"x", overwrite=True)  # rule only matches op='read'
+    t0 = time.monotonic()
+    with pytest.raises(ArtificialException):
+        io.read_bytes(path)
+    assert time.monotonic() - t0 < 0.1  # no 200 ms nap on the failed read
+    # rule exhausted: next read succeeds AND pays the latency
+    t0 = time.monotonic()
+    assert io.read_bytes(path) == b"x"
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_chaos_write_latency_is_paid(tmp_path):
+    LatencyFileIO.configure(write_ms=60.0)
+    FailingFileIO.reset("cw", max_fails=0, possibility=0)
+    io = get_file_io("chaos://cw/x")
+    t0 = time.monotonic()
+    io.write_bytes(f"chaos://cw{tmp_path}/a.bin", b"a")
+    io.write_bytes(f"chaos://cw{tmp_path}/b.bin", b"b")
+    assert time.monotonic() - t0 >= 0.1
+
+
+def test_chaos_atomic_write_torn_on_rename_fault(tmp_path):
+    # crash semantics must pass THROUGH the composed stack: a rename-phase
+    # fault leaves the torn tmp sibling on disk, target absent
+    FailingFileIO.schedule("ctorn", FaultRule(op="rename", path="snapshot-9"))
+    io = get_file_io("chaos://ctorn/x")
+    path = f"chaos://ctorn{tmp_path}/snapshot-9"
+    with pytest.raises(ArtificialException):
+        io.try_atomic_write(path, b"payload")
+    local = LocalFileIO()
+    names = [f.path.rsplit("/", 1)[-1] for f in local.list_files(str(tmp_path))]
+    assert any("snapshot-9" in n and ".tmp" in n for n in names), names
+    assert not local.exists(str(tmp_path / "snapshot-9"))
+    # retry (rule exhausted) lands the commit
+    assert io.try_atomic_write(path, b"payload")
+    assert local.read_bytes(str(tmp_path / "snapshot-9")) == b"payload"
+
+
+def test_chaos_atomic_write_nothing_on_write_fault(tmp_path):
+    FailingFileIO.schedule("cwf", FaultRule(op="write", path="snapshot-3"))
+    io = get_file_io("chaos://cwf/x")
+    with pytest.raises(ArtificialException):
+        io.try_atomic_write(f"chaos://cwf{tmp_path}/snapshot-3", b"z")
+    assert LocalFileIO().list_files(str(tmp_path)) == []
+
+
+def test_chaos_atomic_write_cas_loser_no_litter(tmp_path):
+    FailingFileIO.reset("ccas", max_fails=0, possibility=0)
+    io = get_file_io("chaos://ccas/x")
+    path = f"chaos://ccas{tmp_path}/snapshot-1"
+    assert io.try_atomic_write(path, b"a")
+    assert not io.try_atomic_write(path, b"b")
+    local = LocalFileIO()
+    assert local.read_bytes(str(tmp_path / "snapshot-1")) == b"a"
+    assert len(local.list_files(str(tmp_path))) == 1
+
+
+def test_latency_io_keeps_single_wrapper_behavior(tmp_path):
+    # existing latency:// scheme: no-arg construction, atomic write still CAS
+    io = get_file_io("latency:///x")
+    path = f"latency://{tmp_path}/snapshot-5"
+    assert io.try_atomic_write(path, b"one")
+    assert not io.try_atomic_write(path, b"two")
+    assert io.read_bytes(path) == b"one"
+    assert len(LocalFileIO().list_files(str(tmp_path))) == 1
+
+
+def test_chaos_env_spec_configures_process(tmp_path, monkeypatch):
+    spec = chaos_spec("cenv", read_ms=1.5, write_ms=2.5, possibility=100, seed=3)
+    monkeypatch.setenv(CHAOS_ENV, spec)
+    FailingFileIO._states.pop("cenv", None)
+    apply_chaos_env()
+    assert LatencyFileIO.read_ms == 1.5 and LatencyFileIO.write_ms == 2.5
+    st = FailingFileIO._states["cenv"]
+    assert st.possibility == 100
+    # re-applying (factory re-entry) must NOT reset live fault counters
+    st.fails = 7
+    apply_chaos_env()
+    assert FailingFileIO._states["cenv"].fails == 7
+    # the scheme factory applies the env on construction
+    io = get_file_io("chaos://cenv/x")
+    p = f"chaos://cenv{tmp_path}/h.txt"
+    FailingFileIO.retry_until_success("cenv", lambda: io.write_text(p, "hi"))
+    FailingFileIO.reset("cenv", max_fails=0, possibility=0)
+    assert io.read_text(p) == "hi"

@@ -622,7 +622,10 @@ def test_gateway_hedged_sql_scan_fragments(gwcat):
     g = gateway_metrics()
     q = "SELECT g, count(*), sum(v) FROM db.ch GROUP BY g ORDER BY g"
     want = query(gwcat, q).to_pylist()
-    with _cluster(t.path, 2, delays={0: 250}) as (cli, _agents, _coord):
+    # 700 ms shame, 25 ms deadline: the hedge must win even when the
+    # secondary pays first-scan JIT compile (a 250 ms shame lost the race
+    # ~30% of the time — both attempts compile, the margin was noise)
+    with _cluster(t.path, 2, delays={0: 700}) as (cli, _agents, _coord):
         won0 = g.counter("hedges_won").count
         with Gateway(
             t, catalog=gwcat, client=cli,
@@ -855,3 +858,96 @@ def test_gateway_mixed_kind_storm(tmp_path):
             )
             assert gw.wait_hedges_drained(30.0)
     assert gw.hedge_inflight() == 0
+
+
+# ---------------------------------------------------------------------------
+# gateway under faults (ISSUE 18): routed reads across a worker respawn
+# ---------------------------------------------------------------------------
+
+
+def test_regression_routed_get_fails_over_transient_worker_fault(gwcat):
+    """The primary worker's serving socket dies mid-stream (the respawn
+    window of the mega soak): gets owned by it must fail over to the
+    surviving worker and return bit-identical rows, with route_failovers
+    counted and ZERO untyped sheds — a dead socket is pressure, and
+    pressure is typed."""
+    t = _mk_cluster_table(gwcat, name="db.cf")
+    g = gateway_metrics()
+    with _cluster(t.path, 2) as (cli, agents, _coord):
+        with Gateway(t, catalog=gwcat, client=cli) as gw:
+            keys = list(range(0, 48)) + [999_999]
+            want = [(k, k * 0.25, f"g{k % 5}") if k < 600 else None for k in keys]
+            assert gw.get_batch(keys) == want  # healthy baseline
+            untyped0 = g.counter("sheds_untyped").count
+            failovers0 = g.counter("route_failovers").count
+            # SIGKILL shape, not a polite drain: tear down worker 0's
+            # listening socket without setting its _closed flag (which
+            # would answer in-flight requests with a typed shutting-down
+            # BUSY), and drop the cached conn so the next call reconnects
+            # into a refused socket. Heartbeats keep it registered, so the
+            # route still points at the dead address — the respawn window.
+            srv = agents[0].server._server
+            srv.shutdown()
+            srv.server_close()
+            cli.drop_conn(0)
+            gw._pool.close()  # cached sockets still reach the dead server's threads
+            got = gw.get_batch(keys)
+            assert got == want  # bit-identical from the surviving worker
+            assert g.counter("route_failovers").count > failovers0
+            assert g.counter("sheds_untyped").count == untyped0
+
+
+def test_regression_unowned_bucket_routes_to_live_worker(gwcat):
+    """A bucket whose owner vanished from the route entirely (killed and
+    not yet re-registered) must route to any live worker — shared
+    filesystem, same answer — not raise a raw KeyError through get_batch
+    (the flagship mega-soak failure shape)."""
+    t = _mk_cluster_table(gwcat, name="db.cu")
+    g = gateway_metrics()
+    with _cluster(t.path, 2) as (cli, _agents, _coord):
+        with Gateway(t, catalog=gwcat, client=cli) as gw:
+            keys = list(range(0, 32))
+            want = [(k, k * 0.25, f"g{k % 5}") for k in keys]
+            assert gw.get_batch(keys) == want
+            untyped0 = g.counter("sheds_untyped").count
+            # simulate the respawn window: strip every bucket worker 0 owns
+            # from the client's route, keeping worker 0's address live
+            cli.refresh_route()
+            full_route = dict(cli._route)
+            orphaned = {b: w for b, w in full_route.items() if w != 0}
+            assert len(orphaned) < len(full_route), "worker 0 owns no bucket"
+            real_refresh = cli.refresh_route
+            cli.refresh_route = lambda: None  # the coordinator still hasn't reassigned
+            try:
+                cli._route = dict(orphaned)
+                assert gw.get_batch(keys) == want
+                assert g.counter("sheds_untyped").count == untyped0
+            finally:
+                cli.refresh_route = real_refresh
+
+
+def test_regression_dead_route_shed_has_sane_retry_after(gwcat):
+    """EVERY worker dead (the whole pool mid-respawn): the escape must be
+    the typed 'route-respawning' shed carrying a positive retry_after_ms —
+    never None, never negative, never a raw ConnectionError/KeyError."""
+    t = _mk_cluster_table(gwcat, name="db.cd")
+    g = gateway_metrics()
+    with _cluster(t.path, 2) as (cli, agents, _coord):
+        with Gateway(t, catalog=gwcat, client=cli) as gw:
+            assert gw.get_batch([1, 2, 3]) == [
+                (k, k * 0.25, f"g{k % 5}") for k in (1, 2, 3)
+            ]
+            untyped0 = g.counter("sheds_untyped").count
+            for wid, a in enumerate(agents):
+                srv = a.server._server
+                srv.shutdown()
+                srv.server_close()
+                cli.drop_conn(wid)
+            gw._pool.close()
+            with pytest.raises(GatewayShedError) as ei:
+                gw.get_batch([1, 2, 3])
+            info = ei.value.shed_info
+            assert info.state == "route-respawning"
+            assert isinstance(info.retry_after_ms, int)
+            assert info.retry_after_ms >= 1
+            assert g.counter("sheds_untyped").count == untyped0

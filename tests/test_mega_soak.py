@@ -1,0 +1,165 @@
+"""Production mega-soak (ISSUE 18): the scenario matrix, the kill-schedule
+coverage audit, and the journaled-put identity the gateway writers recover
+through. The full supervisor run (processes + chaos store + oracle verdict)
+lives in scripts/verify.sh's `mega` stage and benchmarks/mega_soak_bench.py;
+these tests pin the pieces that must hold for that run to mean anything."""
+
+import pytest
+
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.resilience import faults
+from paimon_tpu.service.cluster import DEFAULT_CLUSTER_KILLS
+from paimon_tpu.service.gateway import Gateway
+from paimon_tpu.service.mega_soak import (
+    DEFAULT_MATRIX,
+    DEFAULT_MEGA_KILLS,
+    GW_USER_PREFIX,
+    MEGA_USER_PREFIXES,
+    MegaConfig,
+    MegaScenario,
+    scenario_schema,
+)
+from paimon_tpu.service.oracle import find_landed_append
+from paimon_tpu.service.proc_soak import DEFAULT_SCRIPTED_KILLS
+from paimon_tpu.types import BIGINT, DOUBLE, RowType
+
+
+# ---------------------------------------------------------------------------
+# crash-point coverage audit: every registered point is armed by a soak
+# ---------------------------------------------------------------------------
+def test_mega_kill_schedule_covers_every_crash_point():
+    """DEFAULT_MEGA_KILLS alone must arm every name in ALL_CRASH_POINTS —
+    a crash point nobody schedules is a recovery path nobody soaks."""
+    armed = {faults._parse_spec(spec)[0] for _, spec in DEFAULT_MEGA_KILLS}
+    assert armed == set(faults.ALL_CRASH_POINTS), (
+        f"unarmed crash points: {set(faults.ALL_CRASH_POINTS) - armed}; "
+        f"unknown specs: {armed - set(faults.ALL_CRASH_POINTS)}"
+    )
+
+
+def test_mega_kill_schedule_spans_process_kinds():
+    kinds = {kind for kind, _ in DEFAULT_MEGA_KILLS}
+    assert len(kinds) >= 3, f"kill schedule must span >=3 process kinds, got {kinds}"
+    # the service-plane points belong to service-plane processes
+    by_point = {faults._parse_spec(s)[0]: k for k, s in DEFAULT_MEGA_KILLS}
+    assert by_point["gateway:put-sent"] == "gateway-writer"
+    assert by_point["subscriber:batch-journaled"] == "subscriber"
+    assert by_point["cluster:before-ship"] == "worker"
+
+
+def test_mega_kill_specs_are_hard_kills():
+    """Every scheduled spec must parse as a hard kill (os._exit, no
+    unwinding) — a CrashError a `finally` can observe is a softer death
+    than the SIGKILL the soak claims to survive."""
+    for _, spec in DEFAULT_MEGA_KILLS:
+        name, nth, kill = faults._parse_spec(spec)
+        assert kill, f"{spec!r} is not a :kill spec"
+        assert nth >= 1
+        assert name in faults.ALL_CRASH_POINTS
+
+
+def test_union_of_soak_schedules_covers_every_crash_point():
+    """The per-service soaks (proc_soak writers, cluster workers) plus the
+    mega schedule together must also cover everything — the audit holds
+    even for whoever runs the narrower soaks alone."""
+    specs = list(DEFAULT_SCRIPTED_KILLS) + list(DEFAULT_CLUSTER_KILLS)
+    specs += [spec for _, spec in DEFAULT_MEGA_KILLS]
+    armed = {faults._parse_spec(s)[0] for s in specs}
+    assert armed >= set(faults.ALL_CRASH_POINTS)
+
+
+# ---------------------------------------------------------------------------
+# scenario matrix shape
+# ---------------------------------------------------------------------------
+def test_matrix_covers_the_advertised_axes():
+    names = [sc.name for sc in DEFAULT_MATRIX]
+    assert len(names) == len(set(names))
+    assert {sc.schema for sc in DEFAULT_MATRIX} == {"kv", "dict", "wide"}
+    buckets = {sc.bucket for sc in DEFAULT_MATRIX}
+    assert -1 in buckets and any(b > 0 for b in buckets), "fixed + dynamic bucket modes"
+    assert len({sc.cdc_format for sc in DEFAULT_MATRIX}) >= 4
+    assert any(sc.cluster for sc in DEFAULT_MATRIX)
+    assert any(sc.branch_tag for sc in DEFAULT_MATRIX)
+    assert any(sc.consumer_expiry for sc in DEFAULT_MATRIX)
+    # engine toggles actually differ somewhere in the matrix
+    toggled = {k for sc in DEFAULT_MATRIX for k, _ in sc.table_options}
+    assert "sort-engine" in toggled
+
+
+def test_table_ident_is_sql_safe():
+    for sc in DEFAULT_MATRIX:
+        assert "-" not in sc.table_ident, sc.table_ident
+        assert sc.table_ident.startswith("mega.")
+    assert MegaScenario(name="a-b-c").table_ident == "mega.a_b_c"
+
+
+def test_scenario_schemas():
+    for kind in ("kv", "dict", "wide"):
+        rt = scenario_schema(kind)
+        assert rt.field_names[0] == "k"
+    assert len(scenario_schema("wide").field_names) == 4
+    with pytest.raises(ValueError):
+        scenario_schema("jagged")
+
+
+def test_mega_config_from_table_options():
+    from paimon_tpu.options import CoreOptions, Options
+
+    co = CoreOptions(
+        Options(
+            {
+                "soak.mega.duration": "30 s",
+                "soak.mega.cluster-workers": "3",
+                "soak.mega.kill-period": "4 s",
+                "soak.mega.chaos.read-ms": "2.5",
+                "soak.mega.chaos.possibility": "150",
+            }
+        )
+    )
+    cfg = MegaConfig.from_table_options(co)
+    assert cfg.duration_s == 30.0
+    assert cfg.cluster_workers == 3
+    assert cfg.kill_period_s == 4.0
+    assert cfg.chaos_read_ms == 2.5
+    assert cfg.chaos_possibility == 150
+
+
+def test_user_prefixes_partition_the_journal_planes():
+    """The oracle folds all planes with ONE startswith(tuple) filter — the
+    prefixes must be mutually non-overlapping or rounds double-fold."""
+    assert GW_USER_PREFIX in MEGA_USER_PREFIXES
+    for a in MEGA_USER_PREFIXES:
+        for b in MEGA_USER_PREFIXES:
+            if a != b:
+                assert not a.startswith(b)
+
+
+# ---------------------------------------------------------------------------
+# the journaled-put identity: adopt-never-replay through the gateway
+# ---------------------------------------------------------------------------
+def test_gateway_put_identifier_resolves_from_the_chain(tmp_path):
+    """A gateway put with (user, identifier) must be recoverable by a
+    respawned client from the snapshot chain alone: find_landed_append
+    returns the landed APPEND sid for the identifier it acked nothing
+    about, and None for a round that never committed (adopt, never
+    replay — the PR 9/15 protocol the mega gateway writers run)."""
+    cat = FileSystemCatalog(str(tmp_path / "wh"), commit_user="test")
+    rt = RowType.of(("k", BIGINT(nullable=False)), ("v", DOUBLE()))
+    table = cat.create_table("db.t", rt, primary_keys=("k",), options={"bucket": "2"})
+    gw = Gateway(table, catalog=cat)
+    try:
+        user = f"{GW_USER_PREFIX}-0"
+        sid = gw.put(
+            {"k": [1, 2, 3], "v": [0.5, 1.5, 2.5]}, tenant=None, user=user, identifier=7
+        )
+        assert sid is not None
+        assert find_landed_append(table.store, user, 7) == sid
+        # an identifier that never committed resolves to None -> replay it
+        assert find_landed_append(table.store, user, 8) is None
+        # another user's identifier space is disjoint
+        assert find_landed_append(table.store, f"{GW_USER_PREFIX}-1", 7) is None
+        # the landed rows are served back through the gateway read path
+        rows = gw.get_batch([1, 2, 3])
+        assert [r[1] for r in rows] == [0.5, 1.5, 2.5]
+    finally:
+        gw.close()
