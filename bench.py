@@ -504,6 +504,22 @@ def bench_gateway() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_elastic() -> list:
+    """Elastic-cluster spot-check (benchmarks/elastic_bench.py is the
+    dedicated rig): a live 8->16 bucket rescale under continuous ingest
+    (zero lost/dup rows, serving p99 <= 2x steady-state), a 2->4 worker
+    scale-out through the join-steal handoff, and hot-bucket replicated
+    serving asserted >= 2x single-owner throughput with every pass
+    bit-identical to the primary and the oracle."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "elastic_bench.py")
+    spec = importlib.util.spec_from_file_location("_elastic_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=1)
+
+
 def bench_resilience() -> dict:
     """Commit resilience spot-check (benchmarks/resilience_bench.py is the
     dedicated rate-sweep): 25 small commits at a 5% injected transient-fault
@@ -613,6 +629,7 @@ def main():
         mesh_rows = bench_mesh()
         sql_cluster_rows = bench_sql_cluster()
         gateway_rows = bench_gateway()
+        elastic_rows = bench_elastic()
         resilience_row = bench_resilience()
         soak_row = bench_soak()
         mega_row = bench_mega()
@@ -671,6 +688,8 @@ def main():
             print(json.dumps(dict(qrow, platform=_PLATFORM)))
         for grow in gateway_rows:
             print(json.dumps(dict(grow, platform=_PLATFORM)))
+        for elrow in elastic_rows:
+            print(json.dumps(dict(elrow, platform=_PLATFORM)))
         print(json.dumps(dict(resilience_row, platform=_PLATFORM)))
         print(json.dumps(dict(soak_row, platform=_PLATFORM)))
         print(json.dumps(dict(mega_row, platform=_PLATFORM)))

@@ -349,8 +349,12 @@ class KeyValueFileReaderFactory:
             sig = tuple((f.id, f.name, repr(f.type)) for f in (self.read_schema.field(n) for n in read_names))
             # decoder identity is part of the key: a batch decoded by the
             # arrow backend must never alias one the native backend would
-            # produce (switching format.parquet.decoder stays sound)
-            key = ("data", self.bucket_dir, meta.file_name, system_columns, sig, fields is None, self.decoder_id)
+            # produce (switching format.parquet.decoder stays sound).
+            # Content-addressed, NOT path-addressed: file names are
+            # uuid-unique, so the same file read through another factory —
+            # a branch view, a rescale rewrite over a table copy — is a
+            # cache hit instead of a cold re-decode.
+            key = ("data", meta.file_name, system_columns, sig, fields is None, self.decoder_id)
             return self.cache.get_or_load(
                 key,
                 lambda: self._decode(meta, None, fields, system_columns),

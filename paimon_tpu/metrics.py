@@ -348,8 +348,14 @@ def cluster_metrics() -> MetricGroup:
     dropped when their owning worker died), serve_gets (get_batch requests
     served by worker serving planes), serve_subscribe_polls (subscribe
     long-polls served by workers), join_parts_served (distributed join
-    partitions executed on workers). Gauges: workers_live, buckets_assigned.
-    Resolved per call so registry.reset() in tests swaps the group out."""
+    partitions executed on workers), rescales (completed cross-worker
+    bucket rescales: schema bump + OVERWRITE snapshot landed and routes
+    republished), handoffs (planned worker admits/retires that moved bucket
+    ranges without a death timeout), replica_reads (serve reads a client
+    routed to a non-primary replica owner). Gauges: workers_live,
+    buckets_assigned, replicas_active (bucket->replica grants currently
+    live). Resolved per call so registry.reset() in tests swaps the group
+    out."""
     return registry.group("cluster")
 
 

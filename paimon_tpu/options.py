@@ -651,6 +651,39 @@ class CoreOptions:
         "bucket's owning worker rewriting, the coordinator committing). "
         "Off = ingest only (read amplification unbounded).",
     )
+    CLUSTER_RESCALE_TIMEOUT = ConfigOption.duration(
+        "cluster.rescale.timeout",
+        "120 s",
+        "Elastic cluster: how long the coordinator waits for every owner's "
+        "rescale rewrite shipment before abandoning the rescale (fence "
+        "lifted, old bucket count kept, rewritten files left as orphans for "
+        "the sweep). Worker deaths inside the window do not abort it — the "
+        "reassignment machinery re-queues the dead owner's buckets on "
+        "whoever inherits them.",
+    )
+    CLUSTER_REPLICA_HEAT_THRESHOLD = ConfigOption.float_(
+        "cluster.replica.heat-threshold",
+        0.0,
+        "Elastic cluster: a bucket whose heat EMA (serve-side get rate plus "
+        "the adaptive compactor's write-rate EMA, ops/s) crosses this gets "
+        "a read replica on another live worker — the replica serves "
+        "get_batch/subscribe/scan_frag off the shared-FS snapshot while the "
+        "primary retains writes. 0 disables replica placement.",
+    )
+    CLUSTER_REPLICA_MAX_PER_BUCKET = ConfigOption.int_(
+        "cluster.replica.max-per-bucket",
+        1,
+        "Elastic cluster: replica owners per hot bucket beyond the primary. "
+        "Replicas decay back off when the bucket's heat EMA falls under "
+        "half the threshold (hysteresis against flapping).",
+    )
+    CLUSTER_REPLICA_INTERVAL = ConfigOption.duration(
+        "cluster.replica.interval",
+        "1 s",
+        "Elastic cluster: cadence of the coordinator's replica-placement "
+        "pass (heat EMA refresh + promote/demote decisions). Every change "
+        "bumps the route epoch so clients refresh immediately.",
+    )
     SQL_CLUSTER_CODE_DOMAIN = ConfigOption.bool_(
         "sql.cluster.code-domain",
         True,

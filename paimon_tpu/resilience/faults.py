@@ -72,6 +72,8 @@ __all__ = [
     "WRITER_CRASH_POINTS",
     "CLUSTER_CRASH_POINTS",
     "SERVICE_CRASH_POINTS",
+    "RESCALE_CRASH_POINTS",
+    "HANDOFF_CRASH_POINTS",
     "ALL_CRASH_POINTS",
     "KILL_EXIT_CODE",
 ]
@@ -107,8 +109,28 @@ SERVICE_CRASH_POINTS = (
     "subscriber:batch-journaled",
 )
 
+# the elastic-topology points (table/rescale.py + service/cluster.py): a
+# worker dying with its rescale rewrite files durable but the shipment
+# never prepared/sent (orphan files; the coordinator re-queues the buckets
+# on whoever owns them next), and a retiring worker dying after draining
+# but before its retire RPC (the planned handoff degrades to the
+# missed-heartbeat death path — same reassignment, plus the timeout). The
+# coordinator's commit half needs no points of its own: the schema bump is
+# a CAS and the OVERWRITE snapshot runs through FileStoreCommit._try_commit,
+# which the commit:* points already cover.
+RESCALE_CRASH_POINTS = (
+    "rescale:files-written",
+    "rescale:before-ship",
+)
+HANDOFF_CRASH_POINTS = ("handoff:before-retire",)
+
 ALL_CRASH_POINTS = (
-    COMMIT_CRASH_POINTS + WRITER_CRASH_POINTS + CLUSTER_CRASH_POINTS + SERVICE_CRASH_POINTS
+    COMMIT_CRASH_POINTS
+    + WRITER_CRASH_POINTS
+    + CLUSTER_CRASH_POINTS
+    + SERVICE_CRASH_POINTS
+    + RESCALE_CRASH_POINTS
+    + HANDOFF_CRASH_POINTS
 )
 
 # 128 + SIGKILL: a hard death at a crash point reports like a kill -9 victim

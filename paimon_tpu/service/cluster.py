@@ -115,6 +115,10 @@ class ClusterConfig:
     sweep_period_s: float = 15.0
     sweep_older_than_ms: int = 45_000
     serve: bool = True  # workers run the get/subscribe serving plane
+    # scripted elastic events: ("rescale", frac, new_buckets) /
+    # ("admit", frac) / ("retire", frac) — frac is the fraction of
+    # duration_s at which the event fires (the elastic soak's churn plan)
+    elastic: tuple = ()
     table_options: dict = field(default_factory=dict)
 
     @classmethod
@@ -187,6 +191,27 @@ class ClusterCoordinator:
         self._task_seq = 0
         self._task_groups: dict[int, list] = {}  # task_id -> [CompactionDecision]
         self._barriers: dict[str, set[int]] = {}
+        # elastic topology (ISSUE 19): the route epoch bumps on ANY
+        # reassignment / rescale / replica change and piggybacks on every
+        # RPC reply (coordinator and worker serving planes alike), so
+        # clients refresh the bucket->worker table immediately instead of
+        # discovering staleness via a rejected shipment or a timeout window
+        self._route_epoch = 1
+        self._rescale: dict | None = None  # active cross-worker rescale state
+        self._rescale_committing = False
+        self._retiring: set[int] = set()  # wids told to drain + hand off
+        self._replicas: dict[int, list[int]] = {}  # bucket -> replica wids
+        self._get_counts: dict[int, int] = {}  # bucket -> gets since last pass
+        self._heat_ema: dict[int, float] = {}  # bucket -> serve-read EMA (1/s)
+        self._heat_t: float | None = None
+        self._next_replica_pass = 0.0
+        from ..options import CoreOptions
+
+        o = self.table.store.options.options
+        self.replica_threshold = float(o.get(CoreOptions.CLUSTER_REPLICA_HEAT_THRESHOLD))
+        self.replica_max = int(o.get(CoreOptions.CLUSTER_REPLICA_MAX_PER_BUCKET))
+        self.replica_interval_s = o.get(CoreOptions.CLUSTER_REPLICA_INTERVAL) / 1000.0
+        self.rescale_timeout_s = o.get(CoreOptions.CLUSTER_RESCALE_TIMEOUT) / 1000.0
         self.go_event = threading.Event()
         self.stop_event = threading.Event()
         self.compaction = None
@@ -274,6 +299,25 @@ class ClusterCoordinator:
             if b in self._pending:
                 self._pending.remove(b)
         slot.epoch = self._epoch
+        self._route_epoch += 1
+        # a grant DURING a rescale re-queues the rewrite for any moved
+        # bucket not yet done — the new owner's task carries the post-grant
+        # epoch, so the dead previous owner's late rescale shipment for the
+        # same bucket is fenced off exactly like a late append
+        if self._rescale is not None:
+            todo = [b for b in buckets if b not in self._rescale["done"]]
+            if todo:
+                slot.tasks.append(self._rescale_task(todo))
+
+    def _rescale_task(self, buckets: list[int]) -> dict:
+        rs = self._rescale
+        return {
+            "kind": "rescale",
+            "buckets": sorted(buckets),
+            "new_buckets": rs["new"],
+            "snapshot": rs["snapshot"],
+            "epoch": self._epoch,
+        }
 
     def _reassign_dead(self, slot: _WorkerSlot) -> None:
         """Missed-heartbeat death: every bucket the dead worker owned moves
@@ -300,13 +344,44 @@ class ClusterCoordinator:
             released = self.compaction.release_owner(slot.wid)
         if released:
             g.counter("charges_released").inc(released)
+        # drop the dead worker from every replica set before choosing new
+        # owners, so promotion below never picks the corpse
+        pruned = False
+        for b, wids in list(self._replicas.items()):
+            if slot.wid in wids:
+                wids = [w for w in wids if w != slot.wid]
+                pruned = True
+                if wids:
+                    self._replicas[b] = wids
+                else:
+                    del self._replicas[b]
         live = [s for s in self._slots.values() if s.alive]
         if not live:
             self._pending.extend(orphans)
         else:
             for b in orphans:
-                target = min(live, key=lambda s: len(s.buckets))
+                # warm promotion: a live replica already serves this bucket
+                # off shared FS — make it the new primary and retire the
+                # grant from the replica set (a worker is never its own
+                # replica); otherwise least-loaded live worker
+                target = None
+                for w in self._replicas.get(b, ()):
+                    s = self._slots.get(w)
+                    if s is not None and s.alive:
+                        target = s
+                        break
+                if target is not None:
+                    rest = [w for w in self._replicas[b] if w != target.wid]
+                    if rest:
+                        self._replicas[b] = rest
+                    else:
+                        del self._replicas[b]
+                else:
+                    target = min(live, key=lambda s: len(s.buckets))
                 self._grant(target, [b])
+        if orphans or pruned:
+            self._route_epoch += 1
+            g.gauge("replicas_active").set(sum(len(v) for v in self._replicas.values()))
         if orphans:
             g.counter("reassignments").inc(len(orphans))
         g.gauge("workers_live").set(sum(1 for s in self._slots.values() if s.alive))
@@ -318,6 +393,91 @@ class ClusterCoordinator:
                 for slot in self._slots.values():
                     if slot.alive and now - slot.last_heartbeat > self.cfg.heartbeat_timeout_s:
                         self._reassign_dead(slot)
+                if self._rescale is not None and now > self._rescale["deadline"]:
+                    self._abort_rescale_locked()
+            if self.replica_threshold > 0 and now >= self._next_replica_pass:
+                self._next_replica_pass = now + self.replica_interval_s
+                try:
+                    self._replica_pass()
+                except Exception:  # noqa: BLE001 — placement is best-effort
+                    pass
+
+    def _abort_rescale_locked(self) -> None:
+        """Rescale timed out (a straggler never shipped): drop the state and
+        re-grant every live worker its current buckets — the fresh epochs
+        resync the fleet and ingest resumes; the rewrite files already
+        shipped are unreferenced and fall to the orphan sweep."""
+        self._rescale = None
+        for slot in self._slots.values():
+            if slot.alive and slot.buckets:
+                slot.tasks = [t for t in slot.tasks if t.get("kind") != "rescale"]
+                self._grant(slot, sorted(slot.buckets))
+        self._metrics().counter("rescale_aborts").inc()
+
+    # ---- replica placement (hot-shard serving, ISSUE 19) ----------------
+    def _replica_pass(self) -> None:
+        """Grant read replicas for hot buckets; demote cooled ones.
+
+        Heat per bucket = serve-side get EMA (reported by workers in
+        heartbeats) + write-heat EMA from the adaptive compactor's
+        observation loop. Crossing `cluster.replica.heat-threshold` grants a
+        secondary owner (least-replica-loaded live worker that is not the
+        primary) for get_batch/subscribe/scan_frag off shared FS; dropping
+        under HALF the threshold demotes (hysteresis, no flapping). The
+        primary keeps writes. Every change bumps the route epoch."""
+        g = self._metrics()
+        now = time.monotonic()
+        with self._lock:
+            dt = (now - self._heat_t) if self._heat_t is not None else None
+            self._heat_t = now
+            if dt and dt > 0:
+                # drain only when there is an interval to rate the counts
+                # over — the first pass must NOT discard gets that landed
+                # before it (a warm client can burst its whole workload in
+                # under one pass interval)
+                counts, self._get_counts = self._get_counts, {}
+                seen = set(counts) | set(self._heat_ema)
+                for b in seen:
+                    inst = counts.get(b, 0) / dt
+                    prev = self._heat_ema.get(b, inst)
+                    self._heat_ema[b] = 0.5 * prev + 0.5 * inst
+            wheat = self.compaction.heat() if self.compaction is not None else {}
+            live = [
+                s
+                for s in self._slots.values()
+                if s.alive and s.serve_addr is not None and s.wid not in self._retiring
+            ]
+            if self._rescale is not None:
+                return  # placement waits out the rescale window
+            rload = {s.wid: 0 for s in live}
+            for wids in self._replicas.values():
+                for w in wids:
+                    if w in rload:
+                        rload[w] += 1
+            changed = False
+            for b in range(self.num_buckets):
+                heat = self._heat_ema.get(b, 0.0) + float(wheat.get(b, 0.0))
+                cur = [w for w in self._replicas.get(b, []) if any(s.wid == w for s in live)]
+                if cur != self._replicas.get(b, []):
+                    changed = True
+                primary = self._owner.get(b)
+                if heat >= self.replica_threshold and len(cur) < self.replica_max:
+                    cands = [s for s in live if s.wid != primary and s.wid not in cur]
+                    if cands:
+                        pick = min(cands, key=lambda s: (rload.get(s.wid, 0), len(s.buckets), s.wid))
+                        cur = cur + [pick.wid]
+                        rload[pick.wid] = rload.get(pick.wid, 0) + 1
+                        changed = True
+                elif cur and heat < self.replica_threshold * 0.5:
+                    cur = []
+                    changed = True
+                if cur:
+                    self._replicas[b] = cur
+                elif b in self._replicas:
+                    del self._replicas[b]
+            if changed:
+                self._route_epoch += 1
+            g.gauge("replicas_active").set(sum(len(v) for v in self._replicas.values()))
 
     # ---- compaction dispatch (the execute_group seam) ------------------
     def _dispatch_group(self, group: list, deep: bool) -> int:
@@ -327,6 +487,8 @@ class ClusterCoordinator:
         g = self._metrics()
         dispatched = 0
         with self._lock:
+            if self._rescale is not None or self._rescale_committing:
+                return 0  # bucket ids are about to change meaning
             for d in group:
                 key = (d.partition, d.bucket)
                 if key in self._compact_inflight:
@@ -358,7 +520,15 @@ class ClusterCoordinator:
         fn = getattr(self, f"_m_{method}", None)
         if fn is None:
             raise ValueError(f"unknown method {method!r}")
-        return fn(req)
+        out = fn(req)
+        # push-based route invalidation: every reply carries the route
+        # epoch and bucket count, so any client touching the coordinator
+        # for ANY reason learns about reassignments/rescales/replica
+        # changes immediately — including workers whose rescale shipment
+        # reply races the final commit
+        out.setdefault("route_epoch", self._route_epoch)
+        out.setdefault("num_buckets", self.num_buckets)
+        return out
 
     def _flags(self) -> dict:
         return {"go": self.go_event.is_set(), "stop": self.stop_event.is_set()}
@@ -376,13 +546,26 @@ class ClusterCoordinator:
             slot.last_heartbeat = time.monotonic()
             if req.get("serve_port"):
                 slot.serve_addr = (req.get("serve_host", "127.0.0.1"), int(req["serve_port"]))
-            if not slot.buckets:
+            if wid in self._retiring:
+                # a retiring worker (or its respawn after a mid-handoff
+                # kill) gets nothing — the heartbeat retire flag drains it
+                pass
+            elif not slot.buckets:
                 # first registration gets the home range; a respawn whose
                 # range was already reassigned steals it back (bounded
                 # churn, keeps every live worker productive) — the epoch
                 # bump fences the previous owner's in-flight rounds
                 want = [b for b in self._home.get(wid, []) if self._owner.get(b) != wid]
                 want += [b for b in self._pending if b not in want]
+                if not want and self._rescale is None:
+                    # runtime scale-out: a joining worker outside the home
+                    # split plans a range handoff — steal buckets from the
+                    # most-loaded live peers toward an even share; each
+                    # grant's epoch bump fences the donor's in-flight round
+                    # (the one fencing round), nothing else is rejected
+                    want = self._plan_join_steal(wid)
+                    if want:
+                        g.counter("handoffs").inc()
                 self._grant(slot, want)
             else:
                 # same buckets, fresh epoch: the PREVIOUS incarnation's
@@ -398,9 +581,36 @@ class ClusterCoordinator:
                 **self._flags(),
             }
 
+    def _plan_join_steal(self, wid: int) -> list[int]:
+        """Pick buckets for a joining worker: repeatedly take the highest
+        bucket from the currently most-loaded live donor (never stripping a
+        donor bare) until the joiner holds an even share. Caller holds the
+        lock; the buckets move via the caller's _grant."""
+        donors = [s for s in self._slots.values() if s.alive and s.wid != wid and s.buckets]
+        total = sum(len(s.buckets) for s in donors)
+        target = total // (len(donors) + 1) if donors else 0
+        sizes = {s.wid: len(s.buckets) for s in donors}
+        steal: list[int] = []
+        taken: set[int] = set()
+        while len(steal) < target:
+            donor = max(donors, key=lambda s: (sizes[s.wid], s.wid))
+            if sizes[donor.wid] <= 1:
+                break
+            pool = [b for b in donor.buckets if b not in taken]
+            if not pool:
+                break
+            b = max(pool)
+            steal.append(b)
+            taken.add(b)
+            sizes[donor.wid] -= 1
+        return steal
+
     def _m_heartbeat(self, req: dict) -> dict:
         wid = int(req["worker"])
+        gets = req.get("gets") or {}
         with self._lock:
+            for b, n in gets.items():
+                self._get_counts[int(b)] = self._get_counts.get(int(b), 0) + int(n)
             slot = self._slots.get(wid)
             if slot is None:
                 return {"reregister": True, **self._flags()}
@@ -409,7 +619,15 @@ class ClusterCoordinator:
                 # declared dead but actually alive (slow round): it must
                 # re-register to get a fresh (possibly different) range
                 return {"reregister": True, **self._flags()}
-            return {"epoch": slot.epoch, "buckets": sorted(slot.buckets), **self._flags()}
+            out = {
+                "epoch": slot.epoch,
+                "buckets": sorted(slot.buckets),
+                "num_buckets": self.num_buckets,
+                **self._flags(),
+            }
+            if wid in self._retiring:
+                out["retire"] = True
+            return out
 
     def _m_admit(self, req: dict) -> dict:
         """Cluster-wide debt-admission gate: non-blocking here, the worker
@@ -418,6 +636,13 @@ class ClusterCoordinator:
         wid = int(req["worker"])
         ident = int(req["ident"])
         buckets = [int(b) for b in req.get("buckets", ())]
+        with self._lock:
+            if self._rescale is not None or self._rescale_committing:
+                # the rescale window: no new rounds start, the already
+                # admitted in-flight ones get fenced at ship — the worker
+                # sees `rescaling` and goes execute its rewrite task
+                self._metrics().counter("admit_denied").inc()
+                return {"admitted": False, "retry_after_ms": 200, "rescaling": True}
         if self.compaction is None:
             return {"admitted": True}
         key = (wid, ident)
@@ -465,11 +690,19 @@ class ClusterCoordinator:
         epoch = int(req["epoch"])
         kind = req.get("kind", "append")
         msgs = [CommitMessage.from_dict(m) for m in req.get("messages", ())]
-        touched = sorted({m.bucket for m in msgs})
+        # a rescale shipment's messages carry NEW bucket ids, which nobody
+        # owns under the old routing — the fence checks the OLD buckets the
+        # task covered instead
+        if kind == "rescale":
+            touched = sorted(int(b) for b in req.get("buckets", ()))
+        else:
+            touched = sorted({m.bucket for m in msgs})
         g = self._metrics()
         with self._lock:
             slot = self._slots.get(wid)
             stale = slot is None or not self._check_fence(slot, epoch, touched)
+        if kind == "rescale":
+            return self._commit_rescale_part(req, msgs, touched, stale)
         if kind == "compact":
             return self._commit_compact(req, msgs, stale)
         ident = int(req["ident"])
@@ -528,12 +761,174 @@ class ClusterCoordinator:
         g.counter("compact_commits").inc()
         return {"sid": sids[0] if sids else None, "stale": False}
 
+    # ---- cross-worker dynamic-bucket rescale (ISSUE 19 tentpole) --------
+    def start_rescale(self, new_buckets: int) -> dict:
+        """Begin a coordinator-driven rescale to `new_buckets`.
+
+        One global epoch bump fences EVERY bucket at once — the one fencing
+        round: in-flight appends/compacts admitted before this instant get
+        rejected stale at ship, new admits are denied for the window, and
+        compaction dispatch pauses. Each live owner is handed a rescale
+        task (its owned old buckets + the pinned snapshot); the rewrites
+        ship back as kind="rescale" CommitMessages and land atomically in
+        `_finish_rescale` once every old bucket is covered. Readers pinned
+        at or before the snapshot stay bit-identical throughout."""
+        new_buckets = int(new_buckets)
+        if new_buckets < 1:
+            return {"started": False, "reason": "bad-bucket-count"}
+        snap = self.table.store.snapshot_manager.latest_snapshot()
+        with self._lock:
+            if self._rescale is not None or self._rescale_committing:
+                return {"started": False, "reason": "rescale-in-progress"}
+            if new_buckets == self.num_buckets:
+                return {"started": False, "reason": "already-at-count"}
+            if snap is None:
+                return {"started": False, "reason": "empty-table"}
+            self._epoch += 1
+            for b in range(self.num_buckets):
+                self._bucket_epoch[b] = self._epoch
+            self._rescale = {
+                "new": new_buckets,
+                "snapshot": snap.id,
+                "epoch": self._epoch,
+                "needed": set(range(self.num_buckets)),
+                "done": set(),
+                "msgs": [],
+                "deadline": time.monotonic() + self.rescale_timeout_s,
+            }
+            self._route_epoch += 1
+            for slot in self._slots.values():
+                if slot.alive and slot.buckets:
+                    slot.tasks.append(self._rescale_task(sorted(slot.buckets)))
+        return {"started": True, "snapshot": snap.id, "new_buckets": new_buckets}
+
+    def _m_rescale(self, req: dict) -> dict:
+        return self.start_rescale(int(req["new_buckets"]))
+
+    def _m_rescale_status(self, req: dict) -> dict:
+        with self._lock:
+            rs = self._rescale
+            return {
+                "active": rs is not None or self._rescale_committing,
+                "num_buckets": self.num_buckets,
+                "done": sorted(rs["done"]) if rs else [],
+            }
+
+    def _commit_rescale_part(self, req: dict, msgs: list, covered: list[int], stale: bool) -> dict:
+        g = self._metrics()
+        with self._lock:
+            rs = self._rescale
+            if rs is None or stale:
+                g.counter("commits_rejected_stale").inc()
+                return {"stale": True, "sid": None}
+            fresh = [b for b in covered if b in rs["needed"] and b not in rs["done"]]
+            if not fresh:
+                return {"stale": False, "sid": None, "dup": True}
+            rs["done"].update(fresh)
+            rs["msgs"].extend(msgs)
+            complete = rs["done"] >= rs["needed"]
+            if complete:
+                # flip to the committing phase under the lock: admits stay
+                # denied and no rival _finish_rescale can start
+                self._rescale = None
+                self._rescale_committing = True
+        if not complete:
+            return {"stale": False, "sid": None}
+        return self._finish_rescale(rs)
+
+    def _finish_rescale(self, rs: dict) -> dict:
+        """Every old bucket rewritten: commit schema-(N+1) (`bucket` option
+        bump) + ONE OVERWRITE snapshot, then atomically republish routing at
+        the new bucket count (fresh contiguous split over live workers).
+        Old data files stay on disk until snapshot expiry, so readers
+        pinned pre-rescale keep their bit-identical view."""
+        from ..table import load_table
+        from ..table.rescale import commit_rescale
+
+        g = self._metrics()
+        try:
+            sid = commit_rescale(self.table, rs["new"], rs["msgs"])
+        except Exception:
+            with self._lock:
+                self._rescale_committing = False
+                self._abort_rescale_locked()
+            raise
+        with self._lock:
+            self.table = load_table(self.table_path, commit_user="cluster-coordinator")
+            self.num_buckets = rs["new"]
+            self._owner.clear()
+            self._bucket_epoch.clear()
+            self._pending.clear()
+            self._commit_stores.clear()  # per-wid stores hold old-layout tables
+            self._replicas.clear()  # bucket ids changed meaning
+            self._heat_ema.clear()
+            self._get_counts.clear()
+            self._home = self._split_ranges()
+            live = sorted((s for s in self._slots.values() if s.alive), key=lambda s: s.wid)
+            for s in self._slots.values():
+                s.buckets.clear()
+                s.tasks = [t for t in s.tasks if t.get("kind") != "rescale"]
+            if live:
+                n, w = self.num_buckets, len(live)
+                for i, s in enumerate(live):
+                    self._grant(s, list(range(i * n // w, (i + 1) * n // w)))
+            else:
+                self._pending.extend(range(self.num_buckets))
+            self._rescale_committing = False
+            self._route_epoch += 1
+            g.gauge("replicas_active").set(0)
+            g.gauge("buckets_assigned").set(len(self._owner))
+        if self.compaction is not None:
+            self.compaction.table = self.table
+        g.counter("rescales").inc()
+        return {"stale": False, "sid": sid, "rescaled": rs["new"]}
+
+    # ---- planned worker retire (scale-in) -------------------------------
+    def request_retire(self, wid: int) -> None:
+        """Flag `wid` for planned drain: the next heartbeat reply carries
+        `retire`, the worker finishes its in-flight round, settles its
+        charges, and calls the retire RPC for the range handoff."""
+        with self._lock:
+            self._retiring.add(int(wid))
+
+    def _m_request_retire(self, req: dict) -> dict:
+        self.request_retire(int(req["worker"]))
+        return {}
+
+    def _m_retire(self, req: dict) -> dict:
+        """The drained worker's handoff: a planned retire is a death without
+        the timeout — the same reassignment machinery moves its range (one
+        fencing round), releases its debt-gate charges, and prunes its
+        replica grants; the worker then exits clean."""
+        wid = int(req["worker"])
+        g = self._metrics()
+        with self._lock:
+            self._retiring.discard(wid)
+            slot = self._slots.get(wid)
+            if slot is None or not slot.alive:
+                return {"retired": True}
+            had = bool(slot.buckets)
+            self._reassign_dead(slot)
+            if had:
+                g.counter("handoffs").inc()
+        return {"retired": True}
+
     def _m_poll_work(self, req: dict) -> dict:
         wid = int(req["worker"])
         epoch = int(req["epoch"])
         with self._lock:
             slot = self._slots.get(wid)
-            if slot is None or slot.epoch != epoch:
+            if slot is None:
+                return {"tasks": [], "resync": True, **self._flags()}
+            if slot.epoch != epoch:
+                # stale poller (its range moved, or a rescale republished
+                # routing): hand back the current assignment so it resyncs
+                # on this reply instead of waiting out a heartbeat
+                if slot.alive:
+                    return {
+                        "tasks": [], "resync": True, "epoch": slot.epoch,
+                        "buckets": sorted(slot.buckets), **self._flags(),
+                    }
                 return {"tasks": [], "resync": True, **self._flags()}
             tasks, slot.tasks = slot.tasks, []
             return {"tasks": tasks, **self._flags()}
@@ -569,7 +964,8 @@ class ClusterCoordinator:
                 for wid, slot in self._slots.items()
                 if slot.alive
             }
-        return {"workers": workers, "num_buckets": self.num_buckets}
+            replicas = {str(b): list(wids) for b, wids in self._replicas.items()}
+        return {"workers": workers, "num_buckets": self.num_buckets, "replicas": replicas}
 
     def _m_status(self, req: dict) -> dict:
         with self._lock:
@@ -694,6 +1090,7 @@ class _WorkerServer:
         host: str = "127.0.0.1",
         port: int = 0,
         delay_ms: "float | None" = None,
+        route_epoch: "callable | None" = None,
     ):
         from ..options import CoreOptions
         from ..table.query import LocalTableQuery
@@ -701,6 +1098,8 @@ class _WorkerServer:
 
         self.table = table
         self._owned = owned  # () -> set[int], the worker's live bucket set
+        self._route_epoch = route_epoch  # () -> int, piggybacked on replies
+        self._get_counts: dict[int, int] = {}  # bucket -> gets (heat report)
         self._lock = threading.Lock()
         # injected straggler latency on the read plane (get_batch/scan_frag):
         # the gateway bench/storm latency-shame one worker to measure hedging
@@ -736,6 +1135,11 @@ class _WorkerServer:
                         out = outer._dispatch(method, req)
                         out["id"] = rid
                         out.setdefault("ok", True)
+                        if outer._route_epoch is not None:
+                            # push invalidation rides the serving plane too:
+                            # a client talking only to workers still learns
+                            # of reassignments the moment they happen
+                            out.setdefault("route_epoch", int(outer._route_epoch()))
                     except Exception as e:  # noqa: BLE001
                         out = {"id": rid, "ok": False, "error": f"{type(e).__name__}: {e}"}
                     _send(self.request, out)
@@ -767,6 +1171,7 @@ class _WorkerServer:
             with self._lock:
                 res = self.query.get_batch(ks, tuple(req.get("partition", ())))
             self._metrics().counter("serve_gets").inc(len(ks))
+            self._note_gets(ks)
             return {"rows": [None if r is None else list(r) for r in res.to_pylist()]}
         if method == "subscribe_open":
             # _sub_seq increments under the lock: two concurrent opens in
@@ -855,6 +1260,51 @@ class _WorkerServer:
         lt, rt = run_part(ll, rl, req.get("algorithm", "sort-merge"), req.get("engine", "numpy"))
         self._metrics().counter("join_parts_served").inc()
         return {"lt": _b64(np.asarray(lt, dtype=np.int64)), "rt": _b64(np.asarray(rt, dtype=np.int64))}
+
+    def _note_gets(self, ks: list) -> None:
+        """Fold served probe keys into per-bucket counts — the worker's
+        heartbeat ships the deltas, the coordinator's replica planner turns
+        them into the serve-read heat EMA."""
+        try:
+            from ..data.batch import ColumnBatch
+            from ..table.bucket import bucket_ids
+            from ..types import RowType
+
+            keys = self.table.schema.bucket_keys
+            if not ks or len(keys) != 1 or any(len(k) != 1 for k in ks):
+                return
+            fields = {f.name: f for f in self.table.schema.fields}
+            rt = RowType.of((keys[0], fields[keys[0]].type))
+            probe = ColumnBatch.from_pydict(rt, {keys[0]: [k[0] for k in ks]})
+            bs = bucket_ids(probe, keys, max(self.table.store.options.bucket, 1))
+            with self._lock:
+                for b in bs.tolist():
+                    self._get_counts[b] = self._get_counts.get(b, 0) + 1
+        except Exception:  # noqa: BLE001 — heat is advisory, never fail a get
+            pass
+
+    def take_get_counts(self) -> dict[int, int]:
+        with self._lock:
+            out, self._get_counts = self._get_counts, {}
+        return out
+
+    def reload_table(self, table) -> None:
+        """Swap the serving plane onto a reloaded table (bucket-count change
+        after a rescale): a query constructed over the OLD schema would
+        bucketize probes hash%old against the new layout — a silent miss.
+        The new query refreshes off-lock, then swaps in atomically; the
+        shared hub keeps tailing (decode is bucket-count independent)."""
+        from ..table.query import LocalTableQuery
+
+        fresh = LocalTableQuery(table)
+        with self._lock:
+            old_q, self.query = self.query, fresh
+            self.table = table
+        fresh.follow(hub=self._hub, lock=self._lock)
+        try:
+            old_q.unfollow()
+        except Exception:  # noqa: BLE001
+            pass
 
     def close(self) -> None:
         self._closed = True
@@ -945,13 +1395,20 @@ class ClusterWorkerAgent:
         self.rng = np.random.default_rng(seed * 7919 + wid * 104729 + incarnation)
         self.incarnation = incarnation
         self.conn = _RpcConn(coord_host, coord_port)
+        self.route_epoch = 0
         self.server: _WorkerServer | None = None
         if serve:
-            self.server = _WorkerServer(table, self._owned_set, delay_ms=serve_delay_ms)
+            self.server = _WorkerServer(
+                table, self._owned_set, delay_ms=serve_delay_ms,
+                route_epoch=lambda: self.route_epoch,
+            )
         self._assign_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
         self._epoch = 0
         self._buckets: set[int] = set()
         self._go = False
+        self._retire_flag = False
+        self.retired = False
         self._stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         self.journal = None
@@ -1018,13 +1475,57 @@ class ClusterWorkerAgent:
             return set(self._buckets)
 
     def _apply(self, resp: dict) -> None:
+        # bucket-count change applies BEFORE the epoch/bucket assignment:
+        # by the time a post-rescale epoch is visible to ingest_round, the
+        # table, keygen, and serving query already speak the new layout
+        # (the reverse order would let a round write old-layout files and
+        # ship them under a new epoch — past the fence, wrong total_buckets)
+        nb = resp.get("num_buckets")
+        if nb is not None and int(nb) != self.num_buckets:
+            self._on_bucket_count_change(int(nb))
         with self._assign_lock:
+            re = resp.get("route_epoch")
+            if re is not None and int(re) > self.route_epoch:
+                self.route_epoch = int(re)
             if "epoch" in resp and resp.get("epoch") is not None:
                 self._epoch = int(resp["epoch"])
                 self._buckets = {int(b) for b in resp.get("buckets", ())}
             self._go = bool(resp.get("go", self._go))
+            if resp.get("retire"):
+                self._retire_flag = True
             if resp.get("stop"):
                 self._stop.set()
+
+    def _on_bucket_count_change(self, n: int) -> None:
+        """The coordinator committed a rescale: reload the table at the new
+        schema, re-key the fresh-key generator, rebucketize the landed-key
+        update pool, and swap the serving plane's query — all before the
+        new assignment epoch becomes visible (see _apply)."""
+        from ..table import load_table
+
+        with self._reload_lock:
+            if n == self.num_buckets:
+                return
+            table = load_table(str(self.table.path), commit_user=self.user)
+            new_map: dict[int, list[int]] = {}
+            landed = [k for ks in self.landed_by_bucket.values() for k in ks]
+            if landed:
+                from ..data.batch import ColumnBatch
+                from ..table.bucket import bucket_ids
+                from ..types import BIGINT, RowType
+
+                ks = np.asarray(landed, dtype=np.int64)
+                bs = bucket_ids(
+                    ColumnBatch.from_pydict(RowType.of(("k", BIGINT())), {"k": ks}), ["k"], n
+                )
+                for k, b in zip(landed, bs.tolist()):
+                    new_map.setdefault(int(b), []).append(int(k))
+            self.table = table
+            self.num_buckets = n
+            self.keygen.num_buckets = n
+            self.landed_by_bucket = new_map
+            if self.server is not None:
+                self.server.reload_table(table)
 
     def assignment(self) -> tuple[int, list[int]]:
         with self._assign_lock:
@@ -1045,8 +1546,14 @@ class ClusterWorkerAgent:
 
         def loop():
             while not self._stop.wait(self.heartbeat_interval_s):
+                kw = {"worker": self.wid, "epoch": self._epoch}
+                if self.server is not None:
+                    gets = self.server.take_get_counts()
+                    if gets:
+                        # serve-read heat report: the replica planner's input
+                        kw["gets"] = {str(b): n for b, n in gets.items()}
                 try:
-                    resp = self.conn.call("heartbeat", worker=self.wid, epoch=self._epoch)
+                    resp = self.conn.call("heartbeat", **kw)
                 except Exception:
                     continue  # coordinator shutting down: main loop handles stop
                 if resp.get("reregister"):
@@ -1073,6 +1580,10 @@ class ClusterWorkerAgent:
             r = self.conn.call("admit", worker=self.wid, ident=ident, buckets=buckets)
             if r.get("admitted"):
                 return True
+            if r.get("rescaling"):
+                # the rescale window: stop queueing at the gate and go poll —
+                # the rewrite task for our owned buckets is waiting
+                return False
             if time.monotonic() >= deadline:
                 return False
             time.sleep(min(r.get("retry_after_ms", 100) / 1000.0, 0.25))
@@ -1145,9 +1656,40 @@ class ClusterWorkerAgent:
         self._apply(r)
         done = 0
         for task in r.get("tasks", ()):
-            if self._execute_task(task, epoch):
+            if task.get("kind") == "rescale":
+                if self._execute_rescale(task):
+                    done += 1
+            elif self._execute_task(task, epoch):
                 done += 1
         return done
+
+    def _execute_rescale(self, task: dict) -> bool:
+        """Worker half of the cross-worker rescale: rewrite the owned old
+        buckets at the pinned snapshot (merged rows, clustered by new
+        bucket id), ship the new-layout CommitMessages under the task's
+        fence epoch. The coordinator commits once every old bucket is
+        covered; a kill before the ship just re-queues these buckets on
+        whoever inherits them."""
+        from ..resilience.faults import crash_point
+        from ..table.rescale import rescale_messages
+
+        _, msgs, _ = rescale_messages(
+            self.table,
+            int(task["new_buckets"]),
+            buckets=[int(b) for b in task["buckets"]],
+            snapshot_id=task.get("snapshot"),
+        )
+        crash_point("rescale:before-ship")
+        r = self.conn.call(
+            "ship_commit",
+            worker=self.wid,
+            epoch=int(task["epoch"]),
+            kind="rescale",
+            buckets=[int(b) for b in task["buckets"]],
+            messages=[m.to_dict() for m in msgs],
+        )
+        self._apply(r)
+        return not r.get("stale")
 
     def _execute_task(self, task: dict, epoch: int) -> bool:
         """Worker half of the cluster compaction drain: rewrite through the
@@ -1188,13 +1730,18 @@ class ClusterWorkerAgent:
         self.register()
         self.start_heartbeats()
         while not self._stop.wait(0.2):
-            pass
+            if self._retire_flag:
+                self.retire()
+                break
 
     def run_soak(self) -> None:
         self.register()
         self.start_heartbeats()
         while not self._stop.is_set():
             try:
+                if self._retire_flag:
+                    self.retire()
+                    break
                 self.ingest_round()
                 self.poll_and_compact()
             except ConnectionError:
@@ -1203,6 +1750,22 @@ class ClusterWorkerAgent:
                 # a lost CAS race surfaced as an error response, an injected
                 # fault, etc. — survivable, re-observe and continue
                 time.sleep(0.05)
+
+    def retire(self) -> None:
+        """Planned scale-in drain: called BETWEEN rounds, so every shipped
+        round is settled and nothing is in flight — the retire RPC hands the
+        range off through the reassignment machinery (a death without the
+        timeout) and this process exits clean. A kill at the crash point
+        degrades to exactly the missed-heartbeat path: same handoff, later."""
+        from ..resilience.faults import crash_point
+
+        crash_point("handoff:before-retire")
+        try:
+            self.conn.call("retire", worker=self.wid)
+        except Exception:  # noqa: BLE001 — coordinator gone: drain anyway
+            pass
+        self.retired = True
+        self._stop.set()
 
     def wait_go(self, timeout_s: float = 120.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -1254,6 +1817,11 @@ class ClusterClient:
         self._conns: dict[int, _RpcConn] = {}
         self._route: dict[int, int] = {}
         self._addrs: dict[int, tuple[str, int]] = {}
+        self._replicas: dict[int, list[int]] = {}
+        self._route_lock = threading.Lock()
+        self.route_epoch = 0
+        self._route_dirty = False
+        self._rr = 0
         self.refresh_route()
 
     def refresh_route(self) -> None:
@@ -1267,16 +1835,78 @@ class ClusterClient:
             addrs[wid] = (info["host"], info["port"])
             for b in info["buckets"]:
                 route[int(b)] = wid
+        replicas = {
+            int(b): [int(w) for w in wids if int(w) in addrs]
+            for b, wids in (r.get("replicas") or {}).items()
+        }
         self._route, self._addrs = route, addrs
+        self._replicas = {b: ws for b, ws in replicas.items() if ws}
+        self.num_buckets = int(r.get("num_buckets", self.num_buckets))
+        with self._route_lock:
+            e = int(r.get("route_epoch", 0))
+            if e > self.route_epoch:
+                self.route_epoch = e
+            self._route_dirty = False
         for wid in list(self._conns):
             if wid not in addrs:
                 self._conns.pop(wid).close()
+
+    def note_route_epoch(self, epoch: int) -> None:
+        """Push-based invalidation sink: every RPC reply (coordinator or
+        worker serving plane) carries the route epoch; a bump marks the
+        cached route dirty, and the next routing decision refreshes —
+        clients learn about rescales/reassignments/replica changes without
+        waiting for a rejected call."""
+        with self._route_lock:
+            if epoch > self.route_epoch:
+                self.route_epoch = epoch
+                self._route_dirty = True
+
+    def _maybe_refresh(self) -> None:
+        with self._route_lock:
+            dirty = self._route_dirty
+        if dirty:
+            self.refresh_route()
+
+    def _call(self, wid: int, method: str, **kw) -> dict:
+        """Worker RPC with the route-epoch sniff on the reply."""
+        r = self._conn(wid).call(method, **kw)
+        e = r.get("route_epoch")
+        if e is not None:
+            self.note_route_epoch(int(e))
+        return r
 
     def _conn(self, wid: int) -> _RpcConn:
         conn = self._conns.get(wid)
         if conn is None:
             conn = self._conns[wid] = _RpcConn(*self.addr_of(wid))
         return conn
+
+    def replicas_of(self, bucket: int) -> list[int]:
+        """Live replica owners of a bucket (primaries excluded) — the
+        gateway's replica-first hedge pool."""
+        self._maybe_refresh()
+        return [w for w in self._replicas.get(int(bucket), ()) if w in self._addrs]
+
+    def serving_owner_of(self, bucket: int) -> int:
+        """Read routing: round-robin over the primary plus every live
+        replica (a hot bucket's gets spread across its owner set); writes
+        and compaction stay primary-only, so this is only ever used on the
+        serving plane where any owner answers bit-identically off shared
+        FS."""
+        primary = self.owner_of(bucket)
+        reps = [w for w in self._replicas.get(int(bucket), ()) if w != primary and w in self._addrs]
+        if not reps:
+            return primary
+        ring = [primary, *reps]
+        with self._route_lock:
+            self._rr += 1
+            pick = ring[self._rr % len(ring)]
+        if pick != primary:
+            from ..metrics import cluster_metrics
+
+            cluster_metrics().counter("replica_reads").inc()
+        return pick
 
     def owner_of(self, bucket: int) -> int:
         """The worker serving a bucket's reads. Every consumer (routed
@@ -1286,6 +1916,7 @@ class ClusterClient:
         no window where a respawn surfaces as a raw KeyError. With nothing
         live at all the escape is ConnectionError, which every dispatch
         failover loop already absorbs."""
+        self._maybe_refresh()
         if bucket not in self._route:
             self.refresh_route()
         wid = self._route.get(bucket)
@@ -1327,7 +1958,7 @@ class ClusterClient:
         the planner's failover loop owns re-dispatch."""
         deadline = time.monotonic() + busy_wait_s
         while True:
-            r = self._conn(wid).call("scan_frag", frag=frag)
+            r = self._call(wid, "scan_frag", frag=frag)
             if not r.get("busy"):
                 return r["partial"]
             if time.monotonic() >= deadline:
@@ -1352,13 +1983,34 @@ class ClusterClient:
         out: list = [None] * len(ks)
         by_wid: dict[int, list[int]] = {}
         for i, b in enumerate(buckets.tolist()):
-            by_wid.setdefault(self.owner_of(int(b)), []).append(i)
+            by_wid.setdefault(self.serving_owner_of(int(b)), []).append(i)
         for wid, idxs in by_wid.items():
-            rows = self._conn(wid).call(
-                "get_batch",
-                keys=[list(ks[i]) for i in idxs],
-                partition=list(partition),
-            )["rows"]
+            try:
+                rows = self._call(
+                    wid,
+                    "get_batch",
+                    keys=[list(ks[i]) for i in idxs],
+                    partition=list(partition),
+                )["rows"]
+            except ConnectionError:
+                # the picked owner (typically a replica) died mid-read: one
+                # failover pass through the refreshed primaries — a second
+                # failure escapes like any other dead route
+                self.drop_conn(wid)
+                self.refresh_route()
+                retry: dict[int, list[int]] = {}
+                for i in idxs:
+                    retry.setdefault(self.owner_of(int(buckets[i])), []).append(i)
+                for w2, idxs2 in retry.items():
+                    rows2 = self._call(
+                        w2,
+                        "get_batch",
+                        keys=[list(ks[i]) for i in idxs2],
+                        partition=list(partition),
+                    )["rows"]
+                    for i, row in zip(idxs2, rows2):
+                        out[i] = None if row is None else tuple(row)
+                continue
             for i, row in zip(idxs, rows):
                 out[i] = None if row is None else tuple(row)
         return out
@@ -1368,10 +2020,11 @@ class ClusterClient:
         """[(wid, handle)] per owning worker; each handle's poll() returns
         {rows, snapshot_id, checkpoint} filtered to that worker's share of
         `buckets` (all buckets when None)."""
+        self._maybe_refresh()
         want = list(range(self.num_buckets)) if buckets is None else [int(b) for b in buckets]
         by_wid: dict[int, list[int]] = {}
         for b in want:
-            by_wid.setdefault(self.owner_of(b), []).append(b)
+            by_wid.setdefault(self.serving_owner_of(b), []).append(b)
         handles = []
         for wid, bs in by_wid.items():
             conn = self._conn(wid)
@@ -1390,7 +2043,8 @@ class ClusterClient:
             out = []
             for i, (ll, rl, algorithm, engine) in enumerate(parts):
                 wid = self.owner_of(i % self.num_buckets)
-                r = self._conn(wid).call(
+                r = self._call(
+                    wid,
                     "join_part",
                     ll=_b64(np.asarray(ll, dtype=np.uint32)),
                     rl=_b64(np.asarray(rl, dtype=np.uint32)),
@@ -1453,9 +2107,14 @@ class ClusterSupervisor:
             "procs_respawned": 0,
             "worker_errors": 0,
             "sweeps_during_soak": 0,
+            "workers_admitted": 0,
+            "workers_retired": 0,
+            "rescales_requested": 0,
         }
         self._kill_cursor = 0
         self._incarnations: dict[tuple, int] = {}
+        self._retiring_wids: set[int] = set()
+        self._spawned_wids: set[int] = set()
 
     # ---- setup ---------------------------------------------------------
     def _table_options(self) -> dict:
@@ -1520,6 +2179,7 @@ class ClusterSupervisor:
             self._kill_cursor += 1
         inc = self._incarnations.get(("w", wid), 0)
         self._incarnations[("w", wid)] = inc + 1
+        self._spawned_wids.add(wid)
         log = open(os.path.join(self.run_dir, f"worker-{wid}.{inc}.log"), "wb")
         cmd = [
             sys.executable, "-m", "paimon_tpu.service.cluster", "worker",
@@ -1563,6 +2223,34 @@ class ClusterSupervisor:
         self.counts["procs_spawned"] += 1
         return p
 
+    def _elastic_event(self, act: str, arg: "int | None", workers: dict) -> None:
+        """One scripted elastic action against the live fleet: a rescale
+        (coordinator-driven, under load), a worker admit (fresh wid beyond
+        the home split — the register steal path plans its range handoff),
+        or a retire (coordinator drain flag; the clean rc=0 exit is removed
+        from the fleet instead of respawned)."""
+        if act == "rescale":
+            new_n = arg if arg else self.coordinator.num_buckets * 2
+            r = self.coordinator.start_rescale(new_n)
+            if r.get("started"):
+                self.counts["rescales_requested"] += 1
+        elif act == "admit":
+            wid = (max(workers) + 1) if workers else self.cfg.workers
+            workers[wid] = self._spawn_worker(wid)
+            self.counts["workers_admitted"] += 1
+        elif act == "retire":
+            live = [
+                w
+                for w in sorted(workers)
+                if workers[w].poll() is None and w not in self._retiring_wids
+            ]
+            if len(live) > 1:  # never retire the last worker
+                wid = live[-1]  # highest wid: the admitted joiner when present
+                self.coordinator.request_retire(wid)
+                self._retiring_wids.add(wid)
+        else:
+            raise ValueError(f"unknown elastic action {act!r}")
+
     def _reap(self, role: str, idx: int, rc: int) -> None:
         from ..metrics import soak_metrics
         from ..resilience.faults import KILL_EXIT_CODE
@@ -1601,11 +2289,26 @@ class ClusterSupervisor:
             else float("inf")
         )
         next_sweep = t_start + cfg.sweep_period_s if cfg.sweep_period_s > 0 else float("inf")
+        # scripted elastic plan: (absolute time, action, arg), time-ordered
+        elastic = sorted(
+            (
+                t_start + float(ev[1]) * cfg.duration_s,
+                str(ev[0]),
+                int(ev[2]) if len(ev) > 2 and ev[2] is not None else None,
+            )
+            for ev in cfg.elastic
+        )
         gauge = compaction_metrics().gauge("read_amplification_p99")
         while time.monotonic() < deadline:
             for wid, p in list(workers.items()):
                 rc = p.poll()
                 if rc is None:
+                    continue
+                if rc == 0 and wid in self._retiring_wids:
+                    # planned retire completed its handoff: remove, never
+                    # respawn — the range already moved to the survivors
+                    del workers[wid]
+                    self.counts["workers_retired"] += 1
                     continue
                 self._reap("worker", wid, rc)
                 workers[wid] = self._spawn_worker(wid)
@@ -1618,8 +2321,15 @@ class ClusterSupervisor:
                 readers[rid] = self._spawn_reader(rid)
                 self.counts["procs_respawned"] += 1
             now = time.monotonic()
+            while elastic and now >= elastic[0][0]:
+                _, act, arg = elastic.pop(0)
+                try:
+                    self._elastic_event(act, arg, workers)
+                except Exception:
+                    self.errors.append(f"elastic {act} failed:\n{traceback.format_exc()}")
             if now >= next_kill and workers:
-                victim = workers[int(rng.integers(0, cfg.workers))]
+                wids = sorted(workers)
+                victim = workers[wids[int(rng.integers(0, len(wids)))]]
                 if victim.poll() is None:
                     victim.kill()
                 next_kill = now + float(rng.uniform(0.5, 1.5)) * cfg.kill_period_s
@@ -1663,13 +2373,14 @@ class ClusterSupervisor:
         from .oracle import fold_landed_rounds, read_client_logs, verify_table_state
 
         table = self._fresh_table()
+        journal_wids = sorted(self._spawned_wids) or list(range(self.cfg.workers))
         landed, stats = fold_landed_rounds(
             table.store,
             {
                 f"{ClusterCoordinator.USER_PREFIX}{wid}": os.path.join(
                     self.run_dir, f"journal-{wid}.jsonl"
                 )
-                for wid in range(self.cfg.workers)
+                for wid in journal_wids
             },
             user_prefix=ClusterCoordinator.USER_PREFIX,
             inconsistencies=self.inconsistencies,
@@ -1717,11 +2428,16 @@ class ClusterSupervisor:
                 "compact_conflicts",
                 "admit_denied",
                 "charges_released",
+                "rescales",
+                "rescale_aborts",
+                "handoffs",
+                "replica_reads",
             )
         }
         return {
             "wall_s": round(wall_s, 2),
             "consistent": consistent,
+            "final_buckets": table.store.options.bucket,
             "accepted_commits": len(landed),
             "expected_unique_keys": len(expected),
             "final_rows": state["final_rows"],
@@ -1950,7 +2666,23 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--read-amp-ceiling", type=int, default=10)
     ap.add_argument("--min-kills", type=int, default=0)
     ap.add_argument("--no-compaction", action="store_false", dest="compaction")
+    ap.add_argument(
+        "--elastic-script",
+        default="",
+        help=(
+            "comma-separated elastic events action[:arg]@frac, e.g. "
+            "'rescale:8@0.3,admit@0.5,retire@0.7' — rescale to 8 buckets at "
+            "30%% of the duration, admit a worker at 50%%, retire one at 70%%"
+        ),
+    )
     args = ap.parse_args(argv)
+    elastic = []
+    for spec in (s.strip() for s in args.elastic_script.split(",")):
+        if not spec:
+            continue
+        head, frac = spec.rsplit("@", 1)
+        act, _, arg = head.partition(":")
+        elastic.append((act, float(frac), int(arg)) if arg else (act, float(frac)))
     base = args.base_dir or tempfile.mkdtemp(prefix="paimon_cluster_")
     cfg = ClusterConfig(
         workers=args.workers,
@@ -1965,6 +2697,7 @@ def main(argv: list[str] | None = None) -> int:
         kill_period_s=args.kill_period,
         sweep_period_s=args.sweep_period,
         compaction=args.compaction,
+        elastic=tuple(elastic),
     )
     report = run_cluster_soak(base, cfg)
     print(json.dumps(report, indent=2, default=str))

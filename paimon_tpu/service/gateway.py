@@ -348,12 +348,16 @@ class Gateway:
         buckets = bucket_ids(probe, self._table.schema.bucket_keys, client.num_buckets)
         out: list = [None] * len(ks)
         by_wid: dict[int, list[int]] = {}
+        wid_bucket: dict[int, int] = {}
         for i, b in enumerate(buckets.tolist()):
-            by_wid.setdefault(self._owner_for(int(b)), []).append(i)
+            wid = self._owner_for(int(b))
+            by_wid.setdefault(wid, []).append(i)
+            wid_bucket.setdefault(wid, int(b))
         for wid, idxs in by_wid.items():
             r = self._rpc_failover(
                 wid,
                 "get_batch",
+                _bucket=wid_bucket.get(wid),
                 keys=[list(ks[i]) for i in idxs],
                 partition=list(partition),
             )
@@ -509,10 +513,16 @@ class Gateway:
 
     # ------------------------------------------------------------------
     # hedging
-    def _secondary_for(self, primary: int) -> "int | None":
+    def _secondary_for(self, primary: int, bucket: "int | None" = None) -> "int | None":
         candidates = [w for w in self._client.live_workers() if w != primary]
         if not candidates:
             return None
+        if bucket is not None:
+            # replica-first: a secondary owner of this bucket serves its gets
+            # from a warm local view, so the hedge lands on the cheapest host
+            reps = [w for w in self._client.replicas_of(int(bucket)) if w != primary and w in candidates]
+            if reps:
+                return reps[0]
         # deterministic: the next live worker after the primary, cyclically
         later = [w for w in candidates if w > primary]
         return (later or candidates)[0]
@@ -588,10 +598,12 @@ class Gateway:
                 self._inflight_cond.wait(remaining)
             return True
 
-    def _hedged_rpc(self, primary_wid: int, method: str, **kw) -> dict:
+    def _hedged_rpc(self, primary_wid: int, method: str, _bucket: "int | None" = None, **kw) -> dict:
         """One worker RPC with tail-latency hedging. Returns the first
         non-BUSY response; a BUSY payload only when every attempt answered
-        BUSY. Raises like _RpcConn.call when all attempts fail."""
+        BUSY. Raises like _RpcConn.call when all attempts fail. `_bucket`
+        is a routing hint only (never sent on the wire): hedges for a
+        replicated bucket go replica-first."""
         g = self._metrics()
         with self._hedge_lock:
             self._hedge_requests += 1
@@ -604,7 +616,7 @@ class Gateway:
             pass
         except Exception:
             raise
-        secondary_wid = self._secondary_for(primary_wid)
+        secondary_wid = self._secondary_for(primary_wid, bucket=_bucket)
         allowed = False
         if secondary_wid is not None:
             with self._hedge_lock:
@@ -652,7 +664,9 @@ class Gateway:
         escape is the typed 'route-respawning' shed, never a raw KeyError."""
         client = self._client
         try:
-            return client.owner_of(bucket)
+            # replica-aware: round-robins over the primary plus any granted
+            # read replicas — hot buckets spread their serve load
+            return client.serving_owner_of(bucket)
         except (KeyError, ConnectionError):
             live = client.live_workers()
             if live:
@@ -667,7 +681,7 @@ class Gateway:
             )
         )
 
-    def _rpc_failover(self, wid: int, method: str, **kw) -> dict:
+    def _rpc_failover(self, wid: int, method: str, _bucket: "int | None" = None, **kw) -> dict:
         """_hedged_rpc hardened against a dead route: a connection-grain
         failure (the worker is mid-respawn, so its socket refuses or resets
         before the hedge deadline even starts) refreshes the route and
@@ -680,7 +694,7 @@ class Gateway:
         last: "BaseException | None" = None
         for _ in range(3):
             try:
-                return self._hedged_rpc(wid, method, **kw)
+                return self._hedged_rpc(wid, method, _bucket=_bucket, **kw)
             except FileNotFoundError:
                 raise  # user error (missing table/path), not a dead route
             except (ConnectionError, TimeoutError, OSError) as e:
@@ -692,8 +706,9 @@ class Gateway:
                     pass
                 # a respawned worker re-registers under the same wid with a
                 # fresh address, so the primary stays a candidate; otherwise
-                # step to the next live worker cyclically
-                alt = self._secondary_for(wid)
+                # prefer a replica of the touched bucket, then step to the
+                # next live worker cyclically
+                alt = self._secondary_for(wid, bucket=_bucket)
                 if alt is not None:
                     wid = alt
         self._metrics().counter("sheds_typed").inc()

@@ -106,6 +106,13 @@ DEFAULT_MEGA_KILLS = (
     ("writer", "flush:files-written:3:kill"),
     ("writer", "commit:before-manifests:2:kill"),
     ("writer", "flush:before-dispatch:2:kill"),
+    # the elastic-topology axis (ISSUE 19): workers dying inside the rescale
+    # rewrite window and a retiring worker dying after draining but before
+    # its retire RPC — armed on the cluster cells, fired by the elastic
+    # churn thread's scripted rescale/admit/retire events
+    ("worker", "rescale:files-written:1:kill"),
+    ("worker", "rescale:before-ship:1:kill"),
+    ("worker", "handoff:before-retire:1:kill"),
 )
 
 # metric groups the matrix must tick (the acceptance census)
@@ -678,6 +685,9 @@ class MegaSoakSupervisor:
             "sweeps_during_soak": 0,
             "snapshot_expiries": 0,
             "faults_injected": 0,
+            "rescales_requested": 0,
+            "workers_admitted": 0,
+            "workers_retired": 0,
         }
         self.kills_by_kind: dict[str, int] = {}
         self.kills_by_point: dict[str, int] = {}
@@ -1077,6 +1087,52 @@ class MegaSoakSupervisor:
                 sub_id = None
                 time.sleep(0.3)
         cell["gw_sub_rows"] = rows
+
+    def _elastic_loop(self, cell, t_start: float, deadline: float) -> None:
+        """The elastic-topology axis on cluster cells: one live rescale
+        under the full chaos load, one worker admit (the join-steal range
+        handoff), one planned retire (drain + handoff) — scripted at fixed
+        fractions of the cell duration so the armed rescale:*/handoff:*
+        crash specs have a live window to fire in."""
+        sc: MegaScenario = cell["scenario"]
+        coord = cell["coordinator"]
+        dur = max(deadline - t_start, 1.0)
+        plan = []
+        if sc.bucket > 0:  # dynamic tables assign buckets per key
+            plan.append((t_start + 0.35 * dur, "rescale"))
+        plan.append((t_start + 0.55 * dur, "admit"))
+        plan.append((t_start + 0.75 * dur, "retire"))
+        while plan and time.monotonic() < deadline and not cell["stop"].is_set():
+            if time.monotonic() < plan[0][0]:
+                time.sleep(0.2)
+                continue
+            _, act = plan.pop(0)
+            try:
+                if act == "rescale":
+                    r = coord.start_rescale(coord.num_buckets * 2)
+                    if r.get("started"):
+                        self.counts["rescales_requested"] += 1
+                elif act == "admit":
+                    idx = 1 + max(
+                        (i for k, i in self._procs if k == "worker"), default=-1
+                    )
+                    self._spawn_child(cell, "worker", idx)
+                    self.counts["workers_admitted"] += 1
+                elif act == "retire":
+                    live = sorted(
+                        i
+                        for (k, i), (p, _) in list(self._procs.items())
+                        if k == "worker"
+                        and p.poll() is None
+                        and ("worker", i) not in cell["no_respawn"]
+                    )
+                    if len(live) > 1:  # never retire the last worker
+                        wid = live[-1]  # the admitted joiner when present
+                        cell["no_respawn"].add(("worker", wid))
+                        coord.request_retire(wid)
+                        self.counts["workers_retired"] += 1
+            except Exception:
+                cell["errors"].append(f"elastic {act} failed:\n{traceback.format_exc()}")
         if sub_id is not None:
             try:
                 gw.subscribe_close(sub_id)
@@ -1131,6 +1187,7 @@ class MegaSoakSupervisor:
             "inconsistencies": [],
             "expired_consumers": set(),
             "untyped_at_start": untyped_at_start,
+            "no_respawn": set(),  # retired workers stay retired
         }
         self._procs: dict[tuple, tuple] = {}
         self._incarnations: dict[tuple, int] = {}
@@ -1178,6 +1235,15 @@ class MegaSoakSupervisor:
                 daemon=True,
             ),
         ]
+        if sc.cluster:
+            threads.append(
+                threading.Thread(
+                    target=self._elastic_loop,
+                    args=(cell, t_start, deadline),
+                    name="mega-elastic",
+                    daemon=True,
+                )
+            )
         for t in threads:
             t.start()
 
@@ -1194,6 +1260,9 @@ class MegaSoakSupervisor:
                     if rc is None:
                         continue
                     self._reap(cell, kind, idx, rc, spec)
+                    if (kind, idx) in cell["no_respawn"]:
+                        del self._procs[(kind, idx)]  # planned retire: gone for good
+                        continue
                     self._spawn_child(cell, kind, idx)
                     self.counts["procs_respawned"] += 1
                 now = time.monotonic()

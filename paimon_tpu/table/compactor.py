@@ -444,6 +444,17 @@ class AdaptiveCompactorService:
         with self._runs_cond:
             return [k for k, r in self._runs.items() if r >= bound]
 
+    def heat(self) -> dict[int, float]:
+        """Per-bucket write-heat EMA (rows/s, from the sequence-number delta
+        tracked across observations) folded over partitions. The elastic
+        cluster's replica planner combines this with the serve-side get rate
+        to decide which buckets deserve read replicas — the same LUDA-style
+        heat signal that already orders the compaction queue."""
+        out: dict[int, float] = {}
+        for (_, bucket), rate in self._rate.items():
+            out[bucket] = out.get(bucket, 0.0) + rate
+        return out
+
     def wait_for_headroom(self, timeout_s: float = 30.0) -> bool:
         """Block the calling ingest writer until no bucket sits at/over the
         read-amp ceiling (re-evaluated at every observation round) — the

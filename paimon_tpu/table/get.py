@@ -71,10 +71,14 @@ def probe_batch(query: "LocalTableQuery", keys):
 def _bucket_groups(query: "LocalTableQuery", probe, partition: tuple):
     """[(bucket, probe_row_indices | None)] — None means the whole batch
     (dynamic-bucket tables probe every bucket of the partition)."""
-    if query.store.options.bucket > 0:
+    n = getattr(query, "_probe_buckets", 0) or query.store.options.bucket
+    if n > 0:
         from .bucket import bucket_ids
 
-        ids = bucket_ids(probe, query.table.schema.bucket_keys, query.store.options.bucket)
+        # snapshot-consistent routing: the query's _probe_buckets tracks the
+        # bucket count of the snapshot being served, which diverges from the
+        # construction-time option during a live rescale
+        ids = bucket_ids(probe, query.table.schema.bucket_keys, n)
         return [(int(b), np.flatnonzero(ids == b)) for b in np.unique(ids)]
     buckets = sorted({pb[1] for pb in query._get_indexes if pb[0] == partition})
     return [(b, None) for b in buckets]

@@ -166,6 +166,16 @@ class LocalTableQuery:
         self._delta_indexes: dict[tuple, tuple] = {}  # (pb) -> (file names, BucketGetIndex)
         self._write: "TableWrite | None" = None
         self._snapshot_id: int | None = None
+        # probe-routing bucket count, kept consistent with the snapshot
+        # being SERVED (not the construction-time options): after a live
+        # rescale the plan's files carry the new layout while this query
+        # object still holds the old schema — bucketizing probes with the
+        # stale count would silently miss. refresh() re-resolves it from
+        # the planned snapshot's schema.
+        self._probe_buckets: int = max(self.store.options.bucket, 0)
+        from ..core.schema import SchemaManager
+
+        self._schemas = SchemaManager(self.table.file_io, str(self.table.path))
         self._follow_thread: threading.Thread | None = None
         self._follow_stop: threading.Event | None = None
         self._follow_sub = None
@@ -196,6 +206,13 @@ class LocalTableQuery:
         sid = plan.snapshot.id if plan.snapshot else None
         if sid == self._snapshot_id:
             return
+        probe_buckets = self._probe_buckets
+        if plan.snapshot is not None and self.store.options.bucket > 0:
+            try:
+                sch = self._schemas.schema(plan.snapshot.schema_id)
+                probe_buckets = int(sch.options.get("bucket", probe_buckets))
+            except Exception:  # noqa: BLE001 — fall back to the last-known count
+                pass
         from ..core.deletionvectors import DeletionVectorsIndexFile
 
         dv_io = DeletionVectorsIndexFile(self.table.file_io, self.table.path)
@@ -251,6 +268,7 @@ class LocalTableQuery:
                     self._get_indexes.pop(pb, None)
                     self._bucket_sigs.pop(pb, None)
             self._snapshot_id = sid
+            self._probe_buckets = probe_buckets
 
     # ---- subscription-driven refresh ------------------------------------
     def follow(self, hub=None, lock: "threading.Lock | None" = None) -> "LocalTableQuery":
@@ -346,13 +364,15 @@ class LocalTableQuery:
         candidates: Sequence[tuple] = [
             pb for pb in self._levels if pb[0] == partition
         ]
-        if self.store.options.bucket > 0:
+        if self._probe_buckets > 0:
             from ..data.batch import ColumnBatch
             from .bucket import bucket_ids
 
             key_schema = self.store.value_schema.project(self.store.key_names)
             probe = ColumnBatch.from_pydict(key_schema, {k: [v] for k, v in zip(self.store.key_names, key)})
-            b = int(bucket_ids(probe, self.table.schema.bucket_keys, self.store.options.bucket)[0])
+            # _probe_buckets, NOT options.bucket: routing must match the
+            # layout of the snapshot being served (see refresh)
+            b = int(bucket_ids(probe, self.table.schema.bucket_keys, self._probe_buckets)[0])
             candidates = [(partition, b)] if (partition, b) in self._levels else []
         for pb in candidates:
             out = self._levels[pb].lookup(key)

@@ -1,0 +1,169 @@
+"""Live dynamic-bucket rescale: rewrite a fixed-bucket table at a new bucket
+count, committed as schema-(N+1) (``bucket`` option bump) plus ONE atomic
+OVERWRITE snapshot.
+
+The rewrite is a mesh repartition: every old bucket's merged rows are
+clustered by their NEW bucket id through the same distributed clustering
+sort the sort-compact path uses (`mesh_cluster_permutation`, PR 7), so the
+per-new-bucket row order is deterministic and bit-identical between the
+single-process path here and the cross-worker path in
+``service/cluster.py`` (where each worker rewrites only the old buckets it
+owns and ships the CommitMessages to the coordinator).
+
+Protocol, shared by both paths:
+
+1. pin a snapshot S (the latest at rescale start);
+2. read each old bucket's merged rows (deletes dropped — the rewrite
+   materializes the latest value per key), route every row to
+   ``hash(key) % new_buckets`` and cluster rows by target bucket with the
+   stable clustering permutation;
+3. write the clustered rows through a TableWrite over a ``bucket=new``
+   table copy (write-only: no inline compaction during the rewrite) —
+   entries carry ``total_buckets=new``;
+4. commit schema-(N+1) with ``bucket=new``, then commit one OVERWRITE
+   snapshot that logically deletes every live pre-rescale entry and adds
+   the rewritten files.
+
+Readers pinned at <= S keep reading the old files — logically deleted but
+on disk until snapshot expiry — so pre-rescale reads stay bit-identical;
+readers planning after the OVERWRITE see only the new layout. Routing
+atomicity between steps 4a and 4b is the caller's job: the cluster
+coordinator epoch-fences every shipment for the whole window and only
+republishes routes once both commits land; the single-process path is an
+offline operation (the reference's rescale requires an offline INSERT
+OVERWRITE for exactly this reason).
+
+The rewrite reads go through the PR 1 data-file cache: the cache key is
+content-addressed (uuid-unique file name, not bucket path), so surviving
+files decoded by any earlier read — a serving query, a compaction — are
+cache hits here instead of cold re-decodes.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable, Sequence
+
+import numpy as np
+
+from ..core.manifest import CommitMessage, ManifestCommittable
+from ..core.schema import SchemaChange, SchemaManager
+
+if TYPE_CHECKING:
+    from . import FileStoreTable
+
+__all__ = ["rescale_messages", "commit_rescale", "rescale_table", "cluster_by_new_bucket"]
+
+
+def cluster_by_new_bucket(table: "FileStoreTable", batch, new_buckets: int):
+    """Stable-cluster `batch`'s rows by their new bucket id. Returns
+    (clustered batch, new bucket ids aligned with the clustered batch).
+    Uses the distributed clustering sort when the key mesh is live
+    (`mesh_cluster_permutation` is bit-identical to the single-device
+    stable sort by contract); falls back to the host stable argsort."""
+    from .bucket import bucket_ids
+
+    ids = bucket_ids(batch, table.schema.bucket_keys, new_buckets)
+    perm = None
+    try:
+        from ..parallel.mesh_exec import mesh_cluster_permutation
+
+        lanes = ids.astype(np.uint32).reshape(-1, 1)
+        perm = mesh_cluster_permutation(lanes, table.store.options)
+    except Exception:
+        perm = None
+    if perm is None:
+        perm = np.argsort(ids, kind="stable")
+    perm = np.asarray(perm, dtype=np.int64)
+    return batch.take(perm), ids[perm]
+
+
+def rescale_messages(
+    table: "FileStoreTable",
+    new_buckets: int,
+    buckets: "Iterable[int] | None" = None,
+    snapshot_id: "int | None" = None,
+) -> tuple["int | None", list[CommitMessage], int]:
+    """Rewrite the merged rows of `buckets` (default: every bucket) of the
+    pinned snapshot at `new_buckets`. Returns (pinned snapshot id,
+    CommitMessages with total_buckets=new, rows rewritten). Pure rewrite —
+    nothing is committed; the caller (coordinator or `rescale_table`) owns
+    the commit."""
+    if new_buckets < 1:
+        raise ValueError(f"new bucket count must be >= 1, got {new_buckets}")
+    store = table.store
+    if store.options.bucket < 1:
+        raise ValueError("cross-bucket rescale applies to fixed-bucket tables (dynamic tables assign per key)")
+    scan = store.new_scan()
+    if snapshot_id is not None:
+        scan = scan.with_snapshot(snapshot_id)
+    plan = scan.plan()
+    sid = plan.snapshot.id if plan.snapshot else None
+    want = None if buckets is None else set(int(b) for b in buckets)
+
+    from ..core.deletionvectors import DeletionVectorsIndexFile
+
+    dv_io = DeletionVectorsIndexFile(table.file_io, table.path)
+    target = table.copy({"bucket": str(new_buckets), "write-only": "true"})
+    from .write import TableWrite
+
+    tw = TableWrite(target)
+    rows = 0
+    try:
+        for partition, pbuckets in sorted(plan.grouped().items()):
+            for bucket, files in sorted(pbuckets.items()):
+                if want is not None and bucket not in want:
+                    continue
+                dv_index = plan.dv_index_for(partition, bucket)
+                dvs = dv_io.read_all(dv_index) if dv_index else None
+                batch = store.read_bucket(partition, bucket, files, drop_delete=True, deletion_vectors=dvs)
+                if batch.num_rows == 0:
+                    continue
+                clustered, _ = cluster_by_new_bucket(table, batch, new_buckets)
+                tw.write(clustered)
+                rows += clustered.num_rows
+        msgs = tw.prepare_commit()
+        from ..resilience.faults import crash_point
+
+        crash_point("rescale:files-written")
+    finally:
+        tw.close()
+    return sid, msgs, rows
+
+
+def commit_rescale(
+    table: "FileStoreTable",
+    new_buckets: int,
+    messages: Sequence[CommitMessage],
+    commit_identifier: "int | None" = None,
+) -> "int | None":
+    """Commit half: schema bump to ``bucket=new`` then ONE OVERWRITE snapshot
+    replacing every live entry with the rewritten files. Returns the
+    OVERWRITE snapshot id."""
+    from ..core.commit import BATCH_COMMIT_IDENTIFIER
+
+    SchemaManager(table.file_io, str(table.path)).commit_changes(
+        SchemaChange.set_option("bucket", str(new_buckets))
+    )
+    # commit through a table reloaded AT the bumped schema: the OVERWRITE
+    # snapshot must record the new schema id — serving queries resolve
+    # their probe-routing bucket count from the planned snapshot's schema,
+    # so a snapshot carrying new-layout files under the old schema id would
+    # mis-route every get until the next commit
+    from . import load_table
+
+    fresh = load_table(str(table.path), commit_user=table.store.commit_user)
+    ident = commit_identifier if commit_identifier is not None else BATCH_COMMIT_IDENTIFIER
+    sids = fresh.store.new_commit().overwrite(ManifestCommittable(ident, messages=list(messages)))
+    return sids[-1] if sids else None
+
+
+def rescale_table(table: "FileStoreTable", new_buckets: int) -> "FileStoreTable":
+    """Single-process rescale: rewrite every bucket, commit, and return the
+    reloaded table at the new bucket count. Offline operation — no rival
+    writers may commit during the window (the cluster path in
+    service/cluster.py fences them instead)."""
+    _, msgs, _ = rescale_messages(table, new_buckets)
+    commit_rescale(table, new_buckets, msgs)
+    from . import load_table
+
+    return load_table(str(table.path), commit_user=table.store.commit_user)

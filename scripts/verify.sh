@@ -111,6 +111,20 @@
 #                              — asserting >= 2 kills survived, fold == final
 #                              scan, 0 lost/dup rows, 0 leaked files, and
 #                              sampled read-amp p99 <= the adaptive ceiling.
+#   scripts/verify.sh elastic  elastic-cluster stage: the tests/test_elastic.py
+#                              suite (live bucket rescale parity + pinned
+#                              readers + data-file cache reuse, join-steal
+#                              scale-out, planned retire handoff, hot-bucket
+#                              read replicas incl. randomized replica/oracle
+#                              consistency and replica-death failover, push
+#                              route invalidation), then a ~60 s DETERMINISTIC
+#                              elastic soak — 2 workers under continuous
+#                              ingest with one scripted live rescale 4->8 at
+#                              30% (one worker armed to die with its rewrite
+#                              files durable but unshipped), one worker admit
+#                              at 50% (join-steal handoff), one planned
+#                              retire at 70% — asserting >= 1 kill survived,
+#                              0 lost/dup rows, 0 leaked files.
 #   scripts/verify.sh encode   native-encoder roundtrip parity stage: the
 #                              full test_encode suite (incl. the slow
 #                              corpus sweep) with the encoder forced
@@ -300,6 +314,17 @@ if [ "${1:-}" = "cluster" ]; then
     --duration 45 --workers 2 --readers 1 --seed 0 \
     --scripted-kills "flush:files-written:2:kill,cluster:compact-executing:1:kill,cluster:before-ship:2:kill" \
     --kill-period 10 --sweep-period 15 --min-kills 2
+fi
+
+if [ "${1:-}" = "elastic" ]; then
+  env JAX_PLATFORMS=cpu \
+    timeout -k 10 600 python -m pytest tests/test_elastic.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  exec env JAX_PLATFORMS=cpu timeout -k 10 300 python -m paimon_tpu.service.cluster \
+    --duration 60 --workers 2 --readers 1 --seed 0 --buckets 4 \
+    --scripted-kills "rescale:files-written:1:kill" \
+    --kill-period 0 --sweep-period 20 \
+    --elastic-script "rescale:8@0.3,admit@0.5,retire@0.7" --min-kills 1
 fi
 
 if [ "${1:-}" = "gateway" ]; then

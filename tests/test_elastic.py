@@ -1,0 +1,514 @@
+"""Elastic cluster (ISSUE 19): live dynamic-bucket rescale, runtime worker
+scale-out/in with planned range handoff, and replicated serving for hot
+shards.
+
+In-process tests drive ClusterCoordinator.handle() and ClusterWorkerAgent
+directly (the TCP layer is a thin shim over both) so the elastic edges —
+one-fencing-round rescale, admit gating, join steal, retire handoff, replica
+grant/demote/promote — are deterministic. The randomized replica-consistency
+suite asserts replica-served reads stay bit-identical to the primary and to
+the single-process oracle across snapshot advances, promotion, and a replica
+killed mid-read.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from paimon_tpu.core.schema import SchemaManager
+from paimon_tpu.fs import get_file_io
+from paimon_tpu.metrics import cluster_metrics, registry
+from paimon_tpu.service.cluster import (
+    ClusterClient,
+    ClusterConfig,
+    ClusterCoordinator,
+    ClusterWorkerAgent,
+    bucket_key_pools,
+)
+from paimon_tpu.service.soak import SCHEMA
+from paimon_tpu.table import load_table
+from paimon_tpu.table.query import LocalTableQuery
+from paimon_tpu.table.rescale import rescale_messages, rescale_table
+
+
+def _mk_table(root: str, buckets: int = 4, **extra) -> None:
+    opts = {
+        "bucket": str(buckets),
+        "write-only": "true",
+        "merge.engine": "mesh",
+        "write-buffer-rows": "128",
+        "compaction.adaptive.read-amp-ceiling": "10",
+        "compaction.adaptive.interval": "200 ms",
+    }
+    opts.update(extra)
+    SchemaManager(get_file_io(root), root).create_table(SCHEMA, primary_keys=["k"], options=opts)
+
+
+def _commit(t, ident, rows: dict) -> None:
+    from paimon_tpu.core.manifest import ManifestCommittable
+    from paimon_tpu.table.write import TableWrite
+
+    tw = TableWrite(t)
+    tw.write({"k": list(rows), "v": list(rows.values())})
+    msgs = tw.prepare_commit()
+    tw.close()
+    t.store.new_commit().commit(ManifestCommittable(ident, messages=msgs))
+
+
+def _scan_rows(root) -> list[tuple]:
+    rb = load_table(root, commit_user="scan").new_read_builder()
+    out = rb.new_read().read_all(rb.new_scan().plan())
+    return sorted(zip(out.column("k").values.tolist(), out.column("v").values.tolist()))
+
+
+def _coordinator(root, workers=2, compaction=False, **kw) -> ClusterCoordinator:
+    cfg = ClusterConfig(workers=workers, buckets=4, compaction=compaction, **kw)
+    return ClusterCoordinator(root, cfg).start()
+
+
+def _agent(root, coord, wid, tmp_path=None, serve=False, **kw) -> ClusterWorkerAgent:
+    t = load_table(root, commit_user=f"cluster-w{wid}")
+    journal = str(tmp_path / f"journal-{wid}.jsonl") if tmp_path is not None else None
+    a = ClusterWorkerAgent(
+        wid, t, coord.host, coord.port, journal_path=journal, serve=serve,
+        round_rows=48, heartbeat_interval_s=0.1, **kw,
+    )
+    a.register()
+    return a
+
+
+@pytest.fixture
+def cluster_table(tmp_path):
+    root = str(tmp_path / "t")
+    _mk_table(root)
+    return root
+
+
+def _drive_rescale(coord, agents, deadline_s=45.0):
+    """Poll every agent until the coordinator's rescale window closes."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for a in agents:
+            a.poll_and_compact()
+        if not coord.handle("rescale_status", {})["active"]:
+            for a in agents:  # settling poll: every reply carries num_buckets
+                a.poll_and_compact()
+            return
+        time.sleep(0.05)
+    raise TimeoutError("rescale did not complete")
+
+
+# ---------------------------------------------------------------------------
+# single-process rescale (offline path): parity, pinned readers, cache reuse
+# ---------------------------------------------------------------------------
+def test_rescale_table_roundtrip_parity(tmp_path):
+    root = str(tmp_path / "t")
+    _mk_table(root, buckets=4)
+    t = load_table(root, commit_user="w")
+    _commit(t, 1, {k: float(k) for k in range(600)})
+    _commit(t, 2, {k: float(k) * 2 for k in range(0, 600, 3)})  # updates
+    before = _scan_rows(root)
+    assert len(before) == 600
+
+    t8 = rescale_table(load_table(root, commit_user="w"), 8)
+    assert t8.store.options.bucket == 8
+    assert _scan_rows(root) == before
+    # gets route with the new bucket count
+    q = LocalTableQuery(t8)
+    got = q.get_batch([(3,), (123,), (10**9,)]).to_pylist()
+    assert got[0] == (3, 6.0) and got[1] == (123, 246.0) and got[2] is None
+
+    t2 = rescale_table(t8, 2)  # shrink leg
+    assert t2.store.options.bucket == 2
+    assert _scan_rows(root) == before
+
+
+def test_rescale_pinned_reader_stays_bit_identical(tmp_path):
+    root = str(tmp_path / "t")
+    _mk_table(root, buckets=4)
+    t = load_table(root, commit_user="w")
+    _commit(t, 1, {k: float(k) for k in range(300)})
+    pinned_sid = t.store.snapshot_manager.latest_snapshot_id()
+
+    def read_at(sid):
+        s = load_table(root, commit_user="r").store
+        plan = s.new_scan().with_snapshot(sid).plan()
+        rows = []
+        for partition, pbuckets in sorted(plan.grouped().items()):
+            for bucket, files in sorted(pbuckets.items()):
+                b = s.read_bucket(partition, bucket, files, drop_delete=True)
+                rows.extend(zip(b.column("k").values.tolist(), b.column("v").values.tolist()))
+        return sorted(rows)
+
+    want = read_at(pinned_sid)
+    assert len(want) == 300
+    rescale_table(t, 8)
+    # re-plan AT the pinned snapshot after the rescale committed: the old
+    # files are logically deleted but still on disk — bit-identical view
+    assert read_at(pinned_sid) == want
+
+
+def test_rescale_reuses_data_file_cache(tmp_path):
+    """Satellite: the rewrite reads ride the PR 1 data-file cache. The key is
+    content-addressed (uuid-unique file name), not bucket-path-addressed, so
+    files decoded by any earlier reader are hits, not cold re-decodes."""
+    root = str(tmp_path / "t")
+    _mk_table(root, buckets=4)
+    t = load_table(root, commit_user="w")
+    _commit(t, 1, {k: float(k) for k in range(800)})
+    # warm: a full merged read through a SEPARATE table instance (a serving
+    # scan) decodes every data file into the shared cache
+    _scan_rows(root)
+    g = registry.group("cache", cache="data-file")
+    hits0 = g.counter("hits").count
+    _, msgs, rows = rescale_messages(load_table(root, commit_user="w"), 8)
+    assert rows == 800 and msgs
+    assert g.counter("hits").count > hits0  # rewrite re-decoded nothing cold
+
+
+def test_query_probe_buckets_follow_served_snapshot(tmp_path):
+    """A live query object built pre-rescale re-routes its probes with the
+    bucket count OF THE SNAPSHOT IT SERVES after refresh() — no silent-miss
+    window from a stale construction-time option."""
+    root = str(tmp_path / "t")
+    _mk_table(root, buckets=4)
+    t = load_table(root, commit_user="w")
+    _commit(t, 1, {k: float(k) for k in range(400)})
+    q = LocalTableQuery(t)
+    assert q._probe_buckets == 4
+    assert q.get_batch([(7,)]).to_pylist()[0] == (7, 7.0)
+
+    rescale_table(t, 16)
+    q.refresh()
+    assert q._probe_buckets == 16
+    got = q.get_batch([(7,), (399,), (12345,)]).to_pylist()
+    assert got[0] == (7, 7.0) and got[1] == (399, 399.0) and got[2] is None
+
+
+# ---------------------------------------------------------------------------
+# cross-worker rescale: coordinator-driven, epoch-fenced, atomic routing
+# ---------------------------------------------------------------------------
+def test_cross_worker_rescale_under_cluster(cluster_table, tmp_path):
+    g = cluster_metrics()
+    rescales0 = g.counter("rescales").count
+    coord = _coordinator(cluster_table, workers=2)
+    agents, cli = [], None
+    try:
+        agents = [_agent(cluster_table, coord, w, tmp_path, serve=True) for w in range(2)]
+        for _ in range(2):
+            for a in agents:
+                assert a.ingest_round()
+        expect = {k for a in agents for ks in a.landed_by_bucket.values() for k in ks}
+        before = _scan_rows(cluster_table)
+        assert {k for k, _ in before} == expect
+
+        r = coord.handle("rescale", {"new_buckets": 8})
+        assert r["started"], r
+        _drive_rescale(coord, agents)
+        assert coord.num_buckets == 8
+        assert load_table(cluster_table, commit_user="chk").store.options.bucket == 8
+        assert _scan_rows(cluster_table) == before  # zero lost / dup rows
+        assert g.counter("rescales").count == rescales0 + 1
+        # the fleet speaks the new layout: fresh rounds land at 8 buckets
+        for a in agents:
+            assert a.num_buckets == 8
+            assert a.ingest_round()
+        # routed gets at the new count match the oracle
+        cli = ClusterClient(load_table(cluster_table, commit_user="cli"), coord.host, coord.port)
+        assert cli.num_buckets == 8
+        keys = sorted(expect)[:16] + [10**9]
+        oracle = LocalTableQuery(load_table(cluster_table, commit_user="oracle"))
+        want = []
+        for k in keys:
+            d = oracle.lookup((), (k,))
+            want.append(None if d is None else tuple(d.to_pylist()[0]))
+        deadline = time.monotonic() + 20.0
+        rows = cli.get_batch(keys)
+        while rows != want and time.monotonic() < deadline:
+            time.sleep(0.2)
+            rows = cli.get_batch(keys)
+        assert rows == want
+    finally:
+        if cli is not None:
+            cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+def test_rescale_window_fences_and_gates(cluster_table, tmp_path):
+    """The one fencing round: an append admitted before start_rescale is
+    rejected stale at ship; new admits are denied with the `rescaling` flag
+    (the worker goes and executes its rewrite instead of queueing)."""
+    coord = _coordinator(cluster_table, workers=1)
+    a0 = None
+    try:
+        a0 = _agent(cluster_table, coord, 0, tmp_path)
+        assert a0.ingest_round()
+        epoch0, owned0 = a0.assignment()
+        # build a round's messages pre-rescale, ship them post-start
+        from paimon_tpu.data.batch import ColumnBatch
+        from paimon_tpu.table.write import TableWrite
+
+        fresh, _, _ = a0.keygen.take(set(owned0), 8)
+        ks = [k for b in owned0 for k in fresh[b]]
+        tw = TableWrite(a0.table)
+        tw.write(ColumnBatch.from_pydict(SCHEMA, {"k": ks, "v": [1.0] * len(ks)}))
+        msgs = [m.to_dict() for m in tw.prepare_commit()]
+        tw.close()
+
+        assert coord.start_rescale(8)["started"]
+        r = coord.handle(
+            "ship_commit",
+            {"worker": 0, "epoch": epoch0, "ident": 99, "kind": "append", "messages": msgs},
+        )
+        assert r["stale"] and r["sid"] is None
+        adm = coord.handle("admit", {"worker": 0, "ident": 100, "buckets": list(owned0)})
+        assert not adm["admitted"] and adm["rescaling"]
+        # double-start is refused while the window is open
+        assert not coord.start_rescale(16)["started"]
+        _drive_rescale(coord, [a0])
+        assert coord.num_buckets == 8
+        # post-rescale the gate reopens and rounds land at the new layout
+        assert a0.ingest_round()
+    finally:
+        if a0 is not None:
+            a0.close()
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# runtime worker scale-out (join steal) and scale-in (planned retire)
+# ---------------------------------------------------------------------------
+def test_scale_out_joiner_steals_even_share(cluster_table):
+    g = cluster_metrics()
+    handoffs0 = g.counter("handoffs").count
+    coord = _coordinator(cluster_table, workers=2)
+    try:
+        coord.handle("register", {"worker": 0, "incarnation": 0})
+        coord.handle("register", {"worker": 1, "incarnation": 0})
+        r2 = coord.handle("register", {"worker": 2, "incarnation": 0})
+        assert r2["buckets"], "joiner got nothing to do"
+        owned = [set(coord.assignment_of(w)[1]) for w in range(3)]
+        assert set().union(*owned) == {0, 1, 2, 3}
+        assert sum(len(o) for o in owned) == 4  # disjoint, nothing lost
+        assert all(o for o in owned)  # no donor stripped bare
+        assert g.counter("handoffs").count == handoffs0 + 1
+    finally:
+        coord.close()
+
+
+def test_planned_retire_hands_off_range(cluster_table, tmp_path):
+    g = cluster_metrics()
+    handoffs0 = g.counter("handoffs").count
+    coord = _coordinator(cluster_table, workers=2)
+    agents = []
+    try:
+        agents = [_agent(cluster_table, coord, w, tmp_path) for w in range(2)]
+        for a in agents:
+            a.start_heartbeats()
+            assert a.ingest_round()
+        retiree = set(coord.assignment_of(1)[1])
+        assert retiree
+        coord.request_retire(1)
+        deadline = time.monotonic() + 10.0
+        while not agents[1]._retire_flag and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert agents[1]._retire_flag  # heartbeat carried the drain order
+        agents[1].retire()
+        assert agents[1].retired
+        assert coord.assignment_of(1)[1] == []
+        assert retiree <= set(coord.assignment_of(0)[1])  # handed off whole
+        assert g.counter("handoffs").count == handoffs0 + 1
+        # the survivor ingests the inherited range; nothing is lost
+        assert agents[0].ingest_round()
+        expect = {k for a in agents for ks in a.landed_by_bucket.values() for k in ks}
+        assert {k for k, _ in _scan_rows(cluster_table)} == expect
+    finally:
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# read replicas for hot buckets
+# ---------------------------------------------------------------------------
+def _hot_cluster(tmp_path, threshold="1"):
+    root = str(tmp_path / "t")
+    _mk_table(
+        root,
+        **{
+            "cluster.replica.heat-threshold": threshold,
+            "cluster.replica.interval": "100 ms",
+        },
+    )
+    coord = _coordinator(root, workers=2)
+    agents = [_agent(root, coord, w, tmp_path, serve=True) for w in range(2)]
+    for a in agents:
+        a.start_heartbeats()
+        assert a.ingest_round()
+    cli = ClusterClient(load_table(root, commit_user="cli"), coord.host, coord.port)
+    return root, coord, agents, cli
+
+
+def _wait_replica(coord, cli, bucket, deadline_s=20.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if cli.replicas_of(bucket):
+            return cli.replicas_of(bucket)
+        time.sleep(0.1)
+        cli.refresh_route()
+    raise TimeoutError(
+        f"no replica granted for bucket {bucket}; "
+        f"ema={coord._heat_ema} thr={coord.replica_threshold}"
+    )
+
+
+def _oracle_rows(root, keys):
+    oracle = LocalTableQuery(load_table(root, commit_user="oracle"))
+    out = []
+    for k in keys:
+        d = oracle.lookup((), (k,))
+        out.append(None if d is None else tuple(d.to_pylist()[0]))
+    return out
+
+
+def test_hot_bucket_replica_grant_parity_and_promotion(tmp_path):
+    root, coord, agents, cli = _hot_cluster(tmp_path)
+    try:
+        hot = 0
+        hot_keys = [k for a in agents for k in a.landed_by_bucket.get(hot, [])]
+        assert hot_keys
+        want = _oracle_rows(root, hot_keys)
+        # hammer the hot bucket until the served rows converge AND the heat
+        # EMA crosses the threshold -> replica granted, route epoch pushed
+        deadline = time.monotonic() + 20.0
+        while cli.get_batch(hot_keys) != want and time.monotonic() < deadline:
+            time.sleep(0.1)
+        for _ in range(30):
+            cli.get_batch(hot_keys)
+        reps = _wait_replica(coord, cli, hot)
+        primary = coord._owner[hot]
+        assert reps and primary not in reps
+        # bit-identical: primary-served vs replica-served vs oracle
+        prim_rows = cli._call(primary, "get_batch", keys=[[k] for k in hot_keys], partition=[])["rows"]
+        rep_rows = cli._call(reps[0], "get_batch", keys=[[k] for k in hot_keys], partition=[])["rows"]
+        assert prim_rows == rep_rows
+        assert [None if r is None else tuple(r) for r in rep_rows] == want
+        replica_reads0 = cluster_metrics().counter("replica_reads").count
+        for _ in range(4):  # round-robin: both owners get picked
+            assert cli.get_batch(hot_keys) == want
+        assert cluster_metrics().counter("replica_reads").count > replica_reads0
+        # warm promotion: the primary dies -> the replica becomes primary
+        with coord._lock:
+            coord._reassign_dead(coord._slots[primary])
+        assert coord._owner[hot] == reps[0]
+        assert reps[0] not in coord._replicas.get(hot, [])
+        cli.refresh_route()
+        assert cli.get_batch(hot_keys) == want  # served by the promoted owner
+    finally:
+        cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+def test_replica_killed_mid_read_fails_over(tmp_path):
+    root, coord, agents, cli = _hot_cluster(tmp_path)
+    try:
+        hot = 0
+        hot_keys = [k for a in agents for k in a.landed_by_bucket.get(hot, [])]
+        want = _oracle_rows(root, hot_keys)
+        deadline = time.monotonic() + 20.0
+        while cli.get_batch(hot_keys) != want and time.monotonic() < deadline:
+            time.sleep(0.1)
+        for _ in range(30):
+            cli.get_batch(hot_keys)
+        reps = _wait_replica(coord, cli, hot)
+        rep_wid = reps[0]
+        # SIGKILL the replica's serving plane: its socket now refuses — every
+        # round-robin pick of the corpse must fail over to the primary and
+        # still answer bit-identically
+        agents[rep_wid].server.close()
+        agents[rep_wid].server = None
+        for _ in range(6):  # ring size 2: the dead pick is exercised
+            assert cli.get_batch(hot_keys) == want
+    finally:
+        cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+def test_randomized_replica_consistency(tmp_path):
+    """Randomized parity suite: across snapshot advances with replicas
+    active, every client read (round-robining primary/replica) stays
+    bit-identical to the single-process oracle — present and absent keys."""
+    root, coord, agents, cli = _hot_cluster(tmp_path)
+    try:
+        rng = np.random.default_rng(7)
+        hot = 0
+        for _ in range(25):
+            cli.get_batch([int(k) for k in bucket_key_pools(4, 0, 8)[hot]])
+        _wait_replica(coord, cli, hot)
+        for _round in range(4):
+            for a in agents:
+                assert a.ingest_round()  # snapshot advances
+            landed = sorted({k for a in agents for ks in a.landed_by_bucket.values() for k in ks})
+            sample = [int(landed[i]) for i in rng.integers(0, len(landed), 12)]
+            sample += [int(10**8 + v) for v in rng.integers(0, 1000, 3)]  # absent
+            want = _oracle_rows(root, sample)
+            deadline = time.monotonic() + 20.0
+            rows = cli.get_batch(sample)
+            while rows != want and time.monotonic() < deadline:
+                time.sleep(0.15)  # serving follows the commit subscription
+                rows = cli.get_batch(sample)
+            assert rows == want, f"round {_round} diverged"
+            assert cli.get_batch(sample) == want  # the other ring member
+    finally:
+        cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+# ---------------------------------------------------------------------------
+# push-based route invalidation
+# ---------------------------------------------------------------------------
+def test_route_epoch_pushed_through_worker_replies(cluster_table, tmp_path):
+    coord = _coordinator(cluster_table, workers=2)
+    agents, cli = [], None
+    try:
+        agents = [_agent(cluster_table, coord, w, tmp_path, serve=True) for w in range(2)]
+        for a in agents:
+            a.start_heartbeats()
+            assert a.ingest_round()
+        cli = ClusterClient(load_table(cluster_table, commit_user="cli"), coord.host, coord.port)
+        e0 = cli.route_epoch
+        assert e0 > 0
+        moved = set(coord.assignment_of(1)[1])
+        # silence worker 1's heartbeats first: a heartbeat from a worker
+        # declared dead triggers a re-register, which steals its home range
+        # BACK (by design) and would race the ownership assertion below
+        agents[1]._stop.set()
+        agents[1]._hb_thread.join(timeout=5)
+        with coord._lock:
+            coord._reassign_dead(coord._slots[1])  # bumps the route epoch
+        # worker 0's heartbeat picks up the bump; its next serving reply
+        # piggybacks it; the client marks dirty and refreshes on the next
+        # routing decision — no rejected call, no timeout window
+        keys = [k for ks in agents[0].landed_by_bucket.values() for k in ks[:2]]
+        deadline = time.monotonic() + 10.0
+        while cli.route_epoch == e0 and time.monotonic() < deadline:
+            cli.get_batch(keys)
+            time.sleep(0.1)
+        assert cli.route_epoch > e0
+        cli.get_batch(keys)  # the dirty flag forced the refresh
+        assert all(cli.owner_of(b) == 0 for b in moved)
+    finally:
+        if cli is not None:
+            cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
