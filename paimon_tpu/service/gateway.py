@@ -348,16 +348,23 @@ class Gateway:
         buckets = bucket_ids(probe, self._table.schema.bucket_keys, client.num_buckets)
         out: list = [None] * len(ks)
         by_wid: dict[int, list[int]] = {}
-        wid_bucket: dict[int, int] = {}
+        wid_bucket_keys: dict[int, dict[int, int]] = {}
         for i, b in enumerate(buckets.tolist()):
             wid = self._owner_for(int(b))
             by_wid.setdefault(wid, []).append(i)
-            wid_bucket.setdefault(wid, int(b))
+            counts = wid_bucket_keys.setdefault(wid, {})
+            counts[int(b)] = counts.get(int(b), 0) + 1
         for wid, idxs in by_wid.items():
+            # Hedge/failover hint: the bucket carrying the most keys in this
+            # worker's group. Best-effort for mixed-bucket batches — any
+            # worker serves any key off the shared FS, so reads stay correct;
+            # only the replica-first warm-view preference is approximate.
+            counts = wid_bucket_keys[wid]
+            hint = max(counts, key=counts.get) if counts else None
             r = self._rpc_failover(
                 wid,
                 "get_batch",
-                _bucket=wid_bucket.get(wid),
+                _bucket=hint,
                 keys=[list(ks[i]) for i in idxs],
                 partition=list(partition),
             )

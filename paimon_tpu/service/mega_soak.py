@@ -1087,6 +1087,11 @@ class MegaSoakSupervisor:
                 sub_id = None
                 time.sleep(0.3)
         cell["gw_sub_rows"] = rows
+        if sub_id is not None:
+            try:
+                gw.subscribe_close(sub_id)
+            except Exception:
+                pass
 
     def _elastic_loop(self, cell, t_start: float, deadline: float) -> None:
         """The elastic-topology axis on cluster cells: one live rescale
@@ -1133,11 +1138,6 @@ class MegaSoakSupervisor:
                         self.counts["workers_retired"] += 1
             except Exception:
                 cell["errors"].append(f"elastic {act} failed:\n{traceback.format_exc()}")
-        if sub_id is not None:
-            try:
-                gw.subscribe_close(sub_id)
-            except Exception:
-                pass
 
     # ---- one cell ------------------------------------------------------
     def _census(self, sc: MegaScenario) -> dict[str, int]:

@@ -451,7 +451,8 @@ class AdaptiveCompactorService:
         to decide which buckets deserve read replicas — the same LUDA-style
         heat signal that already orders the compaction queue."""
         out: dict[int, float] = {}
-        for (_, bucket), rate in self._rate.items():
+        # Snapshot: the observation loop mutates _rate concurrently.
+        for (_, bucket), rate in list(self._rate.items()):
             out[bucket] = out.get(bucket, 0.0) + rate
         return out
 
