@@ -489,6 +489,36 @@ def bench_sql_cluster() -> list:
     return mod.run_headline(iters=2)
 
 
+def bench_sql_shuffle() -> list:
+    """Single-process high-cardinality GROUP BY no-regression guard
+    (benchmarks/sql_shuffle_bench.py is the dedicated 4-worker shuffle rig
+    with the >=2x coordinator-combine-stage headline): times the LOCAL
+    segment-reduce path at >=100k distinct groups — the pure path the
+    shuffle plane must not disturb — asserted within ~1.1x the measured
+    baseline."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "sql_shuffle_bench.py")
+    spec = importlib.util.spec_from_file_location("_sql_shuffle_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_local_headline(iters=2)
+
+
+def bench_scan_plan() -> list:
+    """Scan-planning scale spot-check (benchmarks/scan_plan_bench.py is the
+    dedicated rig): plan latency over a 10k-entry live manifest set built
+    through the real commit path, full and partition-pruned, against a
+    stated metadata-only budget."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "scan_plan_bench.py")
+    spec = importlib.util.spec_from_file_location("_scan_plan_bench", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.run_headline(iters=2)
+
+
 def bench_gateway() -> list:
     """Gateway hedged-read spot-check (benchmarks/gateway_bench.py is the
     dedicated rig): one latency-shamed worker in a 2-worker cluster, the
@@ -628,6 +658,8 @@ def main():
         encode_rows = bench_encode()
         mesh_rows = bench_mesh()
         sql_cluster_rows = bench_sql_cluster()
+        sql_shuffle_rows = bench_sql_shuffle()
+        scan_plan_rows = bench_scan_plan()
         gateway_rows = bench_gateway()
         elastic_rows = bench_elastic()
         resilience_row = bench_resilience()
@@ -686,6 +718,10 @@ def main():
             print(json.dumps(dict(mrow, platform=_PLATFORM)))
         for qrow in sql_cluster_rows:
             print(json.dumps(dict(qrow, platform=_PLATFORM)))
+        for shrow in sql_shuffle_rows:
+            print(json.dumps(dict(shrow, platform=_PLATFORM)))
+        for sprow in scan_plan_rows:
+            print(json.dumps(dict(sprow, platform=_PLATFORM)))
         for grow in gateway_rows:
             print(json.dumps(dict(grow, platform=_PLATFORM)))
         for elrow in elastic_rows:

@@ -97,6 +97,11 @@ class Histogram:
     def min(self) -> float:
         return min(self._values) if self._values else 0.0
 
+    @property
+    def last(self) -> float:
+        """Most recent sample — per-operation readout for benches/tests."""
+        return self._values[-1] if self._values else 0.0
+
 
 class MetricGroup:
     def __init__(self, name: str, tags: dict[str, str] | None = None):
@@ -372,10 +377,22 @@ def sql_metrics() -> MetricGroup:
     keys travelled coordinator-ward as dictionary codes + pruned pools,
     never expanded), rows_streamed (non-aggregate rows gathered back
     Arrow-encoded), fragment_cache_hits (aggregate queries answered from
-    the coordinator's fragment-result cache — same snapshot, same fragment
-    signature — without any worker RPC); histograms: scatter_ms (dispatch +
-    worker execution + gather wall millis per query), combine_ms
-    (coordinator-side code-domain combine wall millis per aggregate query).
+    the coordinator's fragment-result cache — same snapshot, same
+    bucket-layout epoch, same fragment signature — without any worker RPC),
+    shuffle_rounds (GROUP BY queries that combined via worker↔worker
+    shuffle exchange instead of at the coordinator), parts_exchanged
+    (nonempty group-domain hash partitions shipped worker→worker over
+    exchange_part), exchange_bytes (approximate wire bytes of those
+    parts), shuffle_retried (shuffle recovery actions: a range re-homed
+    off a dead owner, or a missing part reshipped/re-executed);
+    histograms: scatter_ms (dispatch + worker execution + gather wall
+    millis per query), combine_ms (coordinator-side SERIAL combine stage
+    millis per aggregate query: partial payload decode + second-stage
+    unify/reduce — or, under shuffle, reduced-range decode + concat —
+    + final batch assembly; RPC wait excluded, so classic vs shuffle
+    readings compare the exact work the shuffle plane moves off the
+    coordinator), shuffle_ms (scatter + exchange + per-range fold +
+    concat wall millis per shuffled aggregate).
     Resolved per call so registry.reset() in tests swaps the group out."""
     return registry.group("sql")
 

@@ -23,6 +23,10 @@ The pieces:
   prune_pool      — drop pool entries no surviving code references before a
                     dictionary page is written (file dictionaries stay
                     minimal across compaction chains)
+  partition_rows  — value-hash shuffle partitioner (ISSUE 20): rows hash
+                    over pool VALUES gathered through their codes, so two
+                    workers with disjoint code spaces agree on the shuffle
+                    range of every shared group key
 
 `merge.dict-domain` (default off) gates the reader that produces code-backed
 columns; PAIMON_TPU_DICT_DOMAIN overrides in either direction (the
@@ -51,6 +55,10 @@ __all__ = [
     "prune_pool",
     "cache_usable",
     "encode_column",
+    "pool_value_hashes",
+    "partition_rows",
+    "partition_rows_np",
+    "partition_rows_jax",
 ]
 
 DEFAULT_POOL_LIMIT = 1 << 20  # codes stay far inside uint32/int32 range
@@ -308,3 +316,105 @@ def prune_pool(
     remap = np.cumsum(used, dtype=np.int64) - 1
     remap[~used] = 0  # dead entries: clip to a harmless rank
     return pool[used], remap_codes(remap.astype(np.uint32), codes)
+
+
+# ---------------------------------------------------------------------------
+# value-hash shuffle partitioner (ISSUE 20): the distributed-aggregation
+# exchange keys. Hashes are pure functions of VALUES — never of pool ranks,
+# process ids, or PYTHONHASHSEED — so every worker routes a given group key
+# to the same shuffle range despite per-worker code spaces. Cost discipline:
+# one hash per POOL entry (O(|pool|) host work), then an O(rows) uint32
+# gather + mix, numpy engine with a bit-identical JAX twin.
+# ---------------------------------------------------------------------------
+_NULL_HASH = 0x9E3779B9  # the NULL sentinel's fixed hash slot
+_HASH_SEED = 2166136261  # FNV-1a offset basis
+_HASH_PRIME = 16777619  # FNV-1a prime (column mixing step)
+
+
+def _fmix32(xp, h):
+    """murmur3's 32-bit finalizer — pure uint32 shifts/multiplies, so the
+    numpy and jax twins are bit-identical by construction."""
+    h = h ^ (h >> 16)
+    h = h * xp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * xp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def pool_value_hashes(pool: np.ndarray) -> np.ndarray:
+    """One deterministic uint32 hash per pool entry, plus a trailing slot
+    for the NULL sentinel code ``len(pool)``. Object entries hash their
+    utf-8 bytes (crc32 — stable across processes); fixed-width entries hash
+    canonicalized 64-bit views (-0.0 folds into +0.0 and NaNs collapse to
+    one pattern, mirroring np.unique's equality so unify_pools and the
+    partitioner never disagree about which values are the same group)."""
+    import zlib
+
+    n = len(pool)
+    out = np.empty(n + 1, dtype=np.uint32)
+    out[n] = np.uint32(_NULL_HASH)
+    if n == 0:
+        return out
+    if pool.dtype == np.dtype(object):
+        for i, v in enumerate(pool):
+            if isinstance(v, str):
+                b = v.encode("utf-8")
+            elif isinstance(v, (bytes, bytearray)):
+                b = bytes(v)
+            else:
+                b = repr(v).encode("utf-8")
+            out[i] = zlib.crc32(b) & 0xFFFFFFFF
+        return out
+    kind = pool.dtype.kind
+    if kind == "f":
+        x = pool.astype(np.float64, copy=True)
+        x += 0.0  # -0.0 + 0.0 == +0.0: signed zeros hash together
+        bits = x.view(np.uint64).copy()
+        bits[np.isnan(x)] = np.uint64(0x7FF8000000000000)  # one NaN pattern
+    elif kind in "Mm":
+        bits = pool.view(np.int64).astype(np.uint64)
+    elif kind == "u":
+        bits = pool.astype(np.uint64)
+    else:  # signed ints / bools: two's-complement 64-bit view
+        bits = pool.astype(np.int64).view(np.uint64)
+    lo = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (bits >> np.uint64(32)).astype(np.uint32)
+    out[:n] = _fmix32(np, lo ^ _fmix32(np, hi))
+    return out
+
+
+def partition_rows_np(tables: Sequence[np.ndarray], codes_list, num_parts: int) -> np.ndarray:
+    h = np.full(len(codes_list[0]), _HASH_SEED, dtype=np.uint32)
+    for tbl, codes in zip(tables, codes_list):
+        h = _fmix32(np, (h ^ tbl.take(codes.astype(np.int64, copy=False))) * np.uint32(_HASH_PRIME))
+    return (h % np.uint32(num_parts)).astype(np.uint32)
+
+
+def partition_rows_jax(tables, codes_list, num_parts: int):
+    import jax.numpy as jnp
+
+    h = jnp.full(len(codes_list[0]), _HASH_SEED, dtype=jnp.uint32)
+    for tbl, codes in zip(tables, codes_list):
+        gathered = jnp.take(jnp.asarray(tbl), jnp.asarray(codes.astype(np.int64, copy=False)), axis=0)
+        h = _fmix32(jnp, (h ^ gathered) * jnp.uint32(_HASH_PRIME))
+    return h % jnp.uint32(num_parts)
+
+
+def partition_rows(pools: Sequence[np.ndarray], codes_list, num_parts: int) -> np.ndarray:
+    """(n,) uint32 shuffle-range id per row: per-column value hashes
+    (pool_value_hashes, NULL sentinel included) gather through the uint32
+    codes and mix across key columns. Engine-routed like remap_codes —
+    numpy by default, the JAX twin under PAIMON_TPU_DICT_ENGINE=jax; both
+    are bit-identical (pure uint32 integer mixing). Collisions only skew
+    range balance, never correctness: a value maps to exactly one range."""
+    if not codes_list:
+        return np.zeros(0, np.uint32)
+    if num_parts <= 1:
+        return np.zeros(len(codes_list[0]), np.uint32)
+    tables = [pool_value_hashes(p) for p in pools]
+    if os.environ.get("PAIMON_TPU_DICT_ENGINE") == "jax":
+        return np.asarray(partition_rows_jax(tables, codes_list, num_parts)).astype(
+            np.uint32, copy=False
+        )
+    return partition_rows_np(tables, codes_list, num_parts)

@@ -715,11 +715,31 @@ class CoreOptions:
         "sql.cluster.fragment-cache",
         True,
         "Distributed SQL: cache aggregate fragment partials at the "
-        "coordinator keyed on (snapshot id, fragment signature — semantic "
-        "template plus every planned split). A repeated aggregate over an "
-        "unchanged table answers without any worker RPC "
-        "(sql{fragment_cache_hits}); any plan at a newer snapshot purges "
-        "the table's stale entries.",
+        "coordinator keyed on (snapshot id, bucket-layout epoch, fragment "
+        "signature — semantic template plus every planned split). A "
+        "repeated aggregate over an unchanged table answers without any "
+        "worker RPC (sql{fragment_cache_hits}); a plan at a newer snapshot "
+        "or under a rescaled bucket layout purges the table's stale "
+        "entries.",
+    )
+    SQL_CLUSTER_SHUFFLE_THRESHOLD = ConfigOption.int_(
+        "sql.cluster.shuffle.threshold",
+        50_000,
+        "Distributed SQL: estimated distinct-group count above which a "
+        "GROUP BY combines via worker↔worker shuffle instead of at the "
+        "coordinator. The estimate comes from the planned splits' file "
+        "stats (integer key: global max-min+1; otherwise row count) at "
+        "zero extra IO. The PAIMON_TPU_SQL_SHUFFLE env var forces the "
+        "path on/off regardless of the estimate (the verify stage runs "
+        "the parity suite both ways).",
+    )
+    SQL_CLUSTER_SHUFFLE_RANGES = ConfigOption.int_(
+        "sql.cluster.shuffle.ranges",
+        0,
+        "Distributed SQL: number R of group-domain hash ranges a shuffle "
+        "aggregation partitions into (each range owner unifies and "
+        "reduces its range; the coordinator only concatenates). 0 = one "
+        "range per live worker, the balanced default.",
     )
     GATEWAY_MAX_INFLIGHT = ConfigOption.int_(
         "gateway.max-inflight",

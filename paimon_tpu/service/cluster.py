@@ -1113,6 +1113,24 @@ class _WorkerServer:
         self._scan_slots = threading.BoundedSemaphore(
             max(1, int(table.store.options.options.get(CoreOptions.SQL_CLUSTER_SCAN_MAX_INFLIGHT)))
         )
+        # shuffle exchange plane (ISSUE 20). Admission is a SEPARATE
+        # semaphore from _scan_slots: a scan_frag HOLDS its scan slot while
+        # delivering parts to peer owners, so shared admission would
+        # livelock a fleet of mutually-delivering workers into circular
+        # BUSY retries. Buffers are TTL-GC'd; a coordinator that finishes
+        # cleanly closes them explicitly (exchange_close).
+        self._exch_slots = threading.BoundedSemaphore(
+            max(2, 2 * int(table.store.options.options.get(CoreOptions.SQL_CLUSTER_SCAN_MAX_INFLIGHT)))
+        )
+        self._exch_lock = threading.Lock()
+        # inbound: qid -> {"ts", "parts": {(range, src): wire partial}} —
+        # delivery is keyed, so hedged/re-executed duplicates overwrite
+        # with bit-identical content instead of double-counting
+        self._exch_in: dict[str, dict] = {}
+        # outbound (the reship buffer): (qid, src) -> {"ts", "parts":
+        # {range: wire partial}} — survives the range owner, not the source
+        self._exch_out: dict[tuple, dict] = {}
+        self._peer_conns: dict[tuple, _RpcConn] = {}
         # one hub per worker process: the refresher AND every routed
         # subscription share its decode-once tailer; the server owns its
         # lifecycle (for_table hubs outlive their subscribers by design)
@@ -1157,7 +1175,14 @@ class _WorkerServer:
     def _dispatch(self, method: str, req: dict) -> dict:
         if method == "ping":
             return {"buckets": sorted(self._owned())}
-        if self._closed and method in ("get_batch", "subscribe_open", "scan_frag"):
+        if self._closed and method in (
+            "get_batch",
+            "subscribe_open",
+            "scan_frag",
+            "exchange_part",
+            "exchange_combine",
+            "exchange_reship",
+        ):
             # shutdown race (ISSUE 17 bugfix hunt): a request landing while
             # close() tears down the hub must answer a TYPED shed, not leak
             # a fresh hub/tailer out of a re-created subscription
@@ -1199,6 +1224,14 @@ class _WorkerServer:
             return self._join_part(req)
         if method == "scan_frag":
             return self._scan_frag(req)
+        if method == "exchange_part":
+            return self._exchange_part(req)
+        if method == "exchange_combine":
+            return self._exchange_combine(req)
+        if method == "exchange_reship":
+            return self._exchange_reship(req)
+        if method == "exchange_close":
+            return self._exchange_close(req)
         raise ValueError(f"unknown method {method!r}")
 
     def _scan_frag(self, req: dict) -> dict:
@@ -1220,9 +1253,234 @@ class _WorkerServer:
             frag = decode_fragment(req["frag"])
             part = execute_scan_fragment(self.table, frag)
             self._metrics().counter("scan_frags_served").inc()
+            if frag.get("shuffle") and part["mode"] == "agg":
+                return {"partial": self._shuffle_out(frag, part)}
             return {"partial": encode_partial(part, code_domain=bool(frag.get("code_domain", True)))}
         finally:
             self._scan_slots.release()
+
+    # ---- shuffle exchange plane (ISSUE 20) ------------------------------
+    _EXCHANGE_TTL_S = 600.0
+
+    def _shuffle_out(self, frag: dict, part: dict) -> dict:
+        """Shuffle-source tail of scan_frag: hash-partition the fragment
+        partial by group-key VALUE into the plan's R ranges
+        (table.query.partition_agg_partial), buffer every nonempty part for
+        reship, deliver each to its range owner, and answer a summary whose
+        `sent` map is the coordinator's per-range expectation source. A
+        delivery that fails is swallowed — the part stays buffered and the
+        coordinator reships/recovers at combine time; failing the scan here
+        would throw away a perfectly good partial."""
+        from ..sql.cluster import encode_partial, wire_partial_bytes
+        from ..table.query import partition_agg_partial
+
+        qid, src = frag["shuffle"]["qid"], frag["src"]
+        ranges = frag["shuffle"]["ranges"]
+        code_domain = bool(frag.get("code_domain", True))
+        parts = partition_agg_partial(part, len(ranges))
+        wire = {
+            r: encode_partial(pt, code_domain=code_domain)
+            for r, pt in enumerate(parts)
+            if pt is not None
+        }
+        now = time.monotonic()
+        with self._exch_lock:
+            self._gc_exchange_locked()
+            self._exch_out[(qid, src)] = {"ts": now, "parts": wire}
+        sent: dict = {}
+        nbytes = 0
+
+        def _ship(r, wp):
+            try:
+                self._deliver_part(ranges[r][1], int(ranges[r][2]), qid, r, src, wp)
+            except (ConnectionError, OSError, TimeoutError):
+                pass  # dead/slow owner: coordinator heals it at combine time
+
+        # concurrent deliveries: each remote part pays a full serialize +
+        # round-trip, and a source owes R-1 of them — overlapping them keeps
+        # the scatter's critical path at ~one part instead of R-1
+        remote = []
+        for r, wp in wire.items():
+            nbytes += wire_partial_bytes(wp)
+            sent[str(r)] = int(parts[r]["rows"])
+            if (ranges[r][1], int(ranges[r][2])) == (self.host, self.port):
+                _ship(r, wp)  # self-delivery is a buffer insert, no wire
+            else:
+                remote.append((r, wp))
+        if len(remote) == 1:
+            _ship(*remote[0])
+        elif remote:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=len(remote)) as pool:
+                for f in [pool.submit(_ship, r, wp) for r, wp in remote]:
+                    f.result()
+        self._metrics().counter("exchange_parts_sent").inc(len(wire))
+        return {
+            "mode": "shuffle",
+            "src": src,
+            "rows": int(part["rows"]),
+            "rows_reduced_device": int(part.get("rows_reduced_device", 0)),
+            "sent": sent,
+            "bytes": int(nbytes),
+        }
+
+    def _deliver_part(
+        self, host: str, port: int, qid: str, rng: int, src: str, wp: dict, busy_wait_s: float = 10.0
+    ) -> None:
+        """Ship one buffered part to a range owner. Self-delivery drops
+        straight into the inbound buffer (no wire); remote delivery absorbs
+        typed-BUSY with the advertised backoff and raises on a dead peer."""
+        if (host, int(port)) == (self.host, self.port):
+            with self._exch_lock:
+                box = self._exch_in.setdefault(qid, {"ts": time.monotonic(), "parts": {}})
+                box["parts"][(int(rng), src)] = wp
+                box["ts"] = time.monotonic()
+            return
+        deadline = time.monotonic() + busy_wait_s
+        while True:
+            conn = self._peer_conn(host, int(port))
+            try:
+                r = conn.call("exchange_part", qid=qid, rng=int(rng), src=src, part=wp)
+            except (ConnectionError, OSError):
+                self._drop_peer(host, int(port))
+                raise
+            if not r.get("busy"):
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"exchange peer {host}:{port} still BUSY after {busy_wait_s}s")
+            time.sleep(float(r.get("retry_after_ms", 50)) / 1000.0)
+
+    def _peer_conn(self, host: str, port: int) -> _RpcConn:
+        with self._exch_lock:
+            conn = self._peer_conns.get((host, port))
+        if conn is not None:
+            return conn
+        fresh = _RpcConn(host, port, timeout=10.0)  # connect outside the lock
+        with self._exch_lock:
+            won = self._peer_conns.setdefault((host, port), fresh)
+        if won is not fresh:
+            fresh.close()
+        return won
+
+    def _drop_peer(self, host: str, port: int) -> None:
+        with self._exch_lock:
+            conn = self._peer_conns.pop((host, port), None)
+        if conn is not None:
+            conn.close()
+
+    def _gc_exchange_locked(self) -> None:
+        cutoff = time.monotonic() - self._EXCHANGE_TTL_S
+        for q in [q for q, box in self._exch_in.items() if box["ts"] < cutoff]:
+            del self._exch_in[q]
+        for k in [k for k, box in self._exch_out.items() if box["ts"] < cutoff]:
+            del self._exch_out[k]
+
+    def _exchange_shed(self):
+        from ..metrics import soak_metrics
+        from .shed import ShedInfo
+
+        soak_metrics().counter("shed_requests").inc()
+        return ShedInfo(kind="sql", state="busy-exchange", retry_after_ms=50).to_payload()
+
+    def _exchange_part(self, req: dict) -> dict:
+        """Receive one shuffle part from a peer worker (keyed delivery:
+        (qid, range, src) — redelivery overwrites idempotently)."""
+        if not self._exch_slots.acquire(blocking=False):
+            return self._exchange_shed()
+        try:
+            with self._exch_lock:
+                self._gc_exchange_locked()
+                box = self._exch_in.setdefault(req["qid"], {"ts": time.monotonic(), "parts": {}})
+                box["parts"][(int(req["rng"]), req["src"])] = req["part"]
+                box["ts"] = time.monotonic()
+            self._metrics().counter("exchange_parts_received").inc()
+            return {}
+        finally:
+            self._exch_slots.release()
+
+    def _exchange_combine(self, req: dict) -> dict:
+        """Fold this worker's shuffle range: decode every EXPECTED part
+        from the inbound buffer and run the coordinator's own
+        combine_partials over them — the range's final reduction, answered
+        as one already-reduced partial. Parts still missing (delivery
+        failed in flight, or this worker is a fresh replacement owner) are
+        named so the coordinator can reship them."""
+        if not self._exch_slots.acquire(blocking=False):
+            return self._exchange_shed()
+        try:
+            from ..sql.cluster import combine_partials, decode_partial, encode_partial
+
+            qid, rng = req["qid"], int(req["rng"])
+            expect = list(req.get("expect") or [])
+            with self._exch_lock:
+                parts_map = dict(self._exch_in.get(qid, {}).get("parts", {}))
+            have = {src: parts_map.get((rng, src)) for src in expect}
+            missing = sorted(src for src, wp in have.items() if wp is None)
+            if missing:
+                return {"missing": missing}
+            group_cols = list(req.get("group_cols") or [])
+            kern = [tuple(k) for k in req.get("kern") or []]
+            projection = req.get("projection")
+            schema = (
+                self.table.row_type.project(list(projection))
+                if projection is not None
+                else self.table.row_type
+            )
+            parts = [decode_partial(have[src], schema, group_cols) for src in expect]
+            parts = [q for q in parts if q["rows"]]
+            if not parts:  # unreachable: senders never ship empty parts
+                raise ValueError(f"exchange_combine: no nonempty parts for range {rng}")
+            pools, codes, outs, anyv, first_pos = combine_partials(
+                parts, len(group_cols), kern, req.get("engine", "xla")
+            )
+            out_part = {
+                "mode": "agg",
+                "pools": pools,
+                "group_codes": codes,
+                "outs": outs,
+                "anyv": anyv,
+                "first_pos": first_pos,
+                "rows": int(len(first_pos)),
+                "rows_reduced_device": 0,  # the sources already accounted theirs
+            }
+            self._metrics().counter("exchange_combines_served").inc()
+            return {"partial": encode_partial(out_part, code_domain=bool(req.get("code_domain", True)))}
+        finally:
+            self._exch_slots.release()
+
+    def _exchange_reship(self, req: dict) -> dict:
+        """Re-send one buffered outbound part to a (possibly re-homed)
+        range owner. Delivery failure answers shipped=false instead of
+        raising: the coordinator's next move (re-execute the fragment)
+        is the same either way, and an error reply would surface as a
+        spurious RuntimeError in its recovery loop."""
+        if not self._exch_slots.acquire(blocking=False):
+            return self._exchange_shed()
+        try:
+            qid, src, rng = req["qid"], req["src"], int(req["rng"])
+            with self._exch_lock:
+                wp = self._exch_out.get((qid, src), {}).get("parts", {}).get(rng)
+            if wp is None:
+                return {"shipped": False}
+            try:
+                self._deliver_part(req["host"], int(req["port"]), qid, rng, src, wp)
+            except (ConnectionError, OSError, TimeoutError):
+                return {"shipped": False}
+            self._metrics().counter("exchange_parts_reshipped").inc()
+            return {"shipped": True}
+        finally:
+            self._exch_slots.release()
+
+    def _exchange_close(self, req: dict) -> dict:
+        """Drop a finished query's exchange buffers (best-effort; the TTL
+        GC catches whatever a dead coordinator leaves behind)."""
+        qid = req["qid"]
+        with self._exch_lock:
+            self._exch_in.pop(qid, None)
+            for k in [k for k in self._exch_out if k[0] == qid]:
+                del self._exch_out[k]
+        return {}
 
     def _subscribe_poll(self, req: dict) -> dict:
         from ..types import RowKind
@@ -1308,6 +1566,13 @@ class _WorkerServer:
 
     def close(self) -> None:
         self._closed = True
+        with self._exch_lock:
+            peer_conns = list(self._peer_conns.values())
+            self._peer_conns.clear()
+            self._exch_in.clear()
+            self._exch_out.clear()
+        for c in peer_conns:
+            c.close()
         for sub_id in list(self._subs):
             sub, _ = self._subs.pop(sub_id, (None, None))
             if sub is not None:
@@ -1964,6 +2229,73 @@ class ClusterClient:
             if time.monotonic() >= deadline:
                 raise TimeoutError(f"worker {wid} still BUSY after {busy_wait_s}s")
             time.sleep(float(r.get("retry_after_ms", 50)) / 1000.0)
+
+    # ---- shuffle exchange (ISSUE 20) ------------------------------------
+    def exchange_combine(
+        self,
+        wid: int,
+        qid: str,
+        rng: int,
+        expect: list,
+        group_cols,
+        kern,
+        engine: str,
+        code_domain: bool,
+        projection,
+        busy_wait_s: float = 10.0,
+    ) -> "tuple[dict | None, list]":
+        """Ask range owner `wid` to fold the expected parts of range `rng`
+        into one reduced partial. Returns (wire partial, []) on success or
+        (None, missing srcs) when the owner's inbound buffer has gaps —
+        the coordinator reships those and retries. BUSY absorbs with the
+        advertised backoff like scan_frag."""
+        deadline = time.monotonic() + busy_wait_s
+        while True:
+            r = self._call(
+                wid,
+                "exchange_combine",
+                qid=qid,
+                rng=int(rng),
+                expect=list(expect),
+                group_cols=list(group_cols),
+                kern=[list(k) for k in kern],
+                engine=engine,
+                code_domain=bool(code_domain),
+                projection=None if projection is None else list(projection),
+            )
+            if r.get("busy"):
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"worker {wid} still BUSY after {busy_wait_s}s")
+                time.sleep(float(r.get("retry_after_ms", 50)) / 1000.0)
+                continue
+            if r.get("missing") is not None:
+                return None, list(r["missing"])
+            return r["partial"], []
+
+    def exchange_reship(self, wid: int, qid: str, rng: int, src: str, host: str, port: int) -> bool:
+        """Ask source worker `wid` to re-send its buffered part for
+        (qid, rng, src) to the range's current owner at host:port. False on
+        any failure (source dead, buffer gone, delivery failed) — the
+        caller's escalation (re-execute the fragment) is uniform."""
+        try:
+            r = self._call(
+                wid, "exchange_reship", qid=qid, rng=int(rng), src=src, host=host, port=int(port)
+            )
+        except (ConnectionError, OSError, TimeoutError, RuntimeError):
+            self.drop_conn(wid)
+            return False
+        if r.get("busy"):
+            return False
+        return bool(r.get("shipped"))
+
+    def exchange_close(self, qid: str, wids) -> None:
+        """Best-effort buffer release on every worker a shuffle touched;
+        the worker-side TTL GC covers whatever this misses."""
+        for wid in wids:
+            try:
+                self._call(wid, "exchange_close", qid=qid)
+            except Exception:  # noqa: BLE001 — cleanup must never fail a query
+                pass
 
     # ---- batched gets ---------------------------------------------------
     def get_batch(self, keys, partition: tuple = ()) -> list:

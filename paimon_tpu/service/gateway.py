@@ -473,7 +473,13 @@ class Gateway:
     def sql(self, statement: str, tenant: "str | None" = None):
         """Execute one SELECT (or EXPLAIN SELECT) — distributed through the
         cluster route when a client is attached (scan fragments hedged),
-        locally otherwise. Returns the result ColumnBatch."""
+        locally otherwise. Returns the result ColumnBatch.
+
+        Hedging composes with shuffle aggregation (ISSUE 20) untouched: a
+        hedged shuffle-mode fragment may run on two workers, but partial
+        content is deterministic and exchange delivery is keyed
+        (qid, range, src), so the duplicate's parts overwrite bit-identical
+        bytes at the range owners — never double-counted."""
         if self._catalog is None:
             raise ValueError("gateway has no catalog: SQL routing needs one")
         name = self._admit(tenant, "sql", len(statement))

@@ -33,6 +33,25 @@ coordinator reassigns the dead worker's buckets on missed heartbeats) and
 re-dispatches to the new owners until `sql.cluster.retry-timeout` expires.
 Typed-BUSY sheds (`sql.cluster.scan.max-inflight`) retry inside
 ClusterClient.scan_frag with the server-advertised backoff.
+
+Shuffle aggregation (ISSUE 20): when the estimated distinct-group count
+(from the planned splits' file stats — zero extra IO) exceeds
+`sql.cluster.shuffle.threshold`, the combine itself scales out. Each worker
+hash-partitions its fragment partial by group-key VALUE
+(ops.dicts.partition_rows — hashes agree across workers despite disjoint
+per-worker code spaces) into R ranges, ships partition i to range i's owner
+over the `exchange_part` RPC, and answers a summary instead of the partial.
+Every range owner then unifies pools and segment-reduces ITS range in the
+code domain (`exchange_combine`), so the coordinator only concatenates R
+already-reduced, value-disjoint ranges — no second reduce — and runs the
+shared _finish tail. first_pos min-reduces inside each range, so global
+first-appearance order survives the shuffle bit-exactly. A range owner
+dying mid-shuffle is healed under the same retry deadline: the range moves
+to a live worker, sources reship their buffered parts (`exchange_reship`),
+and a source whose buffer died with it re-executes its fragment — partial
+content is deterministic and delivery is keyed (qid, range, src), so
+re-runs and gateway hedges overwrite idempotently. PAIMON_TPU_SQL_SHUFFLE
+forces the path on/off (the verify stage runs the parity suite both ways).
 """
 
 from __future__ import annotations
@@ -72,10 +91,13 @@ __all__ = [
     "cluster_query",
     "clear_fragment_cache",
     "resolve_code_domain",
+    "resolve_shuffle",
     "encode_fragment",
     "decode_fragment",
     "encode_partial",
     "decode_partial",
+    "combine_partials",
+    "wire_partial_bytes",
 ]
 
 
@@ -93,6 +115,18 @@ def resolve_code_domain(enabled) -> bool:
     if isinstance(enabled, str):
         return enabled.strip().lower() in ("1", "on", "true")
     return bool(enabled)
+
+
+def resolve_shuffle() -> "bool | None":
+    """Tri-state shuffle override: PAIMON_TPU_SQL_SHUFFLE "1"/"on"/"true"
+    forces the exchange path, "0"/"off"/"false" forces coordinator combine,
+    unset (None) defers to the sql.cluster.shuffle.threshold estimate."""
+    env = os.environ.get("PAIMON_TPU_SQL_SHUFFLE", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -220,6 +254,28 @@ def decode_partial(d: dict, schema, group_cols=()) -> dict:
     return out
 
 
+def wire_partial_bytes(enc: dict) -> int:
+    """Approximate wire size of one ENCODED partial: b64 payload lengths
+    plus a rough object-pool/expanded-value estimate. The exchange_bytes
+    accounting — close enough for capacity planning without paying a second
+    json.dumps per shipped part."""
+    n = 0
+    for key in ("outs", "anyv", "group_codes"):
+        for d in enc.get(key) or []:
+            n += len(d.get("d", ""))
+    fp = enc.get("first_pos")
+    if isinstance(fp, dict):
+        n += len(fp.get("d", ""))
+    for pd in enc.get("pools") or []:
+        if "arr" in pd:
+            n += len(pd["arr"].get("d", ""))
+        else:
+            n += sum(len(str(v)) + 3 for v in pd.get("obj", ()))
+    for col in enc.get("vals") or []:
+        n += sum(len(str(v)) + 3 for v in col)
+    return n
+
+
 # ---------------------------------------------------------------------------
 # coordinator: plan -> scatter -> combine
 # ---------------------------------------------------------------------------
@@ -236,6 +292,7 @@ def _scatter(
     retry_ms: int,
     busy_wait_s: float,
     scan_frag_fn=None,
+    decorate=None,
 ):
     """Dispatch one fragment per owning worker, failover on dead
     connections: failed fragments' splits return to the pool, the route
@@ -245,7 +302,12 @@ def _scatter(
     `scan_frag_fn` swaps the per-fragment RPC (same (wid, frag,
     busy_wait_s) contract as ClusterClient.scan_frag) — the gateway
     threads its hedged variant through here so scan fragments race a
-    secondary worker past the hedge deadline."""
+    secondary worker past the hedge deadline.
+
+    `decorate(frag, wid, items)` rewrites each fragment dict just before
+    encoding, once per DISPATCH ATTEMPT (retries included) — the shuffle
+    planner mints a fresh source id per attempt so partial deliveries
+    from a dead attempt can never be mistaken for a live one's."""
     from ..metrics import sql_metrics
 
     g = sql_metrics()
@@ -253,6 +315,13 @@ def _scatter(
     deadline = time.monotonic() + retry_ms / 1000.0
     results: list[dict] = []
     round_no = 0
+
+    def _frag(wid, items):
+        frag = dict(template, splits=items)
+        if decorate is not None:
+            frag = decorate(frag, wid, items)
+        return encode_fragment(frag)
+
     while pending:
         g.counter("fragments").inc(len(pending))
         if round_no:
@@ -260,12 +329,7 @@ def _scatter(
         round_no += 1
         with ThreadPoolExecutor(max_workers=max(len(pending), 1)) as ex:
             futs = {
-                wid: ex.submit(
-                    call,
-                    wid,
-                    encode_fragment(dict(template, splits=items)),
-                    busy_wait_s,
-                )
+                wid: ex.submit(call, wid, _frag(wid, items), busy_wait_s)
                 for wid, items in pending.items()
             }
             failed: list = []
@@ -306,14 +370,177 @@ def _sentinel_remap(remap, pool_len: int, unified_len: int) -> np.ndarray:
     return np.concatenate([np.asarray(base, dtype=np.int64), [unified_len]]).astype(np.uint32)
 
 
+def _unify_partials(parts, n_group_cols: int):
+    """Put N decoded partials in ONE code space: unify each group column's
+    pools, re-rank every partial's codes through the sentinel-extended
+    gather tables, concatenate. Returns (pools, lane-stacked codes)."""
+    from ..ops.dicts import remap_codes, unify_pools
+
+    pools_f, codes_f = [], []
+    for gi in range(n_group_cols):
+        unified, remaps = unify_pools([q["pools"][gi] for q in parts])
+        mapped = [
+            remap_codes(
+                _sentinel_remap(rm, len(q["pools"][gi]), len(unified)),
+                q["group_codes"][gi],
+            )
+            for q, rm in zip(parts, remaps)
+        ]
+        pools_f.append(unified)
+        codes_f.append(np.concatenate(mapped).astype(np.uint32, copy=False))
+    return pools_f, codes_f
+
+
+def combine_partials(parts, n_group_cols: int, kern, engine: str):
+    """Second-stage reduce over N partials' rows, keyed on the UNIFIED code
+    domain; returns (pools, group codes, outs, anyv, first_pos) in the
+    _assemble_group_batch contract. Shared verbatim by the coordinator's
+    single-point combine and every shuffle range owner's per-range fold —
+    one reducer, one set of semantics, bit-identical results either way."""
+    from ..ops.aggregates import segment_reduce
+
+    pools_f, codes_f = _unify_partials(parts, n_group_cols)
+    rows = sum(len(q["first_pos"]) for q in parts)
+    lanes = np.column_stack(codes_f) if n_group_cols else np.zeros((rows, 1), np.uint32)
+    cols2 = [
+        (
+            np.concatenate([q["outs"][ki] for q in parts]),
+            np.concatenate([q["anyv"][ki] for q in parts]),
+        )
+        for ki in range(len(kern))
+    ]
+    fns2 = tuple(_KERNEL_COMBINE[fn] for fn, _ in kern)
+    pos = np.concatenate([q["first_pos"] for q in parts])
+    rep, outs, anyv, first_pos = segment_reduce(lanes, cols2, fns2, pos=pos, engine=engine)
+    return pools_f, [c[rep] for c in codes_f], outs, anyv, first_pos
+
+
+def _concat_ranges(parts, n_group_cols: int):
+    """Concatenate R already-reduced shuffle ranges — the coordinator's
+    ENTIRE combine under shuffle, and the reason the path scales: ranges
+    partition the group domain by VALUE, so no group key appears in two
+    parts and no second segment_reduce is needed. Only pool unification
+    (pure code re-ranking) runs here; outs/anyv/first_pos concatenate
+    as-is and _assemble_group_batch's stable argsort over the min-reduced
+    first_pos restores the exact single-process emission order."""
+    pools_f, codes_f = _unify_partials(parts, n_group_cols)
+    outs = [
+        np.concatenate([q["outs"][ki] for q in parts])
+        for ki in range(len(parts[0]["outs"]))
+    ]
+    anyv = [
+        np.concatenate([q["anyv"][ki] for q in parts])
+        for ki in range(len(parts[0]["anyv"]))
+    ]
+    first_pos = np.concatenate([q["first_pos"] for q in parts])
+    return pools_f, codes_f, outs, anyv, first_pos
+
+
+def _estimate_group_count(t, by_wid: dict, group_cols) -> int:
+    """Distinct-group upper estimate from the planned splits' file stats
+    (DataFileMeta valueStats min/max/nullCount), ZERO extra IO: an integer
+    key column estimates global max−min+1 (+1 when any file holds nulls);
+    a column with no usable stats falls back to the total row count.
+    Multi-column estimates multiply, clipped at total rows — GROUP BY a, b
+    can never exceed the row count. Deliberately an upper bound: crossing
+    the threshold costs one extra exchange round-trip, underestimating
+    costs a coordinator-side combine of millions of partial rows."""
+    total_rows = 0
+    num_kinds = {}
+    for g in group_cols:
+        try:
+            num_kinds[g] = np.dtype(t.row_type.field(g).type.numpy_dtype()).kind
+        except Exception:  # noqa: BLE001 — unknown type: row-count fallback
+            num_kinds[g] = "O"
+    lo: dict = {}
+    hi: dict = {}
+    nulls: dict = {}
+    usable = {g: num_kinds[g] in "iu" for g in group_cols}
+    for items in by_wid.values():
+        for _seq, sd in items:
+            for f in sd.get("files", []):
+                total_rows += int(f.get("rowCount") or 0)
+                vs = f.get("valueStats") or {}
+                for g in group_cols:
+                    if not usable[g]:
+                        continue
+                    st = vs.get(g)
+                    mn = st.get("min") if isinstance(st, dict) else None
+                    mx = st.get("max") if isinstance(st, dict) else None
+                    if not isinstance(mn, int) or not isinstance(mx, int):
+                        usable[g] = False  # pruned/absent stats: fall back
+                        continue
+                    lo[g] = mn if g not in lo else min(lo[g], mn)
+                    hi[g] = mx if g not in hi else max(hi[g], mx)
+                    if int((st or {}).get("nullCount") or 0) > 0:
+                        nulls[g] = True
+    est = 1
+    for g in group_cols:
+        if usable.get(g) and g in lo:
+            col = hi[g] - lo[g] + 1 + (1 if nulls.get(g) else 0)
+        else:
+            col = total_rows
+        est = min(est * max(col, 1), max(total_rows, 1))
+    return int(est if group_cols else 0)
+
+
+def _decide_shuffle(t, client, opts, group_cols, by_wid: dict):
+    """(shuffle on?, estimated groups, human reason) — the planner's call,
+    shared by cluster_query and EXPLAIN so the surfaced plan IS the
+    executed one. Needs a GROUP BY and ≥2 live workers (a lone worker
+    exchanging with itself only adds RPC hops); then the env force-switch,
+    then the stats estimate against sql.cluster.shuffle.threshold."""
+    from ..options import CoreOptions
+
+    est = _estimate_group_count(t, by_wid, group_cols) if group_cols else 0
+    if not group_cols:
+        return False, est, "no GROUP BY key"
+    live = client.live_workers()
+    if len(live) < 2:
+        return False, est, f"only {len(live)} live worker(s)"
+    forced = resolve_shuffle()
+    if forced is False:
+        return False, est, "forced off (PAIMON_TPU_SQL_SHUFFLE)"
+    if forced is True:
+        return True, est, "forced on (PAIMON_TPU_SQL_SHUFFLE)"
+    thresh = int(opts.get(CoreOptions.SQL_CLUSTER_SHUFFLE_THRESHOLD))
+    if est >= thresh:
+        return True, est, f"estimated groups {est} >= threshold {thresh}"
+    return False, est, f"estimated groups {est} < threshold {thresh}"
+
+
+def _range_table(client, opts) -> list:
+    """[[wid, host, port], ...] — shuffle range i's owner and serving
+    address under the CURRENT route. sql.cluster.shuffle.ranges sizes R
+    (0 = one range per live worker); ranges deal round-robin so every
+    worker folds ~1/W of the group domain."""
+    from ..options import CoreOptions
+
+    live = client.live_workers()
+    if not live:
+        raise ConnectionError("no live workers for shuffle range assignment")
+    nr = int(opts.get(CoreOptions.SQL_CLUSTER_SHUFFLE_RANGES)) or len(live)
+    return [[w, *client.addr_of(w)] for w in (live[i % len(live)] for i in range(nr))]
+
+
+# test seam: callable(stage, ctx) invoked at named points of the shuffle
+# orchestration ("post-scatter" — after summaries, before any combine).
+# The mid-shuffle-death tests kill a range owner here; None in production.
+_SHUFFLE_TEST_HOOK = None
+
+
 # ---------------------------------------------------------------------------
 # fragment result cache: aggregate partials are immutable once the snapshot
 # they scanned is pinned, so repeated aggregates over an unchanged table skip
-# the scatter entirely. Keyed per table path on (snapshot_id, signature);
-# any plan at a NEWER snapshot purges the table's older entries.
+# the scatter entirely. Keyed per table path on (snapshot_id, bucket-layout
+# epoch, signature); a plan at a NEWER snapshot or a DIFFERENT layout purges
+# the table's older entries. The layout key closes the live-rescale hole
+# (ISSUE 20 satellite): an 8→16 rescale rewrites every bucket's file set
+# under a schema bump — a coordinator still holding the pre-rescale table
+# object must never serve its stale split set's partials from cache.
 # ---------------------------------------------------------------------------
 _FRAG_CACHE_LOCK = threading.Lock()
-_FRAG_CACHE: dict[str, tuple[int, dict[str, list]]] = {}
+_FRAG_CACHE: dict[str, tuple[int, str, dict[str, list]]] = {}
 
 
 def clear_fragment_cache() -> None:
@@ -322,11 +549,23 @@ def clear_fragment_cache() -> None:
         _FRAG_CACHE.clear()
 
 
-def _fragment_signature(template: dict, by_wid: dict):
-    """(snapshot_id, sha1) identity of one aggregate scatter: the template's
-    semantic core plus every planned split (seq, partition, bucket, files).
-    Returns None when any split carries no snapshot pin — nothing stable to
-    key on — so unpinned plans always scatter."""
+def _table_layout(t) -> str:
+    """Bucket-layout (rescale) epoch of a table object: schema id + bucket
+    count. table.rescale commits the new count as a schema bump, so a
+    cached partial planned under the old layout keys differently even when
+    its data snapshot id coincides."""
+    try:
+        return f"{int(t.schema.id)}:{int(t.store.options.bucket)}"
+    except Exception:  # noqa: BLE001 — no stable layout: cache still snap-keyed
+        return "?"
+
+
+def _fragment_signature(template: dict, by_wid: dict, layout: str = "?"):
+    """(snapshot_id, layout, sha1) identity of one aggregate scatter: the
+    template's semantic core plus every planned split (seq, partition,
+    bucket, files) under the table's bucket-layout epoch. Returns None when
+    any split carries no snapshot pin — nothing stable to key on — so
+    unpinned plans always scatter."""
     snaps: set = set()
     ids: list = []
     for wid in sorted(by_wid):
@@ -352,32 +591,34 @@ def _fragment_signature(template: dict, by_wid: dict):
         k: template.get(k)
         for k in ("mode", "where", "projection", "group_cols", "kern", "engine", "code_domain")
     }
-    blob = json.dumps([core, ids], sort_keys=True, default=str)
-    return max(snaps), hashlib.sha1(blob.encode()).hexdigest()
+    blob = json.dumps([core, ids, layout], sort_keys=True, default=str)
+    return max(snaps), layout, hashlib.sha1(blob.encode()).hexdigest()
 
 
 def _frag_cache_get(path: str, key):
     if key is None:
         return None
-    snap, sig = key
+    snap, layout, sig = key
     with _FRAG_CACHE_LOCK:
         ent = _FRAG_CACHE.get(path)
-        if ent is not None and ent[0] == snap:
-            return ent[1].get(sig)
+        if ent is not None and ent[0] == snap and ent[1] == layout:
+            return ent[2].get(sig)
     return None
 
 
 def _frag_cache_put(path: str, key, raw: list) -> None:
     if key is None:
         return
-    snap, sig = key
+    snap, layout, sig = key
     with _FRAG_CACHE_LOCK:
         ent = _FRAG_CACHE.get(path)
-        if ent is None or ent[0] < snap:  # snapshot advanced: purge stale partials
-            ent = (snap, {})
+        if ent is None or ent[0] < snap or (ent[0] == snap and ent[1] != layout):
+            # snapshot advanced OR layout rescaled at the same snapshot:
+            # purge — partials planned under the old layout are unreachable
+            ent = (snap, layout, {})
             _FRAG_CACHE[path] = ent
-        if ent[0] == snap:
-            ent[1][sig] = raw
+        if ent[0] == snap and ent[1] == layout:
+            ent[2][sig] = raw
 
 
 def _explain_cluster(catalog: "Catalog", statement: str, client):
@@ -415,6 +656,23 @@ def _explain_cluster(catalog: "Catalog", statement: str, client):
         lines.append(
             f"fragment -> worker {wid}: {len(sps)} splits, {files} files (buckets {buckets})"
         )
+    # shuffle plan (ISSUE 20 satellite): the SAME decision code the executor
+    # runs, so what EXPLAIN prints is what cluster_query will do
+    if plan.group_cols and not plan.is_join:
+        by_wid_d = {
+            wid: [(i, sp.to_dict()) for i, sp in enumerate(sps)]
+            for wid, sps in by_wid.items()
+        }
+        on, est, why = _decide_shuffle(t, client, opts, plan.group_cols, by_wid_d)
+        if on:
+            ranges = _range_table(client, opts)
+            lines.append(
+                f"shuffle: on ({why}), estimated groups {est}, {len(ranges)} ranges"
+            )
+            for i, (w, _h, _p) in enumerate(ranges):
+                lines.append(f"  range {i} -> worker {w}")
+        else:
+            lines.append(f"shuffle: off ({why})")
     return plan_batch(lines)
 
 
@@ -458,6 +716,11 @@ def cluster_query(
     frag_cache = bool(opts.get(CoreOptions.SQL_CLUSTER_FRAGMENT_CACHE))
     engine = _engine_for(t)
     g = sql_metrics()
+    # coordinator-side serial combine work (ms) accumulated across the query:
+    # payload decode + second-stage combine (or shuffle range concat) — the
+    # stage the shuffle plane exists to shrink, surfaced as sql{combine_ms}.
+    # list.append is atomic, so the shuffle fetch threads share it safely.
+    ser_ms: list = []
     if p.where_text:  # surface parse errors before any RPC, like query()
         try:
             to_predicate(parse_expr(p.where_text), p.where_text)
@@ -491,7 +754,7 @@ def cluster_query(
                 raise _LocalFallback
         return kern, imap
 
-    def _gather_agg(projection, group_cols, kern):
+    def _gather_agg(projection, group_cols, kern, by_wid=None):
         template = {
             "mode": "agg",
             "where": p.where_text,
@@ -501,8 +764,9 @@ def cluster_query(
             "engine": engine,
             "code_domain": code_domain,
         }
-        by_wid = _plan_frags(projection, None)
-        key = _fragment_signature(template, by_wid) if frag_cache else None
+        if by_wid is None:
+            by_wid = _plan_frags(projection, None)
+        key = _fragment_signature(template, by_wid, _table_layout(t)) if frag_cache else None
         raw = _frag_cache_get(str(t.path), key)
         if raw is not None:
             g.counter("fragment_cache_hits").inc(1)
@@ -512,7 +776,9 @@ def cluster_query(
             g.histogram("scatter_ms").update((time.perf_counter() - t0) * 1000)
             _frag_cache_put(str(t.path), key, raw)
         schema = t.row_type.project(projection)
+        t1 = time.perf_counter()
         parts = [decode_partial(r, schema, group_cols) for r in raw]
+        ser_ms.append((time.perf_counter() - t1) * 1000)
         parts = [q for q in parts if q["rows"]]
         for q in parts:
             g.counter("rows_reduced_device").inc(q["rows_reduced_device"])
@@ -520,39 +786,186 @@ def cluster_query(
 
     def _combine(parts, group_cols, kern):
         """Second-stage reduce over the partial rows, keyed on the UNIFIED
-        code domain; returns (pools, codes, outs, anyv, first_pos) in the
+        code domain (combine_partials, shared with the shuffle range
+        owners); returns (pools, codes, outs, anyv, first_pos) in the
         _assemble_group_batch contract."""
-        from ..ops.aggregates import segment_reduce
-        from ..ops.dicts import remap_codes, unify_pools
-
-        pools_f, codes_f = [], []
-        for gi in range(len(group_cols)):
-            unified, remaps = unify_pools([q["pools"][gi] for q in parts])
-            mapped = [
-                remap_codes(
-                    _sentinel_remap(rm, len(q["pools"][gi]), len(unified)),
-                    q["group_codes"][gi],
-                )
-                for q, rm in zip(parts, remaps)
-            ]
-            pools_f.append(unified)
-            codes_f.append(np.concatenate(mapped).astype(np.uint32, copy=False))
-        rows = sum(len(q["first_pos"]) for q in parts)
-        lanes = np.column_stack(codes_f) if group_cols else np.zeros((rows, 1), np.uint32)
-        cols2 = [
-            (
-                np.concatenate([q["outs"][ki] for q in parts]),
-                np.concatenate([q["anyv"][ki] for q in parts]),
-            )
-            for ki in range(len(kern))
-        ]
-        fns2 = tuple(_KERNEL_COMBINE[fn] for fn, _ in kern)
-        pos = np.concatenate([q["first_pos"] for q in parts])
-        rep, outs, anyv, first_pos = segment_reduce(lanes, cols2, fns2, pos=pos, engine=engine)
+        t1 = time.perf_counter()
+        out = combine_partials(parts, len(group_cols), kern, engine)
+        ser_ms.append((time.perf_counter() - t1) * 1000)
         g.counter("partials_combined").inc(len(parts))
         if code_domain and group_cols:
-            g.counter("code_domain_groups").inc(rows)
-        return pools_f, [c[rep] for c in codes_f], outs, anyv, first_pos
+            g.counter("code_domain_groups").inc(sum(len(q["first_pos"]) for q in parts))
+        return out
+
+    def _shuffle_agg(projection, group_cols, kern, by_wid, schema):
+        """The ISSUE 20 tentpole orchestration. Scatter shuffle-mode
+        fragments (each worker partitions its partial by group-key value
+        and ships range i to range i's owner, answering a summary), build
+        the per-range expectation lists from the summaries' sent maps
+        (empty parts are never shipped, so only shipped parts are waited
+        on), fold every range at its owner in parallel, and concatenate the
+        R reduced ranges. A dead range owner re-homes to a live worker and
+        the sources reship their buffered parts; a dead source re-executes
+        its fragment under the SAME src id (content deterministic, delivery
+        keyed — overwrites are idempotent), all under retry_ms."""
+        qid = f"q{os.urandom(8).hex()}"
+        ranges = _range_table(client, opts)
+        t0 = time.perf_counter()
+        g.counter("shuffle_rounds").inc()
+        deadline = time.monotonic() + retry_ms / 1000.0
+        src_info: dict = {}
+        ctr = [0]
+
+        def decorate(frag, wid, items):
+            ctr[0] += 1
+            src = f"{qid}#{ctr[0]}"
+            src_info[src] = {"wid": wid, "splits": items}
+            return dict(frag, src=src, shuffle={"qid": qid, "ranges": [list(r) for r in ranges]})
+
+        template = {
+            "mode": "agg",
+            "where": p.where_text,
+            "projection": projection,
+            "group_cols": group_cols,
+            "kern": kern,
+            "engine": engine,
+            "code_domain": code_domain,
+        }
+        raw = _scatter(
+            client, by_wid, template, retry_ms, busy_wait_s, scan_frag_fn, decorate=decorate
+        )
+        summaries = [r for r in raw if r.get("mode") == "shuffle"]
+        expects: dict = {r: [] for r in range(len(ranges))}
+        for s in summaries:
+            g.counter("rows_reduced_device").inc(int(s.get("rows_reduced_device", 0)))
+            g.counter("parts_exchanged").inc(len(s.get("sent") or {}))
+            g.counter("exchange_bytes").inc(int(s.get("bytes", 0)))
+            for rs in s.get("sent") or {}:
+                expects[int(rs)].append(s["src"])
+        hook = _SHUFFLE_TEST_HOOK
+        if hook is not None:
+            hook("post-scatter", {"qid": qid, "ranges": ranges, "expects": expects})
+
+        def _reexec_src(src, call):
+            """Re-run one source fragment whole on ANY live worker (shared
+            FS serves any split anywhere) — all its splits in ONE fragment,
+            or two workers would overwrite each other under one src id."""
+            frag = encode_fragment(
+                dict(
+                    template,
+                    splits=src_info[src]["splits"],
+                    src=src,
+                    shuffle={"qid": qid, "ranges": [list(r) for r in ranges]},
+                )
+            )
+            while True:
+                for w in client.live_workers():
+                    try:
+                        rsp = call(w, frag, busy_wait_s)
+                    except (ConnectionError, OSError, TimeoutError):
+                        client.drop_conn(w)
+                        continue
+                    if rsp.get("mode") == "shuffle":
+                        g.counter("parts_exchanged").inc(len(rsp.get("sent") or {}))
+                        g.counter("exchange_bytes").inc(int(rsp.get("bytes", 0)))
+                    return
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"shuffle source {src} unrecoverable")
+                time.sleep(0.05)
+                try:
+                    client.refresh_route()
+                except (ConnectionError, OSError):
+                    continue
+
+        def _replace_owner(rng):
+            """Re-home a dead range onto a live worker under a refreshed
+            route; its expected parts reship/re-execute on the next probe."""
+            while True:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"shuffle range {rng} owner unrecoverable")
+                time.sleep(0.05)
+                try:
+                    client.refresh_route()
+                    live = client.live_workers()
+                    if not live:
+                        continue
+                    w = live[rng % len(live)]
+                    ranges[rng] = [w, *client.addr_of(w)]
+                    return
+                except (ConnectionError, OSError):
+                    continue
+
+        call = scan_frag_fn if scan_frag_fn is not None else client.scan_frag
+
+        def _fetch_range(rng):
+            """Fold range `rng` at its owner, healing owner death and
+            missing parts until the deadline. Returns the decoded partial."""
+            while True:
+                wid = int(ranges[rng][0])
+                try:
+                    partial, missing = client.exchange_combine(
+                        wid,
+                        qid,
+                        rng,
+                        expects[rng],
+                        group_cols,
+                        kern,
+                        engine,
+                        code_domain,
+                        projection,
+                        busy_wait_s=busy_wait_s,
+                    )
+                except (ConnectionError, OSError, TimeoutError):
+                    client.drop_conn(wid)
+                    if time.monotonic() >= deadline:
+                        raise
+                    g.counter("shuffle_retried").inc()
+                    _replace_owner(rng)
+                    continue
+                if partial is not None:
+                    td = time.perf_counter()
+                    dec = decode_partial(partial, schema, group_cols)
+                    ser_ms.append((time.perf_counter() - td) * 1000)
+                    return dec
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"shuffle range {rng}: parts missing {missing}")
+                # in-flight delivery loss or a fresh replacement owner:
+                # reship each missing part from its source's buffer, falling
+                # back to fragment re-execution when the buffer died too
+                g.counter("shuffle_retried").inc()
+                host, port = ranges[rng][1], int(ranges[rng][2])
+                for src in missing:
+                    info = src_info.get(src)
+                    shipped = info is not None and client.exchange_reship(
+                        info["wid"], qid, rng, src, host, port
+                    )
+                    if not shipped:
+                        _reexec_src(src, call)
+                time.sleep(0.02)
+
+        pending = [r for r in range(len(ranges)) if expects[r]]
+        parts = []
+        try:
+            if pending:
+                with ThreadPoolExecutor(max_workers=len(pending)) as ex:
+                    futs = [ex.submit(_fetch_range, r) for r in pending]
+                    parts = [f.result() for f in futs]
+            parts = [q for q in parts if q["rows"]]
+            if not parts:
+                return None
+            tc = time.perf_counter()
+            out = _concat_ranges(parts, len(group_cols))
+            ser_ms.append((time.perf_counter() - tc) * 1000)
+            g.counter("partials_combined").inc(len(parts))
+            if code_domain and group_cols:
+                g.counter("code_domain_groups").inc(sum(len(q["first_pos"]) for q in parts))
+            g.histogram("shuffle_ms").update((time.perf_counter() - t0) * 1000)
+            return out
+        finally:
+            involved = {int(r[0]) for r in ranges} | {
+                i["wid"] for i in src_info.values()
+            }
+            client.exchange_close(qid, sorted(involved))
 
     def group_reduce(items2, aggs2):
         from .select import _group_aggregate
@@ -564,17 +977,37 @@ def cluster_query(
         projection = list(
             dict.fromkeys(p.group_cols + [c for fn, c in kern if c != "*"])
         )
-        schema, parts = _gather_agg(projection, p.group_cols, kern)
+        by_wid = _plan_frags(projection, None)
+        shuffle_on, _est, _why = _decide_shuffle(t, client, opts, p.group_cols, by_wid)
+        if shuffle_on:
+            schema = t.row_type.project(projection)
+            combined = _shuffle_agg(projection, p.group_cols, kern, by_wid, schema)
+            if combined is None:
+                return _group_aggregate(
+                    ColumnBatch.empty(schema), items2, aggs2, p.group_cols, engine=engine
+                )
+            pools, codes, outs, anyv, first_pos = combined
+            t1 = time.perf_counter()
+            out = _assemble_group_batch(
+                t.row_type, items2, aggs2, imap, p.group_cols, pools, codes, outs, anyv, first_pos
+            )
+            g.histogram("combine_ms").update(
+                sum(ser_ms) + (time.perf_counter() - t1) * 1000
+            )
+            return out
+        schema, parts = _gather_agg(projection, p.group_cols, kern, by_wid)
         if not parts:
             return _group_aggregate(
                 ColumnBatch.empty(schema), items2, aggs2, p.group_cols, engine=engine
             )
-        t1 = time.perf_counter()
         pools, codes, outs, anyv, first_pos = _combine(parts, p.group_cols, kern)
+        t1 = time.perf_counter()
         out = _assemble_group_batch(
             t.row_type, items2, aggs2, imap, p.group_cols, pools, codes, outs, anyv, first_pos
         )
-        g.histogram("combine_ms").update((time.perf_counter() - t1) * 1000)
+        g.histogram("combine_ms").update(
+            sum(ser_ms) + (time.perf_counter() - t1) * 1000
+        )
         return out
 
     def scalar_reduce(items, aggs):
@@ -589,8 +1022,8 @@ def cluster_query(
         schema, parts = _gather_agg(projection, [], kern)
         if not parts:
             return _aggregate(ColumnBatch.empty(schema), items, aggs)
-        t1 = time.perf_counter()
         _, _, outs, anyv, _ = _combine(parts, [], kern)
+        t1 = time.perf_counter()
         # reproduce sql.select._aggregate's scalar semantics exactly: one
         # row always; an aggregate with no valid input is NULL typed DOUBLE
         names, types, values = [], [], []
@@ -615,7 +1048,9 @@ def cluster_query(
             tuple(DataField(i, nm, ty) for i, (nm, ty) in enumerate(zip(names, types)))
         )
         out = ColumnBatch.from_pydict(rt, {nm: [v] for nm, v in zip(names, values)})
-        g.histogram("combine_ms").update((time.perf_counter() - t1) * 1000)
+        g.histogram("combine_ms").update(
+            sum(ser_ms) + (time.perf_counter() - t1) * 1000
+        )
         return out
 
     if p.group_cols or p.is_agg:

@@ -32,7 +32,7 @@ if TYPE_CHECKING:
     from . import FileStoreTable
     from .write import TableWrite
 
-__all__ = ["LocalTableQuery", "execute_scan_fragment"]
+__all__ = ["LocalTableQuery", "execute_scan_fragment", "partition_agg_partial"]
 
 
 def execute_scan_fragment(table: "FileStoreTable", frag: dict) -> dict:
@@ -134,6 +134,83 @@ def execute_scan_fragment(table: "FileStoreTable", frag: dict) -> dict:
         "rows": n,
         "rows_reduced_device": counter.count - before,
     }
+
+
+def _prune_with_sentinel(pool, codes):
+    """prune_pool for shuffle parts: codes may carry the NULL sentinel
+    ``len(pool)``, which the generic prune would gather out of bounds.
+    Returns (pruned pool, codes) with the sentinel re-seated at the pruned
+    pool's length — the exact shape encode_partial/combine expect."""
+    import numpy as np
+
+    from ..ops.dicts import prune_pool
+
+    n = len(pool)
+    if n == 0:  # all rows NULL: sentinel is 0 before and after
+        return pool, codes.astype(np.uint32, copy=False)
+    valid = codes < n
+    if bool(valid.all()):
+        return prune_pool(pool, codes)
+    used = np.zeros(n, dtype=np.bool_)
+    used[codes[valid]] = True
+    if bool(used.all()):
+        p2, remap = pool, None
+    else:
+        remap = np.cumsum(used, dtype=np.int64) - 1
+        p2 = pool[used]
+    out = np.full(len(codes), len(p2), dtype=np.uint32)  # sentinel slots
+    live = codes[valid].astype(np.int64, copy=False)
+    out[valid] = (live if remap is None else remap[live]).astype(np.uint32)
+    return p2, out
+
+
+def partition_agg_partial(part: dict, num_parts: int) -> list:
+    """Split one mode-"agg" fragment partial into `num_parts` shuffle parts
+    by hashing group-key VALUES (ops.dicts.partition_rows), so every worker
+    agrees on each key's range despite disjoint per-worker code spaces.
+    Returns a list of length num_parts; entry i is a partial dict holding
+    exactly the groups whose hash lands in range i (pools pruned to the
+    part's referenced values — wire bytes scale ~1/R), or None when the
+    fragment has no groups in that range (nothing is shipped for it).
+    Disjointness by value means a range owner's combine is the final
+    reduction for its groups; min-reducing first_pos inside each range
+    preserves global first-appearance order."""
+    import numpy as np
+
+    from ..ops.dicts import partition_rows
+
+    pools = part["pools"]
+    codes_list = part["group_codes"]
+    n = int(len(part["first_pos"]))
+    if num_parts <= 1 or not pools:
+        # no key columns (scalar agg) or degenerate R: everything is range 0
+        return [part if n else None] + [None] * max(0, num_parts - 1)
+    pids = partition_rows(pools, codes_list, num_parts)
+    out = []
+    for r in range(num_parts):
+        mask = pids == np.uint32(r)
+        cnt = int(mask.sum())
+        if cnt == 0:
+            out.append(None)
+            continue
+        sub_pools, sub_codes = [], []
+        for p, c in zip(pools, codes_list):
+            p2, c2 = _prune_with_sentinel(p, c[mask])
+            sub_pools.append(p2)
+            sub_codes.append(c2)
+        out.append(
+            {
+                "mode": "agg",
+                "pools": sub_pools,
+                "group_codes": sub_codes,
+                "outs": [o[mask] for o in part["outs"]],
+                "anyv": [a[mask] for a in part["anyv"]],
+                "first_pos": part["first_pos"][mask],
+                "rows": cnt,
+                "rows_reduced_device": 0,
+            }
+        )
+    return out
 
 
 class LocalTableQuery:

@@ -183,6 +183,19 @@
 #                              then 0 (expanded values on the wire) — so
 #                              both combine currencies prove bit-identical
 #                              distributed results.
+#   scripts/verify.sh sql-shuffle  distributed shuffle-aggregation parity
+#                              stage: the tests/test_sql_shuffle.py suite
+#                              (value-hash partitioner twins, shuffle
+#                              parity at 2/4 workers, range-owner death
+#                              mid-query, duplicate-dispatch idempotence,
+#                              frag-cache layout-epoch keying incl. a live
+#                              8->16 rescale) plus tests/test_sql_cluster.py
+#                              run TWICE — PAIMON_TPU_SQL_SHUFFLE forced 1
+#                              (every GROUP BY combines via worker↔worker
+#                              exchange), then 0 (single-point coordinator
+#                              combine) — so both aggregation topologies
+#                              prove bit-identical to the single-process
+#                              evaluator.
 #
 # Exits non-zero on test failure/timeout; tier-1 prints DOTS_PASSED=<n>
 # (count of passing tests) for trend comparison.
@@ -351,6 +364,18 @@ if [ "${1:-}" = "sql-cluster" ]; then
   for cd in 1 0; do
     env JAX_PLATFORMS=cpu PAIMON_TPU_SQL_CODE_DOMAIN=$cd \
       timeout -k 10 600 python -m pytest tests/test_sql_cluster.py tests/test_sql_select.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+  done
+  exit 0
+fi
+
+if [ "${1:-}" = "sql-shuffle" ]; then
+  # shuffle exchange forced on, then off: every grouped query must be
+  # bit-identical to the single-process evaluator whether partials combine
+  # peer-to-peer at range owners or single-point at the coordinator
+  for sh in 1 0; do
+    env JAX_PLATFORMS=cpu PAIMON_TPU_SQL_SHUFFLE=$sh \
+      timeout -k 10 600 python -m pytest tests/test_sql_shuffle.py tests/test_sql_cluster.py -q \
       -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
   done
   exit 0

@@ -1,0 +1,539 @@
+"""Distributed shuffle aggregation (ISSUE 20): when a GROUP BY's estimated
+distinct-group count crosses sql.cluster.shuffle.threshold, workers
+hash-partition their fragment partials by group-key VALUE and ship range i
+to range i's owner (exchange_part), each owner reduces its range, and the
+coordinator only concatenates — bit-identical to the single-process
+evaluator at every worker count, under forced-on/off/auto decisions,
+duplicate (hedged) dispatch, and mid-shuffle worker death.
+
+The value-hash partitioner is the load-bearing piece: per-worker dictionary
+code spaces are disjoint, so partitions must agree on VALUES (canonicalized
+floats, NULL sentinel included) across any pool ordering and across the
+numpy/jax twins."""
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paimon_tpu.sql.cluster as sqlc
+from paimon_tpu.catalog import FileSystemCatalog
+from paimon_tpu.metrics import sql_metrics
+from paimon_tpu.ops.dicts import (
+    _NULL_HASH,
+    pool_value_hashes,
+    partition_rows,
+    partition_rows_jax,
+    partition_rows_np,
+)
+from paimon_tpu.service.cluster import (
+    ClusterClient,
+    ClusterConfig,
+    ClusterCoordinator,
+    ClusterWorkerAgent,
+)
+from paimon_tpu.sql import cluster_query, query
+from paimon_tpu.sql.cluster import (
+    _frag_cache_get,
+    _frag_cache_put,
+    clear_fragment_cache,
+)
+from paimon_tpu.table import load_table
+from paimon_tpu.table.query import partition_agg_partial
+from paimon_tpu.types import BIGINT, DOUBLE, STRING, RowType
+
+N = 1_500
+BUCKETS = 4
+
+
+# ---------------------------------------------------------------------------
+# value-hash partitioner units
+# ---------------------------------------------------------------------------
+
+
+def test_pool_value_hashes_shape_and_null_slot():
+    h = pool_value_hashes(np.array(["a", "b", "c"], dtype=object))
+    assert h.dtype == np.uint32 and len(h) == 4
+    assert h[3] == np.uint32(_NULL_HASH)  # sentinel slot rides at len(pool)
+    assert len(set(h.tolist())) == 4  # distinct values, distinct hashes
+
+
+def test_pool_value_hashes_value_identity_across_orderings():
+    """Same VALUE -> same hash regardless of where it sits in the pool:
+    the property that lets disjoint per-worker code spaces agree."""
+    a = pool_value_hashes(np.array(["x", "y", "z"], dtype=object))
+    b = pool_value_hashes(np.array(["z", "x", "y"], dtype=object))
+    assert a[0] == b[1] and a[1] == b[2] and a[2] == b[0]
+    ia = pool_value_hashes(np.array([7, 11, 13], dtype=np.int64))
+    ib = pool_value_hashes(np.array([13, 7, 11], dtype=np.int64))
+    assert ia[0] == ib[1] and ia[1] == ib[2] and ia[2] == ib[0]
+
+
+def test_pool_value_hashes_float_canonicalization():
+    """-0.0 folds onto +0.0 and every NaN payload collapses to the quiet
+    NaN bit pattern — equal SQL values must land in the same range."""
+    h = pool_value_hashes(np.array([0.0, -0.0, np.nan, np.float64("nan")]))
+    assert h[0] == h[1] and h[2] == h[3]
+    assert h[0] != h[2]
+
+
+def test_partition_rows_cross_code_space_agreement():
+    """Two workers hold the same values under different pools/codes; their
+    per-row partition ids must match row for row."""
+    vals = ["g0", "g1", "g2", "g1", None, "g0", None, "g2"]
+    pool_a = np.array(["g0", "g1", "g2"], dtype=object)
+    pool_b = np.array(["g2", "g0", "g1"], dtype=object)  # different code space
+    code_a = {"g0": 0, "g1": 1, "g2": 2, None: 3}
+    code_b = {"g2": 0, "g0": 1, "g1": 2, None: 3}
+    ca = np.array([code_a[v] for v in vals], dtype=np.uint32)
+    cb = np.array([code_b[v] for v in vals], dtype=np.uint32)
+    for r in (2, 3, 7):
+        pa = partition_rows([pool_a], [ca], r)
+        pb = partition_rows([pool_b], [cb], r)
+        assert pa.dtype == np.uint32
+        assert pa.tolist() == pb.tolist()
+        assert (pa < r).all()
+    # NULL rows agree with each other (single sentinel hash)
+    p = partition_rows([pool_a], [ca], 5)
+    assert p[4] == p[6]
+
+
+def test_partition_rows_multi_key_and_jax_twin(monkeypatch):
+    pools = [
+        np.array(["a", "b"], dtype=object),
+        np.array([1, 2, 3], dtype=np.int64),
+    ]
+    rng = np.random.default_rng(5)
+    codes = [
+        rng.integers(0, 3, size=64).astype(np.uint32),  # incl. NULL sentinel 2
+        rng.integers(0, 4, size=64).astype(np.uint32),  # incl. NULL sentinel 3
+    ]
+    want = partition_rows_np(
+        [pool_value_hashes(p) for p in pools], codes, 4
+    )
+    jax_got = partition_rows_jax(
+        [pool_value_hashes(p) for p in pools], codes, 4
+    )
+    assert want.tolist() == np.asarray(jax_got).tolist()
+    monkeypatch.setenv("PAIMON_TPU_DICT_ENGINE", "jax")
+    routed = partition_rows(pools, codes, 4)
+    assert want.tolist() == np.asarray(routed).tolist()
+
+
+def test_partition_rows_degenerate():
+    assert partition_rows([], [], 4).tolist() == []
+    p = np.array(["a"], dtype=object)
+    c = np.zeros(5, np.uint32)
+    assert partition_rows([p], [c], 1).tolist() == [0] * 5
+
+
+# ---------------------------------------------------------------------------
+# partition_agg_partial units
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_part(n=20, pool_size=6, seed=3):
+    rng = np.random.default_rng(seed)
+    pool = np.array([f"k{i}" for i in range(pool_size)], dtype=object)
+    codes = rng.integers(0, pool_size + 1, size=n).astype(np.uint32)  # incl. NULL
+    return {
+        "mode": "agg",
+        "pools": [pool],
+        "group_codes": [codes],
+        "outs": [np.arange(n, dtype=np.float64), rng.integers(0, 9, n).astype(np.float64)],
+        "anyv": [np.ones(n, bool)],
+        "first_pos": np.arange(n, dtype=np.int64) * 10,
+        "rows": n,
+        "rows_reduced_device": 0,
+    }
+
+
+def test_partition_agg_partial_conserves_rows_and_sentinel():
+    part = _synthetic_part()
+    pool = part["pools"][0]
+    out = partition_agg_partial(dict(part), 3)
+    assert len(out) == 3
+    total = 0
+    orig = {
+        (None if c == len(pool) else pool[c], fp)
+        for c, fp in zip(part["group_codes"][0].tolist(), part["first_pos"].tolist())
+    }
+    got = set()
+    for sub in out:
+        if sub is None:
+            continue
+        total += sub["rows"]
+        p2, c2 = sub["pools"][0], sub["group_codes"][0]
+        assert (c2 <= len(p2)).all()  # codes valid in the PRUNED pool
+        assert len(sub["first_pos"]) == sub["rows"]
+        assert all(len(o) == sub["rows"] for o in sub["outs"])
+        for c, fp in zip(c2.tolist(), sub["first_pos"].tolist()):
+            got.add((None if c == len(p2) else p2[c], fp))
+    assert total == part["rows"]
+    assert got == orig  # every (value, position) pair survives, none invented
+
+
+def test_partition_agg_partial_value_ranges_are_disjoint():
+    """A value's rows all land in ONE range — the property that makes each
+    range owner's reduce final (coordinator concat needs no second pass)."""
+    part = _synthetic_part(n=60, pool_size=8, seed=11)
+    pool = part["pools"][0]
+    out = partition_agg_partial(dict(part), 4)
+    home: dict = {}
+    for r, sub in enumerate(out):
+        if sub is None:
+            continue
+        p2 = sub["pools"][0]
+        for c in sub["group_codes"][0].tolist():
+            v = None if c == len(p2) else p2[c]
+            assert home.setdefault(v, r) == r, f"value {v!r} split across ranges"
+    assert len(home) > 1
+
+
+def test_partition_agg_partial_degenerate_shapes():
+    part = _synthetic_part()
+    # R=1: pass-through, no partition work
+    out = partition_agg_partial(part, 1)
+    assert out[0] is part and len(out) == 1
+    # scalar aggregate (no key pools): everything is range 0
+    scalar = dict(part, pools=[], group_codes=[])
+    out = partition_agg_partial(scalar, 3)
+    assert out[0] is scalar and out[1] is None and out[2] is None
+    # empty partial: nothing shipped anywhere
+    empty = dict(part, first_pos=np.zeros(0, np.int64), rows=0)
+    empty["outs"] = [np.zeros(0)] * 2
+    empty["anyv"] = [np.zeros(0, bool)]
+    empty["group_codes"] = [np.zeros(0, np.uint32)]
+    assert partition_agg_partial(empty, 2) == [None, None]
+
+
+# ---------------------------------------------------------------------------
+# cluster rig
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    """4-bucket PK fact table, two overlapping commits (queries see MERGED
+    rows), nullable int + exactly-representable doubles + string group key."""
+    wh = str(tmp_path_factory.mktemp("sqlshuffle"))
+    cat = FileSystemCatalog(wh, commit_user="rig")
+    t = cat.create_table(
+        "db.r",
+        RowType.of(("k", BIGINT(False)), ("a", BIGINT()), ("b", DOUBLE()), ("g", STRING())),
+        primary_keys=["k"],
+        options={"bucket": str(BUCKETS), "write-only": "true"},
+    )
+    rng = np.random.default_rng(17)
+    for r in range(2):
+        ks = rng.choice(2 * N, size=N, replace=False)
+        wb = t.new_batch_write_builder()
+        w = wb.new_write()
+        w.write({
+            "k": ks.tolist(),
+            "a": [None if x % 13 == 0 else int(x * (r + 1) % 400) for x in ks.tolist()],
+            "b": (ks * 0.25 + r).tolist(),
+            "g": [f"g{int(x) % 23}" for x in ks.tolist()],
+        })
+        wb.new_commit().commit(w.prepare_commit())
+    return cat, t.path
+
+
+@contextlib.contextmanager
+def _cluster(root, workers, heartbeat_timeout_s=4.0, buckets=BUCKETS):
+    coord = ClusterCoordinator(
+        root,
+        ClusterConfig(
+            workers=workers, buckets=buckets, compaction=False,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+        ),
+    ).start()
+    agents, cli = [], None
+    try:
+        for wid in range(workers):
+            a = ClusterWorkerAgent(
+                wid, load_table(root, commit_user=f"shw{wid}"), coord.host, coord.port,
+                serve=True, heartbeat_interval_s=0.1,
+            )
+            a.register()
+            a.start_heartbeats()
+            agents.append(a)
+        cli = ClusterClient(load_table(root, commit_user="shcli"), coord.host, coord.port)
+        yield cli, agents, coord
+    finally:
+        if cli is not None:
+            cli.close()
+        for a in agents:
+            a.close()
+        coord.close()
+
+
+GROUP_QUERIES = [
+    "SELECT g, count(*), count(a), sum(a), min(b), max(b), avg(a) FROM db.r GROUP BY g ORDER BY g",
+    # nullable int key: the NULL sentinel rides the exchange wire
+    "SELECT a, count(*), sum(b) FROM db.r GROUP BY a ORDER BY a LIMIT 40",
+    "SELECT a, g, sum(b), min(b) FROM db.r GROUP BY a, g ORDER BY a, g LIMIT 60",
+    "SELECT g, sum(b) FROM db.r GROUP BY g HAVING count(*) > 5 ORDER BY sum(b) DESC",
+    "SELECT DISTINCT g FROM db.r ORDER BY g",
+    # first-appearance order without ORDER BY must survive the shuffle
+    "SELECT g, count(*) FROM db.r GROUP BY g",
+    "SELECT g, sum(a) FROM db.r WHERE k < 900 GROUP BY g ORDER BY g",
+    # empty scan through the shuffle path
+    "SELECT g, sum(a) FROM db.r WHERE k > 999999 GROUP BY g",
+]
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+def test_shuffle_parity_forced_on(rig, workers, monkeypatch):
+    cat, root = rig
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "1")
+    with _cluster(root, workers) as (cli, _agents, _coord):
+        rounds0 = sql_metrics().counter("shuffle_rounds").count
+        parts0 = sql_metrics().counter("parts_exchanged").count
+        for q in GROUP_QUERIES:
+            want = query(cat, q)
+            got = cluster_query(cat, q, cli)
+            assert want.schema.field_names == got.schema.field_names, q
+            assert want.to_pylist() == got.to_pylist(), q
+        assert sql_metrics().counter("shuffle_rounds").count > rounds0
+        assert sql_metrics().counter("parts_exchanged").count > parts0
+        assert sql_metrics().counter("exchange_bytes").count > 0
+
+
+def test_shuffle_forced_off_and_scalar_unaffected(rig, monkeypatch):
+    cat, root = rig
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "0")
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        rounds0 = sql_metrics().counter("shuffle_rounds").count
+        for q in GROUP_QUERIES + ["SELECT count(*), sum(a), avg(b) FROM db.r"]:
+            assert query(cat, q).to_pylist() == cluster_query(cat, q, cli).to_pylist(), q
+        assert sql_metrics().counter("shuffle_rounds").count == rounds0
+
+
+def test_shuffle_single_worker_degrades_to_classic(rig, monkeypatch):
+    """Forcing shuffle on with one live worker is a no-op: there is nobody
+    to exchange with, so the planner keeps the coordinator-combine path."""
+    cat, root = rig
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "1")
+    q = "SELECT g, count(*), sum(b) FROM db.r GROUP BY g ORDER BY g"
+    with _cluster(root, 1) as (cli, _agents, _coord):
+        rounds0 = sql_metrics().counter("shuffle_rounds").count
+        assert query(cat, q).to_pylist() == cluster_query(cat, q, cli).to_pylist()
+        assert sql_metrics().counter("shuffle_rounds").count == rounds0
+
+
+def test_shuffle_threshold_auto_decision(rig, tmp_path, monkeypatch):
+    """With the env unset the planner decides from the stats-based group
+    estimate vs sql.cluster.shuffle.threshold — and EXPLAIN shows the same
+    decision the executor makes."""
+    monkeypatch.delenv("PAIMON_TPU_SQL_SHUFFLE", raising=False)
+    cat, root = rig
+    q = "SELECT g, count(*), sum(b) FROM db.r GROUP BY g ORDER BY g"
+    # default threshold (50k) far above this table's estimate: off
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        lines = [r[0] for r in cluster_query(cat, "EXPLAIN " + q, cli).to_pylist()]
+        (sh,) = [l for l in lines if l.startswith("shuffle:")]
+        assert sh.startswith("shuffle: off (estimated groups ")
+        assert "< threshold 50000" in sh
+        rounds0 = sql_metrics().counter("shuffle_rounds").count
+        assert query(cat, q).to_pylist() == cluster_query(cat, q, cli).to_pylist()
+        assert sql_metrics().counter("shuffle_rounds").count == rounds0
+    # threshold 1 on a dedicated table: estimate crosses it, shuffle on
+    lo = FileSystemCatalog(str(tmp_path / "lowh"), commit_user="lo")
+    t = lo.create_table(
+        "db.s",
+        RowType.of(("k", BIGINT(False)), ("v", DOUBLE()), ("g", STRING())),
+        primary_keys=["k"],
+        options={
+            "bucket": str(BUCKETS),
+            "write-only": "true",
+            "sql.cluster.shuffle.threshold": "1",
+        },
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    ks = np.arange(800, dtype=np.int64)
+    w.write({
+        "k": ks.tolist(),
+        "v": (ks * 0.5).tolist(),
+        "g": [f"c{int(x) % 9}" for x in ks.tolist()],
+    })
+    wb.new_commit().commit(w.prepare_commit())
+    q2 = "SELECT g, count(*), sum(v) FROM db.s GROUP BY g ORDER BY g"
+    with _cluster(t.path, 2) as (cli, _agents, _coord):
+        lines = [r[0] for r in cluster_query(lo, "EXPLAIN " + q2, cli).to_pylist()]
+        (sh,) = [l for l in lines if l.startswith("shuffle: ")]
+        assert sh.startswith("shuffle: on (estimated groups ")
+        assert ">= threshold 1" in sh
+        rounds0 = sql_metrics().counter("shuffle_rounds").count
+        assert query(lo, q2).to_pylist() == cluster_query(lo, q2, cli).to_pylist()
+        assert sql_metrics().counter("shuffle_rounds").count == rounds0 + 1
+
+
+def test_explain_shuffle_plan_shape(rig, monkeypatch):
+    """Satellite: EXPLAIN pins the shuffle block's shape — decision line
+    with reason + estimate + range count, then one `range i -> worker w`
+    line per range, sitting after the fragment lines."""
+    cat, root = rig
+    q = "EXPLAIN SELECT g, count(*) FROM db.r GROUP BY g ORDER BY g"
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "1")
+        lines = [r[0] for r in cluster_query(cat, q, cli).to_pylist()]
+        (i,) = [n for n, l in enumerate(lines) if l.startswith("shuffle: ")]
+        assert lines[i] == (
+            "shuffle: on (forced on (PAIMON_TPU_SQL_SHUFFLE)), "
+            "estimated groups 3000, 2 ranges"
+        )
+        assert any(l.startswith("fragment -> worker ") for l in lines[:i])
+        ranges = [l for l in lines[i + 1:] if l.startswith("  range ")]
+        assert len(ranges) == 2
+        for n, l in enumerate(ranges):
+            assert l.startswith(f"  range {n} -> worker ")
+        monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "0")
+        lines = [r[0] for r in cluster_query(cat, q, cli).to_pylist()]
+        assert "shuffle: off (forced off (PAIMON_TPU_SQL_SHUFFLE))" in lines
+        # non-grouped EXPLAIN carries no shuffle block at all
+        lines = [
+            r[0]
+            for r in cluster_query(cat, "EXPLAIN SELECT k FROM db.r LIMIT 3", cli).to_pylist()
+        ]
+        assert not any(l.startswith("shuffle") for l in lines)
+
+
+def test_shuffle_range_sizing_option(rig, monkeypatch):
+    """sql.cluster.shuffle.ranges pins R (0, the default, means one range
+    per live worker) — parity holds with fewer and more ranges than
+    workers, ranges assigned round-robin."""
+    cat, root = rig
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "1")
+    q = "SELECT g, count(*), sum(b) FROM db.r GROUP BY g ORDER BY g"
+    want = query(cat, q).to_pylist()
+    real_get = cat.get_table
+    with _cluster(root, 2) as (cli, _agents, _coord):
+        for r in (1, 3, 5):
+            tt = real_get("db.r").copy({"sql.cluster.shuffle.ranges": str(r)})
+            monkeypatch.setattr(cat, "get_table", lambda name, _t=tt: _t)
+            rounds0 = sql_metrics().counter("shuffle_rounds").count
+            assert cluster_query(cat, q, cli).to_pylist() == want, f"R={r}"
+            # R=1 still shuffles (single range owner does the whole reduce)
+            assert sql_metrics().counter("shuffle_rounds").count == rounds0 + 1
+            ex = [
+                row[0]
+                for row in cluster_query(cat, "EXPLAIN " + q, cli).to_pylist()
+                if row[0].startswith("  range ")
+            ]
+            assert len(ex) == r, f"R={r}"
+
+
+def test_shuffle_duplicate_dispatch_idempotent(rig, monkeypatch):
+    """A hedge-style duplicate scan_frag re-partitions and re-delivers the
+    same parts under the same (qid, range, src) keys: buffered overwrites
+    are bit-identical, the result exact."""
+    cat, root = rig
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "1")
+    q = "SELECT g, count(*), sum(a), min(b) FROM db.r GROUP BY g ORDER BY g"
+    with _cluster(root, 2) as (cli, _agents, _coord):
+
+        def doubled(wid, frag, busy_wait_s=10.0):
+            cli.scan_frag(wid, frag, busy_wait_s=busy_wait_s)  # the hedge
+            return cli.scan_frag(wid, frag, busy_wait_s=busy_wait_s)
+
+        got = cluster_query(cat, q, cli, scan_frag_fn=doubled)
+        assert got.to_pylist() == query(cat, q).to_pylist()
+
+
+def test_shuffle_range_owner_death_mid_query(rig, monkeypatch):
+    """SIGKILL-grade loss of a range owner AFTER the scatter delivered its
+    parts: the coordinator re-homes the range to a survivor, sources reship
+    their buffered parts (the dead worker's own parts re-execute under the
+    same src id), and the result stays exact — retries counted."""
+    cat, root = rig
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "1")
+    q = "SELECT g, count(*), count(a), sum(a), min(b), max(b) FROM db.r GROUP BY g ORDER BY g"
+    want = query(cat, q).to_pylist()
+    with _cluster(root, 3, heartbeat_timeout_s=1.0) as (cli, agents, _coord):
+        fired = []
+
+        def hook(stage, info):
+            if stage == "post-scatter" and not fired:
+                fired.append(info["ranges"][0][0])
+                agents[fired[0]].close()  # range 0's owner dies mid-shuffle
+
+        monkeypatch.setattr(sqlc, "_SHUFFLE_TEST_HOOK", hook)
+        before = sql_metrics().counter("shuffle_retried").count
+        got = cluster_query(cat, q, cli)
+        assert fired, "test hook never fired — shuffle path not taken"
+        assert got.to_pylist() == want
+        assert sql_metrics().counter("shuffle_retried").count > before
+
+
+# ---------------------------------------------------------------------------
+# fragment-cache bucket-layout epoch (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_frag_cache_keyed_on_layout_epoch():
+    clear_fragment_cache()
+    path = "/tmp/layout-epoch-test"
+    key8 = (5, "1:8", "sig-a")
+    _frag_cache_put(path, key8, [{"rows": 1}])
+    assert _frag_cache_get(path, key8) == [{"rows": 1}]
+    # same snapshot, rescaled layout: must NOT serve the stale split set
+    assert _frag_cache_get(path, (5, "2:16", "sig-a")) is None
+    # a put under the new layout at the same snapshot purges the old epoch
+    _frag_cache_put(path, (5, "2:16", "sig-b"), [{"rows": 2}])
+    assert _frag_cache_get(path, key8) is None
+    assert _frag_cache_get(path, (5, "2:16", "sig-b")) == [{"rows": 2}]
+    # newer snapshot still purges as before
+    _frag_cache_put(path, (6, "2:16", "sig-c"), [{"rows": 3}])
+    assert _frag_cache_get(path, (5, "2:16", "sig-b")) is None
+    clear_fragment_cache()
+
+
+def test_frag_cache_live_rescale_8_to_16(tmp_path, monkeypatch):
+    """Regression (satellite 1): populate the fragment cache on an 8-bucket
+    table, live-rescale to 16 under a running cluster, and prove the next
+    aggregate cannot be served from the pre-rescale split set — fresh
+    scatter, exact result."""
+    from paimon_tpu.table.rescale import rescale_table
+
+    monkeypatch.setenv("PAIMON_TPU_SQL_SHUFFLE", "0")
+    clear_fragment_cache()
+    cat = FileSystemCatalog(str(tmp_path / "rswh"), commit_user="rs")
+    t = cat.create_table(
+        "db.f",
+        RowType.of(("k", BIGINT(False)), ("v", DOUBLE()), ("g", STRING())),
+        primary_keys=["k"],
+        options={
+            "bucket": "8",
+            "write-only": "true",
+            "sql.cluster.fragment-cache": "true",
+        },
+    )
+    wb = t.new_batch_write_builder()
+    w = wb.new_write()
+    ks = np.arange(1000, dtype=np.int64)
+    w.write({
+        "k": ks.tolist(),
+        "v": (ks * 0.25).tolist(),
+        "g": [f"z{int(x) % 11}" for x in ks.tolist()],
+    })
+    wb.new_commit().commit(w.prepare_commit())
+    q = "SELECT g, count(*), sum(v) FROM db.f GROUP BY g ORDER BY g"
+    with _cluster(t.path, 2, buckets=8) as (cli, _agents, _coord):
+        want = query(cat, q).to_pylist()
+        assert cluster_query(cat, q, cli).to_pylist() == want
+        hits0 = sql_metrics().counter("fragment_cache_hits").count
+        assert cluster_query(cat, q, cli).to_pylist() == want
+        assert sql_metrics().counter("fragment_cache_hits").count == hits0 + 1
+        # live rescale while the cluster keeps serving
+        rescale_table(cat.get_table("db.f"), 16)
+        hits1 = sql_metrics().counter("fragment_cache_hits").count
+        want2 = query(cat, q).to_pylist()
+        assert want2 == want  # rescale moves rows, it does not change them
+        assert cluster_query(cat, q, cli).to_pylist() == want2
+        # the post-rescale plan must have re-scattered, not hit the cache
+        assert sql_metrics().counter("fragment_cache_hits").count == hits1
+    clear_fragment_cache()

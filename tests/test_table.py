@@ -278,6 +278,68 @@ def test_stream_plan_aligned(catalog):
     assert [r[0] for r in read.read_all(splits).to_pylist()] == [2]
 
 
+def test_stream_plan_aligned_concurrent_writer_exact_boundary(catalog):
+    """plan_aligned vs a concurrently committing writer (ISSUE 20
+    satellite, round-5 verdict Weak #7): the poll loop races commits
+    landing at arbitrary points between plan() calls, yet every aligned
+    plan must sit EXACTLY on one snapshot boundary — each delta holds one
+    commit's batch, whole, never rows of a half-landed or merged-in-later
+    commit — and replaying the plans in order reconstructs the commit
+    sequence with nothing lost, duplicated, or reordered."""
+    import threading
+
+    # write-only: inline compaction would interleave COMPACT snapshots whose
+    # delta plans are empty — the test pins COMMIT boundaries, not compaction
+    t = create(catalog, "db.aligned_race", options={"bucket": "1", "write-only": "true"})
+    write_batch(t, {"id": [0], "region": ["seed"], "amount": [0.0]})
+    scan = t.new_read_builder().new_stream_scan()
+    first = scan.plan()  # starting plan: the seed commit
+    read = t.new_read_builder().new_read()
+    assert [r[0] for r in read.read_all(first).to_pylist()] == [0]
+
+    commits = 12
+    batches = {i: list(range(i * 100, i * 100 + 7)) for i in range(1, commits + 1)}
+    done = threading.Event()
+
+    def writer():
+        # no artificial pacing: commits land as fast as they can, so plans
+        # race the writer at every interleaving the loop can produce
+        for i in range(1, commits + 1):
+            ids = batches[i]
+            write_batch(
+                t,
+                {
+                    "id": ids,
+                    "region": [f"c{i}"] * len(ids),
+                    "amount": [float(x) for x in ids],
+                },
+            )
+        done.set()
+
+    th = threading.Thread(target=writer)
+    th.start()
+    seen: list[list[int]] = []
+    try:
+        while len(seen) < commits:
+            splits = scan.plan_aligned(timeout_seconds=30.0, poll_seconds=0.01)
+            assert splits is not None, f"aligned plan timed out after {len(seen)} deltas"
+            rows = read.read_all(splits).to_pylist()
+            # one aligned plan == one commit, exactly: every row carries the
+            # same commit tag and the id set is that commit's whole batch
+            tags = {r[1] for r in rows}
+            assert len(tags) == 1, f"aligned plan mixed commits: {sorted(tags)}"
+            i = int(next(iter(tags))[1:])
+            assert sorted(r[0] for r in rows) == batches[i]
+            seen.append(sorted(r[0] for r in rows))
+    finally:
+        th.join()
+    assert done.is_set()
+    # in-order, gapless, duplicate-free reconstruction of the commit stream
+    assert seen == [batches[i] for i in range(1, commits + 1)]
+    # quiescent tail: nothing further to align on
+    assert scan.plan_aligned(timeout_seconds=0.2, poll_seconds=0.05) is None
+
+
 def test_batch_split_packing(catalog):
     """Section-aware weighted bin-packing (reference MergeTreeSplitGenerator
     splitForBatch): key-disjoint sections spread over multiple splits under a
